@@ -1,0 +1,16 @@
+"""autodist_tpu — a TPU-native distributed training framework.
+
+A ground-up JAX/XLA re-design with the capabilities of AutoDist (Petuum):
+distribution expressed as a compilation problem.  A declarative cluster
+description (:class:`ResourceSpec`), a per-variable :class:`Strategy`
+(synchronizer + partitioner + placement), and a strategy compiler that lowers
+the strategy onto a :class:`jax.sharding.Mesh` as shardings and XLA
+collectives — instead of the reference's TF graph rewriting
+(see /root/reference/autodist/autodist.py:297-322 for the original facade).
+"""
+from autodist_tpu.const import ENV  # noqa: F401
+from autodist_tpu.resource_spec import DeviceSpec, ResourceSpec  # noqa: F401
+
+__version__ = "0.1.0"
+
+__all__ = ["ResourceSpec", "DeviceSpec", "ENV", "__version__"]

@@ -1,0 +1,102 @@
+"""Framework-wide constants and environment configuration.
+
+TPU-native analog of the reference's ``autodist/const.py`` (reference
+``autodist/const.py:32-89``): a working directory for run artifacts, name
+prefixes, and a typed registry of environment variables.  Where the reference
+needed gRPC port ranges and a TF collective group leader, we need none — the
+JAX/PJRT distributed runtime handles rendezvous — so those knobs are replaced
+by mesh-axis names and coordinator addresses.
+"""
+from __future__ import annotations
+
+import enum
+import os
+
+# Root for all run artifacts (strategies, traces, graph dumps, logs).
+# Reference: DEFAULT_WORKING_DIR = /tmp/autodist (autodist/const.py:32-36).
+DEFAULT_WORKING_DIR = os.environ.get("AUTODIST_TPU_WORKDIR", "/tmp/autodist_tpu")
+DEFAULT_STRATEGY_DIR = os.path.join(DEFAULT_WORKING_DIR, "strategies")
+DEFAULT_TRACE_DIR = os.path.join(DEFAULT_WORKING_DIR, "traces")
+DEFAULT_GRAPH_DIR = os.path.join(DEFAULT_WORKING_DIR, "graphs")
+DEFAULT_LOG_DIR = os.path.join(DEFAULT_WORKING_DIR, "logs")
+DEFAULT_CHECKPOINT_DIR = os.path.join(DEFAULT_WORKING_DIR, "checkpoints")
+
+# Canonical mesh-axis names.  These are the TPU-native replacement for the
+# reference's device lists in Strategy.graph_config.replicas: instead of
+# enumerating device strings, a strategy names which mesh axes a tensor is
+# partitioned over.
+MESH_AXIS_DATA = "data"      # data parallelism (batch axis)
+MESH_AXIS_MODEL = "model"    # tensor/model parallelism (partitioned variables)
+MESH_AXIS_SEQ = "seq"        # sequence/context parallelism (ring attention)
+MESH_AXIS_PIPE = "pipe"      # pipeline parallelism (stages)
+MESH_AXIS_EXPERT = "expert"  # expert parallelism (MoE)
+
+ALL_MESH_AXES = (
+    MESH_AXIS_DATA,
+    MESH_AXIS_MODEL,
+    MESH_AXIS_SEQ,
+    MESH_AXIS_PIPE,
+    MESH_AXIS_EXPERT,
+)
+
+# Name-scope prefix used when the explicit (shard_map) execution path labels
+# per-variable synchronization segments; analog of AUTODIST_PREFIX name scopes
+# (autodist/const.py:41-49).
+AUTODIST_PREFIX = "AutoDistTPU"
+
+
+def _bool(v):
+    return v in ("True", "true", "1")
+
+
+_ENV_PARSERS = {
+    # non-empty ⇒ this process is a worker; value = its address
+    "AUTODIST_WORKER": lambda v: v or "",
+    # strategy id to load instead of building (worker path)
+    "AUTODIST_STRATEGY_ID": lambda v: v or "",
+    "AUTODIST_MIN_LOG_LEVEL": lambda v: v or "INFO",
+    # extra assertions during tests
+    "AUTODIST_IS_TESTING": _bool,
+    # print launch commands instead of executing them
+    "AUTODIST_DEBUG_REMOTE": _bool,
+    # jax.distributed coordinator (host:port)
+    "AUTODIST_COORDINATOR_ADDRESS": lambda v: v or "",
+    "AUTODIST_NUM_PROCESSES": lambda v: int(v) if v else 1,
+    "AUTODIST_PROCESS_ID": lambda v: int(v) if v else 0,
+    "SYS_DATA_PATH": lambda v: v or "",
+    "SYS_RESOURCE_PATH": lambda v: v or "",
+}
+
+
+class ENV(enum.Enum):
+    """Typed environment-variable registry.
+
+    Mirrors the reference's ``ENV`` enum (``autodist/const.py:55-89``):
+    ``ENV.X.val`` returns the parsed value of environment variable ``X`` with
+    a typed default.
+    """
+
+    AUTODIST_WORKER = "AUTODIST_WORKER"
+    AUTODIST_STRATEGY_ID = "AUTODIST_STRATEGY_ID"
+    AUTODIST_MIN_LOG_LEVEL = "AUTODIST_MIN_LOG_LEVEL"
+    AUTODIST_IS_TESTING = "AUTODIST_IS_TESTING"
+    AUTODIST_DEBUG_REMOTE = "AUTODIST_DEBUG_REMOTE"
+    AUTODIST_COORDINATOR_ADDRESS = "AUTODIST_COORDINATOR_ADDRESS"
+    AUTODIST_NUM_PROCESSES = "AUTODIST_NUM_PROCESSES"
+    AUTODIST_PROCESS_ID = "AUTODIST_PROCESS_ID"
+    SYS_DATA_PATH = "SYS_DATA_PATH"
+    SYS_RESOURCE_PATH = "SYS_RESOURCE_PATH"
+
+    @property
+    def val(self):
+        """Parsed value of the environment variable, with the typed default."""
+        return _ENV_PARSERS[self.name](os.environ.get(self.name))
+
+
+# Worker/chief role detection, mirroring autodist/autodist.py:40-41.
+def is_worker() -> bool:
+    return bool(ENV.AUTODIST_WORKER.val)
+
+
+def is_chief() -> bool:
+    return not is_worker()

@@ -1,0 +1,263 @@
+"""Cluster resource description.
+
+TPU-native analog of the reference's ``autodist/resource_spec.py:45-331``.
+The reference parses a ``resource_spec.yml`` naming nodes (address, cpus,
+gpus, chief flag, ssh config, network bandwidth) plus SSH credentials.  Here a
+node is a TPU-VM worker host with some number of attached TPU chips; SSH
+configs are retained for the coordinator's launcher, and an optional explicit
+``mesh`` section lets users pin logical mesh-axis sizes (data/model/seq/pipe/
+expert) instead of leaving the choice to the strategy builder.
+
+Example yaml::
+
+    nodes:
+      - address: 10.0.0.1
+        chips: 4
+        chief: true
+      - address: 10.0.0.2
+        chips: 4
+        ssh_config: conf1
+    ssh:
+      conf1:
+        username: ubuntu
+        key_file: ~/.ssh/id_rsa
+        port: 22
+        python_venv: source /opt/venv/bin/activate
+        shared_envs: {TPU_NAME: my-pod}
+    network_bandwidth: 100   # Gbps, used by load-balancing strategies
+    mesh:                    # optional
+      data: 4
+      model: 2
+"""
+from __future__ import annotations
+
+import enum
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import yaml
+
+from autodist_tpu.utils import logging
+
+
+class DeviceType(enum.Enum):
+    """Accelerator kind in a :class:`DeviceSpec` (reference resource_spec.py:218-233)."""
+
+    CPU = "CPU"
+    TPU = "TPU"
+    GPU = "GPU"  # accepted for spec compatibility; mapped to TPU semantics
+
+
+@dataclass(frozen=True)
+class DeviceSpec:
+    """AutoDist-level device name ``address:TPU:index``.
+
+    Parity: the reference's ``DeviceSpec`` with ``address:GPU:idx`` naming and
+    a string parser (``autodist/resource_spec.py:218-277``).
+    """
+
+    host_address: str
+    device_type: DeviceType = DeviceType.TPU
+    device_index: int = 0
+
+    def _sort_key(self):
+        return (self.host_address, self.device_type.value, self.device_index)
+
+    def __lt__(self, other: "DeviceSpec"):
+        return self._sort_key() < other._sort_key()
+
+    def name_string(self) -> str:
+        return f"{self.host_address}:{self.device_type.value}:{self.device_index}"
+
+    def __str__(self) -> str:  # pragma: no cover - convenience
+        return self.name_string()
+
+    @classmethod
+    def from_string(cls, name: str) -> "DeviceSpec":
+        parts = name.split(":")
+        if len(parts) == 1:
+            return cls(host_address=parts[0], device_type=DeviceType.CPU, device_index=0)
+        if len(parts) == 2:
+            # "address:index" — assume TPU
+            return cls(parts[0], DeviceType.TPU, int(parts[1]))
+        if len(parts) == 3:
+            return cls(parts[0], DeviceType(parts[1].upper()), int(parts[2]))
+        raise ValueError(f"Cannot parse device string: {name!r}")
+
+
+@dataclass
+class SSHConfig:
+    """SSH credentials for one named config (reference resource_spec.py:160-215)."""
+
+    username: str = ""
+    port: int = 22
+    key_file: Optional[str] = None
+    python_venv: str = ""
+    env: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class NodeSpec:
+    address: str
+    chips: int = 0
+    cpus: List[int] = field(default_factory=list)
+    chief: bool = False
+    ssh_config: Optional[str] = None
+
+
+class ResourceSpecError(ValueError):
+    pass
+
+
+class ResourceSpec:
+    """Parsed cluster description.
+
+    Accepts a yaml path, a pre-parsed dict, or nothing (in which case the
+    local JAX devices are used — the common single-host TPU-VM case, a
+    convenience the reference lacked because TF required explicit specs).
+    """
+
+    def __init__(self, resource_file: Optional[str] = None,
+                 resource_info: Optional[dict] = None):
+        self._nodes: List[NodeSpec] = []
+        self._ssh_configs: Dict[str, SSHConfig] = {}
+        self.network_bandwidth_gbps: float = 1.0
+        self.mesh_hint: Dict[str, int] = {}
+
+        if resource_info is None and resource_file is not None:
+            if not os.path.exists(resource_file):
+                raise ResourceSpecError(f"Resource spec file not found: {resource_file}")
+            with open(resource_file, "r", encoding="utf-8") as f:
+                resource_info = yaml.safe_load(f)
+        if resource_info is not None:
+            self._parse(resource_info)
+        else:
+            self._from_local_devices()
+        self._validate()
+
+    # -- construction ------------------------------------------------------
+    def _parse(self, info: dict) -> None:
+        nodes = info.get("nodes")
+        if not nodes:
+            raise ResourceSpecError("resource spec must contain a non-empty 'nodes' list")
+        for raw in nodes:
+            if "address" not in raw:
+                raise ResourceSpecError(f"node entry missing 'address': {raw}")
+            chips = int(raw.get("chips", raw.get("tpus", 0)) or 0)
+            # Accept the reference's 'gpus' key, treating listed accelerator
+            # indices as chips (spec-file compatibility).
+            if not chips and raw.get("gpus"):
+                chips = len(raw["gpus"])
+            node = NodeSpec(
+                address=str(raw["address"]),
+                chips=chips,
+                cpus=[int(c) for c in raw.get("cpus", [])],
+                chief=bool(raw.get("chief", False)),
+                ssh_config=raw.get("ssh_config"),
+            )
+            self._nodes.append(node)
+        for name, raw in (info.get("ssh") or {}).items():
+            self._ssh_configs[name] = SSHConfig(
+                username=raw.get("username", ""),
+                port=int(raw.get("port", 22)),
+                key_file=raw.get("key_file"),
+                python_venv=raw.get("python_venv", ""),
+                env={str(k): str(v) for k, v in (raw.get("shared_envs") or {}).items()},
+            )
+        self.network_bandwidth_gbps = float(info.get("network_bandwidth", 1.0))
+        self.mesh_hint = {str(k): int(v) for k, v in (info.get("mesh") or {}).items()}
+        # Reference behavior: exactly-one-chief check, defaulting the single
+        # node to chief (resource_spec.py:120-150).
+        if len(self._nodes) == 1:
+            self._nodes[0].chief = True
+
+    def _from_local_devices(self) -> None:
+        import jax  # local import: keep spec parsing importable without jax
+
+        n = len(jax.devices())
+        self._nodes = [NodeSpec(address="localhost", chips=n, chief=True)]
+        logging.info("ResourceSpec auto-derived from local devices: %d chip(s)", n)
+
+    def _validate(self) -> None:
+        chiefs = [n for n in self._nodes if n.chief]
+        if len(chiefs) != 1:
+            raise ResourceSpecError(
+                f"resource spec must designate exactly one chief node, got {len(chiefs)}"
+            )
+        seen = set()
+        for n in self._nodes:
+            if n.address in seen:
+                raise ResourceSpecError(f"duplicate node address {n.address}")
+            seen.add(n.address)
+            if n.chips == 0 and not n.cpus:
+                n.cpus = [0]  # CPU-only node, mirrors reference's cpu fallback
+        for n in self._nodes:
+            if n.ssh_config and n.ssh_config not in self._ssh_configs:
+                raise ResourceSpecError(f"node {n.address} names unknown ssh config "
+                                        f"{n.ssh_config!r}")
+
+    # -- queries -----------------------------------------------------------
+    @property
+    def nodes(self) -> List[NodeSpec]:
+        return list(self._nodes)
+
+    @property
+    def num_nodes(self) -> int:
+        return len(self._nodes)
+
+    @property
+    def chief(self) -> str:
+        """Chief node address (reference resource_spec.py:120-135)."""
+        return next(n.address for n in self._nodes if n.chief)
+
+    @property
+    def ssh_config_map(self) -> Dict[str, SSHConfig]:
+        return dict(self._ssh_configs)
+
+    def ssh_config_for(self, address: str) -> Optional[SSHConfig]:
+        node = next((n for n in self._nodes if n.address == address), None)
+        if node is None or node.ssh_config is None:
+            return None
+        return self._ssh_configs[node.ssh_config]
+
+    @property
+    def num_chips(self) -> int:
+        return sum(n.chips for n in self._nodes)
+
+    @property
+    def tpu_devices(self) -> List[DeviceSpec]:
+        """All accelerator devices, ordered by node then index."""
+        out = []
+        for n in self._nodes:
+            for i in range(n.chips):
+                out.append(DeviceSpec(n.address, DeviceType.TPU, i))
+        return out
+
+    @property
+    def cpu_devices(self) -> List[DeviceSpec]:
+        out = []
+        for n in self._nodes:
+            for i in (n.cpus or [0]):
+                out.append(DeviceSpec(n.address, DeviceType.CPU, i))
+        return out
+
+    @property
+    def devices(self) -> List[DeviceSpec]:
+        """Compute devices used for replicas: TPU chips, or CPUs of chip-less
+        nodes (parity with reference PS strategy device choice,
+        strategy/ps_strategy.py:45-60)."""
+        out: List[DeviceSpec] = []
+        for n in self._nodes:
+            if n.chips:
+                out.extend(DeviceSpec(n.address, DeviceType.TPU, i) for i in range(n.chips))
+            else:
+                out.extend(DeviceSpec(n.address, DeviceType.CPU, i) for i in (n.cpus or [0]))
+        return out
+
+    def node_address_to_chips(self) -> Dict[str, int]:
+        return {n.address: n.chips for n in self._nodes}
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return (f"ResourceSpec(nodes={len(self._nodes)}, chips={self.num_chips}, "
+                f"chief={self.chief!r})")

@@ -1,0 +1,42 @@
+"""Test configuration.
+
+All tests run on a virtual 8-device CPU backend so multi-chip sharding is
+exercised without TPU hardware — the capability upgrade over the reference's
+test suite, which needed a real 2-machine GPU cluster for its distributed
+matrix (reference ``tests/integration/test_dist.py:1-43``, Jenkinsfile:92-131).
+
+Mirrors the reference's ``--run-integration`` gate
+(reference ``tests/conftest.py:1-17``).
+"""
+import os
+
+# Force CPU even if the host environment preset JAX_PLATFORMS to a TPU
+# platform or pre-imported jax (sitecustomize): the config can still be
+# updated as long as no backend has been initialized yet.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import pytest  # noqa: E402
+
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--run-integration", action="store_true", default=False,
+        help="run integration tests (strategy x case matrix)")
+
+
+def pytest_collection_modifyitems(config, items):
+    if config.getoption("--run-integration"):
+        return
+    skip = pytest.mark.skip(reason="needs --run-integration option to run")
+    for item in items:
+        if "integration" in item.keywords:
+            item.add_marker(skip)

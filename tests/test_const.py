@@ -1,0 +1,24 @@
+"""ENV registry tests (parity: reference const.py:55-89 usage)."""
+import os
+
+from autodist_tpu.const import ENV, is_chief, is_worker
+
+
+def test_env_defaults(monkeypatch):
+    for name in ("AUTODIST_WORKER", "AUTODIST_IS_TESTING", "AUTODIST_NUM_PROCESSES"):
+        monkeypatch.delenv(name, raising=False)
+    assert ENV.AUTODIST_WORKER.val == ""
+    assert ENV.AUTODIST_IS_TESTING.val is False
+    assert ENV.AUTODIST_NUM_PROCESSES.val == 1
+    assert ENV.AUTODIST_MIN_LOG_LEVEL.val == "INFO"
+    assert is_chief() and not is_worker()
+
+
+def test_env_parsing(monkeypatch):
+    monkeypatch.setenv("AUTODIST_WORKER", "10.0.0.2")
+    monkeypatch.setenv("AUTODIST_IS_TESTING", "True")
+    monkeypatch.setenv("AUTODIST_NUM_PROCESSES", "16")
+    assert ENV.AUTODIST_WORKER.val == "10.0.0.2"
+    assert ENV.AUTODIST_IS_TESTING.val is True
+    assert ENV.AUTODIST_NUM_PROCESSES.val == 16
+    assert is_worker() and not is_chief()

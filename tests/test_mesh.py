@@ -1,0 +1,62 @@
+"""Mesh construction tests."""
+import jax
+import pytest
+
+from autodist_tpu import mesh as mesh_lib
+from autodist_tpu.resource_spec import ResourceSpec
+
+
+def test_default_data_mesh():
+    m = mesh_lib.build_mesh()
+    assert m.axis_names == ("data",)
+    assert m.shape["data"] == 8
+
+
+def test_axes_canonical_order():
+    m = mesh_lib.build_mesh({"model": 2, "data": 2, "seq": 2})
+    # canonical order: data before seq before model
+    assert m.axis_names == ("data", "seq", "model")
+    assert dict(m.shape) == {"data": 2, "seq": 2, "model": 2}
+
+
+def test_remainder_absorbed_into_data():
+    m = mesh_lib.build_mesh({"model": 2})
+    assert dict(m.shape) == {"data": 4, "model": 2}
+
+
+def test_mesh_hint_from_resource_spec():
+    spec = ResourceSpec(resource_info={
+        "nodes": [{"address": "localhost", "chips": 8}],
+        "mesh": {"data": 4, "model": 2},
+    })
+    m = mesh_lib.build_mesh(resource_spec=spec)
+    assert dict(m.shape) == {"data": 4, "model": 2}
+
+
+def test_bad_axes():
+    with pytest.raises(ValueError):
+        mesh_lib.build_mesh({"data": 3})  # 3 does not divide 8
+
+
+def test_mesh_coords():
+    m = mesh_lib.build_mesh({"data": 4, "model": 2})
+    dev = m.devices[2][1]
+    assert mesh_lib.mesh_coords_of(m, dev) == {"data": 2, "model": 1}
+
+
+def test_single_device_mesh():
+    m = mesh_lib.build_mesh(devices=jax.devices()[:1])
+    assert m.shape["data"] == 1
+
+
+def test_size_one_axes_preserved():
+    m = mesh_lib.build_mesh({"data": 8, "model": 1})
+    assert m.axis_names == ("data", "model")
+    assert dict(m.shape) == {"data": 8, "model": 1}
+
+
+def test_device_spec_sortable():
+    from autodist_tpu.resource_spec import DeviceSpec, DeviceType
+    devs = [DeviceSpec("b", DeviceType.TPU, 0), DeviceSpec("a", DeviceType.CPU, 1),
+            DeviceSpec("a", DeviceType.TPU, 0)]
+    assert sorted(devs)[0].host_address == "a"

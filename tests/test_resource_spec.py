@@ -1,0 +1,128 @@
+"""ResourceSpec / DeviceSpec tests (parity: reference tests/test_resource_spec.py,
+tests/test_device_spec.py)."""
+import os
+import textwrap
+
+import pytest
+
+from autodist_tpu.resource_spec import (
+    DeviceSpec,
+    DeviceType,
+    ResourceSpec,
+    ResourceSpecError,
+)
+
+
+def _write(tmp_path, text):
+    p = tmp_path / "spec.yml"
+    p.write_text(textwrap.dedent(text))
+    return str(p)
+
+
+def test_single_node(tmp_path):
+    spec = ResourceSpec(_write(tmp_path, """
+        nodes:
+          - address: 10.0.0.1
+            chips: 4
+    """))
+    assert spec.num_nodes == 1
+    assert spec.num_chips == 4
+    # Single node auto-promoted to chief (reference resource_spec.py:120-150).
+    assert spec.chief == "10.0.0.1"
+    assert [d.name_string() for d in spec.tpu_devices] == [
+        "10.0.0.1:TPU:0", "10.0.0.1:TPU:1", "10.0.0.1:TPU:2", "10.0.0.1:TPU:3"]
+
+
+def test_multi_node_with_ssh(tmp_path):
+    spec = ResourceSpec(_write(tmp_path, """
+        nodes:
+          - address: a
+            chips: 4
+            chief: true
+          - address: b
+            chips: 4
+            ssh_config: conf
+        ssh:
+          conf:
+            username: u
+            key_file: /k
+            port: 2222
+        network_bandwidth: 100
+        mesh:
+          data: 2
+          model: 4
+    """))
+    assert spec.num_nodes == 2
+    assert spec.chief == "a"
+    assert spec.num_chips == 8
+    assert spec.ssh_config_for("b").username == "u"
+    assert spec.ssh_config_for("b").port == 2222
+    assert spec.ssh_config_for("a") is None
+    assert spec.network_bandwidth_gbps == 100
+    assert spec.mesh_hint == {"data": 2, "model": 4}
+
+
+def test_gpus_key_compat(tmp_path):
+    # The reference's yaml format lists gpu indices; we accept it.
+    spec = ResourceSpec(_write(tmp_path, """
+        nodes:
+          - address: localhost
+            gpus: [0, 1]
+    """))
+    assert spec.num_chips == 2
+
+
+def test_cpu_only_node(tmp_path):
+    spec = ResourceSpec(_write(tmp_path, """
+        nodes:
+          - address: localhost
+            cpus: [0]
+    """))
+    assert spec.num_chips == 0
+    assert [d.device_type for d in spec.devices] == [DeviceType.CPU]
+
+
+def test_errors(tmp_path):
+    with pytest.raises(ResourceSpecError):  # no chief among 2 nodes
+        ResourceSpec(_write(tmp_path, """
+            nodes:
+              - {address: a, chips: 1}
+              - {address: b, chips: 1}
+        """))
+    with pytest.raises(ResourceSpecError):  # two chiefs
+        ResourceSpec(_write(tmp_path, """
+            nodes:
+              - {address: a, chips: 1, chief: true}
+              - {address: b, chips: 1, chief: true}
+        """))
+    with pytest.raises(ResourceSpecError):  # duplicate address
+        ResourceSpec(_write(tmp_path, """
+            nodes:
+              - {address: a, chips: 1, chief: true}
+              - {address: a, chips: 1}
+        """))
+    with pytest.raises(ResourceSpecError):  # unknown ssh config
+        ResourceSpec(_write(tmp_path, """
+            nodes:
+              - {address: a, chips: 1, chief: true, ssh_config: nope}
+        """))
+    with pytest.raises(ResourceSpecError):
+        ResourceSpec(os.path.join(str(tmp_path), "missing.yml"))
+
+
+def test_auto_from_local_devices():
+    spec = ResourceSpec()
+    assert spec.num_nodes == 1
+    assert spec.chief == "localhost"
+    assert spec.num_chips == 8  # virtual CPU device count from conftest
+
+
+def test_device_spec_roundtrip():
+    d = DeviceSpec("1.2.3.4", DeviceType.TPU, 3)
+    assert d.name_string() == "1.2.3.4:TPU:3"
+    assert DeviceSpec.from_string("1.2.3.4:TPU:3") == d
+    assert DeviceSpec.from_string("host") == DeviceSpec("host", DeviceType.CPU, 0)
+    assert DeviceSpec.from_string("host:2") == DeviceSpec("host", DeviceType.TPU, 2)
+    assert DeviceSpec.from_string("h:gpu:1").device_type == DeviceType.GPU
+    with pytest.raises(ValueError):
+        DeviceSpec.from_string("a:b:c:d")
