@@ -49,23 +49,20 @@ def _bool(v):
     return v in ("True", "true", "1")
 
 
-_ENV_PARSERS = {
-    # non-empty ⇒ this process is a worker; value = its address
-    "AUTODIST_WORKER": lambda v: v or "",
-    # strategy id to load instead of building (worker path)
-    "AUTODIST_STRATEGY_ID": lambda v: v or "",
-    "AUTODIST_MIN_LOG_LEVEL": lambda v: v or "INFO",
-    # extra assertions during tests
-    "AUTODIST_IS_TESTING": _bool,
-    # print launch commands instead of executing them
-    "AUTODIST_DEBUG_REMOTE": _bool,
-    # jax.distributed coordinator (host:port)
-    "AUTODIST_COORDINATOR_ADDRESS": lambda v: v or "",
-    "AUTODIST_NUM_PROCESSES": lambda v: int(v) if v else 1,
-    "AUTODIST_PROCESS_ID": lambda v: int(v) if v else 0,
-    "SYS_DATA_PATH": lambda v: v or "",
-    "SYS_RESOURCE_PATH": lambda v: v or "",
-}
+def _str(v):
+    return v or ""
+
+
+def _int0(v):
+    return int(v) if v else 0
+
+
+def _int1(v):
+    return int(v) if v else 1
+
+
+def _loglevel(v):
+    return v or "INFO"
 
 
 class ENV(enum.Enum):
@@ -73,24 +70,32 @@ class ENV(enum.Enum):
 
     Mirrors the reference's ``ENV`` enum (``autodist/const.py:55-89``):
     ``ENV.X.val`` returns the parsed value of environment variable ``X`` with
-    a typed default.
+    a typed default.  Each member's value is ``(name, parser)`` so the
+    registry is self-contained — a member cannot exist without its parser.
+    (Plain-callable values don't work: functions in an Enum body become
+    methods, not members.)
     """
 
-    AUTODIST_WORKER = "AUTODIST_WORKER"
-    AUTODIST_STRATEGY_ID = "AUTODIST_STRATEGY_ID"
-    AUTODIST_MIN_LOG_LEVEL = "AUTODIST_MIN_LOG_LEVEL"
-    AUTODIST_IS_TESTING = "AUTODIST_IS_TESTING"
-    AUTODIST_DEBUG_REMOTE = "AUTODIST_DEBUG_REMOTE"
-    AUTODIST_COORDINATOR_ADDRESS = "AUTODIST_COORDINATOR_ADDRESS"
-    AUTODIST_NUM_PROCESSES = "AUTODIST_NUM_PROCESSES"
-    AUTODIST_PROCESS_ID = "AUTODIST_PROCESS_ID"
-    SYS_DATA_PATH = "SYS_DATA_PATH"
-    SYS_RESOURCE_PATH = "SYS_RESOURCE_PATH"
+    # non-empty ⇒ this process is a worker; value = its address
+    AUTODIST_WORKER = ("AUTODIST_WORKER", _str)
+    # strategy id to load instead of building (worker path)
+    AUTODIST_STRATEGY_ID = ("AUTODIST_STRATEGY_ID", _str)
+    AUTODIST_MIN_LOG_LEVEL = ("AUTODIST_MIN_LOG_LEVEL", _loglevel)
+    # extra assertions during tests
+    AUTODIST_IS_TESTING = ("AUTODIST_IS_TESTING", _bool)
+    # print launch commands instead of executing them
+    AUTODIST_DEBUG_REMOTE = ("AUTODIST_DEBUG_REMOTE", _bool)
+    # jax.distributed coordinator (host:port)
+    AUTODIST_COORDINATOR_ADDRESS = ("AUTODIST_COORDINATOR_ADDRESS", _str)
+    AUTODIST_NUM_PROCESSES = ("AUTODIST_NUM_PROCESSES", _int1)
+    AUTODIST_PROCESS_ID = ("AUTODIST_PROCESS_ID", _int0)
+    SYS_DATA_PATH = ("SYS_DATA_PATH", _str)
+    SYS_RESOURCE_PATH = ("SYS_RESOURCE_PATH", _str)
 
     @property
     def val(self):
         """Parsed value of the environment variable, with the typed default."""
-        return _ENV_PARSERS[self.name](os.environ.get(self.name))
+        return self.value[1](os.environ.get(self.name))
 
 
 # Worker/chief role detection, mirroring autodist/autodist.py:40-41.

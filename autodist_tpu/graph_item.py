@@ -1,0 +1,207 @@
+"""Program representation: the TPU-native GraphItem.
+
+The reference's ``GraphItem`` wraps a ``tf.Graph`` plus a gradient→variable
+map and an ``Info`` collection registry (``autodist/graph_item.py:217-296``,
+``111-214``).  In a functional JAX world there is no mutable graph to wrap:
+the "program" is a pure train-step function over a parameter pytree.  The
+TPU-native GraphItem therefore holds:
+
+* ``params`` — the parameter pytree (the "variables"),
+* ``optimizer`` — an ``optax.GradientTransformation`` (captured explicitly
+  rather than via the reference's optimizer monkeypatching,
+  ``autodist/graph_item.py:72-108``; see ``autodist_tpu/patch.py`` for the
+  implicit-capture path),
+* ``loss_fn`` — ``loss_fn(params, batch) -> scalar`` (or ``(loss, aux)``),
+* an :class:`Info` catalog of variables with trainable/untrainable and
+  sparse-gradient annotations (the analog of
+  ``autodist/graph_item.py:111-214``'s collections replacement).
+
+The gradient→target map of the reference is implicit here: JAX gradients are
+pytrees isomorphic to ``params``, so grad↔var pairing is structural.
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def path_name(path: Tuple) -> str:
+    """Human-readable, stable name for a pytree key path: parts joined by '/'.
+
+    Gives flax-style names like ``Dense_0/kernel`` — the analog of the
+    reference's TF variable names used as strategy node keys
+    (``autodist/proto/strategy.proto:44``)."""
+    parts = []
+    for k in path:
+        if isinstance(k, jax.tree_util.DictKey):
+            parts.append(str(k.key))
+        elif isinstance(k, jax.tree_util.GetAttrKey):
+            parts.append(k.name)
+        elif isinstance(k, jax.tree_util.SequenceKey):
+            parts.append(str(k.idx))
+        elif isinstance(k, jax.tree_util.FlattenedIndexKey):
+            parts.append(str(k.key))
+        else:  # pragma: no cover - future key types
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+@dataclass
+class VarInfo:
+    """Catalog entry for one variable (parity: the per-variable metadata the
+    reference keeps in ``Info.variables`` protos, graph_item.py:111-160)."""
+
+    name: str
+    shape: Tuple[int, ...]
+    dtype: str
+    trainable: bool = True
+    sparse: bool = False  # gradient has embedding/scatter structure
+
+    @property
+    def byte_size(self) -> int:
+        return int(np.prod(self.shape or (1,))) * np.dtype(self.dtype).itemsize
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "shape": list(self.shape), "dtype": self.dtype,
+                "trainable": self.trainable, "sparse": self.sparse}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "VarInfo":
+        return cls(name=d["name"], shape=tuple(d["shape"]), dtype=d["dtype"],
+                   trainable=d.get("trainable", True), sparse=d.get("sparse", False))
+
+
+@dataclass
+class Info:
+    """Variable catalog: trainable/untrainable split plus sparse annotations.
+
+    Parity: reference ``Info`` (graph_item.py:111-214) which replaced TF
+    collections with explicit variable/saver/table-initializer lists."""
+
+    variables: List[VarInfo] = field(default_factory=list)
+
+    @property
+    def trainable_variables(self) -> List[VarInfo]:
+        return [v for v in self.variables if v.trainable]
+
+    @property
+    def untrainable_variables(self) -> List[VarInfo]:
+        return [v for v in self.variables if not v.trainable]
+
+    def by_name(self, name: str) -> VarInfo:
+        for v in self.variables:
+            if v.name == name:
+                return v
+        raise KeyError(name)
+
+
+class GraphItem:
+    """The captured training program.
+
+    Args:
+      params: parameter pytree.
+      optimizer: optax ``GradientTransformation`` (may be None for
+        inspection-only GraphItems, e.g. during strategy building on a
+        worker before optimizer construction).
+      loss_fn: ``loss_fn(params, batch) -> loss`` or ``-> (loss, aux)``.
+      sparse_vars: names (or name-prefixes) of variables whose gradients have
+        embedding structure — the analog of the reference detecting
+        ``IndexedSlices`` gradients (graph_item.py:275-296).  Strategy
+        builders treat these differently (e.g. Parallax, parallax_strategy.py:24-71).
+      untrainable_vars: names (or prefixes) excluded from synchronization,
+        e.g. batch-norm statistics.
+      has_aux: whether loss_fn returns ``(loss, aux)``.
+    """
+
+    def __init__(self,
+                 params: Any,
+                 optimizer: Any = None,
+                 loss_fn: Optional[Callable] = None,
+                 sparse_vars: Sequence[str] = (),
+                 untrainable_vars: Sequence[str] = (),
+                 has_aux: bool = False):
+        self.params = params
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn
+        self.has_aux = has_aux
+        self._sparse_patterns = tuple(sparse_vars)
+        self._untrainable_patterns = tuple(untrainable_vars)
+        self.info = self._build_info()
+
+    # -- catalog -----------------------------------------------------------
+    @staticmethod
+    def _matches(name: str, patterns: Tuple[str, ...]) -> bool:
+        """Exact name, path-prefix, or fnmatch glob (e.g. ``*/embedding/*``).
+        Deliberately NOT substring matching — a pattern like ``emb`` must not
+        capture ``embeddings_norm/scale``."""
+        import fnmatch
+        for p in patterns:
+            if name == p or name.startswith(p.rstrip("/") + "/"):
+                return True
+            if any(ch in p for ch in "*?[") and fnmatch.fnmatch(name, p):
+                return True
+        return False
+
+    def _build_info(self) -> Info:
+        leaves = jax.tree_util.tree_flatten_with_path(self.params)[0]
+        infos = []
+        for path, leaf in leaves:
+            name = path_name(path)
+            shape = tuple(getattr(leaf, "shape", ()) or ())
+            dtype = str(jnp.asarray(leaf).dtype) if not hasattr(leaf, "dtype") \
+                else str(leaf.dtype)
+            infos.append(VarInfo(
+                name=name,
+                shape=shape,
+                dtype=dtype,
+                trainable=not self._matches(name, self._untrainable_patterns),
+                sparse=self._matches(name, self._sparse_patterns),
+            ))
+        return Info(variables=infos)
+
+    @property
+    def var_names(self) -> List[str]:
+        return [v.name for v in self.info.variables]
+
+    @property
+    def trainable_var_infos(self) -> List[VarInfo]:
+        return self.info.trainable_variables
+
+    def name_to_leaf(self) -> Dict[str, Any]:
+        leaves = jax.tree_util.tree_flatten_with_path(self.params)[0]
+        return {path_name(p): leaf for p, leaf in leaves}
+
+    def prepare(self) -> "GraphItem":
+        """Refresh the catalog (parity: graph_item.prepare(),
+        graph_item.py:414-417, called at strategy-build time)."""
+        self.info = self._build_info()
+        return self
+
+    # -- grad/step helpers -------------------------------------------------
+    def grad_fn(self) -> Callable:
+        """``grad_fn(params, batch) -> (loss, grads)`` built from loss_fn."""
+        if self.loss_fn is None:
+            raise ValueError("GraphItem has no loss_fn")
+        vg = jax.value_and_grad(self.loss_fn, has_aux=self.has_aux)
+        return vg
+
+    # -- serialization -----------------------------------------------------
+    # The reference serializes the full GraphDef (graph_item.py:419-473).
+    # Functionally the program lives in user code (re-run identically on every
+    # worker — the reference's own execution model, coordinator.py:66-90), so
+    # only the abstract catalog needs to round-trip.
+    def serialize(self) -> str:
+        return json.dumps({
+            "variables": [v.to_dict() for v in self.info.variables],
+            "has_aux": self.has_aux,
+        })
+
+    @classmethod
+    def deserialize_catalog(cls, data: str) -> Info:
+        d = json.loads(data)
+        return Info(variables=[VarInfo.from_dict(v) for v in d["variables"]])

@@ -1,0 +1,33 @@
+"""Strategy builders — parity with ``autodist/strategy/`` (9 modules)."""
+from autodist_tpu.strategy.all_reduce_strategy import AllReduce
+from autodist_tpu.strategy.base import (
+    AllReduceSynchronizerConfig,
+    GraphConfig,
+    PSSynchronizerConfig,
+    Strategy,
+    StrategyBuilder,
+    VarConfig,
+)
+from autodist_tpu.strategy.compiler import (
+    CompiledStrategy,
+    StrategyCompiler,
+    VarPlan,
+    parse_partitioner,
+)
+from autodist_tpu.strategy.parallax_strategy import Parallax
+from autodist_tpu.strategy.partitioned_all_reduce_strategy import PartitionedAR
+from autodist_tpu.strategy.partitioned_ps_strategy import PartitionedPS
+from autodist_tpu.strategy.ps_lb_strategy import PSLoadBalancing
+from autodist_tpu.strategy.ps_strategy import PS
+from autodist_tpu.strategy.random_axis_partition_all_reduce_strategy import (
+    RandomAxisPartitionAR,
+)
+from autodist_tpu.strategy.uneven_partition_ps_strategy import UnevenPartitionedPS
+
+__all__ = [
+    "AllReduce", "AllReduceSynchronizerConfig", "CompiledStrategy",
+    "GraphConfig", "PS", "PSLoadBalancing", "PSSynchronizerConfig", "Parallax",
+    "PartitionedAR", "PartitionedPS", "RandomAxisPartitionAR", "Strategy",
+    "StrategyBuilder", "StrategyCompiler", "UnevenPartitionedPS", "VarConfig",
+    "VarPlan", "parse_partitioner",
+]

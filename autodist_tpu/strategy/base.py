@@ -1,0 +1,194 @@
+"""Strategy IR: the per-variable distribution plan.
+
+Shape parity with the reference protobufs (``autodist/proto/strategy.proto:30-69``,
+``synchronizers.proto:24-57``): a Strategy is a list of per-variable node
+configs — each an exclusive choice of synchronizer (PS or AllReduce) plus an
+optional partitioner string ``"1,2,1"`` with per-shard part configs — and a
+graph config listing the replica devices.  Serialization is JSON (the
+reference used binary protos written to ``/tmp/autodist/strategies/<id>``,
+``strategy/base.py:78-99``); ids are UTC timestamps, same scheme.
+"""
+from __future__ import annotations
+
+import datetime
+import json
+import os
+from dataclasses import asdict, dataclass, field
+from typing import Dict, List, Optional
+
+from autodist_tpu.const import DEFAULT_STRATEGY_DIR
+from autodist_tpu.graph_item import GraphItem
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.utils import logging
+
+
+@dataclass
+class PSSynchronizerConfig:
+    """Parameter-server sync (reference synchronizers.proto:40-57).
+
+    On TPU, PS semantics compile to *weight-update sharding*: gradients are
+    reduce-scattered to the shard that owns the variable's optimizer state,
+    the update runs sharded, and fresh params are all-gathered — the XLA-era
+    equivalent of "aggregate on the PS device and broadcast"
+    (cf. arxiv 2004.13336)."""
+
+    reduction_destination: str = ""  # DeviceSpec string, e.g. "10.0.0.1:CPU:0"
+    local_replication: bool = False  # proxy-variable caching (reference ProxyVariable)
+    sync: bool = True
+    staleness: int = 0
+
+    kind: str = "PS"
+
+
+@dataclass
+class AllReduceSynchronizerConfig:
+    """All-reduce sync (reference synchronizers.proto:24-39).
+
+    ``spec`` keeps the reference's AUTO/RING/NCCL vocabulary as a hint; on
+    TPU all variants lower to ``psum`` over the data axis and XLA picks the
+    ICI algorithm.  ``group`` merges small variables into one fused collective
+    (the reference's scoped-allocator chunking, all_reduce_strategy.py:21-90)."""
+
+    spec: str = "AUTO"  # AUTO | RING | NCCL (hint only on TPU)
+    compressor: str = "NoneCompressor"  # NoneCompressor | HorovodCompressor | HorovodCompressorEF
+    group: int = 0
+
+    kind: str = "AllReduce"
+
+
+def _synchronizer_from_dict(d: dict):
+    kind = d.get("kind")
+    if kind == "PS":
+        return PSSynchronizerConfig(**{k: v for k, v in d.items() if k != "kind"})
+    if kind == "AllReduce":
+        return AllReduceSynchronizerConfig(**{k: v for k, v in d.items() if k != "kind"})
+    raise ValueError(f"unknown synchronizer kind {kind!r}")
+
+
+@dataclass
+class VarConfig:
+    """Per-variable node config (reference strategy.proto Node, :41-58)."""
+
+    var_name: str
+    synchronizer: object = None  # PSSynchronizerConfig | AllReduceSynchronizerConfig
+    # "a,b,c" — shard counts per tensor axis; at most one entry > 1
+    # (reference PartitionerConfig, kernel/partitioner.py:38-150).
+    partitioner: str = ""
+    part_config: List["VarConfig"] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {
+            "var_name": self.var_name,
+            "synchronizer": asdict(self.synchronizer) if self.synchronizer else None,
+            "partitioner": self.partitioner,
+            "part_config": [p.to_dict() for p in self.part_config],
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "VarConfig":
+        return cls(
+            var_name=d["var_name"],
+            synchronizer=_synchronizer_from_dict(d["synchronizer"])
+            if d.get("synchronizer") else None,
+            partitioner=d.get("partitioner", ""),
+            part_config=[cls.from_dict(p) for p in d.get("part_config", [])],
+        )
+
+
+@dataclass
+class GraphConfig:
+    """Whole-graph config (reference strategy.proto:60-68): replica devices.
+
+    On TPU this also carries the logical mesh axes the strategy wants, which
+    the compiler intersects with the physical mesh."""
+
+    replicas: List[str] = field(default_factory=list)  # DeviceSpec strings
+    mesh_axes: Dict[str, int] = field(default_factory=dict)
+
+
+class Strategy:
+    """A distribution plan: ``node_config`` per variable + ``graph_config``.
+
+    Parity: reference ``Strategy`` wrapper (strategy/base.py:28-99)."""
+
+    def __init__(self, node_config: Optional[List[VarConfig]] = None,
+                 graph_config: Optional[GraphConfig] = None,
+                 strategy_id: Optional[str] = None):
+        self.node_config: List[VarConfig] = node_config or []
+        self.graph_config: GraphConfig = graph_config or GraphConfig()
+        # Same id scheme as the reference: UTC timestamp (strategy/base.py:40).
+        self.id = strategy_id or datetime.datetime.now(
+            datetime.timezone.utc).strftime("%Y%m%dT%H%M%SM%f")
+        self.path = os.path.join(DEFAULT_STRATEGY_DIR, self.id)
+
+    def to_dict(self) -> dict:
+        return {
+            "id": self.id,
+            "node_config": [n.to_dict() for n in self.node_config],
+            "graph_config": {
+                "replicas": list(self.graph_config.replicas),
+                "mesh_axes": dict(self.graph_config.mesh_axes),
+            },
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Strategy":
+        return cls(
+            node_config=[VarConfig.from_dict(n) for n in d["node_config"]],
+            graph_config=GraphConfig(
+                replicas=d["graph_config"].get("replicas", []),
+                mesh_axes=d["graph_config"].get("mesh_axes", {})),
+            strategy_id=d["id"],
+        )
+
+    def serialize(self, path: Optional[str] = None) -> str:
+        """Write to disk so workers can load the chief-built plan
+        (reference strategy/base.py:78-87)."""
+        path = path or self.path
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(self.to_dict(), f, indent=1)
+        logging.debug("Strategy %s serialized to %s", self.id, path)
+        return path
+
+    @classmethod
+    def deserialize(cls, strategy_id: str, base_dir: Optional[str] = None) -> "Strategy":
+        path = os.path.join(base_dir or DEFAULT_STRATEGY_DIR, strategy_id)
+        with open(path, "r", encoding="utf-8") as f:
+            return cls.from_dict(json.load(f))
+
+    def node_for(self, var_name: str) -> Optional[VarConfig]:
+        for n in self.node_config:
+            if n.var_name == var_name:
+                return n
+        return None
+
+    def __repr__(self) -> str:  # pragma: no cover
+        kinds = {}
+        for n in self.node_config:
+            k = getattr(n.synchronizer, "kind", None) or "None"
+            if n.partitioner:
+                k = "Partitioned" + k
+            kinds[k] = kinds.get(k, 0) + 1
+        return f"Strategy(id={self.id}, vars={len(self.node_config)}, {kinds})"
+
+
+class StrategyBuilder:
+    """Base builder (reference strategy/base.py:102-117): map
+    ``(GraphItem, ResourceSpec) -> Strategy``."""
+
+    def build(self, graph_item: GraphItem, resource_spec: ResourceSpec) -> Strategy:
+        raise NotImplementedError
+
+    # -- shared helpers ----------------------------------------------------
+    @staticmethod
+    def replica_devices(resource_spec: ResourceSpec) -> List[str]:
+        """All compute devices: TPU chips, or CPUs of chip-less nodes
+        (reference ps_strategy.py:45-60)."""
+        return [d.name_string() for d in resource_spec.devices]
+
+    @staticmethod
+    def reduction_device_names(resource_spec: ResourceSpec) -> List[str]:
+        """Candidate PS destinations: one CPU device per node (the reference
+        places PS shards on node CPUs, ps_lb_strategy.py:42-62)."""
+        return [d.name_string() for d in resource_spec.cpu_devices]

@@ -1,0 +1,278 @@
+"""StrategyCompiler: lower a Strategy onto a device mesh.
+
+Parity: reference ``StrategyCompiler`` (``autodist/strategy/base.py:120-168``)
+resolves abstract device names to TF device strings and prunes configs for
+variables without update ops.  The TPU-native compiler instead lowers each
+per-variable config to a :class:`VarPlan` of ``PartitionSpec``s on a
+:class:`jax.sharding.Mesh`:
+
+* **AllReduce** → parameter and optimizer state replicated over ``data``;
+  gradient psum over ``data`` (inserted by GSPMD, or explicitly through a
+  Compressor on the shard_map path).
+* **PS** → parameter replicated for compute, but optimizer state *sharded*
+  over ``data`` — weight-update sharding (arxiv 2004.13336): XLA lowers the
+  gradient reduction to reduce-scatter, runs the update on the owning shard
+  ("the PS"), and all-gathers fresh parameters.  This is the bulk-synchronous
+  TPU equivalent of reduce-to-destination-and-broadcast
+  (reference ps_synchronizer.py:248-329).
+* **partitioner "a,b,c"** → the active tensor axis is sharded over the mesh's
+  ``model`` axis (true GSPMD tensor partitioning — what the reference
+  approximated with per-shard PS placement, kernel/partitioner.py:153-229).
+  On a pure-DP mesh, PS-partitioned variables shard over ``data`` instead
+  (parameters live distributed across "servers"), while AR-partitioned
+  variables stay replicated (shards colocated with every replica — the
+  reference's layout).
+
+Note on load balancing: the reference's byte-size PS assignment decides which
+*node* holds each variable.  Under weight-update sharding every variable's
+update is spread uniformly across the data axis, so balancing is automatic;
+the per-variable ``reduction_destination`` is still resolved (to mesh
+coordinates) and drives DCN placement on multi-slice meshes.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from autodist_tpu.const import MESH_AXIS_DATA, MESH_AXIS_MODEL
+from autodist_tpu.graph_item import GraphItem, VarInfo
+from autodist_tpu.resource_spec import DeviceSpec
+from autodist_tpu.strategy.base import (
+    AllReduceSynchronizerConfig,
+    PSSynchronizerConfig,
+    Strategy,
+    VarConfig,
+)
+from autodist_tpu.utils import logging
+
+
+def parse_partitioner(partitioner: str) -> Tuple[Optional[int], int]:
+    """``"1,4,1"`` → (active_axis, num_shards); ("" or all-ones) → (None, 1).
+
+    Enforces the reference's one-active-axis rule
+    (kernel/partitioner.py:38-150)."""
+    if not partitioner:
+        return None, 1
+    parts = [int(x) for x in partitioner.split(",")]
+    active = [(i, p) for i, p in enumerate(parts) if p > 1]
+    if not active:
+        return None, 1
+    if len(active) > 1:
+        raise ValueError(
+            f"partitioner {partitioner!r} has more than one active axis")
+    return active[0][0], active[0][1]
+
+
+@dataclass
+class VarPlan:
+    """Lowered per-variable plan."""
+
+    var_name: str
+    sync_kind: str                     # "AllReduce" | "PS"
+    param_spec: P                      # parameter layout
+    opt_spec: P                        # layout for same-shaped optimizer slots
+    grad_reduce_axes: Tuple[str, ...]  # mesh axes the gradient is summed over
+    compressor: str = "NoneCompressor"
+    group: int = 0
+    reduction_destination: str = ""
+    destination_coords: Optional[Dict[str, int]] = None
+    staleness: int = 0
+    local_replication: bool = False
+    partition_axis: Optional[int] = None
+    num_shards: int = 1
+    sparse: bool = False
+
+
+@dataclass
+class CompiledStrategy:
+    """A Strategy bound to a mesh: per-variable plans + batch layout."""
+
+    strategy: Strategy
+    mesh: Mesh
+    var_plans: Dict[str, VarPlan]
+    batch_axes: Tuple[str, ...] = (MESH_AXIS_DATA,)
+
+    @property
+    def data_axis_size(self) -> int:
+        return self.mesh.shape.get(MESH_AXIS_DATA, 1)
+
+    def plan_for(self, name: str) -> VarPlan:
+        return self.var_plans[name]
+
+    def batch_spec(self) -> P:
+        return P(self.batch_axes)
+
+    def batch_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, self.batch_spec())
+
+    def param_sharding_tree(self, params):
+        """Pytree of NamedShardings matching ``params``."""
+        from autodist_tpu.graph_item import path_name
+
+        def spec_of(path, leaf):
+            name = path_name(path)
+            plan = self.var_plans.get(name)
+            return NamedSharding(self.mesh, plan.param_spec if plan else P())
+
+        return jax.tree_util.tree_map_with_path(spec_of, params)
+
+    def replicated_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+
+class StrategyCompiler:
+    """Compile ``Strategy × GraphItem × Mesh → CompiledStrategy``.
+
+    ``resource_spec`` (optional) lets the compiler resolve abstract
+    ``reduction_destination`` device strings to data-axis coordinates —
+    the analog of the reference's DeviceResolver
+    (kernel/device/resolver.py:47-67)."""
+
+    def __init__(self, mesh: Mesh, resource_spec=None):
+        self.mesh = mesh
+        self._host_to_data_coord = self._build_host_map(resource_spec)
+
+    def _build_host_map(self, resource_spec) -> Dict[str, int]:
+        """Map node address → the data-axis coordinate of its first chip,
+        assuming mesh devices are laid out in node order (how build_mesh
+        arranges them).  Under weight-update sharding this coordinate is the
+        canonical 'owner' shard of variables destined to that node."""
+        if resource_spec is None:
+            return {}
+        total = max(resource_spec.num_chips, 1)
+        d = self.mesh.shape.get(MESH_AXIS_DATA, 1)
+        out: Dict[str, int] = {}
+        cum = 0
+        for node in resource_spec.nodes:
+            out[node.address] = min(cum * d // total, d - 1)
+            cum += max(node.chips, 1)
+        return out
+
+    # -- helpers -----------------------------------------------------------
+    def _model_axis(self) -> Optional[str]:
+        if self.mesh.shape.get(MESH_AXIS_MODEL, 1) > 1:
+            return MESH_AXIS_MODEL
+        return None
+
+    def _resolve_destination(self, dest: str) -> Optional[Dict[str, int]]:
+        """DeviceSpec string → owning data-axis coordinate, or None when the
+        address is unknown to this mesh (the reduction then rides the data
+        axis uniformly)."""
+        if not dest:
+            return None
+        try:
+            spec = DeviceSpec.from_string(dest)
+        except ValueError:
+            return None
+        coord = self._host_to_data_coord.get(spec.host_address)
+        if coord is None:
+            return None
+        return {MESH_AXIS_DATA: coord}
+
+    @staticmethod
+    def _spec_from_entries(entries: List[Optional[str]]) -> P:
+        entries = list(entries)
+        while entries and entries[-1] is None:
+            entries.pop()
+        return P(*entries)
+
+    def _partition_spec(self, var: VarInfo, axis: Optional[int],
+                        shard_mesh_axis: Optional[str]) -> P:
+        if axis is None or shard_mesh_axis is None:
+            return P()
+        entries: List[Optional[str]] = [None] * len(var.shape)
+        entries[axis] = shard_mesh_axis
+        return self._spec_from_entries(entries)
+
+    def _wus_opt_spec(self, var: VarInfo, param_spec: P) -> P:
+        """Weight-update-sharding layout: shard the largest still-unsharded
+        dim over ``data`` if it divides evenly; otherwise keep the param
+        layout (replicating tiny/odd variables costs nothing)."""
+        d = self.mesh.shape.get(MESH_AXIS_DATA, 1)
+        if d <= 1 or not var.shape:
+            return param_spec
+        entries = list(param_spec) + [None] * (len(var.shape) - len(param_spec))
+        best, best_dim = None, 0
+        for i, dim in enumerate(var.shape):
+            if entries[i] is None and dim % d == 0 and dim > best_dim:
+                best, best_dim = i, dim
+        if best is None:
+            return param_spec
+        entries[best] = MESH_AXIS_DATA
+        return self._spec_from_entries(entries)
+
+    # -- main --------------------------------------------------------------
+    def compile(self, strategy: Strategy, graph_item: GraphItem) -> CompiledStrategy:
+        model_axis = self._model_axis()
+        plans: Dict[str, VarPlan] = {}
+        known = {v.name: v for v in graph_item.info.variables}
+
+        for node in strategy.node_config:
+            var = known.get(node.var_name)
+            if var is None:
+                # Prune configs with no matching variable (parity with the
+                # reference pruning no-update-op nodes, strategy/base.py:128-140).
+                logging.debug("pruning strategy node for unknown var %s",
+                              node.var_name)
+                continue
+            if not var.trainable:
+                continue
+            plans[var.name] = self._compile_node(node, var, model_axis)
+
+        # Untouched trainable vars: replicate + psum (safe default).
+        for name, var in known.items():
+            if var.trainable and name not in plans:
+                plans[name] = VarPlan(
+                    var_name=name, sync_kind="AllReduce", param_spec=P(),
+                    opt_spec=P(), grad_reduce_axes=(MESH_AXIS_DATA,))
+        return CompiledStrategy(strategy=strategy, mesh=self.mesh, var_plans=plans)
+
+    def _compile_node(self, node: VarConfig, var: VarInfo,
+                      model_axis: Optional[str]) -> VarPlan:
+        axis, num_shards = parse_partitioner(node.partitioner)
+        if axis is not None and (len(var.shape) <= axis or var.shape[axis] < 2):
+            raise ValueError(
+                f"partitioner {node.partitioner!r} invalid for {var.name} "
+                f"with shape {var.shape}")
+        sync = node.synchronizer
+        grad_axes = (MESH_AXIS_DATA,) if self.mesh.shape.get(MESH_AXIS_DATA, 1) > 1 \
+            else ()
+
+        if isinstance(sync, AllReduceSynchronizerConfig):
+            # Shards stay colocated with replicas (reference layout) —
+            # partition over 'model' only when the mesh has one.
+            spec = self._partition_spec(var, axis, model_axis)
+            return VarPlan(
+                var_name=var.name, sync_kind="AllReduce",
+                param_spec=spec, opt_spec=spec, grad_reduce_axes=grad_axes,
+                compressor=sync.compressor, group=sync.group,
+                partition_axis=axis if model_axis else None,
+                num_shards=num_shards if model_axis else 1,
+                sparse=var.sparse)
+
+        if isinstance(sync, PSSynchronizerConfig):
+            shard_axis = model_axis or (MESH_AXIS_DATA if axis is not None else None)
+            spec = self._partition_spec(var, axis, shard_axis)
+            if var.sparse and axis is None and var.shape:
+                # Sparse embedding on PS: shard the vocab axis so gradient
+                # scatter-adds land on the owning shard (Parallax lowering).
+                shard_axis2 = model_axis or MESH_AXIS_DATA
+                if var.shape[0] >= self.mesh.shape.get(shard_axis2, 1) > 1:
+                    spec = self._partition_spec(var, 0, shard_axis2)
+            opt_spec = spec if spec != P() else self._wus_opt_spec(var, spec)
+            return VarPlan(
+                var_name=var.name, sync_kind="PS",
+                param_spec=spec, opt_spec=opt_spec, grad_reduce_axes=grad_axes,
+                reduction_destination=sync.reduction_destination,
+                destination_coords=self._resolve_destination(
+                    sync.reduction_destination),
+                staleness=sync.staleness,
+                local_replication=sync.local_replication,
+                partition_axis=axis, num_shards=num_shards,
+                sparse=var.sparse)
+
+        raise ValueError(f"node {node.var_name} has no synchronizer")

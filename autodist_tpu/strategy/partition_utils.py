@@ -1,0 +1,65 @@
+"""Shared partitioning arithmetic for strategy builders.
+
+Parity: the shard-count heuristics of the reference's partitioned strategies
+(``autodist/strategy/partitioned_ps_strategy.py:28-135`` — smallest divisor,
+``uneven_partition_ps_strategy.py:28-135`` — first non-divisor)."""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from autodist_tpu.graph_item import VarInfo
+
+
+def smallest_divisor_gt_one(n: int) -> Optional[int]:
+    """Smallest divisor of ``n`` greater than 1, or None if n <= 1."""
+    if n <= 1:
+        return None
+    i = 2
+    while i * i <= n:
+        if n % i == 0:
+            return i
+        i += 1
+    return n  # prime
+
+
+def first_non_divisor(n: int) -> Optional[int]:
+    """Smallest integer > 1 that does NOT divide ``n`` (uneven sharding)."""
+    if n <= 1:
+        return None
+    if n == 2:  # every int >2 is a non-divisor; reference picks the smallest
+        return None  # cannot shard a length-2 axis unevenly into >1 useful parts
+    i = 2
+    while n % i == 0:
+        i += 1
+    return i if i <= n else None
+
+
+def partition_str(shape: Sequence[int], axis: int, num_shards: int) -> str:
+    """Build the ``"1,4,1"`` partitioner string (one active axis only,
+    reference kernel/partitioner.py:38-150)."""
+    parts = ["1"] * len(shape)
+    parts[axis] = str(num_shards)
+    return ",".join(parts)
+
+
+def partitionable(var: VarInfo, axis: int = 0) -> bool:
+    """A variable can be partitioned along ``axis`` if that dim exists and
+    has length > 1 (reference partitioned_ps_strategy.py:90-110 skips scalars
+    and dim-1 axes; its control-flow-op exclusion has no JAX analog — there
+    is no graph to collide with)."""
+    return len(var.shape) > axis and var.shape[axis] > 1
+
+
+def greedy_load_balance(sizes, num_bins: int):
+    """Assign items to the currently least-loaded bin, in input order —
+    the reference's byte-size load balancing (ps_lb_strategy.py:91-117).
+
+    Returns (assignments, loads): assignments[i] = bin index of item i.
+    """
+    loads = [0.0] * num_bins
+    assignment = []
+    for s in sizes:
+        b = loads.index(min(loads))
+        assignment.append(b)
+        loads[b] += float(s)
+    return assignment, loads

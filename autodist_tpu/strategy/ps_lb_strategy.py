@@ -1,0 +1,48 @@
+"""PSLoadBalancing: greedy byte-size balancing of variables across PS nodes.
+
+Parity: reference ``autodist/strategy/ps_lb_strategy.py:23-117`` (the
+reference's DEFAULT strategy, autodist.py:70).  Each variable is assigned to
+the currently least-loaded reduction destination, load measured in bytes.
+"""
+from __future__ import annotations
+
+from autodist_tpu.graph_item import GraphItem
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.strategy.base import (
+    GraphConfig,
+    PSSynchronizerConfig,
+    Strategy,
+    StrategyBuilder,
+    VarConfig,
+)
+from autodist_tpu.strategy.partition_utils import greedy_load_balance
+
+
+class PSLoadBalancing(StrategyBuilder):
+    def __init__(self, local_proxy_variable: bool = False, sync: bool = True,
+                 staleness: int = 0):
+        self._local_proxy = local_proxy_variable
+        self._sync = sync
+        self._staleness = staleness
+
+    def build(self, graph_item: GraphItem, resource_spec: ResourceSpec) -> Strategy:
+        ps_devices = self.reduction_device_names(resource_spec)
+        variables = graph_item.trainable_var_infos
+        assignment, _ = greedy_load_balance(
+            [v.byte_size for v in variables], len(ps_devices))
+        node_config = [
+            VarConfig(
+                var_name=var.name,
+                synchronizer=PSSynchronizerConfig(
+                    reduction_destination=ps_devices[bin_idx],
+                    local_replication=self._local_proxy,
+                    sync=self._sync,
+                    staleness=self._staleness,
+                ),
+            )
+            for var, bin_idx in zip(variables, assignment)
+        ]
+        return Strategy(
+            node_config=node_config,
+            graph_config=GraphConfig(replicas=self.replica_devices(resource_spec)),
+        )

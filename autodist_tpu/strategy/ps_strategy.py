@@ -1,0 +1,44 @@
+"""PS strategy: every variable synchronized through a single parameter server.
+
+Parity: reference ``autodist/strategy/ps_strategy.py:21-76`` — all variables
+get a PSSynchronizer whose reduction destination is the first node's CPU;
+replicas are all compute devices.
+"""
+from __future__ import annotations
+
+from autodist_tpu.graph_item import GraphItem
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.strategy.base import (
+    GraphConfig,
+    PSSynchronizerConfig,
+    Strategy,
+    StrategyBuilder,
+    VarConfig,
+)
+
+
+class PS(StrategyBuilder):
+    def __init__(self, local_proxy_variable: bool = False, sync: bool = True,
+                 staleness: int = 0):
+        self._local_proxy = local_proxy_variable
+        self._sync = sync
+        self._staleness = staleness
+
+    def build(self, graph_item: GraphItem, resource_spec: ResourceSpec) -> Strategy:
+        reduction_device = self.reduction_device_names(resource_spec)[0]
+        node_config = [
+            VarConfig(
+                var_name=var.name,
+                synchronizer=PSSynchronizerConfig(
+                    reduction_destination=reduction_device,
+                    local_replication=self._local_proxy,
+                    sync=self._sync,
+                    staleness=self._staleness,
+                ),
+            )
+            for var in graph_item.trainable_var_infos
+        ]
+        return Strategy(
+            node_config=node_config,
+            graph_config=GraphConfig(replicas=self.replica_devices(resource_spec)),
+        )

@@ -1,0 +1,36 @@
+"""RandomAxisPartitionAR: shard along a RANDOM non-1 axis, all-reduce shards.
+
+Parity: reference
+``autodist/strategy/random_axis_partition_all_reduce_strategy.py:26-141`` —
+a seeded RNG picks any axis with length > 1 (axis 0 forced for sparse
+variables, since embedding shards must follow the vocab axis).
+"""
+from __future__ import annotations
+
+import random
+
+from autodist_tpu.graph_item import GraphItem
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.strategy.partitioned_all_reduce_strategy import PartitionedAR
+from autodist_tpu.strategy.partition_utils import smallest_divisor_gt_one
+
+
+class RandomAxisPartitionAR(PartitionedAR):
+    def __init__(self, chunk_size: int = 128, seed: int = 600,
+                 all_reduce_spec: str = "AUTO", compressor: str = "NoneCompressor"):
+        super().__init__(chunk_size=chunk_size, all_reduce_spec=all_reduce_spec,
+                         compressor=compressor)
+        self._rng = random.Random(seed)
+
+    def _choose_axis_and_shards(self, var, cap: int):
+        if var.sparse:
+            candidates = [0] if var.shape and var.shape[0] > 1 else []
+        else:
+            candidates = [i for i, d in enumerate(var.shape) if d > 1]
+        if not candidates:
+            return None, None
+        axis = self._rng.choice(candidates)
+        n = smallest_divisor_gt_one(var.shape[axis])
+        if n is None or n > cap:
+            return None, None
+        return axis, n

@@ -1,0 +1,17 @@
+"""UnevenPartitionedPS: shard along axis 0 into a NON-divisor shard count.
+
+Parity: reference ``autodist/strategy/uneven_partition_ps_strategy.py:28-135``
+whose ``get_num_shards`` returns the first integer > 1 that does not divide
+dim 0, producing uneven shards.  On TPU, GSPMD handles non-divisible sharding
+by padding, so uneven shard counts compile fine.
+"""
+from __future__ import annotations
+
+from autodist_tpu.strategy.partitioned_ps_strategy import PartitionedPS
+from autodist_tpu.strategy.partition_utils import first_non_divisor
+
+
+class UnevenPartitionedPS(PartitionedPS):
+    def _num_shards(self, dim0: int, cap: int) -> int:
+        n = first_non_divisor(dim0) or 1
+        return n if n <= cap else 1

@@ -1,0 +1,143 @@
+"""StrategyCompiler lowering tests."""
+import jax.numpy as jnp
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from autodist_tpu.graph_item import GraphItem
+from autodist_tpu.mesh import build_mesh
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.strategy import (
+    AllReduce,
+    Parallax,
+    PartitionedAR,
+    PartitionedPS,
+    PS,
+    StrategyCompiler,
+    parse_partitioner,
+)
+
+
+@pytest.fixture
+def gi():
+    params = {
+        "dense": {"kernel": jnp.zeros((8, 4)), "bias": jnp.zeros((4,))},
+        "emb": {"table": jnp.zeros((96, 8))},
+    }
+    return GraphItem(params, sparse_vars=["emb/table"])
+
+
+@pytest.fixture
+def spec():
+    return ResourceSpec(resource_info={"nodes": [{"address": "localhost", "chips": 8}]})
+
+
+def test_parse_partitioner():
+    assert parse_partitioner("") == (None, 1)
+    assert parse_partitioner("1,1") == (None, 1)
+    assert parse_partitioner("4,1") == (0, 4)
+    assert parse_partitioner("1,2,1") == (1, 2)
+    with pytest.raises(ValueError):
+        parse_partitioner("2,2")
+
+
+def test_allreduce_lowering(gi, spec):
+    mesh = build_mesh({"data": 8})
+    cs = StrategyCompiler(mesh).compile(AllReduce().build(gi, spec), gi)
+    plan = cs.plan_for("dense/kernel")
+    assert plan.sync_kind == "AllReduce"
+    assert plan.param_spec == P()
+    assert plan.opt_spec == P()
+    assert plan.grad_reduce_axes == ("data",)
+    assert cs.batch_spec() == P(("data",))
+
+
+def test_ps_lowering_is_wus(gi, spec):
+    mesh = build_mesh({"data": 8})
+    cs = StrategyCompiler(mesh).compile(PS().build(gi, spec), gi)
+    plan = cs.plan_for("dense/kernel")
+    assert plan.sync_kind == "PS"
+    assert plan.param_spec == P()           # replicated for compute
+    assert plan.opt_spec == P("data")       # update sharded: dim0=8 divisible
+    bias = cs.plan_for("dense/bias")
+    assert bias.opt_spec == P()             # (4,) not divisible by 8 → replicated
+
+
+def test_partitioned_ps_on_dp_mesh(gi, spec):
+    mesh = build_mesh({"data": 8})
+    cs = StrategyCompiler(mesh).compile(PartitionedPS().build(gi, spec), gi)
+    plan = cs.plan_for("dense/kernel")
+    # no model axis → PS shards live across the data axis (ZeRO-style)
+    assert plan.param_spec == P("data")
+    assert plan.partition_axis == 0
+
+
+def test_partitioned_ps_on_model_mesh(gi, spec):
+    mesh = build_mesh({"data": 4, "model": 2})
+    cs = StrategyCompiler(mesh).compile(PartitionedPS().build(gi, spec), gi)
+    plan = cs.plan_for("dense/kernel")
+    assert plan.param_spec == P("model")
+    assert plan.num_shards == 2
+
+
+def test_partitioned_ar_on_dp_mesh_stays_replicated(gi, spec):
+    mesh = build_mesh({"data": 8})
+    cs = StrategyCompiler(mesh).compile(PartitionedAR().build(gi, spec), gi)
+    plan = cs.plan_for("dense/kernel")
+    assert plan.param_spec == P()  # shards colocated with replicas
+
+
+def test_parallax_embedding_sharded(gi, spec):
+    mesh = build_mesh({"data": 8})
+    cs = StrategyCompiler(mesh).compile(Parallax().build(gi, spec), gi)
+    emb = cs.plan_for("emb/table")
+    assert emb.sync_kind == "PS"
+    assert emb.param_spec == P("data")  # vocab axis sharded
+    dense = cs.plan_for("dense/kernel")
+    assert dense.sync_kind == "AllReduce"
+    assert dense.param_spec == P()
+
+
+def test_param_sharding_tree(gi, spec):
+    mesh = build_mesh({"data": 8})
+    cs = StrategyCompiler(mesh).compile(Parallax().build(gi, spec), gi)
+    tree = cs.param_sharding_tree(gi.params)
+    assert tree["emb"]["table"].spec == P("data")
+    assert tree["dense"]["kernel"].spec == P()
+
+
+def test_unknown_var_pruned(gi, spec):
+    strategy = AllReduce().build(gi, spec)
+    strategy.node_config[0].var_name = "ghost/var"
+    mesh = build_mesh({"data": 8})
+    cs = StrategyCompiler(mesh).compile(strategy, gi)
+    assert "ghost/var" not in cs.var_plans
+    # the real var still gets a safe default plan
+    assert all(name in cs.var_plans
+               for name in ("dense/kernel", "dense/bias", "emb/table"))
+
+
+def test_destination_resolution(gi):
+    spec2 = ResourceSpec(resource_info={"nodes": [
+        {"address": "a", "chips": 4, "chief": True}, {"address": "b", "chips": 4}]})
+    mesh = build_mesh({"data": 8})
+    cs = StrategyCompiler(mesh, resource_spec=spec2).compile(
+        PS().build(gi, spec2), gi)
+    plan = cs.plan_for("dense/kernel")
+    # PS builder puts everything on node "a" (first CPU) → data coord 0
+    assert plan.destination_coords == {"data": 0}
+    from autodist_tpu.strategy import PSLoadBalancing
+    cs2 = StrategyCompiler(mesh, resource_spec=spec2).compile(
+        PSLoadBalancing().build(gi, spec2), gi)
+    coords = {p.destination_coords["data"] for p in cs2.var_plans.values()}
+    assert coords == {0, 4}  # balanced across both hosts
+
+
+def test_prime_axis_does_not_explode():
+    import numpy as np
+    gi2 = GraphItem({"emb": {"table": np.zeros((104729, 8), np.float32)}})
+    spec2 = ResourceSpec(resource_info={"nodes": [{"address": "a", "chips": 8}]})
+    s = PartitionedPS().build(gi2, spec2)
+    node = s.node_for("emb/table")
+    assert node.partitioner == ""  # prime > cap → unpartitioned
+    s2 = PartitionedAR().build(gi2, spec2)
+    assert s2.node_for("emb/table").partitioner == ""
