@@ -13,4 +13,12 @@ from autodist_tpu.resource_spec import DeviceSpec, ResourceSpec  # noqa: F401
 
 __version__ = "0.1.0"
 
-__all__ = ["ResourceSpec", "DeviceSpec", "ENV", "__version__"]
+__all__ = ["AutoDist", "ResourceSpec", "DeviceSpec", "ENV", "__version__"]
+
+
+def __getattr__(name):
+    # Lazy: importing the facade pulls in jax; keep `import autodist_tpu` light.
+    if name == "AutoDist":
+        from autodist_tpu.autodist import AutoDist
+        return AutoDist
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

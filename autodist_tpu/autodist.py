@@ -1,0 +1,173 @@
+"""User-facing facade.
+
+Parity target: reference ``AutoDist`` (``autodist/autodist.py:297-322``) —
+``AutoDist(resource_spec_file, strategy_builder)`` + ``scope()`` +
+``create_distributed_session()`` / ``function()``.
+
+TPU-native differences: the user *captures* the functional program explicitly
+(``capture(params, optimizer, loss_fn)``) instead of the reference's implicit
+graph+optimizer monkeypatch capture (``autodist/patch.py:40-116``); the
+"session" holds sharded state and runs a jitted step rather than driving a TF
+gRPC cluster.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Callable, Dict, Optional, Sequence
+
+from autodist_tpu.const import ENV
+from autodist_tpu.graph_item import GraphItem
+from autodist_tpu.kernel.graph_transformer import GraphTransformer
+from autodist_tpu.mesh import build_mesh
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.runner import DistributedSession
+from autodist_tpu.strategy.base import Strategy, StrategyBuilder
+from autodist_tpu.strategy.compiler import StrategyCompiler
+from autodist_tpu.utils import logging
+
+_default_autodist: Optional["AutoDist"] = None
+
+
+def get_default_autodist() -> Optional["AutoDist"]:
+    return _default_autodist
+
+
+def _set_default_autodist(ad: "AutoDist") -> None:
+    """One AutoDist per process (reference autodist.py:46-51); the guard is
+    relaxed under AUTODIST_IS_TESTING so test matrices can re-instantiate."""
+    global _default_autodist
+    if _default_autodist is not None and not ENV.AUTODIST_IS_TESTING.val:
+        raise RuntimeError("Only one AutoDist instance is allowed per process")
+    _default_autodist = ad
+
+
+class AutoDist:
+    """Facade: resource spec + strategy builder → compiled distributed step.
+
+    Args:
+      resource_spec_file: yaml path (or pass ``resource_spec``); omitting both
+        auto-derives a single-node spec from local devices.
+      strategy_builder: a :class:`StrategyBuilder`; defaults to
+        ``PSLoadBalancing`` (the reference's default, autodist.py:70).
+      mesh_axes: optional logical mesh shape override, e.g.
+        ``{"data": 4, "model": 2}``.
+    """
+
+    def __init__(self, resource_spec_file: Optional[str] = None,
+                 strategy_builder: Optional[StrategyBuilder] = None,
+                 resource_spec: Optional[ResourceSpec] = None,
+                 mesh_axes: Optional[Dict[str, int]] = None):
+        _set_default_autodist(self)
+        self._resource_spec = resource_spec or ResourceSpec(resource_spec_file)
+        if strategy_builder is None:
+            from autodist_tpu.strategy.ps_lb_strategy import PSLoadBalancing
+            strategy_builder = PSLoadBalancing()
+        self._strategy_builder = strategy_builder
+        self._mesh_axes = mesh_axes
+        self._graph_item: Optional[GraphItem] = None
+        self._session: Optional[DistributedSession] = None
+        self._strategy: Optional[Strategy] = None
+        self._in_scope = False
+
+    # -- capture -----------------------------------------------------------
+    @contextlib.contextmanager
+    def scope(self):
+        """Context for building/capturing the model (reference
+        autodist.py:309-322).  With the functional API this mainly marks the
+        capture region and enforces the build-before-run ordering."""
+        self._in_scope = True
+        try:
+            yield self
+        finally:
+            self._in_scope = False
+
+    def capture(self, params: Any, optimizer: Any = None,
+                loss_fn: Optional[Callable] = None,
+                sparse_vars: Sequence[str] = (),
+                untrainable_vars: Sequence[str] = (),
+                has_aux: bool = False) -> GraphItem:
+        """Capture the training program (the explicit analog of the
+        reference's optimizer/gradient monkeypatch hooks,
+        graph_item.py:72-108)."""
+        if self.is_built():
+            raise RuntimeError(
+                "Cannot capture after the distributed session was created "
+                "(reference graph-mutation guard, autodist.py:152-165)")
+        self._graph_item = GraphItem(
+            params, optimizer=optimizer, loss_fn=loss_fn,
+            sparse_vars=sparse_vars, untrainable_vars=untrainable_vars,
+            has_aux=has_aux)
+        return self._graph_item
+
+    @property
+    def graph_item(self) -> Optional[GraphItem]:
+        return self._graph_item
+
+    @property
+    def resource_spec(self) -> ResourceSpec:
+        return self._resource_spec
+
+    def is_built(self) -> bool:
+        return self._session is not None
+
+    # -- build pipeline (reference autodist.py:139-150) --------------------
+    def build_strategy(self) -> Strategy:
+        """Chief builds the strategy; workers deserialize the chief's by id
+        (reference _build_or_load_strategy, autodist.py:100-109)."""
+        if self._graph_item is None:
+            raise RuntimeError("capture() the program before building a strategy")
+        self._graph_item.prepare()
+        strategy_id = ENV.AUTODIST_STRATEGY_ID.val
+        if strategy_id:
+            logging.info("worker: loading strategy %s", strategy_id)
+            self._strategy = Strategy.deserialize(strategy_id)
+        else:
+            self._strategy = self._strategy_builder.build(
+                self._graph_item, self._resource_spec)
+            self._strategy.serialize()
+        return self._strategy
+
+    def create_distributed_session(self, mesh=None) -> DistributedSession:
+        """Full build pipeline: strategy → compile → transform → session
+        (reference _create_distributed_session, autodist.py:167-185)."""
+        if self._session is not None:
+            return self._session
+        if self._strategy is None:
+            self.build_strategy()
+        if mesh is None:
+            mesh = build_mesh(self._mesh_axes, resource_spec=self._resource_spec)
+        compiled = StrategyCompiler(
+            mesh, resource_spec=self._resource_spec).compile(
+                self._strategy, self._graph_item)
+        dist_step = GraphTransformer(compiled, self._graph_item).transform()
+        self._session = DistributedSession(self._graph_item, dist_step)
+        logging.info("distributed session created: strategy=%s mesh=%s",
+                     self._strategy.id, dict(mesh.shape))
+        return self._session
+
+    # -- TF2-style one-liner (reference autodist.py:204-289) ---------------
+    def function(self, fn: Optional[Callable] = None):
+        """Decorator parity with ``autodist.function``: wraps a per-batch
+        step; the first call builds the session, later calls run steps.
+
+        The decorated ``fn(batch)`` body is *declarative* in the reference
+        (it defines the graph); here the captured loss_fn/optimizer define
+        the step and ``fn``'s return value selects extra fetches from the
+        metrics dict (or None for all metrics)."""
+
+        def wrap(user_fn):
+            def run_fn(batch):
+                session = self.create_distributed_session()
+                metrics = session.run(batch)
+                out = user_fn(metrics) if user_fn is not None else metrics
+                return out if out is not None else metrics
+            return run_fn
+
+        if fn is not None and not callable(fn):
+            raise TypeError("ad.function expects a callable (or use @ad.function)")
+        return wrap(fn) if fn is not None else wrap(None)
+
+
+def _reset_default_autodist_for_testing() -> None:
+    global _default_autodist
+    _default_autodist = None

@@ -31,11 +31,10 @@ coordinates) and drives DCN placement on multi-slice meshes.
 """
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
 import jax
-import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from autodist_tpu.const import MESH_AXIS_DATA, MESH_AXIS_MODEL
@@ -153,6 +152,10 @@ class StrategyCompiler:
         return out
 
     # -- helpers -----------------------------------------------------------
+    def _grad_axes(self) -> Tuple[str, ...]:
+        return (MESH_AXIS_DATA,) \
+            if self.mesh.shape.get(MESH_AXIS_DATA, 1) > 1 else ()
+
     def _model_axis(self) -> Optional[str]:
         if self.mesh.shape.get(MESH_AXIS_MODEL, 1) > 1:
             return MESH_AXIS_MODEL
@@ -182,7 +185,27 @@ class StrategyCompiler:
 
     def _partition_spec(self, var: VarInfo, axis: Optional[int],
                         shard_mesh_axis: Optional[str]) -> P:
+        """Shard ``var``'s ``axis`` over ``shard_mesh_axis`` — if the dim
+        divides the mesh axis evenly.  Uneven strategy shard counts (the
+        UnevenPartitionedPS family) do not map onto GSPMD's even tiling; such
+        variables stay replicated on the mesh, while the strategy IR retains
+        the uneven plan for spec parity."""
         if axis is None or shard_mesh_axis is None:
+            return P()
+        axis_size = self.mesh.shape.get(shard_mesh_axis, 1)
+        if axis_size <= 1:
+            return P()
+        if var.shape[axis] % axis_size != 0:
+            # jit arg/out shardings and device_put require even tiling (only
+            # with_sharding_constraint pads), so an indivisible dim must stay
+            # replicated. Loud warning: for embeddings the fix is padding the
+            # vocab to a multiple of the mesh axis (good for MXU tiling too).
+            logging.warning(
+                "variable %s dim %d (size %d) is not divisible by mesh axis "
+                "%r (size %d); keeping it replicated. Pad the dimension to a "
+                "multiple of %d to enable sharding.",
+                var.name, axis, var.shape[axis], shard_mesh_axis, axis_size,
+                axis_size)
             return P()
         entries: List[Optional[str]] = [None] * len(var.shape)
         entries[axis] = shard_mesh_axis
@@ -224,12 +247,14 @@ class StrategyCompiler:
             plans[var.name] = self._compile_node(node, var, model_axis)
 
         # Untouched trainable vars: replicate + psum (safe default).
+        grad_axes = self._grad_axes()
         for name, var in known.items():
             if var.trainable and name not in plans:
                 plans[name] = VarPlan(
                     var_name=name, sync_kind="AllReduce", param_spec=P(),
-                    opt_spec=P(), grad_reduce_axes=(MESH_AXIS_DATA,))
-        return CompiledStrategy(strategy=strategy, mesh=self.mesh, var_plans=plans)
+                    opt_spec=P(), grad_reduce_axes=grad_axes)
+        return CompiledStrategy(strategy=strategy, mesh=self.mesh,
+                                var_plans=plans, batch_axes=grad_axes)
 
     def _compile_node(self, node: VarConfig, var: VarInfo,
                       model_axis: Optional[str]) -> VarPlan:
@@ -239,8 +264,7 @@ class StrategyCompiler:
                 f"partitioner {node.partitioner!r} invalid for {var.name} "
                 f"with shape {var.shape}")
         sync = node.synchronizer
-        grad_axes = (MESH_AXIS_DATA,) if self.mesh.shape.get(MESH_AXIS_DATA, 1) > 1 \
-            else ()
+        grad_axes = self._grad_axes()
 
         if isinstance(sync, AllReduceSynchronizerConfig):
             # Shards stay colocated with replicas (reference layout) —
@@ -260,9 +284,7 @@ class StrategyCompiler:
             if var.sparse and axis is None and var.shape:
                 # Sparse embedding on PS: shard the vocab axis so gradient
                 # scatter-adds land on the owning shard (Parallax lowering).
-                shard_axis2 = model_axis or MESH_AXIS_DATA
-                if var.shape[0] >= self.mesh.shape.get(shard_axis2, 1) > 1:
-                    spec = self._partition_spec(var, 0, shard_axis2)
+                spec = self._partition_spec(var, 0, model_axis or MESH_AXIS_DATA)
             opt_spec = spec if spec != P() else self._wus_opt_spec(var, spec)
             return VarPlan(
                 var_name=var.name, sync_kind="PS",

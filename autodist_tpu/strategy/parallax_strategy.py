@@ -27,6 +27,8 @@ class Parallax(StrategyBuilder):
     def __init__(self, chunk_size: int = 128, local_proxy_variable: bool = False,
                  sync: bool = True, staleness: int = 0,
                  all_reduce_spec: str = "AUTO", compressor: str = "NoneCompressor"):
+        if chunk_size < 1:
+            raise ValueError("chunk_size must be >= 1")
         self._chunk_size = chunk_size
         self._local_proxy = local_proxy_variable
         self._sync = sync

@@ -27,6 +27,8 @@ class PartitionedAR(StrategyBuilder):
                  compressor: str = "NoneCompressor", max_shards: int = 0):
         """``max_shards``: cap on shards per variable; 0 ⇒ number of replica
         devices (prevents prime-length axes exploding into per-element shards)."""
+        if chunk_size < 1:
+            raise ValueError("chunk_size must be >= 1")
         self._chunk_size = chunk_size
         self._spec = all_reduce_spec
         self._compressor = compressor
