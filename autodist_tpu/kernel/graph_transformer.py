@@ -14,8 +14,10 @@ shardings and jitting once*:
                    reduce-scatter + sharded update + all-gather
 
 The transformer emits a :class:`DistributedStep`: a jitted
-``(params, opt_state, batch) -> (params, opt_state, metrics)`` function with
-input/output shardings bound and buffers donated.
+``(params, opt_state, sync_state, batch) ->
+(params, opt_state, sync_state, metrics)`` function with input/output
+shardings bound and buffers donated (``sync_state`` carries per-device
+synchronizer state such as compressor residuals; empty on the GSPMD path).
 """
 from __future__ import annotations
 
@@ -34,10 +36,16 @@ from autodist_tpu.utils import logging
 
 @dataclass
 class DistributedStep:
-    """The compiled training step plus everything needed to run it."""
+    """The compiled training step plus everything needed to run it.
 
-    step_fn: Callable            # jitted (params, opt_state, batch) -> (params, opt_state, metrics)
+    ``step_fn(params, opt_state, sync_state, batch)`` →
+    ``(params, opt_state, sync_state, metrics)``.  ``sync_state`` carries
+    per-device synchronizer state (compressor residuals etc.); it is an empty
+    dict on the GSPMD path."""
+
+    step_fn: Callable
     init_fn: Callable            # jitted params -> opt_state (sharded)
+    init_sync_state: Callable    # () -> sync-state pytree
     param_shardings: Any         # pytree of NamedSharding
     opt_shardings: Any
     batch_sharding: NamedSharding
@@ -79,6 +87,16 @@ class GraphTransformer:
         mesh = self.compiled.mesh
         params = gi.params
 
+        from autodist_tpu.const import MESH_AXIS_DATA
+        from autodist_tpu.kernel.synchronization import explicit_sync
+        if explicit_sync.uses_explicit_path(self.compiled):
+            if mesh.shape.get(MESH_AXIS_DATA, 1) > 1:
+                return self._transform_explicit(extra_metrics_fn)
+            # No data axis ⇒ no gradient traffic to compress; the GSPMD path
+            # is equivalent and supports arbitrary meshes.
+            logging.info("compressors requested but mesh has no data axis; "
+                         "using the GSPMD path (nothing to compress)")
+
         param_spec_tree = su.spec_tree_for_params(params, self._param_specs())
         grad_spec_tree = su.spec_tree_for_params(params, self._opt_specs())
         param_sh = su.sharding_tree(mesh, param_spec_tree)
@@ -97,7 +115,7 @@ class GraphTransformer:
         optimizer = gi.optimizer
         has_aux = gi.has_aux
 
-        def step(params, opt_state, batch):
+        def step(params, opt_state, sync_state, batch):
             if has_aux:
                 (loss, aux), grads = vg(params, batch)
             else:
@@ -118,24 +136,47 @@ class GraphTransformer:
                 metrics["aux"] = aux
             if extra_metrics_fn is not None:
                 metrics.update(extra_metrics_fn(params, batch))
-            return params, opt_state, metrics
+            return params, opt_state, sync_state, metrics
 
-        with mesh:
-            step_fn = jax.jit(
-                step,
-                in_shardings=(param_sh, opt_sh, batch_sh),
-                out_shardings=(param_sh, opt_sh, None),
-                donate_argnums=(0, 1),
-            )
-            init_fn = jax.jit(gi.optimizer.init, out_shardings=opt_sh)
+        step_fn = jax.jit(
+            step,
+            in_shardings=(param_sh, opt_sh, None, batch_sh),
+            out_shardings=(param_sh, opt_sh, None, None),
+            donate_argnums=(0, 1),
+        )
+        init_fn = jax.jit(gi.optimizer.init, out_shardings=opt_sh)
 
         logging.info(
             "GraphTransformer: compiled step over mesh %s (%d vars: %s)",
             dict(mesh.shape), len(self.compiled.var_plans),
             _plan_summary(self.compiled))
         return DistributedStep(
-            step_fn=step_fn, init_fn=init_fn,
+            step_fn=step_fn, init_fn=init_fn, init_sync_state=dict,
             param_shardings=param_sh, opt_shardings=opt_sh,
+            batch_sharding=batch_sh, mesh=mesh,
+            compiled_strategy=self.compiled)
+
+    def _transform_explicit(self, extra_metrics_fn: Optional[Callable] = None
+                            ) -> DistributedStep:
+        """Compressor-carrying programs run the whole step inside shard_map
+        with manual collectives (see explicit_sync module docstring)."""
+        from autodist_tpu.kernel.synchronization import explicit_sync
+
+        gi = self.graph_item
+        mesh = self.compiled.mesh
+        has_partitioned = any(p.param_spec != P()
+                              for p in self.compiled.var_plans.values())
+        step_fn, init_fn, init_sync, replicated = \
+            explicit_sync.make_explicit_step(gi, self.compiled, has_partitioned,
+                                             extra_metrics_fn=extra_metrics_fn)
+        param_sh = jax.tree_util.tree_map(lambda _: replicated, gi.params)
+        batch_sh = self.compiled.batch_sharding()
+        logging.info(
+            "GraphTransformer: compiled EXPLICIT step over mesh %s (%d vars)",
+            dict(mesh.shape), len(self.compiled.var_plans))
+        return DistributedStep(
+            step_fn=step_fn, init_fn=init_fn, init_sync_state=init_sync,
+            param_shardings=param_sh, opt_shardings=replicated,
             batch_sharding=batch_sh, mesh=mesh,
             compiled_strategy=self.compiled)
 

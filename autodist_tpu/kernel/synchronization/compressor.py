@@ -1,0 +1,138 @@
+"""Gradient compressors wrapping the data-axis all-reduce.
+
+Parity: reference ``autodist/kernel/synchronization/compressor.py`` —
+``NoneCompressor`` (:36-96, identity), ``HorovodCompressor`` (:146-176,
+dtype-cast compression), ``HorovodCompressorEF`` (:208-284, error feedback),
+``PowerSGDCompressor`` (commented out in the reference; implemented here as
+a rank-r low-rank compressor since TPU matmuls make it cheap).
+
+TPU-native formulation: a compressor is a pure function around
+``lax.pmean``/``psum`` inside a ``shard_map`` over the ``data`` axis.  Any
+per-worker persistent state (error-feedback residuals, PowerSGD factors) is
+carried explicitly as a *sync state* pytree, sharded so each data shard owns
+its own slice — functional replacement for the reference's stateful mirror
+variables.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class Compressor:
+    """Base: compress → all-reduce → decompress, with optional state."""
+
+    name = "Compressor"
+
+    def init_state(self, var_value) -> Any:
+        """Per-device sync state for one variable (local shape — the explicit
+        path stacks it along a leading per-shard axis). None if stateless."""
+        return None
+
+    def reduce(self, grad, state, axis_name: str) -> Tuple[Any, Any]:
+        """Return (globally averaged gradient, new state)."""
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    """Identity compression: plain pmean (reference compressor.py:36-96)."""
+
+    name = "NoneCompressor"
+
+    def reduce(self, grad, state, axis_name):
+        return lax.pmean(grad, axis_name), state
+
+
+class HorovodCompressor(Compressor):
+    """Cast-down compression: reduce in lower precision, cast back
+    (reference compressor.py:146-176).  On TPU the wire format is bfloat16 —
+    same exponent range as fp32, so no overflow handling is needed."""
+
+    name = "HorovodCompressor"
+
+    def __init__(self, wire_dtype=jnp.bfloat16):
+        self._wire = wire_dtype
+
+    def reduce(self, grad, state, axis_name):
+        orig = grad.dtype
+        compressed = grad.astype(self._wire)
+        summed = lax.pmean(compressed, axis_name)
+        return summed.astype(orig), state
+
+
+class HorovodCompressorEF(Compressor):
+    """Error-feedback cast compression (reference compressor.py:208-284):
+    the quantization error of each round is added back before the next
+    compression, preserving convergence (Karimireddy et al., 2019)."""
+
+    name = "HorovodCompressorEF"
+
+    def __init__(self, wire_dtype=jnp.bfloat16):
+        self._wire = wire_dtype
+
+    def init_state(self, var_value):
+        return jnp.zeros_like(var_value)
+
+    def reduce(self, grad, state, axis_name):
+        corrected = grad + state
+        compressed = corrected.astype(self._wire)
+        new_state = corrected - compressed.astype(grad.dtype)  # local residual
+        summed = lax.pmean(compressed, axis_name)
+        return summed.astype(grad.dtype), new_state
+
+
+class PowerSGDCompressor(Compressor):
+    """Rank-r PowerSGD (Vogels et al., 2019).  The reference carries a
+    commented-out implementation (compressor.py:208-284 vicinity); on TPU the
+    two small matmuls ride the MXU so low-rank compression is near-free.
+
+    Only applied to rank-2 gradients; others fall back to pmean.  State is
+    ``(Q, residual)``: the power-iteration basis and the error feedback.
+    """
+
+    name = "PowerSGDCompressor"
+
+    def __init__(self, rank: int = 1):
+        self.rank = rank
+
+    def init_state(self, var_value):
+        shape = tuple(var_value.shape)
+        if len(shape) != 2:
+            return None
+        n, m = shape
+        # Deterministic init: varied, full-rank-ish basis.
+        q = jax.random.normal(jax.random.PRNGKey(n * 31 + m), (m, self.rank),
+                              dtype=var_value.dtype)
+        residual = jnp.zeros(shape, var_value.dtype)
+        return {"q": q, "residual": residual}
+
+    def reduce(self, grad, state, axis_name):
+        if state is None or grad.ndim != 2:
+            return lax.pmean(grad, axis_name), state
+        q, residual = state["q"], state["residual"]
+        corrected = grad + residual
+        # P = M Q ; all-reduce P ; orthonormalize ; Q = Mᵀ P̂ ; all-reduce Q
+        p = corrected @ q
+        p = lax.pmean(p, axis_name)
+        p_hat, _ = jnp.linalg.qr(p)
+        new_q = corrected.T @ p_hat
+        new_q = lax.pmean(new_q, axis_name)
+        approx = p_hat @ new_q.T
+        new_residual = corrected - approx
+        return approx, {"q": new_q, "residual": new_residual}
+
+
+_REGISTRY: Dict[str, type] = {
+    c.name: c for c in (NoneCompressor, HorovodCompressor, HorovodCompressorEF,
+                        PowerSGDCompressor)
+}
+
+
+def get_compressor(name: str) -> Compressor:
+    if name not in _REGISTRY:
+        raise ValueError(f"unknown compressor {name!r}; "
+                         f"available: {sorted(_REGISTRY)}")
+    return _REGISTRY[name]()

@@ -1,0 +1,131 @@
+"""Explicit (shard_map) synchronization path.
+
+The GSPMD path lets XLA insert collectives; this path takes manual control of
+the gradient all-reduce so a :class:`Compressor` can wrap it — the analog of
+the reference's AllReduceSynchronizer inserting ``collective_ops.all_reduce``
+through a compressor (``all_reduce_synchronizer.py:100-127``,
+``compressor.py:85-96``).
+
+Semantics: the whole train step runs inside ``shard_map`` over the mesh.
+Parameters and optimizer state are replicated; the batch is sharded over
+``data``; each device computes local gradients, every variable's gradient is
+averaged over ``data`` through its compressor, and the (identical) update is
+applied on all devices.  Per-device compressor state (error-feedback
+residuals, PowerSGD factors) is carried as a *sync state* pytree with a
+leading per-shard axis, sharded over ``data`` so each device owns its slice.
+
+Restriction: compressors require replicated parameters — model-axis
+partitioned variables would make the user's loss function responsible for
+manual tensor-parallel math inside shard_map.  The transformer falls back to
+replication (with a warning) for such variables when a compressor is active.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from autodist_tpu.const import MESH_AXIS_DATA
+from autodist_tpu.graph_item import GraphItem, path_name
+from autodist_tpu.kernel.synchronization.compressor import (
+    Compressor,
+    get_compressor,
+)
+from autodist_tpu.strategy.compiler import CompiledStrategy
+from autodist_tpu.utils import logging
+
+
+def uses_explicit_path(compiled: CompiledStrategy) -> bool:
+    return any(plan.compressor not in ("", "NoneCompressor")
+               for plan in compiled.var_plans.values())
+
+
+def _compressors_for(gi: GraphItem, compiled: CompiledStrategy
+                     ) -> Dict[str, Compressor]:
+    out: Dict[str, Compressor] = {}
+    for name, leaf in gi.name_to_leaf().items():
+        plan = compiled.var_plans.get(name)
+        comp_name = plan.compressor if plan else "NoneCompressor"
+        out[name] = get_compressor(comp_name or "NoneCompressor")
+    return out
+
+
+def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy,
+                       has_partitioned_vars: bool,
+                       extra_metrics_fn: Optional[Callable] = None):
+    """Returns (step_fn, init_opt_fn, init_sync_state_fn, shardings...)
+    consumed by the GraphTransformer."""
+    import optax
+
+    mesh = compiled.mesh
+    d = mesh.shape.get(MESH_AXIS_DATA, 1)
+    if has_partitioned_vars:
+        logging.warning(
+            "compressors force replicated parameters on the explicit sync "
+            "path; model-axis partitioning is ignored for this program")
+
+    comps = _compressors_for(gi, compiled)
+    vg = jax.value_and_grad(gi.loss_fn, has_aux=gi.has_aux)
+    optimizer = gi.optimizer
+    has_aux = gi.has_aux
+
+    # -- sync state --------------------------------------------------------
+    def init_sync_state():
+        state: Dict[str, Any] = {}
+        for name, leaf in gi.name_to_leaf().items():
+            per_dev = comps[name].init_state(jnp.asarray(leaf))
+            if per_dev is None:
+                continue
+            state[name] = jax.tree_util.tree_map(
+                lambda s: jnp.broadcast_to(s[None], (d,) + s.shape).copy(),
+                per_dev)
+        return jax.device_put(state, NamedSharding(mesh, P(MESH_AXIS_DATA)))
+
+    # -- the local (per-shard) step ---------------------------------------
+    def local_step(params, opt_state, sync_state, batch):
+        if has_aux:
+            (loss, aux), grads = vg(params, batch)
+        else:
+            loss, grads = vg(params, batch)
+            aux = None
+
+        flat, treedef = jax.tree_util.tree_flatten_with_path(grads)
+        new_sync = dict(sync_state)
+        synced = []
+        for path, g in flat:
+            name = path_name(path)
+            st = sync_state.get(name)
+            local_st = None if st is None else jax.tree_util.tree_map(
+                lambda x: jnp.squeeze(x, 0), st)
+            g2, st2 = comps[name].reduce(g, local_st, MESH_AXIS_DATA)
+            if st2 is not None and name in new_sync:
+                new_sync[name] = jax.tree_util.tree_map(
+                    lambda x: jnp.expand_dims(x, 0), st2)
+            synced.append(g2)
+        grads = jax.tree_util.tree_unflatten(
+            treedef, synced) if synced else grads
+
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        metrics = {"loss": lax.pmean(loss, MESH_AXIS_DATA)}
+        if aux is not None:
+            metrics["aux"] = jax.tree_util.tree_map(
+                lambda x: lax.pmean(x, MESH_AXIS_DATA), aux)
+        if extra_metrics_fn is not None:
+            metrics.update(jax.tree_util.tree_map(
+                lambda x: lax.pmean(x, MESH_AXIS_DATA),
+                extra_metrics_fn(params, batch)))
+        return params, opt_state, new_sync, metrics
+
+    mapped = jax.shard_map(
+        local_step, mesh=mesh,
+        in_specs=(P(), P(), P(MESH_AXIS_DATA), P(MESH_AXIS_DATA)),
+        out_specs=(P(), P(), P(MESH_AXIS_DATA), P()))
+    step_fn = jax.jit(mapped, donate_argnums=(0, 1, 2))
+
+    replicated = NamedSharding(mesh, P())
+    init_opt_fn = jax.jit(optimizer.init, out_shardings=replicated)
+    return step_fn, init_opt_fn, init_sync_state, replicated

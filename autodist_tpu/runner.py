@@ -34,6 +34,7 @@ class DistributedSession:
         self._step = dist_step
         self._params = dist_step.place_params(graph_item.params)
         self._opt_state = dist_step.init_fn(self._params)
+        self._sync_state = dist_step.init_sync_state()
         self._step_count = 0
 
     # -- state -------------------------------------------------------------
@@ -69,8 +70,9 @@ class DistributedSession:
         Returns host metrics: at least ``{"loss": ...}``.
         """
         batch = self._step.place_batch(batch)
-        self._params, self._opt_state, metrics = self._step.step_fn(
-            self._params, self._opt_state, batch)
+        self._params, self._opt_state, self._sync_state, metrics = \
+            self._step.step_fn(self._params, self._opt_state,
+                               self._sync_state, batch)
         self._step_count += 1
         return jax.tree_util.tree_map(lambda x: np.asarray(x), metrics)
 
@@ -86,3 +88,4 @@ class DistributedSession:
         them with the strategy's shardings."""
         self._params = self._step.place_params(params)
         self._opt_state = self._step.init_fn(self._params)
+        self._sync_state = self._step.init_sync_state()
