@@ -1,0 +1,2 @@
+from autodist_tpu.checkpoint.saver import Saver  # noqa: F401
+from autodist_tpu.checkpoint.saved_model_builder import SavedModelBuilder  # noqa: F401

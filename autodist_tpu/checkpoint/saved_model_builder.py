@@ -1,0 +1,79 @@
+"""SavedModel-equivalent export.
+
+Parity target: reference ``autodist/checkpoint/saved_model_builder.py:24-64``
+(wraps TF's SavedModelBuilder; requires an AutoDist saver).  The TPU-native
+serving artifact is a **StableHLO export** (``jax.export``): the jitted apply
+function is serialized together with the checkpointed parameters, producing a
+self-contained directory loadable without the model's Python code.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.export
+import numpy as np
+
+from autodist_tpu.checkpoint.saver import Saver, save_params
+from autodist_tpu.utils import logging
+
+
+def _abstract(x):
+    """Shape/dtype without materializing to host (sharded arrays on a
+    multi-host mesh are not np.asarray-able)."""
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is None or dtype is None:
+        arr = np.asarray(x)
+        shape, dtype = arr.shape, arr.dtype
+    return jax.ShapeDtypeStruct(tuple(shape), dtype)
+
+
+class SavedModelBuilder:
+    """Export ``apply_fn(params, *inputs)`` + params for serving.
+
+    ``platforms`` controls the lowering targets baked into the artifact;
+    the default covers CPU serving of TPU-trained models."""
+
+    def __init__(self, export_dir: str,
+                 platforms: Sequence[str] = ("cpu", "tpu")):
+        self._dir = export_dir
+        self._platforms = tuple(platforms)
+        os.makedirs(export_dir, exist_ok=True)
+
+    def add_graph_and_variables(self, apply_fn: Callable, params: Any,
+                                example_inputs: Sequence[Any]) -> None:
+        """Serialize the function (traced on abstract inputs) and the
+        parameter values."""
+        abstract_params = jax.tree_util.tree_map(_abstract, params)
+        abstract_inputs = tuple(_abstract(x) for x in example_inputs)
+        exported = jax.export.export(
+            jax.jit(apply_fn), platforms=self._platforms)(
+                abstract_params, *abstract_inputs)
+        with open(os.path.join(self._dir, "model.stablehlo"), "wb") as f:
+            f.write(exported.serialize())
+        save_params(os.path.join(self._dir, "variables"), params)
+        with open(os.path.join(self._dir, "saved_model_meta.json"), "w",
+                  encoding="utf-8") as f:
+            json.dump({
+                "num_inputs": len(example_inputs),
+                "input_shapes": [list(np.shape(x)) for x in example_inputs],
+            }, f)
+
+    def save(self) -> str:
+        logging.info("saved model exported to %s", self._dir)
+        return self._dir
+
+
+def load_saved_model(export_dir: str):
+    """Load an exported model: returns ``fn(*inputs)`` with params bound."""
+    with open(os.path.join(export_dir, "model.stablehlo"), "rb") as f:
+        exported = jax.export.deserialize(f.read())
+    params = Saver.restore_params(os.path.join(export_dir, "variables"))
+
+    def fn(*inputs):
+        return exported.call(params, *inputs)
+
+    return fn

@@ -1,0 +1,168 @@
+"""Checkpointing with single-device interchangeability.
+
+Parity target: reference ``autodist/checkpoint/saver.py:27-133`` — a Saver
+whose checkpoints use the ORIGINAL single-node variable names/layout, so a
+distributed run's checkpoint restores into a plain single-device program and
+vice versa (the reference's strongest tested invariant,
+``tests/checkpoint/test_partitionedPS_saver.py``), including partitioned
+variables reassembled as one logical tensor (``kernel/partitioner.py:252-347``
+via SaveSliceInfo).
+
+TPU-natively this is Orbax: checkpoints are written against the *global*
+logical shape of every array regardless of its sharding, so a PartitionedPS
+run, an AllReduce run, and a single-device run all produce and accept the
+same checkpoint; only the restore-time sharding differs.
+
+Layout of one checkpoint: ``<dir>/step_N/{params, opt_state[, sync_state],
+autodist_meta.json}`` — separate Orbax items so the params-only interchange
+path never reads optimizer slots (~2x the params' bytes under Adam).
+Optimizer slots and per-device synchronizer state (compressor residuals) are
+saved so resume is exact.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, Optional
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from autodist_tpu.utils import logging
+
+_STEP_RE = re.compile(r"^step_(\d+)$")
+
+
+class Saver:
+    """Save/restore a :class:`DistributedSession`'s state.
+
+    Like the reference (which required the Saver be created before the
+    distributed session so its SaverDef lands in GraphItem.info), binding
+    happens at construction; unlike it, late binding via ``session=`` on
+    save/restore is also allowed.
+    """
+
+    def __init__(self, session=None):
+        self._session = session
+        self._ckptr = ocp.StandardCheckpointer()
+
+    # -- helpers -----------------------------------------------------------
+    @staticmethod
+    def _step_dir(directory: str, step: int) -> str:
+        return os.path.join(directory, f"step_{step}")
+
+    @staticmethod
+    def latest_step(directory: str) -> Optional[int]:
+        if not os.path.isdir(directory):
+            return None
+        steps = [int(m.group(1)) for name in os.listdir(directory)
+                 if (m := _STEP_RE.match(name))]
+        return max(steps) if steps else None
+
+    @staticmethod
+    def latest_checkpoint(directory: str) -> Optional[str]:
+        step = Saver.latest_step(directory)
+        return None if step is None else Saver._step_dir(directory, step)
+
+    def _save_item(self, path: str, item: Any) -> None:
+        self._ckptr.save(os.path.abspath(path), item, force=True)
+        self._ckptr.wait_until_finished()
+
+    # -- save --------------------------------------------------------------
+    def save(self, directory: str, step: Optional[int] = None,
+             session=None) -> str:
+        session = session or self._session
+        if session is None:
+            raise ValueError("Saver has no bound session")
+        step = session.step_count if step is None else step
+        path = self._step_dir(directory, step)
+        os.makedirs(path, exist_ok=True)
+        self._save_item(os.path.join(path, "params"), session.sharded_params)
+        self._save_item(os.path.join(path, "opt_state"), session.opt_state)
+        has_sync = bool(jax.tree_util.tree_leaves(session.sync_state))
+        if has_sync:
+            self._save_item(os.path.join(path, "sync_state"),
+                            session.sync_state)
+        with open(os.path.join(path, "autodist_meta.json"), "w",
+                  encoding="utf-8") as f:
+            json.dump({"step": step, "has_sync_state": has_sync}, f)
+        logging.info("checkpoint saved: %s (step %d)", path, step)
+        return path
+
+    # -- restore -----------------------------------------------------------
+    def restore(self, path: str, session=None) -> int:
+        """Restore params + optimizer state (+ synchronizer state) into the
+        (possibly differently sharded) session; returns the step."""
+        session = session or self._session
+        if session is None:
+            raise ValueError("Saver has no bound session")
+        path = os.path.abspath(path)
+        params = self._ckptr.restore(
+            os.path.join(path, "params"),
+            _abstract_like(session.sharded_params))
+        opt_state = self._ckptr.restore(
+            os.path.join(path, "opt_state"),
+            _abstract_like(session.opt_state))
+        meta = _read_meta(path)
+        sync_state = None
+        if meta.get("has_sync_state") and \
+                jax.tree_util.tree_leaves(session.sync_state):
+            sync_state = self._ckptr.restore(
+                os.path.join(path, "sync_state"),
+                _abstract_like(session.sync_state))
+        step = int(meta.get("step", 0))
+        session.load_state(params, opt_state, step, sync_state=sync_state)
+        logging.info("checkpoint restored: %s (step %d)", path, step)
+        return step
+
+    @staticmethod
+    def restore_params(path: str) -> Any:
+        """Restore ONLY parameters as host numpy arrays in the original
+        single-device layout — the interchange path: a plain JAX program can
+        consume the result of any distributed run, on ANY topology (a
+        single TPU chip can read a checkpoint written by a 64-chip mesh).
+        Reads only the params item, never the optimizer slots."""
+        path = os.path.abspath(os.path.join(path, "params"))
+        ckptr = ocp.StandardCheckpointer()
+        # Restoring without a target replays the original device topology,
+        # which breaks across machines; build a replicated-on-current-devices
+        # target from the checkpoint's own shape/dtype metadata instead.
+        meta = ckptr.metadata(path).item_metadata.tree
+        dev = jax.local_devices()[0]
+        sharding = jax.sharding.SingleDeviceSharding(dev)
+        abstract = jax.tree_util.tree_map(
+            lambda m: jax.ShapeDtypeStruct(tuple(m.shape), m.dtype,
+                                           sharding=sharding), meta)
+        params = ckptr.restore(path, abstract)
+        return jax.tree_util.tree_map(np.asarray, params)
+
+
+def save_params(path: str, params: Any) -> str:
+    """Module-level utility: save a bare params pytree (e.g. from a
+    single-device run) in the same layout Saver produces, so distributed
+    sessions can ``restore_params`` it."""
+    path = os.path.abspath(path)
+    os.makedirs(path, exist_ok=True)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.join(path, "params"), params, force=True)
+    ckptr.wait_until_finished()
+    return path
+
+
+def _abstract_like(tree: Any) -> Any:
+    return jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+        if hasattr(x, "sharding") else jax.ShapeDtypeStruct(
+            np.shape(x), np.asarray(x).dtype),
+        tree)
+
+
+def _read_meta(path: str) -> dict:
+    meta = os.path.join(path, "autodist_meta.json")
+    if os.path.exists(meta):
+        with open(meta, "r", encoding="utf-8") as f:
+            return json.load(f)
+    m = _STEP_RE.match(os.path.basename(path))
+    return {"step": int(m.group(1)) if m else 0}

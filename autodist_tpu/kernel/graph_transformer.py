@@ -51,9 +51,17 @@ class DistributedStep:
     batch_sharding: NamedSharding
     mesh: Any
     compiled_strategy: CompiledStrategy
+    _placer: Optional[Callable] = None
 
     def place_params(self, params):
-        return jax.device_put(params, self.param_shardings)
+        # A jitted identity (not device_put): device_put may alias the
+        # caller's buffers when layouts already match, and the step's
+        # donation would then delete the user's original arrays.  Cached so
+        # repeated placement (set_params/restore) compiles once.
+        if self._placer is None:
+            self._placer = jax.jit(lambda p: p,
+                                   out_shardings=self.param_shardings)
+        return self._placer(params)
 
     def place_batch(self, batch):
         return jax.tree_util.tree_map(

@@ -54,6 +54,12 @@ class DistributedSession:
         return self._opt_state
 
     @property
+    def sync_state(self):
+        """Per-device synchronizer state (compressor residuals etc.); empty
+        dict on the GSPMD path."""
+        return self._sync_state
+
+    @property
     def step_count(self) -> int:
         return self._step_count
 
@@ -85,7 +91,19 @@ class DistributedSession:
 
     def set_params(self, params) -> None:
         """Load new parameter values (e.g. from a checkpoint), re-placing
-        them with the strategy's shardings."""
+        them with the strategy's shardings.  Optimizer state is re-initialized."""
         self._params = self._step.place_params(params)
         self._opt_state = self._step.init_fn(self._params)
         self._sync_state = self._step.init_sync_state()
+
+    def load_state(self, params, opt_state, step: int = 0,
+                   sync_state=None) -> None:
+        """Full resume: params + optimizer state + step counter (+ optional
+        synchronizer state, e.g. compressor residuals — without it, resume of
+        a compressed run is approximate).  Values must already be
+        placed/resharded."""
+        self._params = params
+        self._opt_state = opt_state
+        self._sync_state = (sync_state if sync_state is not None
+                            else self._step.init_sync_state())
+        self._step_count = step
