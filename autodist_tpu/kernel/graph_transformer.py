@@ -48,7 +48,6 @@ class DistributedStep:
     init_sync_state: Callable    # () -> sync-state pytree
     param_shardings: Any         # pytree of NamedSharding
     opt_shardings: Any
-    batch_sharding: NamedSharding
     mesh: Any
     compiled_strategy: CompiledStrategy
     _placer: Optional[Callable] = None
@@ -65,7 +64,7 @@ class DistributedStep:
 
     def place_batch(self, batch):
         return jax.tree_util.tree_map(
-            lambda x: jax.device_put(x, self.batch_sharding), batch)
+            jax.device_put, batch, self.compiled_strategy.batch_shardings(batch))
 
 
 class GraphTransformer:
@@ -111,7 +110,6 @@ class GraphTransformer:
         # NamedSharding trees for in-step constraints: a bare PartitionSpec
         # needs an ambient mesh at trace time, which jit tracing doesn't have.
         grad_sh = su.sharding_tree(mesh, grad_spec_tree)
-        batch_sh = self.compiled.batch_sharding()
 
         # Optimizer-state layout: param-shaped blocks follow the per-variable
         # opt_spec (weight-update sharding for PS vars); scalars replicate.
@@ -146,9 +144,12 @@ class GraphTransformer:
                 metrics.update(extra_metrics_fn(params, batch))
             return params, opt_state, sync_state, metrics
 
+        # Batch shardings are per-leaf (data on dim 0, seq on dim 1 where it
+        # applies) — leave them unspecified and let placed arguments carry
+        # their own layout.
         step_fn = jax.jit(
             step,
-            in_shardings=(param_sh, opt_sh, None, batch_sh),
+            in_shardings=(param_sh, opt_sh, None, None),
             out_shardings=(param_sh, opt_sh, None, None),
             donate_argnums=(0, 1),
         )
@@ -161,8 +162,7 @@ class GraphTransformer:
         return DistributedStep(
             step_fn=step_fn, init_fn=init_fn, init_sync_state=dict,
             param_shardings=param_sh, opt_shardings=opt_sh,
-            batch_sharding=batch_sh, mesh=mesh,
-            compiled_strategy=self.compiled)
+            mesh=mesh, compiled_strategy=self.compiled)
 
     def _transform_explicit(self, extra_metrics_fn: Optional[Callable] = None
                             ) -> DistributedStep:
@@ -178,15 +178,13 @@ class GraphTransformer:
             explicit_sync.make_explicit_step(gi, self.compiled, has_partitioned,
                                              extra_metrics_fn=extra_metrics_fn)
         param_sh = jax.tree_util.tree_map(lambda _: replicated, gi.params)
-        batch_sh = self.compiled.batch_sharding()
         logging.info(
             "GraphTransformer: compiled EXPLICIT step over mesh %s (%d vars)",
             dict(mesh.shape), len(self.compiled.var_plans))
         return DistributedStep(
             step_fn=step_fn, init_fn=init_fn, init_sync_state=init_sync,
             param_shardings=param_sh, opt_shardings=replicated,
-            batch_sharding=batch_sh, mesh=mesh,
-            compiled_strategy=self.compiled)
+            mesh=mesh, compiled_strategy=self.compiled)
 
 
 def _plan_summary(compiled: CompiledStrategy) -> Dict[str, int]:

@@ -1,0 +1,28 @@
+"""Model zoo — the reference's benchmark families, TPU-first.
+
+ResNet-50/101, VGG16, DenseNet121, InceptionV3 (imagenet.py parity),
+BERT-base/large (bert.py parity), lm1b LSTM (examples/lm1b parity),
+NCF (MovieLens parity), plus the flagship TransformerLM (new scope for
+long-context/multi-dim parallelism).
+"""
+from autodist_tpu.models.base import ModelSpec, cross_entropy_loss  # noqa: F401
+from autodist_tpu.models.bert import bert, bert_base, bert_large  # noqa: F401
+from autodist_tpu.models.densenet import densenet121  # noqa: F401
+from autodist_tpu.models.inception import inception_v3  # noqa: F401
+from autodist_tpu.models.lm1b import lm1b  # noqa: F401
+from autodist_tpu.models.ncf import ncf  # noqa: F401
+from autodist_tpu.models.resnet import resnet50, resnet101  # noqa: F401
+from autodist_tpu.models.transformer_lm import transformer_lm  # noqa: F401
+from autodist_tpu.models.vgg import vgg16  # noqa: F401
+
+ALL_MODELS = {
+    "resnet50": resnet50,
+    "resnet101": resnet101,
+    "vgg16": vgg16,
+    "densenet121": densenet121,
+    "inception_v3": inception_v3,
+    "bert": bert,
+    "lm1b": lm1b,
+    "ncf": ncf,
+    "transformer_lm": transformer_lm,
+}

@@ -1,0 +1,46 @@
+"""Model zoo plumbing.
+
+The reference ships its benchmark models as example scripts
+(``examples/benchmark/imagenet.py`` — ResNet/VGG/DenseNet/Inception via
+tf.keras.applications, ``examples/benchmark/bert.py``, ``examples/lm1b``,
+NCF).  Here each model family is a first-class module exposing a
+:class:`ModelSpec` that plugs straight into ``AutoDist.capture``:
+
+    spec = resnet.resnet50(num_classes=1000)
+    params = spec.init(jax.random.PRNGKey(0))
+    ad.capture(params=params, optimizer=..., loss_fn=spec.loss_fn,
+               sparse_vars=spec.sparse_vars)
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Sequence, Tuple
+
+import jax
+import numpy as np
+
+
+@dataclass
+class ModelSpec:
+    """Everything AutoDist needs to distribute one model."""
+
+    name: str
+    init: Callable                 # rng -> params
+    loss_fn: Callable              # (params, batch) -> scalar loss
+    apply_fn: Callable             # (params, inputs) -> outputs (serving)
+    make_batch: Callable           # (rng, batch_size) -> batch pytree
+    sparse_vars: Tuple[str, ...] = ()
+    untrainable_vars: Tuple[str, ...] = ()
+    config: Dict[str, Any] = field(default_factory=dict)
+
+    def sample_batch(self, batch_size: int, seed: int = 0):
+        return self.make_batch(np.random.RandomState(seed), batch_size)
+
+
+def cross_entropy_loss(logits, labels) -> jax.Array:
+    """Mean softmax cross entropy with integer labels."""
+    import jax.numpy as jnp
+
+    logz = jax.nn.log_softmax(logits, axis=-1)
+    onehot = jax.nn.one_hot(labels, logits.shape[-1], dtype=logz.dtype)
+    return -jnp.mean(jnp.sum(onehot * logz, axis=-1))

@@ -1,0 +1,107 @@
+"""BERT encoder for masked-LM pretraining.
+
+Parity target: reference ``examples/benchmark/bert.py`` (BERT-base/large
+pretraining benchmark, samples/sec).  Token/position/segment embeddings +
+encoder stack + MLM head with tied decoder weights.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from autodist_tpu.models.base import ModelSpec
+from autodist_tpu.models.transformer import TransformerStack, dense_attention
+
+
+class BertModel(nn.Module):
+    vocab_size: int
+    num_layers: int
+    num_heads: int
+    head_dim: int
+    d_ff: int
+    max_len: int
+    type_vocab: int = 2
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens, segment_ids):
+        d_model = self.num_heads * self.head_dim
+        emb = self.param("word_embeddings", nn.initializers.normal(0.02),
+                         (self.vocab_size, d_model), self.dtype)
+        pos = self.param("position_embeddings", nn.initializers.normal(0.02),
+                         (self.max_len, d_model), self.dtype)
+        seg = self.param("token_type_embeddings", nn.initializers.normal(0.02),
+                         (self.type_vocab, d_model), self.dtype)
+        x = (jnp.take(emb, tokens, axis=0)
+             + pos[None, :tokens.shape[1]]
+             + jnp.take(seg, segment_ids, axis=0))
+        x = nn.LayerNorm(name="embeddings_ln", use_bias=False)(x)
+        x = TransformerStack(self.num_layers, self.num_heads, self.head_dim,
+                             self.d_ff, causal=False, name="encoder")(x)
+        # MLM head: transform + tied decoder.
+        h = nn.Dense(d_model, name="mlm_transform")(x)
+        h = nn.gelu(h)
+        h = nn.LayerNorm(name="mlm_ln", use_bias=False)(h)
+        return jnp.einsum("btd,vd->btv", h, emb)
+
+
+def bert(vocab_size: int = 30528, num_layers: int = 12, num_heads: int = 12,
+         head_dim: int = 64, d_ff: int = 3072, max_len: int = 512,
+         seq_len: int = 128, dtype=jnp.float32) -> ModelSpec:
+    """BERT-base defaults (vocab padded 30522→30528 for sharding/MXU)."""
+    model = BertModel(vocab_size, num_layers, num_heads, head_dim, d_ff,
+                      max_len, dtype=dtype)
+
+    def init(rng):
+        t = jnp.zeros((2, seq_len), jnp.int32)
+        return model.init(rng, t, t)["params"]
+
+    def apply_fn(params, tokens, segment_ids):
+        return model.apply({"params": params}, tokens, segment_ids)
+
+    def loss_fn(params, batch):
+        logits = apply_fn(params, batch["tokens"], batch["segment_ids"])
+        # masked-LM: average over masked positions only
+        logz = jax.nn.log_softmax(logits, axis=-1)
+        tgt = jax.nn.one_hot(batch["labels"], logits.shape[-1],
+                             dtype=logz.dtype)
+        per_tok = -jnp.sum(tgt * logz, axis=-1)
+        mask = batch["mlm_mask"].astype(per_tok.dtype)
+        return jnp.sum(per_tok * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+    def make_batch(rng: np.random.RandomState, batch_size: int):
+        return {
+            "tokens": rng.randint(0, vocab_size,
+                                  (batch_size, seq_len)).astype(np.int32),
+            "segment_ids": (rng.rand(batch_size, seq_len) > 0.5
+                            ).astype(np.int32),
+            "labels": rng.randint(0, vocab_size,
+                                  (batch_size, seq_len)).astype(np.int32),
+            "mlm_mask": (rng.rand(batch_size, seq_len) < 0.15
+                         ).astype(np.float32),
+        }
+
+    return ModelSpec(
+        name="bert",
+        init=init, loss_fn=loss_fn, apply_fn=apply_fn, make_batch=make_batch,
+        sparse_vars=("word_embeddings", "token_type_embeddings"),
+        config=dict(vocab_size=vocab_size, num_layers=num_layers,
+                    num_heads=num_heads, head_dim=head_dim, d_ff=d_ff,
+                    seq_len=seq_len),
+    )
+
+
+def bert_base(**kw) -> ModelSpec:
+    return bert(**kw)
+
+
+def bert_large(**kw) -> ModelSpec:
+    kw.setdefault("num_layers", 24)
+    kw.setdefault("num_heads", 16)
+    kw.setdefault("head_dim", 64)
+    kw.setdefault("d_ff", 4096)
+    return bert(**kw)

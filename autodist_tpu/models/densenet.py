@@ -1,0 +1,65 @@
+"""DenseNet121 (reference ``examples/benchmark/imagenet.py`` DenseNet121
+benchmark).  GroupNorm for statelessness, as in resnet.py."""
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from autodist_tpu.models.base import ModelSpec
+from autodist_tpu.models.resnet import _image_spec
+
+Conv = partial(nn.Conv, use_bias=False)
+
+
+def _norm(name):
+    return nn.GroupNorm(num_groups=32, name=name)
+
+
+class DenseLayer(nn.Module):
+    growth_rate: int
+
+    @nn.compact
+    def __call__(self, x):
+        y = nn.relu(_norm("norm1")(x))
+        y = Conv(4 * self.growth_rate, (1, 1), name="conv1")(y)
+        y = nn.relu(_norm("norm2")(y))
+        y = Conv(self.growth_rate, (3, 3), padding="SAME", name="conv2")(y)
+        return jnp.concatenate([x, y], axis=-1)
+
+
+class Transition(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        x = nn.relu(_norm("norm")(x))
+        x = Conv(x.shape[-1] // 2, (1, 1), name="conv")(x)
+        return nn.avg_pool(x, (2, 2), strides=(2, 2))
+
+
+class DenseNet(nn.Module):
+    block_sizes: Sequence[int]
+    growth_rate: int
+    num_classes: int
+
+    @nn.compact
+    def __call__(self, x):
+        x = Conv(2 * self.growth_rate, (7, 7), strides=(2, 2),
+                 name="conv_init")(x)
+        x = nn.relu(_norm("norm_init")(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, n in enumerate(self.block_sizes):
+            for j in range(n):
+                x = DenseLayer(self.growth_rate, name=f"block{i}_layer{j}")(x)
+            if i != len(self.block_sizes) - 1:
+                x = Transition(name=f"transition{i}")(x)
+        x = nn.relu(_norm("norm_final")(x))
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, name="head")(x)
+
+
+def densenet121(num_classes: int = 1000, image_size: int = 224) -> ModelSpec:
+    return _image_spec("densenet121",
+                       DenseNet([6, 12, 24, 16], 32, num_classes),
+                       num_classes, image_size)

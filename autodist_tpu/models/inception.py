@@ -1,0 +1,141 @@
+"""InceptionV3 (reference ``examples/benchmark/imagenet.py`` InceptionV3
+benchmark).  Faithful block structure (A/B/C/D/E mixed blocks per Szegedy et
+al. 2015), GroupNorm for statelessness."""
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from autodist_tpu.models.base import ModelSpec
+from autodist_tpu.models.resnet import _image_spec
+
+
+class ConvNorm(nn.Module):
+    filters: int
+    kernel: Tuple[int, int]
+    strides: Tuple[int, int] = (1, 1)
+    padding: str = "SAME"
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(self.filters, self.kernel, strides=self.strides,
+                    padding=self.padding, use_bias=False, name="conv")(x)
+        groups = 32 if self.filters % 32 == 0 else 1
+        x = nn.GroupNorm(num_groups=groups, name="norm")(x)
+        return nn.relu(x)
+
+
+class InceptionA(nn.Module):
+    pool_features: int
+
+    @nn.compact
+    def __call__(self, x):
+        b1 = ConvNorm(64, (1, 1), name="b1")(x)
+        b2 = ConvNorm(48, (1, 1), name="b2_1")(x)
+        b2 = ConvNorm(64, (5, 5), name="b2_2")(b2)
+        b3 = ConvNorm(64, (1, 1), name="b3_1")(x)
+        b3 = ConvNorm(96, (3, 3), name="b3_2")(b3)
+        b3 = ConvNorm(96, (3, 3), name="b3_3")(b3)
+        b4 = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        b4 = ConvNorm(self.pool_features, (1, 1), name="b4")(b4)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionB(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        b1 = ConvNorm(384, (3, 3), strides=(2, 2), padding="VALID",
+                      name="b1")(x)
+        b2 = ConvNorm(64, (1, 1), name="b2_1")(x)
+        b2 = ConvNorm(96, (3, 3), name="b2_2")(b2)
+        b2 = ConvNorm(96, (3, 3), strides=(2, 2), padding="VALID",
+                      name="b2_3")(b2)
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b1, b2, b3], axis=-1)
+
+
+class InceptionC(nn.Module):
+    channels7: int
+
+    @nn.compact
+    def __call__(self, x):
+        c = self.channels7
+        b1 = ConvNorm(192, (1, 1), name="b1")(x)
+        b2 = ConvNorm(c, (1, 1), name="b2_1")(x)
+        b2 = ConvNorm(c, (1, 7), name="b2_2")(b2)
+        b2 = ConvNorm(192, (7, 1), name="b2_3")(b2)
+        b3 = ConvNorm(c, (1, 1), name="b3_1")(x)
+        b3 = ConvNorm(c, (7, 1), name="b3_2")(b3)
+        b3 = ConvNorm(c, (1, 7), name="b3_3")(b3)
+        b3 = ConvNorm(c, (7, 1), name="b3_4")(b3)
+        b3 = ConvNorm(192, (1, 7), name="b3_5")(b3)
+        b4 = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        b4 = ConvNorm(192, (1, 1), name="b4")(b4)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionD(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        b1 = ConvNorm(192, (1, 1), name="b1_1")(x)
+        b1 = ConvNorm(320, (3, 3), strides=(2, 2), padding="VALID",
+                      name="b1_2")(b1)
+        b2 = ConvNorm(192, (1, 1), name="b2_1")(x)
+        b2 = ConvNorm(192, (1, 7), name="b2_2")(b2)
+        b2 = ConvNorm(192, (7, 1), name="b2_3")(b2)
+        b2 = ConvNorm(192, (3, 3), strides=(2, 2), padding="VALID",
+                      name="b2_4")(b2)
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b1, b2, b3], axis=-1)
+
+
+class InceptionE(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        b1 = ConvNorm(320, (1, 1), name="b1")(x)
+        b2 = ConvNorm(384, (1, 1), name="b2_1")(x)
+        b2 = jnp.concatenate([ConvNorm(384, (1, 3), name="b2_2a")(b2),
+                              ConvNorm(384, (3, 1), name="b2_2b")(b2)], -1)
+        b3 = ConvNorm(448, (1, 1), name="b3_1")(x)
+        b3 = ConvNorm(384, (3, 3), name="b3_2")(b3)
+        b3 = jnp.concatenate([ConvNorm(384, (1, 3), name="b3_3a")(b3),
+                              ConvNorm(384, (3, 1), name="b3_3b")(b3)], -1)
+        b4 = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        b4 = ConvNorm(192, (1, 1), name="b4")(b4)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    num_classes: int
+
+    @nn.compact
+    def __call__(self, x):
+        x = ConvNorm(32, (3, 3), strides=(2, 2), padding="VALID",
+                     name="stem1")(x)
+        x = ConvNorm(32, (3, 3), padding="VALID", name="stem2")(x)
+        x = ConvNorm(64, (3, 3), name="stem3")(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = ConvNorm(80, (1, 1), padding="VALID", name="stem4")(x)
+        x = ConvNorm(192, (3, 3), padding="VALID", name="stem5")(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = InceptionA(32, name="mixed0")(x)
+        x = InceptionA(64, name="mixed1")(x)
+        x = InceptionA(64, name="mixed2")(x)
+        x = InceptionB(name="mixed3")(x)
+        x = InceptionC(128, name="mixed4")(x)
+        x = InceptionC(160, name="mixed5")(x)
+        x = InceptionC(160, name="mixed6")(x)
+        x = InceptionC(192, name="mixed7")(x)
+        x = InceptionD(name="mixed8")(x)
+        x = InceptionE(name="mixed9")(x)
+        x = InceptionE(name="mixed10")(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, name="head")(x)
+
+
+def inception_v3(num_classes: int = 1000, image_size: int = 299) -> ModelSpec:
+    return _image_spec("inception_v3", InceptionV3(num_classes),
+                       num_classes, image_size)

@@ -1,0 +1,70 @@
+"""lm1b LSTM language model.
+
+Parity target: reference ``examples/lm1b/language_model.py:15-60`` — an LSTM
+LM over the One Billion Word benchmark with a 793,471-word vocabulary whose
+embedding + softmax variables dominate (the Parallax showcase: embedding
+gradients are sparse and go to sharded PS; LSTM weights are dense and
+all-reduce).  Vocab default padded to 793,472 (multiple of 128) so the table
+shards evenly on TPU meshes.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from autodist_tpu.models.base import ModelSpec, cross_entropy_loss
+
+
+class LSTMLM(nn.Module):
+    vocab_size: int
+    emb_dim: int
+    hidden_dim: int
+    num_layers: int
+
+    @nn.compact
+    def __call__(self, tokens):
+        emb = self.param("embedding", nn.initializers.normal(0.1),
+                         (self.vocab_size, self.emb_dim))
+        x = jnp.take(emb, tokens, axis=0)  # [B, T, E]
+        for i in range(self.num_layers):
+            x = nn.RNN(nn.OptimizedLSTMCell(self.hidden_dim),
+                       name=f"lstm_{i}")(x)
+        # project to softmax dim and tie with an output embedding
+        x = nn.Dense(self.emb_dim, name="proj")(x)
+        softmax_emb = self.param("softmax_embedding",
+                                 nn.initializers.normal(0.1),
+                                 (self.vocab_size, self.emb_dim))
+        return jnp.einsum("bte,ve->btv", x, softmax_emb)
+
+
+def lm1b(vocab_size: int = 793472, emb_dim: int = 512,
+         hidden_dim: int = 2048, num_layers: int = 2,
+         seq_len: int = 20) -> ModelSpec:
+    model = LSTMLM(vocab_size, emb_dim, hidden_dim, num_layers)
+
+    def init(rng):
+        return model.init(rng, jnp.zeros((2, seq_len), jnp.int32))["params"]
+
+    def apply_fn(params, tokens):
+        return model.apply({"params": params}, tokens)
+
+    def loss_fn(params, batch):
+        logits = apply_fn(params, batch["tokens"])
+        return cross_entropy_loss(logits[:, :-1], batch["tokens"][:, 1:])
+
+    def make_batch(rng: np.random.RandomState, batch_size: int):
+        return {"tokens": rng.randint(
+            0, vocab_size, (batch_size, seq_len)).astype(np.int32)}
+
+    return ModelSpec(
+        name="lm1b",
+        init=init, loss_fn=loss_fn, apply_fn=apply_fn, make_batch=make_batch,
+        sparse_vars=("embedding", "softmax_embedding"),
+        config=dict(vocab_size=vocab_size, emb_dim=emb_dim,
+                    hidden_dim=hidden_dim, num_layers=num_layers,
+                    seq_len=seq_len),
+    )

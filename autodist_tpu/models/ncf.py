@@ -1,0 +1,79 @@
+"""Neural Collaborative Filtering (NeuMF).
+
+Parity target: reference NCF benchmark on MovieLens
+(``examples/benchmark/README.md``): GMF + MLP towers over user/item
+embeddings, binary cross-entropy on implicit feedback.  Embedding gradients
+are sparse (Parallax PS candidates).
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from autodist_tpu.models.base import ModelSpec
+
+
+class NeuMF(nn.Module):
+    num_users: int
+    num_items: int
+    mf_dim: int
+    mlp_dims: Sequence[int]
+
+    @nn.compact
+    def __call__(self, users, items):
+        mlp_dim0 = self.mlp_dims[0] // 2
+        emb = lambda n, v, d: self.param(  # noqa: E731
+            n, nn.initializers.normal(0.01), (v, d))
+        mf_u = jnp.take(emb("mf_user_embedding", self.num_users, self.mf_dim),
+                        users, axis=0)
+        mf_i = jnp.take(emb("mf_item_embedding", self.num_items, self.mf_dim),
+                        items, axis=0)
+        mlp_u = jnp.take(emb("mlp_user_embedding", self.num_users, mlp_dim0),
+                         users, axis=0)
+        mlp_i = jnp.take(emb("mlp_item_embedding", self.num_items, mlp_dim0),
+                         items, axis=0)
+        gmf = mf_u * mf_i
+        x = jnp.concatenate([mlp_u, mlp_i], axis=-1)
+        for i, d in enumerate(self.mlp_dims[1:]):
+            x = nn.relu(nn.Dense(d, name=f"mlp_{i}")(x))
+        x = jnp.concatenate([gmf, x], axis=-1)
+        return nn.Dense(1, name="prediction")(x)[..., 0]
+
+
+def ncf(num_users: int = 138496, num_items: int = 26752, mf_dim: int = 64,
+        mlp_dims: Sequence[int] = (256, 256, 128, 64)) -> ModelSpec:
+    """MovieLens-20M-ish sizes, padded to multiples of 128."""
+    model = NeuMF(num_users, num_items, mf_dim, tuple(mlp_dims))
+
+    def init(rng):
+        z = jnp.zeros((2,), jnp.int32)
+        return model.init(rng, z, z)["params"]
+
+    def apply_fn(params, users, items):
+        return model.apply({"params": params}, users, items)
+
+    def loss_fn(params, batch):
+        logits = apply_fn(params, batch["users"], batch["items"])
+        labels = batch["labels"].astype(logits.dtype)
+        return jnp.mean(
+            jnp.maximum(logits, 0) - logits * labels
+            + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+
+    def make_batch(rng: np.random.RandomState, batch_size: int):
+        return {
+            "users": rng.randint(0, num_users, (batch_size,)).astype(np.int32),
+            "items": rng.randint(0, num_items, (batch_size,)).astype(np.int32),
+            "labels": (rng.rand(batch_size) > 0.5).astype(np.float32),
+        }
+
+    return ModelSpec(
+        name="ncf",
+        init=init, loss_fn=loss_fn, apply_fn=apply_fn, make_batch=make_batch,
+        sparse_vars=("mf_user_embedding", "mf_item_embedding",
+                     "mlp_user_embedding", "mlp_item_embedding"),
+        config=dict(num_users=num_users, num_items=num_items),
+    )

@@ -1,0 +1,91 @@
+"""Shared transformer components (TPU-first).
+
+Design notes:
+* dims default to multiples of 128 so matmuls tile the MXU exactly;
+* attention is a pluggable function so sequence-parallel implementations
+  (ring attention, Ulysses — ``autodist_tpu/parallel/``) can replace the
+  dense softmax without touching the model;
+* parameter names are stable strategy keys (e.g. ``layers_0/attn/query/kernel``)
+  — the analog of the reference's TF variable names in strategy node_configs.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def dense_attention(q, k, v, causal: bool) -> jax.Array:
+    """Reference attention: softmax(QKᵀ/√d)V.  [B, T, H, D] layout."""
+    depth = q.shape[-1]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(depth).astype(q.dtype)
+    if causal:
+        t_q, t_k = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((t_q, t_k), bool))
+        logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+class MultiHeadAttention(nn.Module):
+    num_heads: int
+    head_dim: int
+    causal: bool = False
+    attn_fn: Callable = staticmethod(dense_attention)
+
+    @nn.compact
+    def __call__(self, x):
+        d = self.num_heads * self.head_dim
+        proj = lambda name: nn.DenseGeneral(  # noqa: E731
+            (self.num_heads, self.head_dim), use_bias=False, name=name)
+        q, k, v = proj("query")(x), proj("key")(x), proj("value")(x)
+        out = self.attn_fn(q, k, v, self.causal)
+        return nn.DenseGeneral(x.shape[-1], axis=(-2, -1), use_bias=False,
+                               name="out")(out)
+
+
+class MlpBlock(nn.Module):
+    d_ff: int
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.Dense(self.d_ff, use_bias=False, name="wi")(x)
+        h = nn.gelu(h)
+        return nn.Dense(x.shape[-1], use_bias=False, name="wo")(h)
+
+
+class TransformerLayer(nn.Module):
+    num_heads: int
+    head_dim: int
+    d_ff: int
+    causal: bool = False
+    attn_fn: Callable = staticmethod(dense_attention)
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.LayerNorm(name="ln_attn", use_bias=False)(x)
+        x = x + MultiHeadAttention(self.num_heads, self.head_dim, self.causal,
+                                   attn_fn=self.attn_fn, name="attn")(h)
+        h = nn.LayerNorm(name="ln_mlp", use_bias=False)(x)
+        x = x + MlpBlock(self.d_ff, name="mlp")(h)
+        return x
+
+
+class TransformerStack(nn.Module):
+    num_layers: int
+    num_heads: int
+    head_dim: int
+    d_ff: int
+    causal: bool = False
+    attn_fn: Callable = staticmethod(dense_attention)
+
+    @nn.compact
+    def __call__(self, x):
+        for i in range(self.num_layers):
+            x = TransformerLayer(self.num_heads, self.head_dim, self.d_ff,
+                                 self.causal, attn_fn=self.attn_fn,
+                                 name=f"layers_{i}")(x)
+        return nn.LayerNorm(name="ln_final", use_bias=False)(x)

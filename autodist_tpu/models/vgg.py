@@ -1,0 +1,37 @@
+"""VGG16 (reference ``examples/benchmark/imagenet.py`` VGG16 benchmark —
+the PartitionedPS showcase: its huge fc layers are what variable
+partitioning was built for)."""
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from autodist_tpu.models.base import ModelSpec
+from autodist_tpu.models.resnet import _image_spec
+
+_CFG16 = [64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+          512, 512, 512, "M", 512, 512, 512, "M"]
+
+
+class VGG(nn.Module):
+    num_classes: int
+
+    @nn.compact
+    def __call__(self, x):
+        conv_idx = 0
+        for v in _CFG16:
+            if v == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = nn.Conv(v, (3, 3), padding="SAME",
+                            name=f"conv{conv_idx}")(x)
+                x = nn.relu(x)
+                conv_idx += 1
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(4096, name="fc1")(x))
+        x = nn.relu(nn.Dense(4096, name="fc2")(x))
+        return nn.Dense(self.num_classes, name="head")(x)
+
+
+def vgg16(num_classes: int = 1000, image_size: int = 224) -> ModelSpec:
+    return _image_spec("vgg16", VGG(num_classes), num_classes, image_size)

@@ -68,26 +68,40 @@ class DistributedSession:
         return self._step.mesh
 
     # -- running -----------------------------------------------------------
-    def run(self, batch: Any) -> Dict[str, Any]:
+    def place_batch(self, batch: Any) -> Any:
+        """Pre-place a host batch with the strategy's input shardings.
+        Re-running a pre-placed batch skips the host→device transfer — use
+        for input pipelines that prefetch (placing an already-placed batch
+        is a no-op)."""
+        return self._step.place_batch(batch)
+
+    def run(self, batch: Any, sync: bool = True) -> Dict[str, Any]:
         """Run one training step on a global batch.
 
         The batch is split along its leading dimension across the data axis
         (the Remapper's polymorphic-dim splitting, remapper.py:81-123).
-        Returns host metrics: at least ``{"loss": ...}``.
-        """
+        Returns metrics (at least ``{"loss": ...}``) — as host numpy when
+        ``sync`` (the default), or as device arrays when ``sync=False`` so
+        back-to-back steps dispatch asynchronously without a host round-trip
+        per step."""
         batch = self._step.place_batch(batch)
         self._params, self._opt_state, self._sync_state, metrics = \
             self._step.step_fn(self._params, self._opt_state,
                                self._sync_state, batch)
         self._step_count += 1
+        if not sync:
+            return metrics
         return jax.tree_util.tree_map(lambda x: np.asarray(x), metrics)
 
     def run_many(self, batches) -> Dict[str, Any]:
-        """Run a sequence of batches; returns the last step's metrics."""
+        """Run a sequence of batches with async dispatch (no host round-trip
+        per step); returns the last step's metrics on host."""
         metrics = None
         for b in batches:
-            metrics = self.run(b)
-        return metrics
+            metrics = self.run(b, sync=False)
+        if metrics is None:
+            return None
+        return jax.tree_util.tree_map(lambda x: np.asarray(x), metrics)
 
     def set_params(self, params) -> None:
         """Load new parameter values (e.g. from a checkpoint), re-placing

@@ -37,7 +37,7 @@ from typing import Dict, List, Optional, Tuple
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from autodist_tpu.const import MESH_AXIS_DATA, MESH_AXIS_MODEL
+from autodist_tpu.const import MESH_AXIS_DATA, MESH_AXIS_MODEL, MESH_AXIS_SEQ
 from autodist_tpu.graph_item import GraphItem, VarInfo
 from autodist_tpu.resource_spec import DeviceSpec
 from autodist_tpu.strategy.base import (
@@ -47,6 +47,24 @@ from autodist_tpu.strategy.base import (
     VarConfig,
 )
 from autodist_tpu.utils import logging
+
+_warned: set = set()
+
+
+def _warn_once(fmt: str, *args) -> None:
+    key = (fmt,) + args
+    if key not in _warned:
+        _warned.add(key)
+        logging.warning(fmt, *args)
+
+
+def spec_from_entries(entries: List[Optional[str]]) -> P:
+    """Trim trailing Nones and build a PartitionSpec (single normalization
+    rule for the whole module)."""
+    entries = list(entries)
+    while entries and entries[-1] is None:
+        entries.pop()
+    return P(*entries)
 
 
 def parse_partitioner(partitioner: str) -> Tuple[Optional[int], int]:
@@ -107,6 +125,45 @@ class CompiledStrategy:
 
     def batch_sharding(self) -> NamedSharding:
         return NamedSharding(self.mesh, self.batch_spec())
+
+    def batch_sharding_for_leaf(self, leaf,
+                                seq_len: Optional[int] = None) -> NamedSharding:
+        """Per-leaf batch layout: leading dim over ``data``; dim 1 over
+        ``seq`` for leaves that carry the batch's sequence length
+        (sequence/context parallelism — tokens split across chips; GSPMD
+        inserts the attention collectives, and the ring/Ulysses kernels in
+        autodist_tpu.parallel take over when plugged in).
+
+        ``seq_len``: the batch's sequence length (computed by
+        ``batch_shardings`` as the max dim-1 across rank≥2 leaves) — only
+        dims equal to it shard over ``seq``, so same-parity non-sequence
+        dims (one-hot widths etc.) are left alone."""
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        entries: List[Optional[str]] = [None] * len(shape)
+        if shape:
+            d = self.mesh.shape.get(MESH_AXIS_DATA, 1)
+            if d > 1 and self.batch_axes:
+                if shape[0] % d == 0:
+                    entries[0] = MESH_AXIS_DATA
+                else:
+                    _warn_once(
+                        "batch leaf with leading dim %d is not divisible by "
+                        "the data axis (size %d); replicating it on every "
+                        "chip — pad the global batch for data parallelism",
+                        shape[0], d)
+        s = self.mesh.shape.get(MESH_AXIS_SEQ, 1)
+        if (len(shape) >= 2 and s > 1 and seq_len is not None
+                and shape[1] == seq_len and shape[1] % s == 0):
+            entries[1] = MESH_AXIS_SEQ
+        return NamedSharding(self.mesh, spec_from_entries(entries))
+
+    def batch_shardings(self, batch) -> "Any":
+        """Pytree of per-leaf batch shardings (see batch_sharding_for_leaf)."""
+        dims = [s[1] for leaf in jax.tree_util.tree_leaves(batch)
+                if len(s := tuple(getattr(leaf, "shape", ()) or ())) >= 2]
+        seq_len = max(dims) if dims else None
+        return jax.tree_util.tree_map(
+            lambda x: self.batch_sharding_for_leaf(x, seq_len), batch)
 
     def param_sharding_tree(self, params):
         """Pytree of NamedShardings matching ``params``."""
@@ -176,12 +233,7 @@ class StrategyCompiler:
             return None
         return {MESH_AXIS_DATA: coord}
 
-    @staticmethod
-    def _spec_from_entries(entries: List[Optional[str]]) -> P:
-        entries = list(entries)
-        while entries and entries[-1] is None:
-            entries.pop()
-        return P(*entries)
+    _spec_from_entries = staticmethod(spec_from_entries)
 
     def _partition_spec(self, var: VarInfo, axis: Optional[int],
                         shard_mesh_axis: Optional[str]) -> P:

@@ -1,0 +1,87 @@
+"""Benchmark: ResNet-50 training throughput (images/sec) on the local device.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline note: the reference publishes charts, not numbers
+(docs/usage/performance.md; BASELINE.json.published is empty).  Until a
+published number exists, ``vs_baseline`` is the measured value normalized by
+``BASELINE_IMAGES_PER_SEC`` below — the round-1 recorded value on one
+v5e chip, so later rounds report their speedup against round 1.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+# Round-1 measured reference point (one TPU v5e chip, bf16, batch 128):
+# ~2240 images/sec. vs_baseline therefore reports speedup relative to the
+# round-1 build.
+BASELINE_IMAGES_PER_SEC = 2240.0
+
+WARMUP_STEPS = 3
+MEASURE_STEPS = 20
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    os.environ["AUTODIST_IS_TESTING"] = "True"
+    from autodist_tpu.autodist import AutoDist, \
+        _reset_default_autodist_for_testing
+    from autodist_tpu.models.resnet import resnet50
+    from autodist_tpu.strategy import AllReduce
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    batch_size = 128 if on_tpu else 16
+    image_size = 224 if on_tpu else 64
+    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+
+    spec = resnet50(num_classes=1000, image_size=image_size)
+    params = spec.init(jax.random.PRNGKey(0))
+    if on_tpu:
+        params = jax.tree_util.tree_map(
+            lambda x: x.astype(dtype) if x.dtype == jnp.float32 else x, params)
+    batch = spec.sample_batch(batch_size)
+    if on_tpu:
+        batch = {"images": batch["images"].astype(np.float32).astype(
+            jnp.bfloat16), "labels": batch["labels"]}
+
+    _reset_default_autodist_for_testing()
+    ad = AutoDist(strategy_builder=AllReduce())
+    with ad.scope():
+        ad.capture(params=params,
+                   optimizer=optax.sgd(0.1, momentum=0.9),
+                   loss_fn=spec.loss_fn)
+    sess = ad.create_distributed_session()
+
+    # Pre-place the batch (an input pipeline would prefetch like this);
+    # async metrics so steps dispatch back-to-back.  The final step fetches
+    # its loss to host — a hard sync that (unlike block_until_ready over the
+    # remote-TPU tunnel) reliably waits for the whole chain.
+    batch = sess.place_batch(batch)
+    for _ in range(WARMUP_STEPS):
+        sess.run(batch, sync=False)
+    sess.run(batch)
+
+    t0 = time.perf_counter()
+    for _ in range(MEASURE_STEPS - 1):
+        sess.run(batch, sync=False)
+    sess.run(batch)
+    dt = time.perf_counter() - t0
+
+    images_per_sec = batch_size * MEASURE_STEPS / dt
+    print(json.dumps({
+        "metric": "resnet50_train_throughput",
+        "value": round(images_per_sec, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(images_per_sec / BASELINE_IMAGES_PER_SEC, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()
