@@ -1,0 +1,19 @@
+"""Multi-dimensional parallelism beyond the reference's DP+PS scope.
+
+Currently: sequence/context parallelism — ring attention
+(ring_attention.py) and Ulysses all-to-all (ulysses.py).  Pipeline and
+expert parallelism land in pipeline.py / moe.py."""
+from autodist_tpu.parallel.ring_attention import make_ring_attention  # noqa: F401
+from autodist_tpu.parallel.ulysses import make_ulysses_attention  # noqa: F401
+
+
+def sequence_parallel_attention(kind: str, mesh, axis_name: str = "seq"):
+    """Factory: 'ring' | 'ulysses' | 'dense' → attn_fn(q, k, v, causal)."""
+    if kind == "ring":
+        return make_ring_attention(mesh, axis_name)
+    if kind == "ulysses":
+        return make_ulysses_attention(mesh, axis_name)
+    if kind == "dense":
+        from autodist_tpu.models.transformer import dense_attention
+        return dense_attention
+    raise ValueError(f"unknown sequence-parallel attention kind {kind!r}")

@@ -1,0 +1,114 @@
+"""Sequence-parallel attention correctness: ring and Ulysses must match
+dense attention exactly (same math, different communication schedule)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from autodist_tpu.autodist import AutoDist, _reset_default_autodist_for_testing
+from autodist_tpu.mesh import build_mesh
+from autodist_tpu.models.transformer import dense_attention
+from autodist_tpu.parallel import (
+    make_ring_attention,
+    make_ulysses_attention,
+    sequence_parallel_attention,
+)
+
+
+@pytest.fixture(autouse=True)
+def _testing_env(monkeypatch):
+    monkeypatch.setenv("AUTODIST_IS_TESTING", "True")
+    _reset_default_autodist_for_testing()
+
+
+def _qkv(b=2, t=32, h=4, d=16, seed=0):
+    rng = np.random.RandomState(seed)
+    mk = lambda: rng.randn(b, t, h, d).astype(np.float32)  # noqa: E731
+    return jnp.asarray(mk()), jnp.asarray(mk()), jnp.asarray(mk())
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("seq_size", [2, 4, 8])
+def test_ring_matches_dense(causal, seq_size):
+    mesh = build_mesh({"seq": seq_size})
+    q, k, v = _qkv()
+    ref = dense_attention(q, k, v, causal)
+    ring = make_ring_attention(mesh)
+    out = jax.jit(lambda q, k, v: ring(q, k, v, causal))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("seq_size", [2, 4])
+def test_ulysses_matches_dense(causal, seq_size):
+    mesh = build_mesh({"seq": seq_size})
+    q, k, v = _qkv()
+    ref = dense_attention(q, k, v, causal)
+    uly = make_ulysses_attention(mesh)
+    out = jax.jit(lambda q, k, v: uly(q, k, v, causal))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_with_data_axis_too():
+    """Partial-manual shard_map: seq manual, data stays GSPMD."""
+    mesh = build_mesh({"data": 2, "seq": 4})
+    q, k, v = _qkv()
+    ref = dense_attention(q, k, v, True)
+    ring = make_ring_attention(mesh)
+    sh = NamedSharding(mesh, P("data", "seq"))
+    qs, ks, vs = (jax.device_put(x, sh) for x in (q, k, v))
+    out = jax.jit(lambda q, k, v: ring(q, k, v, True))(qs, ks, vs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_rejects_indivisible_heads():
+    mesh = build_mesh({"seq": 8})
+    q, k, v = _qkv(h=4)  # 4 heads, seq=8
+    uly = make_ulysses_attention(mesh)
+    with pytest.raises(ValueError, match="divisible"):
+        uly(q, k, v, False)
+
+
+def test_seq_parallel_lm_end_to_end():
+    """Train the flagship LM with ring attention on a data x seq mesh and
+    match the dense-attention run."""
+    from autodist_tpu.models.transformer_lm import transformer_lm
+    from autodist_tpu.strategy import AllReduce
+
+    mesh = build_mesh({"data": 2, "seq": 4})
+    ring = make_ring_attention(mesh)
+
+    def make(attn_fn):
+        return transformer_lm(vocab_size=256, num_layers=2, num_heads=4,
+                              head_dim=8, d_ff=64, max_len=32, seq_len=32,
+                              attn_fn=attn_fn)
+
+    spec_ring, spec_dense = make(ring), make(dense_attention)
+    params = spec_dense.init(jax.random.PRNGKey(0))
+    batch = spec_dense.sample_batch(8)
+
+    losses = {}
+    for name, spec in (("dense", spec_dense), ("ring", spec_ring)):
+        _reset_default_autodist_for_testing()
+        ad = AutoDist(strategy_builder=AllReduce(),
+                      mesh_axes={"data": 2, "seq": 4})
+        with ad.scope():
+            ad.capture(params=params, optimizer=optax.sgd(0.1),
+                       loss_fn=spec.loss_fn, sparse_vars=spec.sparse_vars)
+        sess = ad.create_distributed_session(mesh=mesh)
+        losses[name] = [float(sess.run(batch)["loss"]) for _ in range(3)]
+    np.testing.assert_allclose(losses["ring"], losses["dense"], rtol=1e-4)
+
+
+def test_factory():
+    mesh = build_mesh({"seq": 2})
+    assert sequence_parallel_attention("dense", mesh) is dense_attention
+    assert callable(sequence_parallel_attention("ring", mesh))
+    assert callable(sequence_parallel_attention("ulysses", mesh))
+    with pytest.raises(ValueError):
+        sequence_parallel_attention("bogus", mesh)
