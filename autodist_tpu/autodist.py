@@ -15,7 +15,9 @@ from __future__ import annotations
 import contextlib
 from typing import Any, Callable, Dict, Optional, Sequence
 
+from autodist_tpu.cluster import Cluster, make_cluster
 from autodist_tpu.const import ENV
+from autodist_tpu.coordinator import Coordinator
 from autodist_tpu.graph_item import GraphItem
 from autodist_tpu.kernel.graph_transformer import GraphTransformer
 from autodist_tpu.mesh import build_mesh
@@ -68,6 +70,8 @@ class AutoDist:
         self._session: Optional[DistributedSession] = None
         self._strategy: Optional[Strategy] = None
         self._in_scope = False
+        self._cluster: Cluster = make_cluster(self._resource_spec)
+        self._coordinator: Optional[Coordinator] = None
 
     # -- capture -----------------------------------------------------------
     @contextlib.contextmanager
@@ -127,6 +131,31 @@ class AutoDist:
             self._strategy.serialize()
         return self._strategy
 
+    @property
+    def cluster(self) -> Cluster:
+        return self._cluster
+
+    @property
+    def coordinator(self) -> Optional[Coordinator]:
+        return self._coordinator
+
+    def _setup(self) -> None:
+        """Chief-only multi-node bootstrap (reference _setup,
+        autodist.py:120-128): fan the user script out to worker hosts, then
+        join the distributed runtime.  Single-node: only Cluster.start()
+        (a no-op)."""
+        if (self._cluster.num_processes > 1
+                and self._cluster.is_chief()
+                and self._coordinator is None):
+            self._coordinator = Coordinator(self._strategy, self._cluster)
+            self._coordinator.launch_clients()
+            import atexit
+            # Chief reaps remote workers at exit (reference autodist worker
+            # lifecycle, coordinator.py:92-110).  Bounded, so a chief-side
+            # crash after launch terminates workers instead of hanging.
+            atexit.register(self._coordinator.reap)
+        self._cluster.start()
+
     def create_distributed_session(self, mesh=None) -> DistributedSession:
         """Full build pipeline: strategy → compile → transform → session
         (reference _create_distributed_session, autodist.py:167-185)."""
@@ -134,6 +163,7 @@ class AutoDist:
             return self._session
         if self._strategy is None:
             self.build_strategy()
+        self._setup()
         if mesh is None:
             mesh = build_mesh(self._mesh_axes, resource_spec=self._resource_spec)
         compiled = StrategyCompiler(

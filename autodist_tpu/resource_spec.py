@@ -124,6 +124,10 @@ class ResourceSpec:
         self._ssh_configs: Dict[str, SSHConfig] = {}
         self.network_bandwidth_gbps: float = 1.0
         self.mesh_hint: Dict[str, int] = {}
+        # Remembered so the Coordinator can ship the spec file to workers
+        # (the reference relied on shared paths; we copy explicitly).
+        self.source_file: Optional[str] = (
+            os.path.abspath(resource_file) if resource_file else None)
 
         if resource_info is None and resource_file is not None:
             if not os.path.exists(resource_file):

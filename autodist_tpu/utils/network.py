@@ -1,0 +1,60 @@
+"""Network helpers.
+
+Parity with reference ``autodist/utils/network.py:1-75`` (``is_local_address``,
+local-ip discovery) without the ``netifaces`` dependency: stdlib ``socket``
+enumeration covers the hostname/loopback cases, and a UDP-connect probe
+recovers the primary outbound interface address.
+"""
+from __future__ import annotations
+
+import socket
+from typing import Set
+
+_LOCAL_SYNONYMS = {"localhost", "127.0.0.1", "0.0.0.0", "::1"}
+
+
+def local_addresses() -> Set[str]:
+    """All addresses that refer to this host."""
+    addrs = set(_LOCAL_SYNONYMS)
+    hostname = socket.gethostname()
+    addrs.add(hostname)
+    try:
+        addrs.add(socket.gethostbyname(hostname))
+    except OSError:
+        pass
+    try:
+        for info in socket.getaddrinfo(hostname, None):
+            addrs.add(info[4][0])
+    except OSError:
+        pass
+    # Primary outbound interface (no packets are sent by connect() on UDP).
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("8.8.8.8", 80))
+            addrs.add(s.getsockname()[0])
+    except OSError:
+        pass
+    return addrs
+
+
+def is_local_address(address: str) -> bool:
+    """Whether ``address`` (ip or hostname, optionally ``host:port``) is this
+    machine.  Reference ``autodist/utils/network.py`` semantics."""
+    host = address.rsplit(":", 1)[0] if _looks_like_host_port(address) else address
+    if host in _LOCAL_SYNONYMS:
+        return True
+    locals_ = local_addresses()
+    if host in locals_:
+        return True
+    try:
+        resolved = socket.gethostbyname(host)
+    except OSError:
+        return False
+    return resolved in locals_
+
+
+def _looks_like_host_port(address: str) -> bool:
+    if address.count(":") != 1:
+        return False
+    host, port = address.split(":")
+    return port.isdigit()
