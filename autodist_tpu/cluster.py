@@ -89,9 +89,14 @@ class Cluster:
 
     @property
     def local_process_id(self) -> int:
-        worker_addr = ENV.AUTODIST_WORKER.val
-        if worker_addr:
-            return self.process_id_for(worker_addr)
+        # Prefer the id the chief shipped explicitly — it is authoritative
+        # even if this process reconstructs the ResourceSpec with a
+        # different node ordering.
+        if ENV.AUTODIST_WORKER.val:
+            pid = os.environ.get(ENV.AUTODIST_PROCESS_ID.name)
+            if pid is not None:
+                return int(pid)
+            return self.process_id_for(ENV.AUTODIST_WORKER.val)
         return 0
 
     def is_chief(self, address: Optional[str] = None) -> bool:
@@ -180,7 +185,7 @@ class Cluster:
         conf = self._spec.ssh_config_for(address) or SSHConfig()
         env = {**conf.env, **(env or {})}
         env_prefix = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
-        inner = " ".join(args)
+        inner = " ".join(shlex.quote(a) for a in args)
         if conf.python_venv:
             inner = f"{conf.python_venv}; {inner}"
         if env_prefix:

@@ -62,6 +62,12 @@ class Coordinator:
             if spec.source_file:
                 self._cluster.remote_copy(spec.source_file, spec.source_file,
                                           node.address)
+            # Best-effort: ship the user script itself so workers don't need
+            # a shared filesystem for the code (the reference assumed
+            # identically-deployed code; we copy the entry script when we
+            # have it — packages still must be pre-deployed).
+            if argv and os.path.isfile(argv[0]):
+                self._cluster.remote_copy(argv[0], argv[0], node.address)
             env = {
                 ENV.AUTODIST_WORKER.name: node.address,
                 ENV.AUTODIST_STRATEGY_ID.name: self._strategy.id,
@@ -76,9 +82,12 @@ class Coordinator:
             }
             # Keep the cluster flavor consistent across processes: a pod
             # chief must produce pod workers (metadata rendezvous), not SSH
-            # workers pointed at a nonexistent coordination service.
-            if os.environ.get("AUTODIST_TPU_POD"):
-                env["AUTODIST_TPU_POD"] = os.environ["AUTODIST_TPU_POD"]
+            # workers pointed at a nonexistent coordination service.  Same
+            # for the workdir — the worker must deserialize the strategy
+            # from the directory the chief copied it into.
+            for passthrough in ("AUTODIST_TPU_POD", "AUTODIST_TPU_WORKDIR"):
+                if os.environ.get(passthrough):
+                    env[passthrough] = os.environ[passthrough]
             proc = self._cluster.remote_exec(
                 [sys.executable or "python", "-u"] + argv,
                 address=node.address, env=env)

@@ -1,0 +1,10 @@
+"""Native host runtime: buffer pool, prefetching data loader, bf16 cast.
+
+The device-side runtime on TPU is XLA/PJRT (the analog of the TF C++ runtime
+the reference delegated to, SURVEY.md §2.9); this package is the *host*-side
+native layer — the piece that must overlap with device steps to keep the MXU
+fed.
+"""
+from autodist_tpu.runtime.data_loader import DataLoader  # noqa: F401
+from autodist_tpu.runtime.native import (fp32_to_bf16,  # noqa: F401
+                                         native_available)

@@ -1,0 +1,199 @@
+"""Prefetching batch loader over in-memory arrays.
+
+The host-side half of the input pipeline: while the device runs step N, the
+native threads assemble batch N+1..N+k into staging buffers (shuffle + gather
++ optional fp32→bf16 cast).  This replaces the reference's feed-dict split
+machinery (``autodist/remapper.py:81-123``) — there the per-replica split
+happened at ``session.run`` time in Python; here batches stream through a
+bounded native queue and the mesh sharding does the splitting on device.
+
+Yielded arrays are views of pooled staging buffers, valid until the next
+iteration (copy them to keep them — the usual pinned-buffer contract).
+Fallback mode (no native lib) does the same work in numpy, preserving the
+exact batch order for a given seed.
+"""
+from __future__ import annotations
+
+import ctypes
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from autodist_tpu.runtime import native as _native
+from autodist_tpu.utils import logging
+
+ArrayDict = Union[Dict[str, np.ndarray], Sequence[np.ndarray]]
+
+
+class DataLoader:
+    """Iterate minibatches of one or more aligned arrays.
+
+    Args:
+      data: dict name→array or sequence of arrays; all share dim 0.
+      batch_size: rows per batch.
+      shuffle: permute rows each epoch (reshuffled per epoch from ``seed``).
+      drop_last: drop the final short batch.
+      to_bf16: names (or indices) of float32 arrays to convert to bfloat16
+        during gathering — host-side cast halves the bytes sent to HBM.
+      num_threads / prefetch_depth: native pipeline parallelism and queue
+        depth.
+      seed: epoch-0 shuffle seed; epoch k uses ``seed + k``.
+    """
+
+    def __init__(self, data: ArrayDict, batch_size: int,
+                 shuffle: bool = True, drop_last: bool = True,
+                 to_bf16: Sequence = (), num_threads: int = 4,
+                 prefetch_depth: int = 2, seed: int = 0):
+        if isinstance(data, dict):
+            self._names: Optional[List[str]] = list(data.keys())
+            arrays = [data[k] for k in self._names]
+        else:
+            self._names = None
+            arrays = list(data)
+        if not arrays:
+            raise ValueError("DataLoader needs at least one array")
+        n0 = arrays[0].shape[0]
+        for a in arrays:
+            if a.shape[0] != n0:
+                raise ValueError("all arrays must share dim 0 "
+                                 f"({a.shape[0]} != {n0})")
+        self._arrays = [np.ascontiguousarray(a) for a in arrays]
+        self._batch_size = int(batch_size)
+        self._shuffle = shuffle
+        self._drop_last = drop_last
+        self._num_threads = num_threads
+        self._prefetch_depth = prefetch_depth
+        self._seed = seed
+        self._epoch = 0
+
+        keys = self._names if self._names is not None else range(len(arrays))
+        self._cast = []
+        for i, k in enumerate(keys):
+            wants = (k in to_bf16) or (i in to_bf16 and self._names is None)
+            if wants and self._arrays[i].dtype != np.float32:
+                raise ValueError(f"to_bf16 target {k!r} is not float32")
+            self._cast.append(bool(wants))
+        if any(self._cast):
+            import ml_dtypes  # noqa: F401  (required for bf16 views)
+
+        self._use_native = _native.native_available()
+        if not self._use_native:
+            logging.debug("DataLoader: native runtime unavailable, "
+                          "numpy fallback active")
+
+    # -- shapes ------------------------------------------------------------
+    @property
+    def num_batches(self) -> int:
+        n = self._arrays[0].shape[0]
+        return n // self._batch_size if self._drop_last else -(-n // self._batch_size)
+
+    def _out_dtype(self, i: int):
+        if self._cast[i]:
+            import ml_dtypes
+            return ml_dtypes.bfloat16
+        return self._arrays[i].dtype
+
+    def _wrap(self, batch_list: List[np.ndarray]):
+        if self._names is None:
+            return tuple(batch_list)
+        return dict(zip(self._names, batch_list))
+
+    # -- iteration ---------------------------------------------------------
+    def __len__(self) -> int:
+        return self.num_batches
+
+    def __iter__(self):
+        epoch_seed = self._seed + self._epoch
+        self._epoch += 1
+        if self._use_native:
+            yield from self._iter_native(epoch_seed)
+        else:
+            yield from self._iter_numpy(epoch_seed)
+
+    def _iter_native(self, epoch_seed: int):
+        loader = _native.NativeLoader(
+            self._arrays, self._batch_size, self._drop_last, self._shuffle,
+            epoch_seed, self._num_threads, self._prefetch_depth, self._cast)
+        held = None
+        try:
+            while True:
+                rows, ptrs = loader.next()
+                if held is not None:
+                    loader.release(held)   # previous batch's buffers
+                    held = None
+                if rows == 0:
+                    break
+                held = ptrs
+                out = []
+                for i, a in enumerate(self._arrays):
+                    dt = self._out_dtype(i)
+                    shape = (rows,) + a.shape[1:]
+                    nbytes = int(np.prod(shape, dtype=np.int64)) * np.dtype(dt).itemsize
+                    buf = (ctypes.c_char * nbytes).from_address(ptrs[i])
+                    out.append(np.frombuffer(buf, dtype=dt).reshape(shape))
+                yield self._wrap(out)
+        finally:
+            loader.close()
+
+    def _iter_numpy(self, epoch_seed: int):
+        n = self._arrays[0].shape[0]
+        perm = np.arange(n, dtype=np.uint32)
+        if self._shuffle:
+            perm = _mt19937_64_permutation(n, epoch_seed)
+        for b in range(self.num_batches):
+            idx = perm[b * self._batch_size:(b + 1) * self._batch_size]
+            out = []
+            for i, a in enumerate(self._arrays):
+                rows = a[idx]
+                if self._cast[i]:
+                    import ml_dtypes
+                    rows = rows.astype(ml_dtypes.bfloat16)
+                out.append(rows)
+            yield self._wrap(out)
+
+
+def _mt19937_64_permutation(n: int, seed: int) -> np.ndarray:
+    """The exact Fisher-Yates permutation the native loader produces (C++
+    ``std::mt19937_64`` + modulo draw), so fallback and native mode yield
+    identical epochs for a given seed."""
+    perm = np.arange(n, dtype=np.uint32)
+    rng = _MT19937_64(seed)
+    for i in range(n - 1, 0, -1):
+        j = rng.next() % (i + 1)
+        perm[i], perm[j] = perm[j], perm[i]
+    return perm
+
+
+class _MT19937_64:
+    """Minimal mt19937_64 (values match std::mt19937_64)."""
+
+    _NN, _MM = 312, 156
+    _MATRIX_A = 0xB5026F5AA96619E9
+    _UM, _LM = 0xFFFFFFFF80000000, 0x7FFFFFFF
+
+    def __init__(self, seed: int):
+        self.mt = [0] * self._NN
+        self.mt[0] = seed & 0xFFFFFFFFFFFFFFFF
+        for i in range(1, self._NN):
+            self.mt[i] = (6364136223846793005 *
+                          (self.mt[i - 1] ^ (self.mt[i - 1] >> 62)) + i) \
+                & 0xFFFFFFFFFFFFFFFF
+        self.mti = self._NN
+
+    def next(self) -> int:
+        if self.mti >= self._NN:
+            for i in range(self._NN):
+                x = (self.mt[i] & self._UM) | \
+                    (self.mt[(i + 1) % self._NN] & self._LM)
+                xA = x >> 1
+                if x & 1:
+                    xA ^= self._MATRIX_A
+                self.mt[i] = self.mt[(i + self._MM) % self._NN] ^ xA
+            self.mti = 0
+        x = self.mt[self.mti]
+        self.mti += 1
+        x ^= (x >> 29) & 0x5555555555555555
+        x ^= (x << 17) & 0x71D67FFFEDA60000
+        x ^= (x << 37) & 0xFFF7EEE000000000
+        x ^= x >> 43
+        return x

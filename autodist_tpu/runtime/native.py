@@ -1,0 +1,159 @@
+"""ctypes bindings for the native host runtime (``native/runtime.cpp``).
+
+The shared library is built on first use with ``make`` (g++ is in the image;
+pybind11 is not, hence the C ABI + ctypes).  Every entry point has a
+pure-Python fallback so the package works where no toolchain exists — the
+loader then runs in numpy, losing only throughput, not behavior.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+from autodist_tpu.utils import logging
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+_LIB_NAME = "libautodist_runtime.so"
+
+_lib: Optional[ctypes.CDLL] = None
+_lib_lock = threading.Lock()
+_build_failed = False
+
+
+def _build_and_load() -> Optional[ctypes.CDLL]:
+    lib_path = os.path.join(_NATIVE_DIR, _LIB_NAME)
+    src_path = os.path.join(_NATIVE_DIR, "runtime.cpp")
+    if not os.path.exists(src_path):
+        return None
+    if (not os.path.exists(lib_path)
+            or os.path.getmtime(lib_path) < os.path.getmtime(src_path)):
+        try:
+            subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                           capture_output=True)
+        except (subprocess.CalledProcessError, FileNotFoundError) as e:
+            err = getattr(e, "stderr", b"") or b""
+            logging.warning("native runtime build failed (%s); using "
+                            "pure-Python fallback. %s", e,
+                            err.decode(errors="replace")[-500:])
+            return None
+    try:
+        lib = ctypes.CDLL(lib_path)
+    except OSError as e:
+        logging.warning("could not load %s: %s", lib_path, e)
+        return None
+
+    lib.ad_buffer_alloc.restype = ctypes.c_void_p
+    lib.ad_buffer_alloc.argtypes = [ctypes.c_size_t, ctypes.c_size_t]
+    lib.ad_buffer_free.argtypes = [ctypes.c_void_p]
+    lib.ad_fp32_to_bf16.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                    ctypes.c_size_t, ctypes.c_int]
+    lib.ad_loader_create.restype = ctypes.c_void_p
+    lib.ad_loader_create.argtypes = [
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_size_t),
+        ctypes.POINTER(ctypes.c_int), ctypes.c_int, ctypes.c_size_t,
+        ctypes.c_size_t, ctypes.c_int, ctypes.c_int, ctypes.c_uint64,
+        ctypes.c_int, ctypes.c_int]
+    lib.ad_loader_next.restype = ctypes.c_size_t
+    lib.ad_loader_next.argtypes = [ctypes.c_void_p,
+                                   ctypes.POINTER(ctypes.c_void_p)]
+    lib.ad_loader_release.argtypes = [ctypes.c_void_p,
+                                      ctypes.POINTER(ctypes.c_void_p),
+                                      ctypes.c_int]
+    lib.ad_loader_num_batches.restype = ctypes.c_size_t
+    lib.ad_loader_num_batches.argtypes = [ctypes.c_void_p]
+    lib.ad_loader_destroy.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The loaded native library, building it if needed; None when
+    unavailable (fallback mode)."""
+    global _lib, _build_failed
+    if _lib is not None or _build_failed:
+        return _lib
+    with _lib_lock:
+        if _lib is None and not _build_failed:
+            if os.environ.get("AUTODIST_NO_NATIVE"):
+                _build_failed = True
+            else:
+                _lib = _build_and_load()
+                if _lib is None:
+                    _build_failed = True
+    return _lib
+
+
+def native_available() -> bool:
+    return get_lib() is not None
+
+
+def fp32_to_bf16(src: np.ndarray, num_threads: int = 4) -> np.ndarray:
+    """Round-to-nearest-even fp32 → bfloat16 on the host.
+
+    Returns an array of dtype ``ml_dtypes.bfloat16`` (numpy's jax-compatible
+    bf16).  Native path is multi-threaded; fallback uses numpy."""
+    import ml_dtypes
+
+    src = np.ascontiguousarray(src, dtype=np.float32)
+    lib = get_lib()
+    if lib is None:
+        return src.astype(ml_dtypes.bfloat16)  # numpy RNE cast
+    out = np.empty(src.shape, dtype=np.uint16)
+    lib.ad_fp32_to_bf16(src.ctypes.data_as(ctypes.c_void_p),
+                        out.ctypes.data_as(ctypes.c_void_p),
+                        src.size, num_threads)
+    return out.view(ml_dtypes.bfloat16)
+
+
+class NativeLoader:
+    """Thin RAII wrapper over the C loader. One epoch per instance."""
+
+    def __init__(self, arrays, batch_size: int, drop_last: bool,
+                 shuffle: bool, seed: int, num_threads: int,
+                 prefetch_depth: int, cast_bf16_flags):
+        self._lib = get_lib()
+        assert self._lib is not None
+        self._arrays = [np.ascontiguousarray(a) for a in arrays]  # keep alive
+        n = len(self._arrays)
+        arr_ptrs = (ctypes.c_void_p * n)(
+            *[a.ctypes.data_as(ctypes.c_void_p).value for a in self._arrays])
+        row_bytes = (ctypes.c_size_t * n)(
+            *[a.strides[0] for a in self._arrays])
+        casts = (ctypes.c_int * n)(*[int(c) for c in cast_bf16_flags])
+        self._handle = self._lib.ad_loader_create(
+            arr_ptrs, row_bytes, casts, n, self._arrays[0].shape[0],
+            batch_size, int(drop_last), int(shuffle), seed & (2**64 - 1),
+            num_threads, prefetch_depth)
+        if not self._handle:
+            raise RuntimeError("ad_loader_create failed")
+        self._n = n
+
+    @property
+    def num_batches(self) -> int:
+        return self._lib.ad_loader_num_batches(self._handle)
+
+    def next(self):
+        """Returns (rows, ptrs) — ptrs must be passed to release(); rows == 0
+        signals end of epoch."""
+        ptrs = (ctypes.c_void_p * self._n)()
+        rows = self._lib.ad_loader_next(self._handle, ptrs)
+        return rows, ptrs
+
+    def release(self, ptrs) -> None:
+        self._lib.ad_loader_release(self._handle, ptrs, self._n)
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.ad_loader_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -7,14 +7,18 @@ recovers the primary outbound interface address.
 """
 from __future__ import annotations
 
+import functools
 import socket
 from typing import Set
 
 _LOCAL_SYNONYMS = {"localhost", "127.0.0.1", "0.0.0.0", "::1"}
 
 
+@functools.lru_cache(maxsize=1)
 def local_addresses() -> Set[str]:
-    """All addresses that refer to this host."""
+    """All addresses that refer to this host.  Cached: DNS lookups and the
+    UDP probe can each block for seconds on resolver-less hosts, and the
+    coordinator calls this several times per node during bootstrap."""
     addrs = set(_LOCAL_SYNONYMS)
     hostname = socket.gethostname()
     addrs.add(hostname)

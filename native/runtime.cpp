@@ -1,0 +1,297 @@
+// AutoDist-TPU native host runtime.
+//
+// The reference delegated all native-performance work to the TF C++ runtime
+// (SURVEY.md §2.9 — gRPC transport, accumulators, queues); on TPU the XLA/PJRT
+// runtime owns the device side, so the native layer that actually matters is
+// the HOST side of the input pipeline: assembling the next batch while the
+// current step runs on the chip.  This library provides:
+//
+//   * an aligned buffer pool (staging slabs for batch assembly),
+//   * a multi-threaded prefetching batch loader: shuffle -> gather rows from
+//     user arrays into contiguous staging buffers -> optional fp32->bf16
+//     cast (halves host->HBM transfer bytes) -> bounded ready queue,
+//   * a parallel fp32->bf16 conversion entry point usable standalone.
+//
+// Pure C ABI so Python binds with ctypes (no pybind11 in the image).
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <random>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Aligned buffer helpers
+// ---------------------------------------------------------------------------
+
+void* ad_buffer_alloc(size_t bytes, size_t alignment) {
+  if (alignment < sizeof(void*)) alignment = sizeof(void*);
+  void* p = nullptr;
+  if (posix_memalign(&p, alignment, bytes) != 0) return nullptr;
+  return p;
+}
+
+void ad_buffer_free(void* p) { free(p); }
+
+// ---------------------------------------------------------------------------
+// fp32 -> bf16 (round-to-nearest-even), multi-threaded
+// ---------------------------------------------------------------------------
+
+static inline uint16_t fp32_to_bf16_rne(uint32_t bits) {
+  // NaN-safe round-to-nearest-even truncation to the top 16 bits.
+  if ((bits & 0x7fffffffu) > 0x7f800000u) {  // NaN: keep payload bit set
+    return static_cast<uint16_t>((bits >> 16) | 0x0040u);
+  }
+  uint32_t rounding_bias = 0x7fffu + ((bits >> 16) & 1u);
+  return static_cast<uint16_t>((bits + rounding_bias) >> 16);
+}
+
+static void cast_range(const float* src, uint16_t* dst, size_t n) {
+  const uint32_t* s = reinterpret_cast<const uint32_t*>(src);
+  for (size_t i = 0; i < n; ++i) dst[i] = fp32_to_bf16_rne(s[i]);
+}
+
+void ad_fp32_to_bf16(const float* src, uint16_t* dst, size_t n,
+                     int num_threads) {
+  if (num_threads <= 1 || n < (1u << 16)) {
+    cast_range(src, dst, n);
+    return;
+  }
+  std::vector<std::thread> ts;
+  size_t chunk = (n + num_threads - 1) / num_threads;
+  for (int t = 0; t < num_threads; ++t) {
+    size_t lo = t * chunk;
+    if (lo >= n) break;
+    size_t hi = lo + chunk < n ? lo + chunk : n;
+    ts.emplace_back([=] { cast_range(src + lo, dst + lo, hi - lo); });
+  }
+  for (auto& t : ts) t.join();
+}
+
+// ---------------------------------------------------------------------------
+// Prefetching batch loader
+// ---------------------------------------------------------------------------
+
+struct AdArraySpec {
+  const uint8_t* data;   // base pointer of the source array
+  size_t row_bytes;      // bytes per row in the source
+  int cast_bf16;         // nonzero: source rows are fp32, emit bf16
+};
+
+struct AdBatch {
+  std::vector<uint8_t*> arrays;  // one staging buffer per source array
+  size_t rows;                   // rows actually gathered (last batch may be short)
+  size_t index;                  // batch ordinal within the epoch
+};
+
+struct AdLoader {
+  std::vector<AdArraySpec> specs;
+  size_t num_rows = 0;
+  size_t batch_size = 0;
+  int drop_last = 0;
+  int shuffle = 0;
+
+  std::vector<uint32_t> perm;          // row permutation for this epoch
+  size_t num_batches = 0;
+  std::atomic<size_t> next_batch{0};   // producer cursor
+
+  // buffer pool: each entry is one buffer-set (one buffer per array)
+  std::deque<std::vector<uint8_t*>> free_pool;
+  std::deque<AdBatch> ready;           // filled batches awaiting consumption
+  size_t ready_expect = 0;             // next ordinal handed to the consumer
+  std::deque<AdBatch> out_of_order;    // filled early by a faster thread
+
+  std::mutex mu;
+  std::condition_variable cv_free;     // producers wait for a free buffer-set
+  std::condition_variable cv_ready;    // consumer waits for the next batch
+  std::vector<std::thread> workers;
+  std::atomic<int> stopping{0};
+
+  size_t out_row_bytes(size_t i) const {
+    const AdArraySpec& s = specs[i];
+    return s.cast_bf16 ? s.row_bytes / 2 : s.row_bytes;
+  }
+};
+
+static void fill_batch(AdLoader* L, AdBatch* b) {
+  size_t start = b->index * L->batch_size;
+  size_t rows = b->rows;
+  for (size_t a = 0; a < L->specs.size(); ++a) {
+    const AdArraySpec& s = L->specs[a];
+    uint8_t* out = b->arrays[a];
+    if (!s.cast_bf16) {
+      for (size_t r = 0; r < rows; ++r) {
+        uint32_t src_row = L->perm[start + r];
+        memcpy(out + r * s.row_bytes, s.data + (size_t)src_row * s.row_bytes,
+               s.row_bytes);
+      }
+    } else {
+      size_t floats = s.row_bytes / 4;
+      for (size_t r = 0; r < rows; ++r) {
+        uint32_t src_row = L->perm[start + r];
+        cast_range(
+            reinterpret_cast<const float*>(s.data + (size_t)src_row * s.row_bytes),
+            reinterpret_cast<uint16_t*>(out + r * (s.row_bytes / 2)), floats);
+      }
+    }
+  }
+}
+
+static void worker_loop(AdLoader* L) {
+  while (!L->stopping.load()) {
+    // Acquire the staging buffer BEFORE claiming a batch index.  The other
+    // order deadlocks: a worker holding the lowest unfilled index can starve
+    // on the free pool while faster workers park every buffer in the
+    // out-of-order queue, which only drains once that lowest index arrives.
+    // Buffer-first guarantees every claimed index completes, so the in-order
+    // drain always advances.
+    std::vector<uint8_t*> bufs;
+    {
+      std::unique_lock<std::mutex> lk(L->mu);
+      L->cv_free.wait(lk, [&] { return L->stopping.load() || !L->free_pool.empty(); });
+      if (L->stopping.load()) return;
+      bufs = std::move(L->free_pool.front());
+      L->free_pool.pop_front();
+    }
+
+    size_t idx = L->next_batch.fetch_add(1);
+    if (idx >= L->num_batches) {
+      std::lock_guard<std::mutex> lk(L->mu);
+      L->free_pool.push_back(std::move(bufs));
+      return;
+    }
+
+    AdBatch b;
+    b.arrays = std::move(bufs);
+    b.index = idx;
+    size_t start = idx * L->batch_size;
+    size_t remaining = L->num_rows - start;
+    b.rows = remaining < L->batch_size ? remaining : L->batch_size;
+    fill_batch(L, &b);
+
+    {
+      std::unique_lock<std::mutex> lk(L->mu);
+      // Deliver in order so shuffled epochs are reproducible from the seed.
+      L->out_of_order.push_back(std::move(b));
+      for (;;) {
+        bool advanced = false;
+        for (auto it = L->out_of_order.begin(); it != L->out_of_order.end(); ++it) {
+          if (it->index == L->ready_expect) {
+            L->ready.push_back(std::move(*it));
+            L->out_of_order.erase(it);
+            ++L->ready_expect;
+            advanced = true;
+            break;
+          }
+        }
+        if (!advanced) break;
+      }
+      L->cv_ready.notify_all();
+    }
+  }
+}
+
+AdLoader* ad_loader_create(const void** arrays, const size_t* row_bytes,
+                           const int* cast_bf16, int num_arrays,
+                           size_t num_rows, size_t batch_size, int drop_last,
+                           int shuffle, uint64_t seed, int num_threads,
+                           int prefetch_depth) {
+  if (num_arrays <= 0 || num_rows == 0 || batch_size == 0) return nullptr;
+  AdLoader* L = new AdLoader();
+  for (int i = 0; i < num_arrays; ++i) {
+    AdArraySpec s;
+    s.data = static_cast<const uint8_t*>(arrays[i]);
+    s.row_bytes = row_bytes[i];
+    s.cast_bf16 = cast_bf16 ? cast_bf16[i] : 0;
+    if (s.cast_bf16 && (s.row_bytes % 4) != 0) { delete L; return nullptr; }
+    L->specs.push_back(s);
+  }
+  L->num_rows = num_rows;
+  L->batch_size = batch_size;
+  L->drop_last = drop_last;
+  L->shuffle = shuffle;
+
+  L->perm.resize(num_rows);
+  for (size_t i = 0; i < num_rows; ++i) L->perm[i] = (uint32_t)i;
+  if (shuffle) {
+    std::mt19937_64 rng(seed);
+    for (size_t i = num_rows - 1; i > 0; --i) {
+      size_t j = rng() % (i + 1);
+      std::swap(L->perm[i], L->perm[j]);
+    }
+  }
+  L->num_batches = drop_last ? num_rows / batch_size
+                             : (num_rows + batch_size - 1) / batch_size;
+
+  if (num_threads < 1) num_threads = 1;
+  if (prefetch_depth < 1) prefetch_depth = 1;
+  int pool_size = prefetch_depth + num_threads;
+  for (int p = 0; p < pool_size; ++p) {
+    std::vector<uint8_t*> bufs;
+    for (size_t a = 0; a < L->specs.size(); ++a) {
+      bufs.push_back(static_cast<uint8_t*>(
+          ad_buffer_alloc(batch_size * L->out_row_bytes(a), 64)));
+    }
+    L->free_pool.push_back(std::move(bufs));
+  }
+  for (int t = 0; t < num_threads; ++t) L->workers.emplace_back(worker_loop, L);
+  return L;
+}
+
+// Blocks until the next in-order batch is ready.  Fills out_ptrs (one pointer
+// per array; owned by the loader until ad_loader_release) and returns the row
+// count, or 0 at end of epoch.
+size_t ad_loader_next(AdLoader* L, void** out_ptrs) {
+  std::unique_lock<std::mutex> lk(L->mu);
+  size_t want = 0;
+  // The batch the consumer wants is ready_expect - ready.size() ... compute
+  // from the front of the ready queue instead: batches are pushed in order.
+  for (;;) {
+    if (!L->ready.empty()) break;
+    if (L->ready_expect >= L->num_batches) return 0;  // epoch drained
+    L->cv_ready.wait(lk);
+  }
+  AdBatch b = std::move(L->ready.front());
+  L->ready.pop_front();
+  want = b.rows;
+  for (size_t a = 0; a < b.arrays.size(); ++a) out_ptrs[a] = b.arrays[a];
+  // Ownership of the buffers passes to the consumer; remember nothing.
+  return want;
+}
+
+// Returns a consumed buffer-set to the pool.
+void ad_loader_release(AdLoader* L, void** ptrs, int num_arrays) {
+  std::vector<uint8_t*> bufs;
+  for (int a = 0; a < num_arrays; ++a)
+    bufs.push_back(static_cast<uint8_t*>(ptrs[a]));
+  {
+    std::lock_guard<std::mutex> lk(L->mu);
+    L->free_pool.push_back(std::move(bufs));
+  }
+  L->cv_free.notify_one();
+}
+
+size_t ad_loader_num_batches(AdLoader* L) { return L->num_batches; }
+
+void ad_loader_destroy(AdLoader* L) {
+  L->stopping.store(1);
+  L->cv_free.notify_all();
+  L->cv_ready.notify_all();
+  for (auto& t : L->workers) t.join();
+  std::lock_guard<std::mutex> lk(L->mu);
+  for (auto& bufs : L->free_pool)
+    for (auto* p : bufs) ad_buffer_free(p);
+  for (auto& b : L->ready)
+    for (auto* p : b.arrays) ad_buffer_free(p);
+  for (auto& b : L->out_of_order)
+    for (auto* p : b.arrays) ad_buffer_free(p);
+  delete L;
+}
+
+}  // extern "C"

@@ -97,7 +97,7 @@ def test_remote_exec_debug(two_node_spec, debug_remote):
 def test_remote_exec_local(two_node_spec, tmp_path):
     c = SSHCluster(two_node_spec)
     out = tmp_path / "probe"
-    proc = c.remote_exec([f"touch {out}"], "localhost")
+    proc = c.remote_exec(["touch", str(out)], "localhost")
     proc.wait()
     assert out.exists()
     c.terminate()
@@ -119,9 +119,9 @@ def test_remote_copy_local(two_node_spec, tmp_path):
     assert dst.read_text() == "payload"
 
 
-def test_coordinator_launch_debug(two_node_spec, debug_remote, tmp_path,
-                                  monkeypatch):
-    monkeypatch.setenv("AUTODIST_TPU_WORKDIR", str(tmp_path))
+def test_coordinator_launch_debug(two_node_spec, debug_remote):
+    # Note AUTODIST_TPU_WORKDIR can't be overridden here: const.py binds the
+    # strategy dir at import time, so the default /tmp workdir is in use.
     strategy = Strategy()
     c = SSHCluster(two_node_spec)
     coord = Coordinator(strategy, c)
@@ -138,8 +138,17 @@ def test_make_cluster_flavors(two_node_spec, monkeypatch):
 
 def test_terminate_kills_children(two_node_spec):
     c = SSHCluster(two_node_spec)
-    proc = c.remote_exec(["sleep 60"], "localhost")
+    proc = c.remote_exec(["sleep", "60"], "localhost")
     assert proc.poll() is None
     c.terminate()
     proc.wait()
     assert proc.poll() is not None
+
+
+def test_remote_exec_quotes_args(two_node_spec, tmp_path):
+    c = SSHCluster(two_node_spec)
+    out = tmp_path / "with space.txt"
+    proc = c.remote_exec(["touch", str(out)], "localhost")
+    proc.wait()
+    assert out.exists()
+    c.terminate()
