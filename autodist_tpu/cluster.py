@@ -186,10 +186,12 @@ class Cluster:
         env = {**conf.env, **(env or {})}
         env_prefix = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
         inner = " ".join(shlex.quote(a) for a in args)
-        if conf.python_venv:
-            inner = f"{conf.python_venv}; {inner}"
+        # Env assignments must prefix the *command*, after any venv
+        # activation — `FOO=bar source venv; cmd` drops FOO before cmd runs.
         if env_prefix:
             inner = f"{env_prefix} {inner}"
+        if conf.python_venv:
+            inner = f"{conf.python_venv}; {inner}"
 
         if is_local_address(address):
             full = ["bash", "-c", inner]

@@ -67,7 +67,13 @@ class Coordinator:
             # identically-deployed code; we copy the entry script when we
             # have it — packages still must be pre-deployed).
             if argv and os.path.isfile(argv[0]):
-                self._cluster.remote_copy(argv[0], argv[0], node.address)
+                try:
+                    self._cluster.remote_copy(argv[0], argv[0], node.address)
+                except Exception as e:  # genuinely best-effort: the code may
+                    # already be deployed at a read-only path on the worker
+                    logging.warning("could not ship %s to %s (%s); assuming "
+                                    "it is already deployed", argv[0],
+                                    node.address, e)
             env = {
                 ENV.AUTODIST_WORKER.name: node.address,
                 ENV.AUTODIST_STRATEGY_ID.name: self._strategy.id,

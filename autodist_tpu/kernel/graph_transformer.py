@@ -121,22 +121,36 @@ class GraphTransformer:
         optimizer = gi.optimizer
         has_aux = gi.has_aux
 
+        # Bounded staleness / proxy mirrors ride in sync_state (see
+        # stale_sync module; the SSP translation of the reference's token
+        # queues, ps_synchronizer.py:385-455).
+        from autodist_tpu.kernel.synchronization.stale_sync import (
+            StaleSync, uses_stale_path)
+        stale = StaleSync(gi, self.compiled) \
+            if uses_stale_path(self.compiled) else None
+
         def step(params, opt_state, sync_state, batch):
+            grad_params = params if stale is None \
+                else stale.before_grads(params, sync_state)
             if has_aux:
-                (loss, aux), grads = vg(params, batch)
+                (loss, aux), grads = vg(grad_params, batch)
             else:
-                loss, grads = vg(params, batch)
+                loss, grads = vg(grad_params, batch)
                 aux = None
             # Force the gradient layout the synchronizers chose: for PS/WUS
             # variables this lowers the data-axis reduction to
             # reduce-scatter; for sharded embeddings the scatter-add lands
             # on the owning shard.
             grads = su.constrain(grads, grad_sh)
+            if stale is not None:
+                grads, sync_state = stale.exchange(grads, sync_state)
             updates, opt_state = optimizer.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             # Fresh params return to their compute layout (all-gather for
             # WUS variables — "broadcast from the PS").
             params = su.constrain(params, param_sh)
+            if stale is not None:
+                sync_state = stale.after_update(params, sync_state)
             metrics = {"loss": loss}
             if aux is not None:
                 metrics["aux"] = aux
@@ -147,20 +161,28 @@ class GraphTransformer:
         # Batch shardings are per-leaf (data on dim 0, seq on dim 1 where it
         # applies) — leave them unspecified and let placed arguments carry
         # their own layout.
+        sync_sh = None if stale is None \
+            else stale.state_shardings(mesh, params)
         step_fn = jax.jit(
             step,
-            in_shardings=(param_sh, opt_sh, None, None),
-            out_shardings=(param_sh, opt_sh, None, None),
-            donate_argnums=(0, 1),
+            in_shardings=(param_sh, opt_sh, sync_sh, None),
+            out_shardings=(param_sh, opt_sh, sync_sh, None),
+            donate_argnums=(0, 1) if stale is None else (0, 1, 2),
         )
         init_fn = jax.jit(gi.optimizer.init, out_shardings=opt_sh)
+        if stale is None:
+            init_sync_state = dict
+        else:
+            def init_sync_state():
+                return jax.device_put(stale.init_state(params), sync_sh)
 
         logging.info(
             "GraphTransformer: compiled step over mesh %s (%d vars: %s)",
             dict(mesh.shape), len(self.compiled.var_plans),
             _plan_summary(self.compiled))
         return DistributedStep(
-            step_fn=step_fn, init_fn=init_fn, init_sync_state=dict,
+            step_fn=step_fn, init_fn=init_fn,
+            init_sync_state=init_sync_state,
             param_shardings=param_sh, opt_shardings=opt_sh,
             mesh=mesh, compiled_strategy=self.compiled)
 

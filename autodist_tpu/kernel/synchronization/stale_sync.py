@@ -1,0 +1,163 @@
+"""Bounded staleness (SSP) and proxy variables under bulk-synchronous XLA.
+
+The reference implements stale-synchronous parallel with token FIFOQueues on
+the PS: a worker may dequeue up to ``staleness`` tokens ahead of the chief's
+enqueues, so fast workers run at most ``staleness`` steps ahead of the
+slowest (``ps_synchronizer.py:385-455``; integration case c9 asserts exactly
+this run-ahead bound).  XLA programs are bulk-synchronous — per-worker step
+counts cannot diverge inside one jitted SPMD program — so the TPU-native
+translation models the *observable* of SSP instead of its mechanism:
+
+    a gradient computed at step t is applied at step t + s.
+
+That is the delayed-gradient pipeline: a rolling queue of ``s`` in-flight
+gradient pytrees rides in the synchronizer state; each step pops the oldest
+gradient (zeros during the first ``s`` warm-up steps — "no worker has
+reported yet"), applies it, and pushes the fresh one.  Fast workers running
+``s`` ahead of the PS and the PS applying s-step-old gradients are the same
+semantics viewed from opposite ends; convergence behavior (the reason SSP
+exists) is identical, and unlike token queues it is deterministic and
+profile-friendly.  Per-variable staleness from the strategy is honored:
+variables with ``staleness == 0`` keep their fresh gradient.
+
+Proxy variables (reference ``kernel/common/proxy_variable.py:46-190``): a
+worker-local mirror of a PS variable, refreshed after each update, so replica
+reads don't re-fetch from the PS.  Under GSPMD a replicated read *is* the
+all-gather XLA inserts, so a per-step proxy is free/implicit; the useful
+TPU analog is a *periodically refreshed* mirror — gradients are computed
+against a cached replicated copy refreshed every ``refresh_period`` steps,
+cutting the per-step all-gather traffic for weight-update-sharded variables
+at the price of (further) bounded parameter staleness.  ``local_replication``
+in the strategy opts a variable in; ``AUTODIST_PROXY_REFRESH`` (default 1 =
+reference semantics, always fresh) sets the period.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from autodist_tpu.graph_item import GraphItem, path_name
+from autodist_tpu.strategy.compiler import CompiledStrategy
+from autodist_tpu.utils import logging
+
+
+def stale_var_depths(compiled: CompiledStrategy) -> Dict[str, int]:
+    """Per-variable staleness depths (>0 only)."""
+    return {name: plan.staleness
+            for name, plan in compiled.var_plans.items() if plan.staleness > 0}
+
+
+def proxy_vars(compiled: CompiledStrategy) -> Tuple[str, ...]:
+    return tuple(name for name, plan in compiled.var_plans.items()
+                 if plan.local_replication)
+
+
+def proxy_refresh_period() -> int:
+    return max(1, int(os.environ.get("AUTODIST_PROXY_REFRESH", "1")))
+
+
+def uses_stale_path(compiled: CompiledStrategy) -> bool:
+    """Whether the step needs synchronizer state: any stale variable, or any
+    proxy variable with a refresh period > 1."""
+    if stale_var_depths(compiled):
+        return True
+    return bool(proxy_vars(compiled)) and proxy_refresh_period() > 1
+
+
+class StaleSync:
+    """Builds the gradient-delay queue and proxy cache around a step.
+
+    Used by the GraphTransformer: ``init_state(params)`` makes the sync-state
+    pytree; ``before_grads(params, state)`` substitutes proxy mirrors;
+    ``exchange(grads, state)`` returns (grads-to-apply, new-state);
+    ``after_update(params, state)`` refreshes proxy mirrors.
+    """
+
+    def __init__(self, gi: GraphItem, compiled: CompiledStrategy):
+        self.compiled = compiled
+        self.depths = stale_var_depths(compiled)
+        self.refresh = proxy_refresh_period()
+        self.proxied = proxy_vars(compiled) if self.refresh > 1 else ()
+        if self.depths:
+            logging.info("SSP: delayed-gradient pipeline active, depths=%s",
+                         self.depths)
+        if self.proxied:
+            logging.info("proxy variables (refresh every %d steps): %s",
+                         self.refresh, list(self.proxied))
+
+    # -- state -------------------------------------------------------------
+    def init_state(self, params: Any) -> Dict[str, Any]:
+        leaves = {path_name(p): leaf for p, leaf in
+                  jax.tree_util.tree_flatten_with_path(params)[0]}
+        queue = {}
+        for name, s in self.depths.items():
+            leaf = leaves[name]
+            queue[name] = jnp.zeros((s,) + tuple(leaf.shape),
+                                    dtype=leaf.dtype)
+        cache = {name: jnp.asarray(leaves[name]) for name in self.proxied}
+        return {"queue": queue, "cache": cache,
+                "step": jnp.zeros((), jnp.int32)}
+
+    def state_shardings(self, mesh, params) -> Any:
+        """Sharding tree matching init_state's output: queue leaves follow
+        the variable's opt layout with a leading (stacked) axis; caches are
+        replicated mirrors; the counter replicates."""
+        rep = NamedSharding(mesh, P())
+        queue_sh = {}
+        for name in self.depths:
+            spec = self.compiled.var_plans[name].opt_spec
+            queue_sh[name] = NamedSharding(mesh, P(None, *spec))
+        cache_sh = {name: rep for name in self.proxied}
+        return {"queue": queue_sh, "cache": cache_sh, "step": rep}
+
+    # -- step hooks --------------------------------------------------------
+    def before_grads(self, params: Any, state: Dict[str, Any]) -> Any:
+        """Parameters to differentiate against: proxied vars read their
+        (possibly stale) mirror."""
+        if not self.proxied:
+            return params
+        cache = state["cache"]
+
+        def swap(path, leaf):
+            name = path_name(path)
+            return cache[name] if name in cache else leaf
+
+        return jax.tree_util.tree_map_with_path(swap, params)
+
+    def exchange(self, grads: Any, state: Dict[str, Any]
+                 ) -> Tuple[Any, Dict[str, Any]]:
+        """Rolls stale variables' gradients through their delay queues."""
+        if not self.depths:
+            return grads, state
+        queue = dict(state["queue"])
+
+        def roll(path, g):
+            name = path_name(path)
+            if name not in queue:
+                return g
+            q = queue[name]
+            delayed = q[0]
+            queue[name] = jnp.concatenate([q[1:], g[None].astype(q.dtype)],
+                                          axis=0)
+            return delayed.astype(g.dtype)
+
+        grads = jax.tree_util.tree_map_with_path(roll, grads)
+        return grads, {**state, "queue": queue}
+
+    def after_update(self, params: Any, state: Dict[str, Any]
+                     ) -> Dict[str, Any]:
+        """Advance the step counter; refresh proxy mirrors on period."""
+        step = state["step"]
+        new_state = {**state, "step": step + 1}
+        if self.proxied:
+            do_refresh = (step + 1) % self.refresh == 0
+            leaves = {path_name(p): leaf for p, leaf in
+                      jax.tree_util.tree_flatten_with_path(params)[0]}
+            new_state["cache"] = {
+                name: jnp.where(do_refresh, leaves[name], cached)
+                for name, cached in state["cache"].items()}
+        return new_state

@@ -105,6 +105,8 @@ class DataLoader:
     def __iter__(self):
         epoch_seed = self._seed + self._epoch
         self._epoch += 1
+        if self._arrays[0].shape[0] == 0:
+            return  # empty split: zero batches in both modes
         if self._use_native:
             yield from self._iter_native(epoch_seed)
         else:
@@ -133,13 +135,28 @@ class DataLoader:
                     out.append(np.frombuffer(buf, dtype=dt).reshape(shape))
                 yield self._wrap(out)
         finally:
+            # Early break / GeneratorExit: return the buffer-set still held
+            # by the consumer, else destroy() can't free it (it only frees
+            # pool/ready/out-of-order sets).
+            if held is not None:
+                loader.release(held)
             loader.close()
+
+    # Above this row count the fallback stops paying for bit-exact parity
+    # with the native permutation (pure-Python Fisher-Yates is ~µs/row) and
+    # uses numpy's shuffle instead — same distribution, different order.
+    _EXACT_PARITY_MAX_ROWS = 1_000_000
 
     def _iter_numpy(self, epoch_seed: int):
         n = self._arrays[0].shape[0]
         perm = np.arange(n, dtype=np.uint32)
         if self._shuffle:
-            perm = _mt19937_64_permutation(n, epoch_seed)
+            if n <= self._EXACT_PARITY_MAX_ROWS:
+                perm = _mt19937_64_permutation(n, epoch_seed)
+            else:
+                logging.debug("fallback shuffle: %d rows > parity threshold,"
+                              " using numpy permutation", n)
+                np.random.default_rng(epoch_seed).shuffle(perm)
         for b in range(self.num_batches):
             idx = perm[b * self._batch_size:(b + 1) * self._batch_size]
             out = []

@@ -33,10 +33,22 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
         return None
     if (not os.path.exists(lib_path)
             or os.path.getmtime(lib_path) < os.path.getmtime(src_path)):
+        # Serialize concurrent builds across processes (several workers can
+        # land on one host): flock a sidecar, then re-check staleness — the
+        # loser of the race finds a fresh .so and skips its own make.
+        import fcntl
+
+        lock_path = os.path.join(_NATIVE_DIR, ".build.lock")
         try:
-            subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
-                           capture_output=True)
-        except (subprocess.CalledProcessError, FileNotFoundError) as e:
+            with open(lock_path, "w") as lock_f:
+                fcntl.flock(lock_f, fcntl.LOCK_EX)
+                if (not os.path.exists(lib_path)
+                        or os.path.getmtime(lib_path)
+                        < os.path.getmtime(src_path)):
+                    subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                                   capture_output=True)
+        except (subprocess.CalledProcessError, FileNotFoundError,
+                PermissionError) as e:
             err = getattr(e, "stderr", b"") or b""
             logging.warning("native runtime build failed (%s); using "
                             "pure-Python fallback. %s", e,

@@ -171,3 +171,21 @@ def test_loader_feeds_training(monkeypatch):
         for batch in loader:
             losses.append(float(session.run(batch)["loss"]))
     assert losses[-1] < 0.1 * losses[0]
+
+
+def test_empty_dataset_yields_no_batches():
+    x = np.empty((0, 4), np.float32)
+    loader = DataLoader((x,), batch_size=8)
+    assert list(loader) == []
+
+
+def test_early_break_then_new_epoch():
+    # Early break must release the held buffer-set (no leak, no deadlock on
+    # later epochs).
+    x, y = make_data(64, 3)
+    loader = DataLoader((x, y), batch_size=8, shuffle=False)
+    for _ in range(5):
+        for batch in loader:
+            break
+    full = collect_epoch(loader)
+    assert len(full) == 8
