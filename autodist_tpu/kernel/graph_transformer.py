@@ -98,6 +98,14 @@ class GraphTransformer:
         from autodist_tpu.kernel.synchronization import explicit_sync
         if explicit_sync.uses_explicit_path(self.compiled):
             if mesh.shape.get(MESH_AXIS_DATA, 1) > 1:
+                from autodist_tpu.kernel.synchronization.stale_sync import \
+                    uses_stale_path
+                if uses_stale_path(self.compiled):
+                    logging.warning(
+                        "strategy requests bounded staleness / proxy "
+                        "variables AND gradient compression; the explicit "
+                        "compressor path runs fully synchronous — "
+                        "staleness/proxy settings are ignored")
                 return self._transform_explicit(extra_metrics_fn)
             # No data axis ⇒ no gradient traffic to compress; the GSPMD path
             # is equivalent and supports arbitrary meshes.
@@ -171,10 +179,18 @@ class GraphTransformer:
         )
         init_fn = jax.jit(gi.optimizer.init, out_shardings=opt_sh)
         if stale is None:
-            init_sync_state = dict
+            def init_sync_state(current_params=None):
+                return {}
         else:
-            def init_sync_state():
-                return jax.device_put(stale.init_state(params), sync_sh)
+            # Takes the CURRENT params (a set_params/checkpoint restore must
+            # seed proxy caches from the restored values, not the capture-time
+            # ones); jitted with out_shardings so the delay queue's zeros are
+            # built shard-by-shard in place, never dense on one device.
+            jit_init = jax.jit(stale.init_state, out_shardings=sync_sh)
+
+            def init_sync_state(current_params=None):
+                return jit_init(params if current_params is None
+                                else current_params)
 
         logging.info(
             "GraphTransformer: compiled step over mesh %s (%d vars: %s)",

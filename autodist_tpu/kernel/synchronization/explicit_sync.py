@@ -73,7 +73,9 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy,
     has_aux = gi.has_aux
 
     # -- sync state --------------------------------------------------------
-    def init_sync_state():
+    def init_sync_state(current_params=None):
+        # Compressor residuals start at zero regardless of parameter values,
+        # so current_params only matters for shape (identical to capture-time).
         state: Dict[str, Any] = {}
         for name, leaf in gi.name_to_leaf().items():
             per_dev = comps[name].init_state(jnp.asarray(leaf))

@@ -154,10 +154,15 @@ class StaleSync:
         step = state["step"]
         new_state = {**state, "step": step + 1}
         if self.proxied:
-            do_refresh = (step + 1) % self.refresh == 0
             leaves = {path_name(p): leaf for p, leaf in
                       jax.tree_util.tree_flatten_with_path(params)[0]}
-            new_state["cache"] = {
-                name: jnp.where(do_refresh, leaves[name], cached)
-                for name, cached in state["cache"].items()}
+            fresh = {name: leaves[name] for name in state["cache"]}
+            # lax.cond (not where): the fresh branch's all-gather of
+            # weight-update-sharded params into the replicated cache must
+            # only execute on refresh steps — that traffic saving is the
+            # whole point of refresh_period > 1.
+            new_state["cache"] = jax.lax.cond(
+                (step + 1) % self.refresh == 0,
+                lambda: fresh,
+                lambda: dict(state["cache"]))
         return new_state

@@ -34,7 +34,7 @@ class DistributedSession:
         self._step = dist_step
         self._params = dist_step.place_params(graph_item.params)
         self._opt_state = dist_step.init_fn(self._params)
-        self._sync_state = dist_step.init_sync_state()
+        self._sync_state = dist_step.init_sync_state(self._params)
         self._step_count = 0
 
     # -- state -------------------------------------------------------------
@@ -108,7 +108,9 @@ class DistributedSession:
         them with the strategy's shardings.  Optimizer state is re-initialized."""
         self._params = self._step.place_params(params)
         self._opt_state = self._step.init_fn(self._params)
-        self._sync_state = self._step.init_sync_state()
+        # Seed from the NEW params — proxy caches must mirror the restored
+        # values, not the capture-time ones.
+        self._sync_state = self._step.init_sync_state(self._params)
 
     def load_state(self, params, opt_state, step: int = 0,
                    sync_state=None) -> None:
@@ -119,5 +121,5 @@ class DistributedSession:
         self._params = params
         self._opt_state = opt_state
         self._sync_state = (sync_state if sync_state is not None
-                            else self._step.init_sync_state())
+                            else self._step.init_sync_state(self._params))
         self._step_count = step

@@ -142,21 +142,21 @@ class DataLoader:
                 loader.release(held)
             loader.close()
 
-    # Above this row count the fallback stops paying for bit-exact parity
-    # with the native permutation (pure-Python Fisher-Yates is ~µs/row) and
-    # uses numpy's shuffle instead — same distribution, different order.
-    _EXACT_PARITY_MAX_ROWS = 1_000_000
+    # Above this row count the (always bit-exact) fallback shuffle gets
+    # noticeably slow (~0.5 s per 1M rows for the swap loop) — warn so the
+    # user knows the native loader is the fix, not a different shuffle.
+    _SLOW_SHUFFLE_WARN_ROWS = 4_000_000
 
     def _iter_numpy(self, epoch_seed: int):
         n = self._arrays[0].shape[0]
         perm = np.arange(n, dtype=np.uint32)
         if self._shuffle:
-            if n <= self._EXACT_PARITY_MAX_ROWS:
-                perm = _mt19937_64_permutation(n, epoch_seed)
-            else:
-                logging.debug("fallback shuffle: %d rows > parity threshold,"
-                              " using numpy permutation", n)
-                np.random.default_rng(epoch_seed).shuffle(perm)
+            if n > self._SLOW_SHUFFLE_WARN_ROWS:
+                logging.warning(
+                    "pure-Python fallback shuffling %d rows; this keeps "
+                    "bit-exact parity with the native loader but is slow — "
+                    "fix the native build for large datasets", n)
+            perm = _mt19937_64_permutation(n, epoch_seed)
         for b in range(self.num_batches):
             idx = perm[b * self._batch_size:(b + 1) * self._batch_size]
             out = []
@@ -172,45 +172,81 @@ class DataLoader:
 def _mt19937_64_permutation(n: int, seed: int) -> np.ndarray:
     """The exact Fisher-Yates permutation the native loader produces (C++
     ``std::mt19937_64`` + modulo draw), so fallback and native mode yield
-    identical epochs for a given seed."""
-    perm = np.arange(n, dtype=np.uint32)
+    identical epochs for a given seed — at ANY row count (multi-host jobs
+    where only some hosts fall back must still assemble identical global
+    batches).  RNG draws and the per-step modulo are vectorized in blocks;
+    only the swap chain itself is a Python loop."""
+    perm = list(range(n))
     rng = _MT19937_64(seed)
-    for i in range(n - 1, 0, -1):
-        j = rng.next() % (i + 1)
-        perm[i], perm[j] = perm[j], perm[i]
-    return perm
+    i = n - 1
+    while i >= 1:
+        block = min(i, 8192)
+        draws = rng.next_array(block)
+        # Fisher-Yates steps i, i-1, ..., i-block+1 use divisors i+1 .. .
+        divisors = np.arange(i + 1, i + 1 - block, -1, dtype=np.uint64)
+        for j in (draws % divisors).tolist():
+            perm[i], perm[j] = perm[j], perm[i]
+            i -= 1
+    return np.asarray(perm, dtype=np.uint32)
 
 
 class _MT19937_64:
-    """Minimal mt19937_64 (values match std::mt19937_64)."""
+    """Minimal mt19937_64 (values match std::mt19937_64), with the
+    state twist and output tempering vectorized over the 312-word state."""
 
     _NN, _MM = 312, 156
     _MATRIX_A = 0xB5026F5AA96619E9
     _UM, _LM = 0xFFFFFFFF80000000, 0x7FFFFFFF
 
     def __init__(self, seed: int):
-        self.mt = [0] * self._NN
-        self.mt[0] = seed & 0xFFFFFFFFFFFFFFFF
+        mt = [0] * self._NN
+        mt[0] = seed & 0xFFFFFFFFFFFFFFFF
         for i in range(1, self._NN):
-            self.mt[i] = (6364136223846793005 *
-                          (self.mt[i - 1] ^ (self.mt[i - 1] >> 62)) + i) \
+            mt[i] = (6364136223846793005 *
+                     (mt[i - 1] ^ (mt[i - 1] >> 62)) + i) \
                 & 0xFFFFFFFFFFFFFFFF
+        self.mt = np.array(mt, dtype=np.uint64)
         self.mti = self._NN
 
+    def _twist(self) -> None:
+        mt, NN, MM = self.mt, self._NN, self._MM
+        u64 = np.uint64
+        UM, LM, MA = u64(self._UM), u64(self._LM), u64(self._MATRIX_A)
+        one, zero = u64(1), u64(0)
+
+        def mix(cur, nxt, far):
+            x = (cur & UM) | (nxt & LM)
+            return far ^ (x >> one) ^ np.where(x & one, MA, zero)
+
+        # i < NN-MM reads only pre-twist words; NN-MM <= i < NN-1 reads
+        # mt[i-156] already updated this twist; i = NN-1 reads mt[0] (new).
+        mt[:NN - MM] = mix(mt[:NN - MM], mt[1:NN - MM + 1], mt[MM:])
+        mt[NN - MM:NN - 1] = mix(mt[NN - MM:NN - 1], mt[NN - MM + 1:],
+                                 mt[:MM - 1])
+        mt[NN - 1:] = mix(mt[NN - 1:], mt[:1], mt[MM - 1:MM])
+        self.mti = 0
+
+    @staticmethod
+    def _temper(x: np.ndarray) -> np.ndarray:
+        u64 = np.uint64
+        x = x ^ ((x >> u64(29)) & u64(0x5555555555555555))
+        x = x ^ ((x << u64(17)) & u64(0x71D67FFFEDA60000))
+        x = x ^ ((x << u64(37)) & u64(0xFFF7EEE000000000))
+        return x ^ (x >> u64(43))
+
+    def next_array(self, k: int) -> np.ndarray:
+        """Next ``k`` tempered outputs as a uint64 array."""
+        out = np.empty(k, dtype=np.uint64)
+        filled = 0
+        while filled < k:
+            if self.mti >= self._NN:
+                self._twist()
+            take = min(self._NN - self.mti, k - filled)
+            out[filled:filled + take] = self._temper(
+                self.mt[self.mti:self.mti + take])
+            self.mti += take
+            filled += take
+        return out
+
     def next(self) -> int:
-        if self.mti >= self._NN:
-            for i in range(self._NN):
-                x = (self.mt[i] & self._UM) | \
-                    (self.mt[(i + 1) % self._NN] & self._LM)
-                xA = x >> 1
-                if x & 1:
-                    xA ^= self._MATRIX_A
-                self.mt[i] = self.mt[(i + self._MM) % self._NN] ^ xA
-            self.mti = 0
-        x = self.mt[self.mti]
-        self.mti += 1
-        x ^= (x >> 29) & 0x5555555555555555
-        x ^= (x << 17) & 0x71D67FFFEDA60000
-        x ^= (x << 37) & 0xFFF7EEE000000000
-        x ^= x >> 43
-        return x
+        return int(self.next_array(1)[0])

@@ -47,8 +47,9 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
                         < os.path.getmtime(src_path)):
                     subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
                                    capture_output=True)
-        except (subprocess.CalledProcessError, FileNotFoundError,
-                PermissionError) as e:
+        except (subprocess.CalledProcessError, OSError) as e:
+            # OSError covers missing make, unwritable or read-only
+            # native/ dir (EROFS), etc. — all fall back to pure Python.
             err = getattr(e, "stderr", b"") or b""
             logging.warning("native runtime build failed (%s); using "
                             "pure-Python fallback. %s", e,

@@ -142,3 +142,24 @@ def test_stale_and_proxy_compose(monkeypatch):
         if (t + 1) % 2 == 0:
             cache = w.copy()
     np.testing.assert_allclose(w_both, w, rtol=1e-5, atol=1e-6)
+
+
+def test_set_params_reseeds_proxy_cache(monkeypatch):
+    """Restoring params must refresh proxy mirrors: the first post-restore
+    gradient is computed against the restored values, not capture-time ones."""
+    monkeypatch.setenv("AUTODIST_PROXY_REFRESH", "4")
+    import jax
+
+    params, loss_fn, _ = make_problem()
+    ad = AutoDist(strategy_builder=PS(local_proxy_variable=True))
+    ad.capture(params, optimizer=optax.sgd(0.1), loss_fn=loss_fn)
+    s = ad.create_distributed_session()
+    restored = {"w": np.full((4, 1), 2.0, np.float32)}
+    s.set_params(restored)
+    b = batches(1)[0]
+    s.run(b)
+    # One plain SGD step from the restored weights (mirror == restored value).
+    g = np.asarray(jax.grad(loss_fn)({"w": restored["w"]}, b)["w"])
+    np.testing.assert_allclose(np.asarray(s.params["w"]),
+                               restored["w"] - 0.1 * g,
+                               rtol=1e-5, atol=1e-6)
