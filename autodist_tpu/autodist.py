@@ -89,6 +89,7 @@ class AutoDist:
                 loss_fn: Optional[Callable] = None,
                 sparse_vars: Sequence[str] = (),
                 untrainable_vars: Sequence[str] = (),
+                pipeline_vars: Sequence[str] = (),
                 has_aux: bool = False) -> GraphItem:
         """Capture the training program (the explicit analog of the
         reference's optimizer/gradient monkeypatch hooks,
@@ -100,7 +101,7 @@ class AutoDist:
         self._graph_item = GraphItem(
             params, optimizer=optimizer, loss_fn=loss_fn,
             sparse_vars=sparse_vars, untrainable_vars=untrainable_vars,
-            has_aux=has_aux)
+            pipeline_vars=pipeline_vars, has_aux=has_aux)
         return self._graph_item
 
     @property

@@ -60,7 +60,8 @@ class VarInfo:
     shape: Tuple[int, ...]
     dtype: str
     trainable: bool = True
-    sparse: bool = False  # gradient has embedding/scatter structure
+    sparse: bool = False    # gradient has embedding/scatter structure
+    pipeline: bool = False  # leading dim is a pipeline-stage axis
 
     @property
     def byte_size(self) -> int:
@@ -68,12 +69,15 @@ class VarInfo:
 
     def to_dict(self) -> dict:
         return {"name": self.name, "shape": list(self.shape), "dtype": self.dtype,
-                "trainable": self.trainable, "sparse": self.sparse}
+                "trainable": self.trainable, "sparse": self.sparse,
+                "pipeline": self.pipeline}
 
     @classmethod
     def from_dict(cls, d: dict) -> "VarInfo":
         return cls(name=d["name"], shape=tuple(d["shape"]), dtype=d["dtype"],
-                   trainable=d.get("trainable", True), sparse=d.get("sparse", False))
+                   trainable=d.get("trainable", True),
+                   sparse=d.get("sparse", False),
+                   pipeline=d.get("pipeline", False))
 
 
 @dataclass
@@ -115,6 +119,10 @@ class GraphItem:
         builders treat these differently (e.g. Parallax, parallax_strategy.py:24-71).
       untrainable_vars: names (or prefixes) excluded from synchronization,
         e.g. batch-norm statistics.
+      pipeline_vars: names (or prefixes) of variables whose LEADING axis is a
+        pipeline-stage axis (stage-stacked parameters,
+        ``autodist_tpu/parallel/pipeline.py``); the compiler shards it over
+        the ``pipe`` mesh axis.  No reference analog (SURVEY §2.8: PP absent).
       has_aux: whether loss_fn returns ``(loss, aux)``.
     """
 
@@ -124,6 +132,7 @@ class GraphItem:
                  loss_fn: Optional[Callable] = None,
                  sparse_vars: Sequence[str] = (),
                  untrainable_vars: Sequence[str] = (),
+                 pipeline_vars: Sequence[str] = (),
                  has_aux: bool = False):
         self.params = params
         self.optimizer = optimizer
@@ -131,6 +140,7 @@ class GraphItem:
         self.has_aux = has_aux
         self._sparse_patterns = tuple(sparse_vars)
         self._untrainable_patterns = tuple(untrainable_vars)
+        self._pipeline_patterns = tuple(pipeline_vars)
         self.info = self._build_info()
 
     # -- catalog -----------------------------------------------------------
@@ -161,6 +171,7 @@ class GraphItem:
                 dtype=dtype,
                 trainable=not self._matches(name, self._untrainable_patterns),
                 sparse=self._matches(name, self._sparse_patterns),
+                pipeline=self._matches(name, self._pipeline_patterns),
             ))
         return Info(variables=infos)
 

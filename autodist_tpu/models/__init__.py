@@ -11,6 +11,7 @@ from autodist_tpu.models.densenet import densenet121  # noqa: F401
 from autodist_tpu.models.inception import inception_v3  # noqa: F401
 from autodist_tpu.models.lm1b import lm1b  # noqa: F401
 from autodist_tpu.models.ncf import ncf  # noqa: F401
+from autodist_tpu.models.pipelined_lm import pipelined_transformer_lm  # noqa: F401
 from autodist_tpu.models.resnet import resnet50, resnet101  # noqa: F401
 from autodist_tpu.models.transformer_lm import transformer_lm  # noqa: F401
 from autodist_tpu.models.vgg import vgg16  # noqa: F401
@@ -25,4 +26,5 @@ ALL_MODELS = {
     "lm1b": lm1b,
     "ncf": ncf,
     "transformer_lm": transformer_lm,
+    # pipelined_transformer_lm is mesh-parameterized; construct it directly.
 }

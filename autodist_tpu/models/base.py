@@ -31,6 +31,7 @@ class ModelSpec:
     make_batch: Callable           # (rng, batch_size) -> batch pytree
     sparse_vars: Tuple[str, ...] = ()
     untrainable_vars: Tuple[str, ...] = ()
+    pipeline_vars: Tuple[str, ...] = ()  # leading dim = pipeline-stage axis
     config: Dict[str, Any] = field(default_factory=dict)
 
     def sample_batch(self, batch_size: int, seed: int = 0):

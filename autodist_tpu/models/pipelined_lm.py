@@ -1,0 +1,106 @@
+"""Pipelined decoder LM: the flagship model with stage-stacked layers.
+
+Same architecture as :mod:`autodist_tpu.models.transformer_lm` (GPT-style
+causal LM, tied embedding head) but the transformer layers are *stacked*:
+every layer parameter carries a leading ``[num_layers]`` axis, reshaped to
+``[num_stages, layers_per_stage]`` at apply time and pipelined over the
+``pipe`` mesh axis (``autodist_tpu/parallel/pipeline.py``).  With
+``pipe == 1`` the stack runs as a plain ``lax.scan`` — the standard
+weight-stacked transformer formulation (compile-time win over unrolled
+layers as well).
+
+No reference analog: pipeline parallelism is absent there (SURVEY §2.8).
+
+Embedding/positional/final-norm parameters are ordinary variables — the
+strategy layer shards or replicates them as usual; the stacked ``stack/*``
+variables are flagged via ``ModelSpec.pipeline_vars`` so the compiler leads
+their PartitionSpec with ``pipe``.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh
+
+from autodist_tpu.models.base import ModelSpec, cross_entropy_loss
+from autodist_tpu.models.transformer import TransformerLayer, dense_attention
+from autodist_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
+
+
+def _layer_norm(x, scale, eps=1e-6):
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * scale
+
+
+def pipelined_transformer_lm(
+        mesh: Mesh, vocab_size: int = 32128, num_layers: int = 12,
+        num_heads: int = 12, head_dim: int = 64, d_ff: int = 3072,
+        max_len: int = 1024, attn_fn: Callable = dense_attention,
+        dtype=jnp.float32, seq_len: Optional[int] = None,
+        num_stages: Optional[int] = None,
+        num_microbatches: Optional[int] = None) -> ModelSpec:
+    """Stage-stacked GPT-style LM pipelined over ``mesh``'s ``pipe`` axis."""
+    seq_len = seq_len or max_len
+    d_model = num_heads * head_dim
+    stages = num_stages or mesh.shape.get("pipe", 1) or 1
+    if num_layers % stages:
+        raise ValueError(f"{num_layers} layers not divisible into "
+                         f"{stages} pipeline stages")
+    layer = TransformerLayer(num_heads, head_dim, d_ff, causal=True,
+                             attn_fn=attn_fn)
+
+    def init(rng):
+        r_emb, r_pos, r_stack = jax.random.split(rng, 3)
+        x = jnp.zeros((2, seq_len, d_model), dtype)
+        per_layer = [
+            layer.init(r, x)["params"]
+            for r in jax.random.split(r_stack, num_layers)]
+        return {
+            "embed": jax.random.normal(r_emb, (vocab_size, d_model),
+                                       dtype) * 0.02,
+            "pos_embed": jax.random.normal(r_pos, (max_len, d_model),
+                                           dtype) * 0.02,
+            "stack": stack_stage_params(per_layer),      # leading [L]
+            "ln_final": {"scale": jnp.ones((d_model,), dtype)},
+        }
+
+    def stage_fn(stage_params, x):
+        # One pipeline stage = scan over its layers_per_stage layers.
+        def body(h, lp):
+            return layer.apply({"params": lp}, h), None
+        out, _ = lax.scan(body, x, stage_params)
+        return out
+
+    def apply_fn(params, tokens):
+        x = jnp.take(params["embed"], tokens, axis=0) \
+            + params["pos_embed"][None, :tokens.shape[1]]
+        stacked = jax.tree_util.tree_map(
+            lambda a: a.reshape((stages, num_layers // stages) + a.shape[1:]),
+            params["stack"])
+        x = pipeline_apply(stage_fn, stacked, x, mesh,
+                           num_microbatches=num_microbatches)
+        x = _layer_norm(x, params["ln_final"]["scale"])
+        return jnp.einsum("btd,vd->btv", x, params["embed"])
+
+    def loss_fn(params, batch):
+        logits = apply_fn(params, batch["tokens"])
+        return cross_entropy_loss(logits[:, :-1], batch["tokens"][:, 1:])
+
+    def make_batch(rng: np.random.RandomState, batch_size: int):
+        return {"tokens": rng.randint(
+            0, vocab_size, (batch_size, seq_len)).astype(np.int32)}
+
+    return ModelSpec(
+        name="pipelined_transformer_lm",
+        init=init, loss_fn=loss_fn, apply_fn=apply_fn, make_batch=make_batch,
+        sparse_vars=("embed",),
+        pipeline_vars=("stack",),
+        config=dict(vocab_size=vocab_size, num_layers=num_layers,
+                    num_heads=num_heads, head_dim=head_dim, d_ff=d_ff,
+                    max_len=max_len, seq_len=seq_len, num_stages=stages),
+    )

@@ -1,0 +1,11 @@
+"""TPU hot-op kernels (Pallas).
+
+The compute path of the framework is JAX/XLA; ops that XLA's automatic
+fusion cannot produce (blockwise attention with online softmax) live here as
+Pallas kernels.  Everything degrades gracefully off-TPU via interpret mode so
+the CPU test mesh exercises the same code path.
+"""
+from autodist_tpu.ops.flash_attention import (  # noqa: F401
+    flash_attention,
+    make_flash_attention,
+)

@@ -1,8 +1,12 @@
 """Multi-dimensional parallelism beyond the reference's DP+PS scope.
 
-Currently: sequence/context parallelism — ring attention
-(ring_attention.py) and Ulysses all-to-all (ulysses.py).  Pipeline and
-expert parallelism land in pipeline.py / moe.py."""
+Sequence/context parallelism — ring attention (ring_attention.py) and
+Ulysses all-to-all (ulysses.py); pipeline parallelism (pipeline.py);
+expert parallelism lands in moe.py."""
+from autodist_tpu.parallel.pipeline import (  # noqa: F401
+    pipeline_apply,
+    stack_stage_params,
+)
 from autodist_tpu.parallel.ring_attention import make_ring_attention  # noqa: F401
 from autodist_tpu.parallel.ulysses import make_ulysses_attention  # noqa: F401
 

@@ -1,0 +1,146 @@
+"""Pipeline parallelism: GPipe-style microbatching over the ``pipe`` mesh axis.
+
+Absent from the reference (SURVEY §2.8: pipeline parallelism NO); new
+first-class scope for the TPU build.
+
+Design (the SPMD "pipelining on a mesh" formulation, cf. the scaling-book
+collective-matmul recipe rather than torch-style per-rank stage processes):
+
+* Stage parameters are *stacked*: every stage-local parameter carries a
+  leading ``[num_stages]`` axis, sharded over ``pipe`` — so the strategy
+  layer sees ordinary variables whose PartitionSpec leads with ``pipe``.
+* The whole pipeline runs inside ``shard_map`` manual over ``pipe``: one
+  ``lax.scan`` over ``num_microbatches + num_stages - 1`` ticks; each tick
+  every device applies its stage to its current activation, then the
+  activations rotate one hop along the ring via ``ppermute`` (nearest
+  neighbor on ICI).  Stage 0 injects a fresh microbatch each tick; the last
+  stage banks its result.
+* Backward is ``jax.grad`` through the scan — XLA reverses the ppermute
+  ring automatically, so no hand-written 1F1B schedule is needed; the
+  bubble is the GPipe bubble (S-1 ticks out of M+S-1).
+
+All other mesh axes stay auto (GSPMD) — data/model sharding of activations
+inside a stage composes transparently.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from autodist_tpu.const import MESH_AXIS_PIPE
+
+
+def _stage_slice(stacked: Any, keepdim: bool = False) -> Any:
+    """Inside shard_map the stage axis is length-1 per device; drop it."""
+    if keepdim:
+        return stacked
+    return jax.tree_util.tree_map(lambda x: jnp.squeeze(x, 0), stacked)
+
+
+def pipeline_apply(stage_fn: Callable, stage_params: Any, x: jax.Array,
+                   mesh: Mesh, *, num_microbatches: Optional[int] = None,
+                   axis_name: str = MESH_AXIS_PIPE) -> jax.Array:
+    """Apply a pipeline of ``S`` identical-signature stages to a batch.
+
+    Args:
+      stage_fn: ``(params_one_stage, x_microbatch) -> y_microbatch`` with
+        ``y`` shaped like ``x`` (inter-stage activations must be homogeneous
+        — true of transformer stacks).
+      stage_params: pytree whose leaves lead with a ``[S]`` stage axis
+        (shard it over ``pipe`` via ``PartitionSpec(axis_name, ...)``).
+      x: global batch ``[B, ...]``; must divide into ``num_microbatches``.
+      num_microbatches: defaults to ``S`` (minimum that fills the pipe).
+
+    Returns ``[B, ...]`` after all stages.
+    """
+    s = mesh.shape.get(axis_name, 1)
+    if s <= 1:
+        # No pipe axis: sequential scan over the stage dimension.
+        def body(h, p):
+            return stage_fn(p, h), None
+        out, _ = lax.scan(body, x, stage_params)
+        return out
+
+    m = num_microbatches or s
+    b = x.shape[0]
+    if b % m:
+        raise ValueError(f"batch {b} not divisible into {m} microbatches")
+    for leaf in jax.tree_util.tree_leaves(stage_params):
+        if leaf.shape[0] != s:
+            raise ValueError(
+                f"stage_params leading dim {leaf.shape[0]} != pipe axis "
+                f"size {s}")
+
+    return _jitted_pipeline(stage_fn, mesh, m, axis_name)(stage_params, x)
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_pipeline(stage_fn: Callable, mesh: Mesh, num_microbatches: int,
+                     axis_name: str) -> Callable:
+    local = functools.partial(_pipeline_local, stage_fn, axis_name=axis_name,
+                              num_microbatches=num_microbatches)
+    # Partial-manual: only the pipe axis is manualized; data/model sharding
+    # of the batch and stage params stays with GSPMD.  jit (inlined when the
+    # caller already traces) because eager shard_map with partial axis_names
+    # trips JAX's internal unmatch path — same workaround as
+    # ops/flash_attention.make_flash_attention.
+    return jax.jit(jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(axis_name), P()), out_specs=P(),
+        axis_names={axis_name}, check_vma=False,
+    ))
+
+
+def _pipeline_local(stage_fn: Callable, stage_params: Any, x: jax.Array, *,
+                    axis_name: str, num_microbatches: int) -> jax.Array:
+    """Per-device pipeline loop (inside shard_map over ``axis_name``)."""
+    s = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    m = num_microbatches
+    params = _stage_slice(stage_params)
+
+    mb = x.reshape((m, x.shape[0] // m) + x.shape[1:])  # [M, mb, ...]
+    zero = jnp.zeros_like(mb[0])
+    # Rotate forward: stage i sends to stage i+1 (ring; the wraparound
+    # carries garbage that stage 0 ignores).
+    perm = [(i, (i + 1) % s) for i in range(s)]
+
+    def tick(carry, t):
+        acc, a_in = carry
+        # Stage 0 picks up microbatch t (while available), others use the
+        # activation received from the previous stage.
+        feed = lax.dynamic_index_in_dim(mb, jnp.minimum(t, m - 1), 0,
+                                        keepdims=False)
+        a = jnp.where(idx == 0, feed, a_in)
+        y = stage_fn(params, a)
+        # Last stage banks microbatch t-(S-1) once it emerges.
+        out_slot = t - (s - 1)
+        bank = jnp.logical_and(idx == s - 1, out_slot >= 0)
+        slot = jnp.maximum(out_slot, 0)
+        cur = lax.dynamic_index_in_dim(acc, slot, 0, keepdims=False)
+        acc = lax.dynamic_update_index_in_dim(
+            acc, jnp.where(bank, y, cur), slot, 0)
+        a_next = lax.ppermute(y, axis_name, perm)
+        return (acc, a_next), None
+
+    vary = lambda v: lax.pcast(v, axis_name, to="varying")  # noqa: E731
+    acc0 = vary(jnp.zeros_like(mb))
+    (acc, _), _ = lax.scan(tick, (acc0, vary(zero)),
+                           jnp.arange(m + s - 1))
+    # Only the last stage holds real outputs; zero elsewhere — a psum
+    # replicates them across pipe (out_specs=P()).
+    acc = lax.psum(jnp.where(idx == s - 1, acc, jnp.zeros_like(acc)),
+                   axis_name)
+    return acc.reshape(x.shape)
+
+
+def stack_stage_params(per_stage_params) -> Any:
+    """Stack a list of per-stage pytrees into one pytree with a leading
+    ``[S]`` axis (helper for hand-built pipelines)."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
+                                  *per_stage_params)

@@ -37,7 +37,12 @@ from typing import Dict, List, Optional, Tuple
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from autodist_tpu.const import MESH_AXIS_DATA, MESH_AXIS_MODEL, MESH_AXIS_SEQ
+from autodist_tpu.const import (
+    MESH_AXIS_DATA,
+    MESH_AXIS_MODEL,
+    MESH_AXIS_PIPE,
+    MESH_AXIS_SEQ,
+)
 from autodist_tpu.graph_item import GraphItem, VarInfo
 from autodist_tpu.resource_spec import DeviceSpec
 from autodist_tpu.strategy.base import (
@@ -271,6 +276,11 @@ class StrategyCompiler:
         if d <= 1 or not var.shape:
             return param_spec
         entries = list(param_spec) + [None] * (len(var.shape) - len(param_spec))
+        if MESH_AXIS_DATA in entries:
+            # Already data-sharded on some dim (e.g. a PS partitioner lowered
+            # onto 'data' on a model-less mesh) — a second entry would be an
+            # invalid duplicate.
+            return param_spec
         best, best_dim = None, 0
         for i, dim in enumerate(var.shape):
             if entries[i] is None and dim % d == 0 and dim > best_dim:
@@ -308,9 +318,30 @@ class StrategyCompiler:
         return CompiledStrategy(strategy=strategy, mesh=self.mesh,
                                 var_plans=plans, batch_axes=grad_axes)
 
+    def _pipeline_spec(self, var: VarInfo, spec: P) -> P:
+        """Stage-stacked variables: shard the leading (stage) axis over
+        ``pipe``.  Applied after synchronizer lowering so it composes with
+        model/data sharding of the inner axes."""
+        pipe = self.mesh.shape.get(MESH_AXIS_PIPE, 1)
+        if pipe <= 1 or not var.shape:
+            return spec
+        if var.shape[0] % pipe != 0:
+            _warn_once(
+                "pipeline variable %s leading dim %d is not divisible by the "
+                "pipe axis (size %d); keeping it replicated", var.name,
+                var.shape[0], pipe)
+            return spec
+        entries = list(spec) + [None] * (len(var.shape) - len(spec))
+        entries[0] = MESH_AXIS_PIPE
+        return self._spec_from_entries(entries)
+
     def _compile_node(self, node: VarConfig, var: VarInfo,
                       model_axis: Optional[str]) -> VarPlan:
         axis, num_shards = parse_partitioner(node.partitioner)
+        if var.pipeline and axis == 0:
+            # Axis 0 is the stage axis (owned by 'pipe'); strategy
+            # partitioning must not claim it.
+            axis, num_shards = None, 1
         if axis is not None and (len(var.shape) <= axis or var.shape[axis] < 2):
             raise ValueError(
                 f"partitioner {node.partitioner!r} invalid for {var.name} "
@@ -322,6 +353,8 @@ class StrategyCompiler:
             # Shards stay colocated with replicas (reference layout) —
             # partition over 'model' only when the mesh has one.
             spec = self._partition_spec(var, axis, model_axis)
+            if var.pipeline:
+                spec = self._pipeline_spec(var, spec)
             return VarPlan(
                 var_name=var.name, sync_kind="AllReduce",
                 param_spec=spec, opt_spec=spec, grad_reduce_axes=grad_axes,
@@ -333,11 +366,17 @@ class StrategyCompiler:
         if isinstance(sync, PSSynchronizerConfig):
             shard_axis = model_axis or (MESH_AXIS_DATA if axis is not None else None)
             spec = self._partition_spec(var, axis, shard_axis)
-            if var.sparse and axis is None and var.shape:
+            if var.sparse and axis is None and var.shape and not var.pipeline:
                 # Sparse embedding on PS: shard the vocab axis so gradient
                 # scatter-adds land on the owning shard (Parallax lowering).
                 spec = self._partition_spec(var, 0, model_axis or MESH_AXIS_DATA)
-            opt_spec = spec if spec != P() else self._wus_opt_spec(var, spec)
+            if var.pipeline:
+                # Stage axis over pipe, then WUS fills a free dim with data
+                # (no-op if the spec already carries 'data' somewhere).
+                spec = self._pipeline_spec(var, spec)
+                opt_spec = self._wus_opt_spec(var, spec)
+            else:
+                opt_spec = spec if spec != P() else self._wus_opt_spec(var, spec)
             return VarPlan(
                 var_name=var.name, sync_kind="PS",
                 param_spec=spec, opt_spec=opt_spec, grad_reduce_axes=grad_axes,

@@ -1,0 +1,86 @@
+"""Flash-attention kernel vs the dense reference implementation.
+
+The dense softmax (``models/transformer.py:dense_attention``) is the oracle:
+forward outputs and gradients must agree to fp32 tolerance for causal and
+full attention, including under a sharded mesh (shard_map manual path).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from autodist_tpu.mesh import build_mesh
+from autodist_tpu.models.transformer import dense_attention
+from autodist_tpu.ops import flash_attention, make_flash_attention
+
+
+def _qkv(rng, b=2, t=32, h=2, d=16):
+    shape = (b, t, h, d)
+    q = jnp.asarray(rng.standard_normal(shape), jnp.float32)
+    k = jnp.asarray(rng.standard_normal(shape), jnp.float32)
+    v = jnp.asarray(rng.standard_normal(shape), jnp.float32)
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_forward_matches_dense(causal):
+    q, k, v = _qkv(np.random.default_rng(0))
+    out = flash_attention(q, k, v, causal, block_q=8, block_k=8)
+    ref = dense_attention(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_gradients_match_dense(causal):
+    q, k, v = _qkv(np.random.default_rng(1), t=16, d=8)
+    w = jnp.asarray(np.random.default_rng(2).standard_normal(
+        (2, 16, 2, 8)), jnp.float32)
+
+    def loss(attn):
+        def f(q, k, v):
+            return jnp.sum(attn(q, k, v, causal) * w)
+        return f
+
+    flash = lambda q, k, v, c: flash_attention(  # noqa: E731
+        q, k, v, c, block_q=8, block_k=8)
+    g_flash = jax.grad(loss(flash), argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(loss(dense_attention), argnums=(0, 1, 2))(q, k, v)
+    for gf, gd, name in zip(g_flash, g_dense, "qkv"):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gd),
+                                   rtol=1e-4, atol=1e-4, err_msg=f"d{name}")
+
+
+def test_uneven_blocks_picks_divisor():
+    # t=24 with requested block 128 → kernel must fall back to a divisor.
+    q, k, v = _qkv(np.random.default_rng(3), t=24)
+    out = flash_attention(q, k, v, True)
+    ref = dense_attention(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_sharded_matches_dense():
+    mesh = build_mesh({"data": 2, "model": 2, "seq": 1})
+    attn = make_flash_attention(mesh, block_q=8, block_k=8)
+    q, k, v = _qkv(np.random.default_rng(4), b=4, h=4)
+
+    @jax.jit
+    def run(q, k, v):
+        return attn(q, k, v, True)
+
+    with jax.set_mesh(mesh):
+        out = run(q, k, v)
+    ref = dense_attention(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_bf16_forward_close():
+    q, k, v = _qkv(np.random.default_rng(5))
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    out = flash_attention(qb, kb, vb, False, block_q=8, block_k=8)
+    ref = dense_attention(q, k, v, False)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out, np.float32), np.asarray(ref),
+                               rtol=5e-2, atol=5e-2)
