@@ -90,6 +90,7 @@ class AutoDist:
                 sparse_vars: Sequence[str] = (),
                 untrainable_vars: Sequence[str] = (),
                 pipeline_vars: Sequence[str] = (),
+                expert_vars: Sequence[str] = (),
                 has_aux: bool = False) -> GraphItem:
         """Capture the training program (the explicit analog of the
         reference's optimizer/gradient monkeypatch hooks,
@@ -101,7 +102,8 @@ class AutoDist:
         self._graph_item = GraphItem(
             params, optimizer=optimizer, loss_fn=loss_fn,
             sparse_vars=sparse_vars, untrainable_vars=untrainable_vars,
-            pipeline_vars=pipeline_vars, has_aux=has_aux)
+            pipeline_vars=pipeline_vars, expert_vars=expert_vars,
+            has_aux=has_aux)
         return self._graph_item
 
     @property

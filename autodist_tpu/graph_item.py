@@ -62,6 +62,7 @@ class VarInfo:
     trainable: bool = True
     sparse: bool = False    # gradient has embedding/scatter structure
     pipeline: bool = False  # leading dim is a pipeline-stage axis
+    expert: bool = False    # leading dim (after any stage axis) is experts
 
     @property
     def byte_size(self) -> int:
@@ -70,14 +71,15 @@ class VarInfo:
     def to_dict(self) -> dict:
         return {"name": self.name, "shape": list(self.shape), "dtype": self.dtype,
                 "trainable": self.trainable, "sparse": self.sparse,
-                "pipeline": self.pipeline}
+                "pipeline": self.pipeline, "expert": self.expert}
 
     @classmethod
     def from_dict(cls, d: dict) -> "VarInfo":
         return cls(name=d["name"], shape=tuple(d["shape"]), dtype=d["dtype"],
                    trainable=d.get("trainable", True),
                    sparse=d.get("sparse", False),
-                   pipeline=d.get("pipeline", False))
+                   pipeline=d.get("pipeline", False),
+                   expert=d.get("expert", False))
 
 
 @dataclass
@@ -123,6 +125,10 @@ class GraphItem:
         pipeline-stage axis (stage-stacked parameters,
         ``autodist_tpu/parallel/pipeline.py``); the compiler shards it over
         the ``pipe`` mesh axis.  No reference analog (SURVEY §2.8: PP absent).
+      expert_vars: names (or prefixes) of variables whose leading axis (or
+        the axis after the stage axis, if also in pipeline_vars) enumerates
+        MoE experts (``autodist_tpu/parallel/moe.py``); sharded over the
+        ``expert`` mesh axis.  No reference analog (SURVEY §2.8: EP absent).
       has_aux: whether loss_fn returns ``(loss, aux)``.
     """
 
@@ -133,6 +139,7 @@ class GraphItem:
                  sparse_vars: Sequence[str] = (),
                  untrainable_vars: Sequence[str] = (),
                  pipeline_vars: Sequence[str] = (),
+                 expert_vars: Sequence[str] = (),
                  has_aux: bool = False):
         self.params = params
         self.optimizer = optimizer
@@ -141,6 +148,7 @@ class GraphItem:
         self._sparse_patterns = tuple(sparse_vars)
         self._untrainable_patterns = tuple(untrainable_vars)
         self._pipeline_patterns = tuple(pipeline_vars)
+        self._expert_patterns = tuple(expert_vars)
         self.info = self._build_info()
 
     # -- catalog -----------------------------------------------------------
@@ -172,6 +180,7 @@ class GraphItem:
                 trainable=not self._matches(name, self._untrainable_patterns),
                 sparse=self._matches(name, self._sparse_patterns),
                 pipeline=self._matches(name, self._pipeline_patterns),
+                expert=self._matches(name, self._expert_patterns),
             ))
         return Info(variables=infos)
 

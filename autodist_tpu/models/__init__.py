@@ -10,8 +10,12 @@ from autodist_tpu.models.bert import bert, bert_base, bert_large  # noqa: F401
 from autodist_tpu.models.densenet import densenet121  # noqa: F401
 from autodist_tpu.models.inception import inception_v3  # noqa: F401
 from autodist_tpu.models.lm1b import lm1b  # noqa: F401
+from autodist_tpu.models.moe_lm import moe_transformer_lm  # noqa: F401
 from autodist_tpu.models.ncf import ncf  # noqa: F401
 from autodist_tpu.models.pipelined_lm import pipelined_transformer_lm  # noqa: F401
+from autodist_tpu.models.pipelined_moe_lm import (  # noqa: F401
+    pipelined_moe_transformer_lm,
+)
 from autodist_tpu.models.resnet import resnet50, resnet101  # noqa: F401
 from autodist_tpu.models.transformer_lm import transformer_lm  # noqa: F401
 from autodist_tpu.models.vgg import vgg16  # noqa: F401
@@ -26,5 +30,6 @@ ALL_MODELS = {
     "lm1b": lm1b,
     "ncf": ncf,
     "transformer_lm": transformer_lm,
-    # pipelined_transformer_lm is mesh-parameterized; construct it directly.
+    # pipelined_transformer_lm / moe_transformer_lm are mesh-parameterized;
+    # construct them directly.
 }

@@ -32,10 +32,19 @@ class ModelSpec:
     sparse_vars: Tuple[str, ...] = ()
     untrainable_vars: Tuple[str, ...] = ()
     pipeline_vars: Tuple[str, ...] = ()  # leading dim = pipeline-stage axis
+    expert_vars: Tuple[str, ...] = ()    # leading dim = MoE expert axis
     config: Dict[str, Any] = field(default_factory=dict)
 
     def sample_batch(self, batch_size: int, seed: int = 0):
         return self.make_batch(np.random.RandomState(seed), batch_size)
+
+
+def layer_norm(x, scale, eps=1e-6) -> jax.Array:
+    """Bias-free layer norm (matches flax ``nn.LayerNorm(use_bias=False)``)
+    for the functional (non-flax) models."""
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * scale
 
 
 def cross_entropy_loss(logits, labels) -> jax.Array:

@@ -1,0 +1,118 @@
+"""MoE decoder LM: the expert-parallel flagship variant.
+
+GPT-style causal LM where every layer's FFN is a top-2 routed
+mixture-of-experts (``autodist_tpu/parallel/moe.py``), expert weights
+sharded over the ``expert`` mesh axis via ``ModelSpec.expert_vars``.
+Attention is pluggable (dense / flash / ring) like the other LMs.
+
+Built functionally (plain parameter dicts, no flax) so the MoE layer's
+router/expert parameters keep explicit strategy-addressable names
+(``layers_i/moe/wi`` …).  No reference analog (SURVEY §2.8: EP absent).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from autodist_tpu.models.base import (
+    ModelSpec,
+    cross_entropy_loss,
+    layer_norm as _layer_norm,
+)
+from autodist_tpu.models.transformer import dense_attention
+from autodist_tpu.parallel.moe import init_moe_params, moe_ffn
+
+
+def _init_layer(rng, d_model, num_heads, head_dim, d_ff, num_experts, dtype):
+    r_q, r_k, r_v, r_o, r_moe = jax.random.split(rng, 5)
+    scale = 1.0 / (d_model ** 0.5)
+    pshape = (d_model, num_heads, head_dim)
+    return {
+        "ln_attn": jnp.ones((d_model,), dtype),
+        "wq": jax.random.normal(r_q, pshape, dtype) * scale,
+        "wk": jax.random.normal(r_k, pshape, dtype) * scale,
+        "wv": jax.random.normal(r_v, pshape, dtype) * scale,
+        "wo": jax.random.normal(r_o, (num_heads, head_dim, d_model),
+                                dtype) * scale,
+        "ln_mlp": jnp.ones((d_model,), dtype),
+        "moe": init_moe_params(r_moe, d_model, d_ff, num_experts, dtype),
+    }
+
+
+def _apply_layer(lp, x, attn_fn, mesh, capacity_factor):
+    h = _layer_norm(x, lp["ln_attn"])
+    q = jnp.einsum("btm,mhd->bthd", h, lp["wq"])
+    k = jnp.einsum("btm,mhd->bthd", h, lp["wk"])
+    v = jnp.einsum("btm,mhd->bthd", h, lp["wv"])
+    a = attn_fn(q, k, v, True)
+    x = x + jnp.einsum("bthd,hdm->btm", a, lp["wo"])
+    h = _layer_norm(x, lp["ln_mlp"])
+    y, aux = moe_ffn(lp["moe"], h, mesh=mesh,
+                     capacity_factor=capacity_factor)
+    return x + y, aux
+
+
+def moe_transformer_lm(
+        mesh: Mesh, vocab_size: int = 32128, num_layers: int = 12,
+        num_heads: int = 12, head_dim: int = 64, d_ff: int = 3072,
+        num_experts: int = 8, max_len: int = 1024,
+        attn_fn: Callable = dense_attention, capacity_factor: float = 2.0,
+        aux_weight: float = 1e-2, dtype=jnp.float32,
+        seq_len: Optional[int] = None) -> ModelSpec:
+    """Expert-parallel GPT-style LM; the load-balancing auxiliary loss is
+    folded into the training loss with weight ``aux_weight``."""
+    seq_len = seq_len or max_len
+    d_model = num_heads * head_dim
+
+    def init(rng):
+        r_emb, r_pos, r_layers = jax.random.split(rng, 3)
+        params = {
+            "embed": jax.random.normal(r_emb, (vocab_size, d_model),
+                                       dtype) * 0.02,
+            "pos_embed": jax.random.normal(r_pos, (max_len, d_model),
+                                           dtype) * 0.02,
+            "ln_final": jnp.ones((d_model,), dtype),
+        }
+        for i, r in enumerate(jax.random.split(r_layers, num_layers)):
+            params[f"layers_{i}"] = _init_layer(
+                r, d_model, num_heads, head_dim, d_ff, num_experts, dtype)
+        return params
+
+    def forward(params, tokens):
+        x = jnp.take(params["embed"], tokens, axis=0) \
+            + params["pos_embed"][None, :tokens.shape[1]]
+        aux_total = 0.0
+        for i in range(num_layers):
+            x, aux = _apply_layer(params[f"layers_{i}"], x, attn_fn, mesh,
+                                  capacity_factor)
+            aux_total = aux_total + aux
+        x = _layer_norm(x, params["ln_final"])
+        logits = jnp.einsum("btd,vd->btv", x, params["embed"])
+        return logits, aux_total / num_layers
+
+    def apply_fn(params, tokens):
+        return forward(params, tokens)[0]
+
+    def loss_fn(params, batch):
+        logits, aux = forward(params, batch["tokens"])
+        ce = cross_entropy_loss(logits[:, :-1], batch["tokens"][:, 1:])
+        return ce + aux_weight * aux
+
+    def make_batch(rng: np.random.RandomState, batch_size: int):
+        return {"tokens": rng.randint(
+            0, vocab_size, (batch_size, seq_len)).astype(np.int32)}
+
+    return ModelSpec(
+        name="moe_transformer_lm",
+        init=init, loss_fn=loss_fn, apply_fn=apply_fn, make_batch=make_batch,
+        sparse_vars=("embed",),
+        expert_vars=("*/moe/wi", "*/moe/wo"),
+        config=dict(vocab_size=vocab_size, num_layers=num_layers,
+                    num_heads=num_heads, head_dim=head_dim, d_ff=d_ff,
+                    num_experts=num_experts, max_len=max_len,
+                    seq_len=seq_len),
+    )

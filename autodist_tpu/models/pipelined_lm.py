@@ -26,15 +26,13 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh
 
-from autodist_tpu.models.base import ModelSpec, cross_entropy_loss
+from autodist_tpu.models.base import (
+    ModelSpec,
+    cross_entropy_loss,
+    layer_norm as _layer_norm,
+)
 from autodist_tpu.models.transformer import TransformerLayer, dense_attention
 from autodist_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
-
-
-def _layer_norm(x, scale, eps=1e-6):
-    mu = x.mean(-1, keepdims=True)
-    var = ((x - mu) ** 2).mean(-1, keepdims=True)
-    return (x - mu) * jax.lax.rsqrt(var + eps) * scale
 
 
 def pipelined_transformer_lm(

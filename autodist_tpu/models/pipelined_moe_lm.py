@@ -1,0 +1,115 @@
+"""Pipelined MoE decoder LM — pipeline AND expert parallelism in one model.
+
+Stage-stacked MoE transformer layers: every layer parameter leads with a
+``[num_layers]`` stage-stack axis (``pipeline_vars``), and the expert
+weights additionally carry the expert axis right after it
+(``stack/moe/wi``: ``[L, E, d_model, d_ff]`` → PartitionSpec
+``('pipe', 'expert', ...)``).  The pipeline rotates microbatches over the
+``pipe`` mesh axis (``parallel/pipeline.py``) while GSPMD lowers each
+stage's MoE dispatch to all-to-alls over ``expert``
+(``parallel/moe.py``).
+
+No reference analog: both parallelisms are absent there (SURVEY §2.8).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh
+
+from autodist_tpu.models.base import (
+    ModelSpec,
+    cross_entropy_loss,
+    layer_norm as _layer_norm,
+)
+from autodist_tpu.models.moe_lm import _apply_layer, _init_layer
+from autodist_tpu.models.transformer import dense_attention
+from autodist_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
+
+
+def pipelined_moe_transformer_lm(
+        mesh: Mesh, vocab_size: int = 32128, num_layers: int = 12,
+        num_heads: int = 12, head_dim: int = 64, d_ff: int = 3072,
+        num_experts: int = 8, max_len: int = 1024,
+        attn_fn: Callable = dense_attention, capacity_factor: float = 2.0,
+        aux_weight: float = 1e-2, dtype=jnp.float32,
+        seq_len: Optional[int] = None, num_stages: Optional[int] = None,
+        num_microbatches: Optional[int] = None) -> ModelSpec:
+    seq_len = seq_len or max_len
+    d_model = num_heads * head_dim
+    stages = num_stages or mesh.shape.get("pipe", 1) or 1
+    if num_layers % stages:
+        raise ValueError(f"{num_layers} layers not divisible into "
+                         f"{stages} pipeline stages")
+
+    def init(rng):
+        r_emb, r_pos, r_layers = jax.random.split(rng, 3)
+        per_layer = [
+            _init_layer(r, d_model, num_heads, head_dim, d_ff, num_experts,
+                        dtype)
+            for r in jax.random.split(r_layers, num_layers)]
+        return {
+            "embed": jax.random.normal(r_emb, (vocab_size, d_model),
+                                       dtype) * 0.02,
+            "pos_embed": jax.random.normal(r_pos, (max_len, d_model),
+                                           dtype) * 0.02,
+            "stack": stack_stage_params(per_layer),      # leading [L]
+            "ln_final": jnp.ones((d_model,), dtype),
+        }
+
+    def stage_fn(stage_params, xa):
+        # Carry = (activations, running aux loss) so the MoE balancing loss
+        # survives the pipeline's homogeneous-activation requirement.
+        x, aux = xa[..., :-1], xa[..., -1:]
+
+        def body(carry, lp):
+            h, a = carry
+            h, aux_i = _apply_layer(lp, h, attn_fn, mesh, capacity_factor)
+            return (h, a + aux_i), None
+        (x, aux_s), _ = lax.scan(body, (x, jnp.mean(aux)), stage_params)
+        aux_col = jnp.broadcast_to(aux_s, xa.shape[:-1] + (1,)).astype(
+            xa.dtype)
+        return jnp.concatenate([x, aux_col], axis=-1)
+
+    def forward(params, tokens):
+        x = jnp.take(params["embed"], tokens, axis=0) \
+            + params["pos_embed"][None, :tokens.shape[1]]
+        stacked = jax.tree_util.tree_map(
+            lambda a: a.reshape((stages, num_layers // stages) + a.shape[1:]),
+            params["stack"])
+        # Append an aux-loss channel so stage outputs stay shape-homogeneous.
+        xa = jnp.concatenate([x, jnp.zeros_like(x[..., :1])], axis=-1)
+        xa = pipeline_apply(stage_fn, stacked, xa, mesh,
+                            num_microbatches=num_microbatches)
+        x, aux = xa[..., :-1], jnp.mean(xa[..., -1])
+        x = _layer_norm(x, params["ln_final"])
+        logits = jnp.einsum("btd,vd->btv", x, params["embed"])
+        return logits, aux / num_layers
+
+    def apply_fn(params, tokens):
+        return forward(params, tokens)[0]
+
+    def loss_fn(params, batch):
+        logits, aux = forward(params, batch["tokens"])
+        ce = cross_entropy_loss(logits[:, :-1], batch["tokens"][:, 1:])
+        return ce + aux_weight * aux
+
+    def make_batch(rng: np.random.RandomState, batch_size: int):
+        return {"tokens": rng.randint(
+            0, vocab_size, (batch_size, seq_len)).astype(np.int32)}
+
+    return ModelSpec(
+        name="pipelined_moe_transformer_lm",
+        init=init, loss_fn=loss_fn, apply_fn=apply_fn, make_batch=make_batch,
+        sparse_vars=("embed",),
+        pipeline_vars=("stack",),
+        expert_vars=("stack/moe/wi", "stack/moe/wo"),
+        config=dict(vocab_size=vocab_size, num_layers=num_layers,
+                    num_heads=num_heads, head_dim=head_dim, d_ff=d_ff,
+                    num_experts=num_experts, max_len=max_len,
+                    seq_len=seq_len, num_stages=stages),
+    )

@@ -2,7 +2,8 @@
 
 Sequence/context parallelism — ring attention (ring_attention.py) and
 Ulysses all-to-all (ulysses.py); pipeline parallelism (pipeline.py);
-expert parallelism lands in moe.py."""
+expert parallelism / MoE (moe.py)."""
+from autodist_tpu.parallel.moe import init_moe_params, moe_ffn  # noqa: F401
 from autodist_tpu.parallel.pipeline import (  # noqa: F401
     pipeline_apply,
     stack_stage_params,

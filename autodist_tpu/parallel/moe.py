@@ -1,0 +1,133 @@
+"""Expert parallelism: mixture-of-experts FFN over the ``expert`` mesh axis.
+
+Absent from the reference (SURVEY §2.8: EP/MoE NO); new first-class scope.
+
+Formulation: GShard/Switch-style capacity-based routing (Lepikhin et al.
+2020, arxiv 2006.16668) expressed as dense einsums over one-hot dispatch/
+combine tensors — the TPU-idiomatic MoE: static shapes (capacity bounds the
+per-expert token count), MXU-friendly batched expert matmuls, and GSPMD
+inserts the expert all-to-alls from the sharding constraints alone
+(expert-major tensors lead with the ``expert`` axis; no hand-written
+``lax.all_to_all`` needed, though the layout is exactly the all-to-all
+dispatch of DeepSpeed-MoE/Tutel-style implementations).
+
+Router runs in fp32 (bf16 softmax over experts is noisy enough to flip
+top-k decisions).  The auxiliary load-balancing loss is returned to the
+caller — models fold it into the training loss.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from autodist_tpu.const import MESH_AXIS_DATA, MESH_AXIS_EXPERT
+
+
+def init_moe_params(rng, d_model: int, d_ff: int, num_experts: int,
+                    dtype=jnp.float32) -> dict:
+    """Router + stacked expert FFN weights (leading ``[E]`` axis — flag these
+    via ``expert_vars`` so the compiler shards it over ``expert``)."""
+    r_router, r_wi, r_wo = jax.random.split(rng, 3)
+    scale_in = 1.0 / (d_model ** 0.5)
+    scale_out = 1.0 / (d_ff ** 0.5)
+    return {
+        "router": (jax.random.normal(r_router, (d_model, num_experts),
+                                     jnp.float32) * scale_in),
+        "wi": (jax.random.normal(r_wi, (num_experts, d_model, d_ff),
+                                 dtype) * scale_in),
+        "wo": (jax.random.normal(r_wo, (num_experts, d_ff, d_model),
+                                 dtype) * scale_out),
+    }
+
+
+def _top2_dispatch(probs: jax.Array, capacity: int
+                   ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """probs [G, S, E] → (dispatch [G,S,E,C] bool, combine [G,S,E,C], aux).
+
+    G = groups (batch), S = tokens per group, E = experts, C = capacity.
+    Tokens overflowing an expert's capacity within their group are dropped
+    (their combine weight is zero — the residual connection carries them).
+    """
+    g, s, e = probs.shape
+
+    idx1 = jnp.argmax(probs, axis=-1)                       # [G,S]
+    mask1 = jax.nn.one_hot(idx1, e, dtype=probs.dtype)      # [G,S,E]
+    probs_wo1 = probs * (1.0 - mask1)
+    idx2 = jnp.argmax(probs_wo1, axis=-1)
+    mask2 = jax.nn.one_hot(idx2, e, dtype=probs.dtype)
+
+    # Positions within each expert's buffer, first-come-first-served along
+    # the token axis; second choices queue after all first choices.
+    pos1 = jnp.cumsum(mask1, axis=1) - mask1                # [G,S,E]
+    pos2 = jnp.cumsum(mask2, axis=1) - mask2 \
+        + jnp.sum(mask1, axis=1, keepdims=True)
+    keep1 = mask1 * (pos1 < capacity)
+    keep2 = mask2 * (pos2 < capacity)
+
+    w1 = jnp.sum(probs * keep1, axis=-1)                    # [G,S]
+    w2 = jnp.sum(probs * keep2, axis=-1)
+    denom = jnp.maximum(w1 + w2, 1e-9)
+    w1, w2 = w1 / denom, w2 / denom
+
+    oh1 = jax.nn.one_hot(jnp.sum(pos1 * keep1, axis=-1).astype(jnp.int32),
+                         capacity, dtype=probs.dtype)       # [G,S,C]
+    oh2 = jax.nn.one_hot(jnp.sum(pos2 * keep2, axis=-1).astype(jnp.int32),
+                         capacity, dtype=probs.dtype)
+    combine = (w1[..., None, None] * keep1[..., None] * oh1[:, :, None]
+               + w2[..., None, None] * keep2[..., None] * oh2[:, :, None])
+    dispatch = combine > 0.0                                # [G,S,E,C]
+
+    # Load-balancing aux loss (GShard eq. 4): fraction of tokens routed to
+    # each expert × mean router probability, summed over experts, scaled E.
+    frac = jnp.mean(mask1, axis=1)                          # [G,E]
+    prob_mean = jnp.mean(probs, axis=1)                     # [G,E]
+    aux = jnp.mean(jnp.sum(frac * prob_mean, axis=-1)) * e
+    return dispatch, combine, aux
+
+
+def moe_ffn(params: dict, x: jax.Array, *,
+            capacity_factor: float = 2.0,
+            mesh: Optional[Mesh] = None,
+            activation=jax.nn.gelu) -> Tuple[jax.Array, jax.Array]:
+    """Top-2 routed expert FFN.
+
+    Args:
+      params: dict from :func:`init_moe_params`.
+      x: ``[batch, seq, d_model]``.
+      capacity_factor: expert buffer size = ``cf · S / E`` per group.
+      mesh: optional — adds sharding constraints so expert-major
+        intermediates shard over ``expert`` (and groups over ``data``),
+        making GSPMD lower the dispatch/combine einsums to all-to-alls.
+
+    Returns ``(y [batch, seq, d_model], aux_loss scalar)``.
+    """
+    g, s, m = x.shape
+    e = params["router"].shape[-1]
+    capacity = max(1, int(capacity_factor * s / e))
+
+    logits = jnp.einsum("gsm,me->gse", x.astype(jnp.float32),
+                        params["router"])
+    probs = jax.nn.softmax(logits, axis=-1)
+    dispatch, combine, aux = _top2_dispatch(probs, capacity)
+    dispatch = dispatch.astype(x.dtype)
+    combine = combine.astype(x.dtype)
+
+    ep_sharding = None
+    if mesh is not None and mesh.shape.get(MESH_AXIS_EXPERT, 1) > 1:
+        ep_sharding = NamedSharding(mesh, P(
+            MESH_AXIS_EXPERT,
+            MESH_AXIS_DATA if mesh.shape.get(MESH_AXIS_DATA, 1) > 1
+            and g % mesh.shape[MESH_AXIS_DATA] == 0 else None))
+
+    expert_in = jnp.einsum("gsec,gsm->egcm", dispatch, x)   # [E,G,C,M]
+    if ep_sharding is not None:
+        expert_in = jax.lax.with_sharding_constraint(expert_in, ep_sharding)
+    h = activation(jnp.einsum("egcm,emf->egcf", expert_in, params["wi"]))
+    expert_out = jnp.einsum("egcf,efm->egcm", h, params["wo"])
+    if ep_sharding is not None:
+        expert_out = jax.lax.with_sharding_constraint(expert_out, ep_sharding)
+    y = jnp.einsum("gsec,egcm->gsm", combine, expert_out)
+    return y, aux

@@ -39,6 +39,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from autodist_tpu.const import (
     MESH_AXIS_DATA,
+    MESH_AXIS_EXPERT,
     MESH_AXIS_MODEL,
     MESH_AXIS_PIPE,
     MESH_AXIS_SEQ,
@@ -318,29 +319,52 @@ class StrategyCompiler:
         return CompiledStrategy(strategy=strategy, mesh=self.mesh,
                                 var_plans=plans, batch_axes=grad_axes)
 
-    def _pipeline_spec(self, var: VarInfo, spec: P) -> P:
-        """Stage-stacked variables: shard the leading (stage) axis over
-        ``pipe``.  Applied after synchronizer lowering so it composes with
-        model/data sharding of the inner axes."""
-        pipe = self.mesh.shape.get(MESH_AXIS_PIPE, 1)
-        if pipe <= 1 or not var.shape:
+    def _structural_spec(self, var: VarInfo, spec: P, target: int,
+                         mesh_axis: str, label: str) -> P:
+        """Shard structural dim ``target`` of ``var`` over ``mesh_axis`` if
+        it divides evenly; warn and keep the spec otherwise.  Applied after
+        synchronizer lowering so it composes with model/data sharding of the
+        remaining axes."""
+        size = self.mesh.shape.get(mesh_axis, 1)
+        if size <= 1 or len(var.shape) <= target:
             return spec
-        if var.shape[0] % pipe != 0:
+        if var.shape[target] % size != 0:
             _warn_once(
-                "pipeline variable %s leading dim %d is not divisible by the "
-                "pipe axis (size %d); keeping it replicated", var.name,
-                var.shape[0], pipe)
+                "%s variable %s dim %d (size %d) is not divisible by the "
+                "%r axis (size %d); keeping it replicated", label, var.name,
+                target, var.shape[target], mesh_axis, size)
             return spec
         entries = list(spec) + [None] * (len(var.shape) - len(spec))
-        entries[0] = MESH_AXIS_PIPE
+        entries[target] = mesh_axis
         return self._spec_from_entries(entries)
+
+    def _structural_axes(self, var: VarInfo) -> Tuple[int, ...]:
+        """Axes owned by pipeline/expert stacking — strategy partitioners
+        must not claim them."""
+        axes = []
+        if var.pipeline:
+            axes.append(0)
+        if var.expert:
+            axes.append(1 if var.pipeline else 0)
+        return tuple(axes)
+
+    def _apply_structural_specs(self, var: VarInfo, spec: P) -> P:
+        if var.pipeline:
+            # Leading dim = pipeline stages.
+            spec = self._structural_spec(var, spec, 0, MESH_AXIS_PIPE,
+                                         "pipeline")
+        if var.expert:
+            # Expert dim: leading, or right after a stage axis.
+            spec = self._structural_spec(var, spec, 1 if var.pipeline else 0,
+                                         MESH_AXIS_EXPERT, "expert")
+        return spec
 
     def _compile_node(self, node: VarConfig, var: VarInfo,
                       model_axis: Optional[str]) -> VarPlan:
         axis, num_shards = parse_partitioner(node.partitioner)
-        if var.pipeline and axis == 0:
-            # Axis 0 is the stage axis (owned by 'pipe'); strategy
-            # partitioning must not claim it.
+        if axis in self._structural_axes(var):
+            # Stage/expert axes are owned by 'pipe'/'expert'; strategy
+            # partitioning must not claim them.
             axis, num_shards = None, 1
         if axis is not None and (len(var.shape) <= axis or var.shape[axis] < 2):
             raise ValueError(
@@ -353,8 +377,7 @@ class StrategyCompiler:
             # Shards stay colocated with replicas (reference layout) —
             # partition over 'model' only when the mesh has one.
             spec = self._partition_spec(var, axis, model_axis)
-            if var.pipeline:
-                spec = self._pipeline_spec(var, spec)
+            spec = self._apply_structural_specs(var, spec)
             return VarPlan(
                 var_name=var.name, sync_kind="AllReduce",
                 param_spec=spec, opt_spec=spec, grad_reduce_axes=grad_axes,
@@ -366,14 +389,15 @@ class StrategyCompiler:
         if isinstance(sync, PSSynchronizerConfig):
             shard_axis = model_axis or (MESH_AXIS_DATA if axis is not None else None)
             spec = self._partition_spec(var, axis, shard_axis)
-            if var.sparse and axis is None and var.shape and not var.pipeline:
+            if (var.sparse and axis is None and var.shape
+                    and not (var.pipeline or var.expert)):
                 # Sparse embedding on PS: shard the vocab axis so gradient
                 # scatter-adds land on the owning shard (Parallax lowering).
                 spec = self._partition_spec(var, 0, model_axis or MESH_AXIS_DATA)
-            if var.pipeline:
-                # Stage axis over pipe, then WUS fills a free dim with data
-                # (no-op if the spec already carries 'data' somewhere).
-                spec = self._pipeline_spec(var, spec)
+            if var.pipeline or var.expert:
+                # Structural axes over pipe/expert, then WUS fills a free dim
+                # with data (no-op if the spec already carries 'data').
+                spec = self._apply_structural_specs(var, spec)
                 opt_spec = self._wus_opt_spec(var, spec)
             else:
                 opt_spec = spec if spec != P() else self._wus_opt_spec(var, spec)

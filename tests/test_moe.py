@@ -1,0 +1,156 @@
+"""MoE routing + expert parallelism.
+
+Oracles: (a) routing invariants (combine weights sum to ≤1, capacity is
+respected), (b) a per-token dense reference computation of the same top-2
+routed FFN, (c) expert-sharded mesh run == unsharded run, (d) end-to-end
+MoE LM training through AutoDist with the expert axis active.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from autodist_tpu.mesh import build_mesh
+from autodist_tpu.parallel.moe import _top2_dispatch, init_moe_params, moe_ffn
+
+
+def test_dispatch_invariants():
+    rng = np.random.default_rng(0)
+    probs = jax.nn.softmax(
+        jnp.asarray(rng.standard_normal((2, 16, 4)), jnp.float32))
+    capacity = 8
+    dispatch, combine, aux = _top2_dispatch(probs, capacity)
+    assert dispatch.shape == (2, 16, 4, 8)
+    # Each token occupies at most 2 expert slots with weights summing to ≤1.
+    per_token = combine.sum(axis=(2, 3))
+    assert float(per_token.max()) <= 1.0 + 1e-5
+    slots = dispatch.astype(np.int32).sum(axis=(2, 3))
+    assert int(slots.max()) <= 2
+    # No expert buffer slot is used twice within a group.
+    slot_use = dispatch.astype(np.int32).sum(axis=1)       # [G,E,C]
+    assert int(slot_use.max()) <= 1
+    assert float(aux) > 0.0
+
+
+def test_moe_ffn_matches_dense_reference():
+    """Reference: loop over tokens, apply each token's kept experts."""
+    rng = np.random.default_rng(1)
+    g, s, m, f, e = 2, 8, 4, 16, 4
+    params = init_moe_params(jax.random.PRNGKey(0), m, f, e)
+    x = jnp.asarray(rng.standard_normal((g, s, m)), jnp.float32)
+    capacity = s  # no drops
+    y, _ = moe_ffn(params, x, capacity_factor=float(capacity * e) / s)
+
+    probs = jax.nn.softmax(
+        jnp.einsum("gsm,me->gse", x, params["router"]), axis=-1)
+    dispatch, combine, _ = _top2_dispatch(probs, capacity)
+    y_ref = np.zeros((g, s, m), np.float32)
+    wsum = combine.sum(axis=(2, 3))
+    for gi in range(g):
+        for si in range(s):
+            acc = np.zeros(m, np.float32)
+            for ei in range(e):
+                w = float(combine[gi, si, ei].sum())
+                if w > 0:
+                    h = jax.nn.gelu(x[gi, si] @ params["wi"][ei])
+                    acc += w * np.asarray(h @ params["wo"][ei])
+            y_ref[gi, si] = acc
+    np.testing.assert_allclose(np.asarray(y), y_ref, rtol=1e-4, atol=1e-4)
+    # With capacity == s nothing drops: weights sum to 1 per token.
+    np.testing.assert_allclose(np.asarray(wsum), 1.0, rtol=1e-5)
+
+
+def test_expert_sharded_matches_unsharded():
+    rng = np.random.default_rng(2)
+    g, s, m, f, e = 4, 16, 8, 32, 4
+    params = init_moe_params(jax.random.PRNGKey(1), m, f, e)
+    x = jnp.asarray(rng.standard_normal((g, s, m)), jnp.float32)
+    y0, aux0 = moe_ffn(params, x)
+
+    mesh = build_mesh({"data": 2, "expert": 4})
+    shard = jax.NamedSharding(mesh, jax.sharding.PartitionSpec("expert"))
+    params_sh = dict(params)
+    params_sh["wi"] = jax.device_put(params["wi"], shard)
+    params_sh["wo"] = jax.device_put(params["wo"], shard)
+
+    @jax.jit
+    def run(p, x):
+        return moe_ffn(p, x, mesh=mesh)
+
+    with jax.set_mesh(mesh):
+        y1, aux1 = run(params_sh, x)
+    np.testing.assert_allclose(np.asarray(y0), np.asarray(y1),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(float(aux0), float(aux1), rtol=1e-6)
+
+
+def test_moe_lm_end_to_end():
+    import os
+    os.environ["AUTODIST_IS_TESTING"] = "True"
+    import optax
+    from autodist_tpu.autodist import AutoDist, \
+        _reset_default_autodist_for_testing
+    from autodist_tpu.models.moe_lm import moe_transformer_lm
+    from autodist_tpu.strategy import Parallax
+
+    axes = {"data": 2, "expert": 2, "model": 2}
+    mesh = build_mesh(axes)
+    spec = moe_transformer_lm(
+        mesh, vocab_size=64, num_layers=2, num_heads=2, head_dim=8,
+        d_ff=32, num_experts=4, max_len=16, seq_len=16)
+    params = spec.init(jax.random.PRNGKey(0))
+
+    _reset_default_autodist_for_testing()
+    ad = AutoDist(strategy_builder=Parallax(), mesh_axes=axes)
+    with ad.scope():
+        ad.capture(params=params, optimizer=optax.adam(1e-2),
+                   loss_fn=spec.loss_fn, sparse_vars=spec.sparse_vars,
+                   expert_vars=spec.expert_vars)
+    sess = ad.create_distributed_session(mesh=mesh)
+
+    # Expert weights must actually be sharded over the expert axis.
+    wi = sess.sharded_params["layers_0"]["moe"]["wi"]
+    assert "expert" in str(wi.sharding.spec)
+
+    batch = spec.sample_batch(8)
+    losses = [float(sess.run(batch)["loss"]) for _ in range(4)]
+    assert losses[-1] < losses[0]
+    assert all(np.isfinite(losses))
+
+
+def test_pipelined_moe_lm_end_to_end():
+    """Pipeline × expert × data in one program; must match the same model
+    on a no-pipe mesh step for step."""
+    import os
+    os.environ["AUTODIST_IS_TESTING"] = "True"
+    import optax
+    from autodist_tpu.autodist import AutoDist, \
+        _reset_default_autodist_for_testing
+    from autodist_tpu.models.pipelined_moe_lm import \
+        pipelined_moe_transformer_lm
+    from autodist_tpu.strategy import PSLoadBalancing
+
+    def run(axes):
+        _reset_default_autodist_for_testing()
+        mesh = build_mesh(axes)
+        spec = pipelined_moe_transformer_lm(
+            mesh, vocab_size=64, num_layers=4, num_heads=2, head_dim=8,
+            d_ff=32, num_experts=2, max_len=16, seq_len=16)
+        params = spec.init(jax.random.PRNGKey(0))
+        ad = AutoDist(strategy_builder=PSLoadBalancing(), mesh_axes=axes)
+        with ad.scope():
+            ad.capture(params=params, optimizer=optax.adam(1e-2),
+                       loss_fn=spec.loss_fn, sparse_vars=spec.sparse_vars,
+                       pipeline_vars=spec.pipeline_vars,
+                       expert_vars=spec.expert_vars)
+        sess = ad.create_distributed_session(mesh=mesh)
+        if axes.get("pipe", 1) > 1:
+            wi = sess.sharded_params["stack"]["moe"]["wi"]
+            assert "pipe" in str(wi.sharding.spec)
+            assert "expert" in str(wi.sharding.spec)
+        batch = spec.sample_batch(8)
+        return [float(sess.run(batch)["loss"]) for _ in range(3)]
+
+    piped = run({"pipe": 2, "expert": 2, "data": 2})
+    flat = run({"data": 8})
+    np.testing.assert_allclose(piped, flat, rtol=1e-4, atol=1e-4)
+    assert piped[-1] < piped[0]
