@@ -85,6 +85,13 @@ class ENV(enum.Enum):
     AUTODIST_IS_TESTING = ("AUTODIST_IS_TESTING", _bool)
     # print launch commands instead of executing them
     AUTODIST_DEBUG_REMOTE = ("AUTODIST_DEBUG_REMOTE", _bool)
+    # profiler-trace the first N session steps (0 = off); SURVEY §5.1 parity
+    # with the reference's RunOptions.trace_level timelines (runner.py:64-75)
+    AUTODIST_TRACE_STEPS = ("AUTODIST_TRACE_STEPS", _int0)
+    # dump staged program snapshots (plan table, StableHLO, optimized HLO);
+    # parity with the reference's per-stage graph dumps
+    # (kernel/graph_transformer.py:62-90)
+    AUTODIST_DUMP_GRAPHS = ("AUTODIST_DUMP_GRAPHS", _bool)
     # jax.distributed coordinator (host:port)
     AUTODIST_COORDINATOR_ADDRESS = ("AUTODIST_COORDINATOR_ADDRESS", _str)
     AUTODIST_NUM_PROCESSES = ("AUTODIST_NUM_PROCESSES", _int1)

@@ -19,7 +19,7 @@ import numpy as np
 from autodist_tpu.graph_item import GraphItem
 from autodist_tpu.kernel import sharding_utils as su
 from autodist_tpu.kernel.graph_transformer import DistributedStep
-from autodist_tpu.utils import logging
+from autodist_tpu.utils import logging, tracing
 
 
 class DistributedSession:
@@ -36,6 +36,13 @@ class DistributedSession:
         self._opt_state = dist_step.init_fn(self._params)
         self._sync_state = dist_step.init_sync_state(self._params)
         self._step_count = 0
+        # Tracing/dumps (SURVEY §5.1): keyed by the strategy id, the same
+        # run identifier the reference used for its artifact paths.
+        self._run_id = dist_step.compiled_strategy.strategy.id
+        self._tracer = tracing.RunTracer(self._run_id)
+        if tracing.dumps_enabled():
+            tracing.dump_stage(self._run_id, "1-strategy-plans",
+                               tracing.plan_table(dist_step.compiled_strategy))
 
     # -- state -------------------------------------------------------------
     @property
@@ -85,13 +92,33 @@ class DistributedSession:
         back-to-back steps dispatch asynchronously without a host round-trip
         per step."""
         batch = self._step.place_batch(batch)
-        self._params, self._opt_state, self._sync_state, metrics = \
-            self._step.step_fn(self._params, self._opt_state,
-                               self._sync_state, batch)
+        if self._step_count == 0 and tracing.dumps_enabled():
+            self._dump_programs(batch)
+        with self._tracer.step(self._step_count):
+            self._params, self._opt_state, self._sync_state, metrics = \
+                self._step.step_fn(self._params, self._opt_state,
+                                   self._sync_state, batch)
+        self._tracer.after_step(self._step_count)
         self._step_count += 1
         if not sync:
             return metrics
         return jax.tree_util.tree_map(lambda x: np.asarray(x), metrics)
+
+    def _dump_programs(self, batch) -> None:
+        """Staged program dumps at first run, when concrete shapes exist:
+        the traced StableHLO (transformed program) and the XLA-optimized
+        HLO (what executes — sharded, fused, collectives inserted).  The
+        compile is shared with the run via jit's cache."""
+        lowered = self._step.step_fn.lower(self._params, self._opt_state,
+                                           self._sync_state, batch)
+        tracing.dump_stage(self._run_id, "2-step-stablehlo",
+                           lowered.as_text())
+        try:
+            compiled = lowered.compile()
+            tracing.dump_stage(self._run_id, "3-step-optimized-hlo",
+                               compiled.as_text())
+        except Exception as e:  # pragma: no cover - backend-dependent
+            logging.warning("optimized-HLO dump unavailable: %r", e)
 
     def run_many(self, batches) -> Dict[str, Any]:
         """Run a sequence of batches with async dispatch (no host round-trip

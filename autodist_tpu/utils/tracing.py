@@ -1,0 +1,154 @@
+"""Tracing & per-stage program dumps.
+
+Parity target: reference auxiliary subsystem #1 (SURVEY §5.1) —
+Chrome-trace timelines per ``session.run`` when tracing is on
+(``autodist/runner.py:64-75, 117-132`` → ``/tmp/autodist/traces``) and graph
+snapshots at each transform stage (``kernel/graph_transformer.py:62-90`` →
+TensorBoard files under ``/tmp/autodist/graphs``).
+
+TPU-native translation:
+
+* run tracing → ``jax.profiler`` device traces (TensorBoard/perfetto
+  format — the XLA/TPU replacement for TF Chrome timelines), capturing the
+  first ``AUTODIST_TRACE_STEPS`` session steps under
+  ``$AUTODIST_TPU_WORKDIR/traces/<run-id>``, each step wrapped in a
+  ``StepTraceAnnotation``;
+* graph snapshots → staged *program* dumps under
+  ``$AUTODIST_TPU_WORKDIR/graphs/<run-id>/`` when ``AUTODIST_DUMP_GRAPHS``
+  is set: the strategy's per-variable plan table (the analog of
+  "1-after-partition"), the step's StableHLO right after tracing (the
+  "transformed graph"), and the XLA-optimized HLO after compilation (what
+  actually runs — sharded, fused, with collectives inserted).
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+from autodist_tpu.const import (
+    DEFAULT_GRAPH_DIR,
+    DEFAULT_TRACE_DIR,
+    ENV,
+)
+from autodist_tpu.utils import logging
+
+
+def dumps_enabled() -> bool:
+    return ENV.AUTODIST_DUMP_GRAPHS.val
+
+
+def dump_stage(run_id: str, tag: str, text: str) -> Optional[str]:
+    """Write one staged program dump; returns the path (None when off)."""
+    if not dumps_enabled():
+        return None
+    d = os.path.join(DEFAULT_GRAPH_DIR, run_id)
+    os.makedirs(d, exist_ok=True)
+    path = os.path.join(d, f"{tag}.txt")
+    with open(path, "w") as f:
+        f.write(text)
+    logging.info("dumped %s (%d bytes)", path, len(text))
+    return path
+
+
+def plan_table(compiled) -> str:
+    """Human-readable per-variable plan table (the partition/placement
+    snapshot — reference stage '1-after-partition')."""
+    lines = [f"mesh: {dict(compiled.mesh.shape)}",
+             f"batch axes: {compiled.batch_axes}", ""]
+    header = (f"{'variable':40s} {'sync':10s} {'param_spec':28s} "
+              f"{'opt_spec':28s} {'reduce':12s} extras")
+    lines.append(header)
+    lines.append("-" * len(header))
+    for name in sorted(compiled.var_plans):
+        p = compiled.var_plans[name]
+        extras = []
+        if p.compressor not in ("", "NoneCompressor"):
+            extras.append(f"compressor={p.compressor}")
+        if p.staleness:
+            extras.append(f"staleness={p.staleness}")
+        if p.num_shards > 1:
+            extras.append(f"shards={p.num_shards}@axis{p.partition_axis}")
+        if p.sparse:
+            extras.append("sparse")
+        lines.append(
+            f"{name:40s} {p.sync_kind:10s} {str(p.param_spec):28s} "
+            f"{str(p.opt_spec):28s} {','.join(p.grad_reduce_axes):12s} "
+            f"{' '.join(extras)}")
+    return "\n".join(lines) + "\n"
+
+
+# The JAX profiler allows one active trace per process; track the owner so
+# a second session (or interpreter exit) flushes a partial window instead of
+# losing it / crashing the next start_trace.
+_active_tracer: Optional["RunTracer"] = None
+_atexit_registered = False
+
+
+def flush_active_trace() -> None:
+    """Stop and write whichever trace window is currently open (no-op when
+    none is).  Called before a new window opens and at interpreter exit, so
+    sessions that run fewer steps than AUTODIST_TRACE_STEPS still produce a
+    (partial) trace."""
+    global _active_tracer
+    t = _active_tracer
+    _active_tracer = None
+    if t is not None and t._active:
+        t._active = False
+        jax.profiler.stop_trace()
+        logging.info("profiler trace written → %s", t._dir)
+
+
+class RunTracer:
+    """Profiler-trace controller for a session's first N steps.
+
+    ``AUTODIST_TRACE_STEPS=N`` captures steps 0..N-1 of every
+    DistributedSession into one ``jax.profiler`` trace.  Viewable with
+    TensorBoard's profile plugin or perfetto.
+    """
+
+    def __init__(self, run_id: str):
+        self._steps = ENV.AUTODIST_TRACE_STEPS.val
+        self._dir = os.path.join(DEFAULT_TRACE_DIR, run_id)
+        self._active = False
+
+    @property
+    def enabled(self) -> bool:
+        return self._steps > 0
+
+    def step(self, step_count: int):
+        """Returns a context manager annotating this step; starts/stops the
+        trace session at the capture-window edges."""
+        if not self.enabled:
+            return _NULL_CTX
+        if step_count == 0 and not self._active:
+            global _active_tracer, _atexit_registered
+            flush_active_trace()  # a prior session's partial window
+            if not _atexit_registered:
+                import atexit
+                atexit.register(flush_active_trace)
+                _atexit_registered = True
+            os.makedirs(self._dir, exist_ok=True)
+            jax.profiler.start_trace(self._dir)
+            self._active = True
+            _active_tracer = self
+            logging.info("profiler trace started → %s (%d steps)",
+                         self._dir, self._steps)
+        return jax.profiler.StepTraceAnnotation("autodist_step",
+                                                step_num=step_count)
+
+    def after_step(self, step_count: int) -> None:
+        if self._active and step_count + 1 >= self._steps:
+            flush_active_trace()
+
+
+class _NullCtx:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_CTX = _NullCtx()

@@ -1,0 +1,59 @@
+"""Shared harness for the benchmark examples.
+
+Parity target: the reference's ``examples/benchmark`` scripts measure
+throughput with a ``TimeHistory`` Keras callback
+(``examples/benchmark/imagenet.py:85-120``); here one loop serves every
+model family: build the ModelSpec, capture it under AutoDist, run warmup +
+timed steps with async dispatch, report items/sec.
+"""
+import argparse
+import time
+
+import numpy as np
+
+
+def benchmark_args(description: str) -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=description)
+    p.add_argument("--strategy", default="AllReduce",
+                   help="strategy builder name (PS, PSLoadBalancing, "
+                        "PartitionedPS, AllReduce, PartitionedAR, Parallax, …)")
+    p.add_argument("--resource-spec", default=None,
+                   help="resource_spec.yml path (default: local devices)")
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--warmup", type=int, default=3)
+    p.add_argument("--lr", type=float, default=1e-3)
+    return p
+
+
+def make_autodist(args, mesh_axes=None):
+    from autodist_tpu import AutoDist
+    from autodist_tpu import strategy as strategies
+
+    builder = getattr(strategies, args.strategy)()
+    return AutoDist(resource_spec_file=args.resource_spec,
+                    strategy_builder=builder, mesh_axes=mesh_axes)
+
+
+def run_benchmark(spec, sess, batch_size: int, steps: int, warmup: int,
+                  unit: str = "samples", items_per_batch: int = None):
+    """Warmup, then timed steps with async dispatch (the input pipeline
+    re-feeds one pre-placed batch, isolating compute+sync throughput)."""
+    batch = sess.place_batch(spec.sample_batch(batch_size))
+    for _ in range(warmup):
+        sess.run(batch, sync=False)
+    loss = float(sess.run(batch)["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps - 1):
+        sess.run(batch, sync=False)
+    metrics = sess.run(batch)  # host sync closes the timing window
+    dt = time.perf_counter() - t0
+
+    items = (items_per_batch or batch_size) * steps
+    rate = items / dt
+    print(f"{spec.name}: {rate:,.1f} {unit}/sec "
+          f"({steps} steps x batch {batch_size} in {dt:.2f}s), "
+          f"loss {loss:.4f} -> {float(metrics['loss']):.4f}")
+    assert np.isfinite(float(metrics["loss"]))
+    return rate
