@@ -91,6 +91,7 @@ class AutoDist:
                 untrainable_vars: Sequence[str] = (),
                 pipeline_vars: Sequence[str] = (),
                 expert_vars: Sequence[str] = (),
+                remat: Optional[str] = None,
                 has_aux: bool = False) -> GraphItem:
         """Capture the training program (the explicit analog of the
         reference's optimizer/gradient monkeypatch hooks,
@@ -103,7 +104,7 @@ class AutoDist:
             params, optimizer=optimizer, loss_fn=loss_fn,
             sparse_vars=sparse_vars, untrainable_vars=untrainable_vars,
             pipeline_vars=pipeline_vars, expert_vars=expert_vars,
-            has_aux=has_aux)
+            remat=remat, has_aux=has_aux)
         return self._graph_item
 
     @property

@@ -30,6 +30,30 @@ import jax.numpy as jnp
 import numpy as np
 
 
+_REMAT_POLICIES = {
+    "full": lambda: None,  # no saveable policy: recompute everything
+    "dots": lambda: jax.checkpoint_policies.checkpoint_dots,
+    "dots_no_batch":
+        lambda: jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+}
+
+
+def _apply_remat(loss_fn: Optional[Callable], remat: Optional[str]
+                 ) -> Optional[Callable]:
+    """Wrap loss_fn in ``jax.checkpoint`` per the named policy (see
+    GraphItem docstring); identity when off."""
+    if loss_fn is None or remat in (None, "", "none"):
+        return loss_fn
+    if remat not in _REMAT_POLICIES:
+        raise ValueError(
+            f"unknown remat policy {remat!r}; choose from "
+            f"{sorted(_REMAT_POLICIES)} or None")
+    policy = _REMAT_POLICIES[remat]()
+    if policy is None:
+        return jax.checkpoint(loss_fn)
+    return jax.checkpoint(loss_fn, policy=policy)
+
+
 def path_name(path: Tuple) -> str:
     """Human-readable, stable name for a pytree key path: parts joined by '/'.
 
@@ -129,6 +153,14 @@ class GraphItem:
         the axis after the stage axis, if also in pipeline_vars) enumerates
         MoE experts (``autodist_tpu/parallel/moe.py``); sharded over the
         ``expert`` mesh axis.  No reference analog (SURVEY §2.8: EP absent).
+      remat: gradient rematerialization policy — trades FLOPs for HBM by
+        recomputing activations in the backward pass (``jax.checkpoint``).
+        One of ``None``/``"none"`` (off), ``"full"`` (recompute everything),
+        ``"dots"`` (save matmul outputs only,
+        ``checkpoint_dots``), ``"dots_no_batch"``
+        (``checkpoint_dots_with_no_batch_dims`` — the usual transformer
+        policy).  No reference analog (TF handled memory in its runtime);
+        on TPU this is the standard lever when activations exceed HBM.
       has_aux: whether loss_fn returns ``(loss, aux)``.
     """
 
@@ -140,10 +172,12 @@ class GraphItem:
                  untrainable_vars: Sequence[str] = (),
                  pipeline_vars: Sequence[str] = (),
                  expert_vars: Sequence[str] = (),
+                 remat: Optional[str] = None,
                  has_aux: bool = False):
         self.params = params
         self.optimizer = optimizer
-        self.loss_fn = loss_fn
+        self.loss_fn = _apply_remat(loss_fn, remat)
+        self.remat = remat
         self.has_aux = has_aux
         self._sparse_patterns = tuple(sparse_vars)
         self._untrainable_patterns = tuple(untrainable_vars)

@@ -63,8 +63,19 @@ class DistributedStep:
         return self._placer(params)
 
     def place_batch(self, batch):
+        def put(x, sh):
+            import numpy as np
+            if isinstance(x, np.ndarray) and not x.flags.owndata:
+                # The CPU backend zero-copy aliases non-owning numpy views
+                # (e.g. the native DataLoader's ring-buffer batches); the
+                # source buffer may be recycled while the step still reads
+                # it.  Force an owning copy so device_put's documented
+                # copy semantics hold.
+                x = np.array(x, copy=True)
+            return jax.device_put(x, sh)
+
         return jax.tree_util.tree_map(
-            jax.device_put, batch, self.compiled_strategy.batch_shardings(batch))
+            put, batch, self.compiled_strategy.batch_shardings(batch))
 
 
 class GraphTransformer:

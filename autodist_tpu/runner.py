@@ -130,6 +130,28 @@ class DistributedSession:
             return None
         return jax.tree_util.tree_map(lambda x: np.asarray(x), metrics)
 
+    def prefetch(self, batches, depth: int = 2):
+        """Yield device-placed batches keeping ``depth`` host→device
+        transfers in flight ahead of compute (device_put is async, so the
+        next batch's copy overlaps the current step) — the device-side half
+        of the input pipeline whose host side is
+        :class:`autodist_tpu.runtime.data_loader.DataLoader`."""
+        from collections import deque
+
+        q: deque = deque()
+        for b in batches:
+            q.append(self.place_batch(b))
+            if len(q) >= depth:
+                yield q.popleft()
+        while q:
+            yield q.popleft()
+
+    def run_epoch(self, batches, prefetch_depth: int = 2) -> Dict[str, Any]:
+        """Run every batch of an epoch with device prefetch + async
+        dispatch; returns the last step's metrics on host (None for an
+        empty iterable)."""
+        return self.run_many(self.prefetch(batches, prefetch_depth))
+
     def set_params(self, params) -> None:
         """Load new parameter values (e.g. from a checkpoint), re-placing
         them with the strategy's shardings.  Optimizer state is re-initialized."""

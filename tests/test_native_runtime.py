@@ -189,3 +189,48 @@ def test_early_break_then_new_epoch():
             break
     full = collect_epoch(loader)
     assert len(full) == 8
+
+
+def test_run_epoch_with_dataloader():
+    """DataLoader → session.run_epoch: host loader + device prefetch +
+    async dispatch produce the same training as a plain loop."""
+    import optax
+    from autodist_tpu.autodist import AutoDist, \
+        _reset_default_autodist_for_testing
+    from autodist_tpu.runtime.data_loader import DataLoader
+    from autodist_tpu.strategy import AllReduce
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 8).astype(np.float32)
+    y = (x @ rng.randn(8, 4)).astype(np.float32)
+
+    def loss_fn(p, b):
+        return float32_mse(p, b)
+
+    def float32_mse(p, b):
+        import jax.numpy as jnp
+        pred = b["x"] @ p["w"]
+        return jnp.mean((pred - b["y"]) ** 2)
+
+    def train(epoch_runner):
+        import os
+
+        import jax.numpy as jnp
+        _reset_default_autodist_for_testing()
+        os.environ["AUTODIST_IS_TESTING"] = "True"
+        ad = AutoDist(strategy_builder=AllReduce(), mesh_axes={"data": 8})
+        with ad.scope():
+            ad.capture(params={"w": jnp.zeros((8, 4))},
+                       optimizer=optax.sgd(0.05), loss_fn=loss_fn)
+        sess = ad.create_distributed_session()
+        loader = DataLoader({"x": x, "y": y}, batch_size=16, shuffle=True,
+                            seed=3)
+        for _ in range(3):
+            metrics = epoch_runner(sess, loader)
+        return float(metrics["loss"]), sess.params["w"]
+
+    l_epoch, w_epoch = train(lambda s, ld: s.run_epoch(ld))
+    l_plain, w_plain = train(
+        lambda s, ld: [s.run(b) for b in ld][-1])
+    np.testing.assert_allclose(l_epoch, l_plain, rtol=1e-6)
+    np.testing.assert_allclose(w_epoch, w_plain, rtol=1e-6)
