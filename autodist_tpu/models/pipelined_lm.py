@@ -32,7 +32,24 @@ from autodist_tpu.models.base import (
     layer_norm as _layer_norm,
 )
 from autodist_tpu.models.transformer import TransformerLayer, dense_attention
-from autodist_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
+from autodist_tpu.parallel.pipeline import (
+    interleaved_stage_order,
+    pipeline_apply,
+    stack_stage_params,
+)
+
+
+def _device_major_layers(per_layer, stages: int, num_virtual: int):
+    """Reorder a pipeline-ordered layer list so the stored stack's leading
+    axis is device-major (chunk block ``d·V + v`` = global stage ``v·S+d``)
+    — then contiguous ``pipe`` sharding of the stack IS the interleaved
+    chunk assignment, with no per-step resharding (see
+    ``pipeline_apply``'s stage_params contract).  Identity for V=1."""
+    if num_virtual <= 1:
+        return per_layer
+    lpc = len(per_layer) // (stages * num_virtual)
+    order = interleaved_stage_order(stages, num_virtual)
+    return [per_layer[g * lpc + k] for g in order for k in range(lpc)]
 
 
 def pipelined_transformer_lm(
@@ -41,14 +58,19 @@ def pipelined_transformer_lm(
         max_len: int = 1024, attn_fn: Callable = dense_attention,
         dtype=jnp.float32, seq_len: Optional[int] = None,
         num_stages: Optional[int] = None,
-        num_microbatches: Optional[int] = None) -> ModelSpec:
-    """Stage-stacked GPT-style LM pipelined over ``mesh``'s ``pipe`` axis."""
+        num_microbatches: Optional[int] = None,
+        num_virtual_stages: int = 1) -> ModelSpec:
+    """Stage-stacked GPT-style LM pipelined over ``mesh``'s ``pipe`` axis.
+
+    ``num_virtual_stages > 1`` selects the interleaved schedule: each device
+    holds that many chunks and the bubble shrinks proportionally."""
     seq_len = seq_len or max_len
     d_model = num_heads * head_dim
     stages = num_stages or mesh.shape.get("pipe", 1) or 1
-    if num_layers % stages:
+    chunks = stages * num_virtual_stages
+    if num_layers % chunks:
         raise ValueError(f"{num_layers} layers not divisible into "
-                         f"{stages} pipeline stages")
+                         f"{chunks} pipeline stage chunks")
     layer = TransformerLayer(num_heads, head_dim, d_ff, causal=True,
                              attn_fn=attn_fn)
 
@@ -58,6 +80,8 @@ def pipelined_transformer_lm(
         per_layer = [
             layer.init(r, x)["params"]
             for r in jax.random.split(r_stack, num_layers)]
+        per_layer = _device_major_layers(per_layer, stages,
+                                         num_virtual_stages)
         return {
             "embed": jax.random.normal(r_emb, (vocab_size, d_model),
                                        dtype) * 0.02,
@@ -78,10 +102,11 @@ def pipelined_transformer_lm(
         x = jnp.take(params["embed"], tokens, axis=0) \
             + params["pos_embed"][None, :tokens.shape[1]]
         stacked = jax.tree_util.tree_map(
-            lambda a: a.reshape((stages, num_layers // stages) + a.shape[1:]),
+            lambda a: a.reshape((chunks, num_layers // chunks) + a.shape[1:]),
             params["stack"])
         x = pipeline_apply(stage_fn, stacked, x, mesh,
-                           num_microbatches=num_microbatches)
+                           num_microbatches=num_microbatches,
+                           num_virtual_stages=num_virtual_stages)
         x = _layer_norm(x, params["ln_final"]["scale"])
         return jnp.einsum("btd,vd->btv", x, params["embed"])
 

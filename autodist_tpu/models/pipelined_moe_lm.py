@@ -28,6 +28,7 @@ from autodist_tpu.models.base import (
 )
 from autodist_tpu.models.moe_lm import _apply_layer, _init_layer
 from autodist_tpu.models.transformer import dense_attention
+from autodist_tpu.models.pipelined_lm import _device_major_layers
 from autodist_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
 
 
@@ -38,13 +39,15 @@ def pipelined_moe_transformer_lm(
         attn_fn: Callable = dense_attention, capacity_factor: float = 2.0,
         aux_weight: float = 1e-2, dtype=jnp.float32,
         seq_len: Optional[int] = None, num_stages: Optional[int] = None,
-        num_microbatches: Optional[int] = None) -> ModelSpec:
+        num_microbatches: Optional[int] = None,
+        num_virtual_stages: int = 1) -> ModelSpec:
     seq_len = seq_len or max_len
     d_model = num_heads * head_dim
     stages = num_stages or mesh.shape.get("pipe", 1) or 1
-    if num_layers % stages:
+    chunks = stages * num_virtual_stages
+    if num_layers % chunks:
         raise ValueError(f"{num_layers} layers not divisible into "
-                         f"{stages} pipeline stages")
+                         f"{chunks} pipeline stage chunks")
 
     def init(rng):
         r_emb, r_pos, r_layers = jax.random.split(rng, 3)
@@ -52,6 +55,8 @@ def pipelined_moe_transformer_lm(
             _init_layer(r, d_model, num_heads, head_dim, d_ff, num_experts,
                         dtype)
             for r in jax.random.split(r_layers, num_layers)]
+        per_layer = _device_major_layers(per_layer, stages,
+                                         num_virtual_stages)
         return {
             "embed": jax.random.normal(r_emb, (vocab_size, d_model),
                                        dtype) * 0.02,
@@ -79,12 +84,13 @@ def pipelined_moe_transformer_lm(
         x = jnp.take(params["embed"], tokens, axis=0) \
             + params["pos_embed"][None, :tokens.shape[1]]
         stacked = jax.tree_util.tree_map(
-            lambda a: a.reshape((stages, num_layers // stages) + a.shape[1:]),
+            lambda a: a.reshape((chunks, num_layers // chunks) + a.shape[1:]),
             params["stack"])
         # Append an aux-loss channel so stage outputs stay shape-homogeneous.
         xa = jnp.concatenate([x, jnp.zeros_like(x[..., :1])], axis=-1)
         xa = pipeline_apply(stage_fn, stacked, xa, mesh,
-                            num_microbatches=num_microbatches)
+                            num_microbatches=num_microbatches,
+                            num_virtual_stages=num_virtual_stages)
         x, aux = xa[..., :-1], jnp.mean(xa[..., -1])
         x = _layer_norm(x, params["ln_final"])
         logits = jnp.einsum("btd,vd->btv", x, params["embed"])

@@ -39,11 +39,24 @@ _DEFAULT_BLOCK = 128  # MXU-aligned tile edge
 
 def _pick_block(t: int, target: int) -> int:
     """Largest divisor of ``t`` that is ≤ target (tiles must cover the
-    sequence exactly; models here use power-of-two lengths)."""
+    sequence exactly; ``_pad_len`` guarantees an MXU-aligned divisor
+    exists on the compiled path)."""
     b = min(t, target)
     while t % b:
         b -= 1
     return b
+
+
+def _pad_len(t: int, interpret: bool) -> int:
+    """Sequence length after padding to an MXU-tileable length.  Compiled
+    Pallas requires (8,128)-aligned tiles; interpret mode has no such
+    constraint.  ≤128 → next multiple of 8 (the whole sequence is one
+    block); >128 → next multiple of 128 (block 128 always divides)."""
+    if interpret:
+        return t
+    if t <= _DEFAULT_BLOCK:
+        return -(-t // 8) * 8
+    return -(-t // _DEFAULT_BLOCK) * _DEFAULT_BLOCK
 
 
 def _use_interpret() -> bool:
@@ -54,15 +67,17 @@ def _use_interpret() -> bool:
 # forward
 # ---------------------------------------------------------------------------
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal: bool,
-                block_k: int, scale: float):
+                block_k: int, scale: float, kv_len: int):
     """One (batch, head, q-block) program: stream K/V blocks, online softmax.
 
     Refs: q [1,1,bq,D]; k/v [1,1,T,D]; o [1,1,bq,D]; lse [1,1,bq,1]
     (the trailing singleton keeps the block's last-two dims TPU-tileable).
+    ``kv_len`` < T means the tail is alignment padding — masked out.
     """
     q = q_ref[0, 0].astype(jnp.float32) * scale            # [bq, D]
     bq, d = q.shape
     t_k = k_ref.shape[2]
+    padded = kv_len < t_k
     num_kb = t_k // block_k
     qi = pl.program_id(2)
     q_pos = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
@@ -74,9 +89,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal: bool,
         v = v_ref[0, 0, pl.ds(k0, block_k), :].astype(jnp.float32)
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)  # [bq, bk]
-        if causal:
+        if causal or padded:
             k_pos = k0 + lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
-            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+            mask = k_pos <= q_pos if causal else k_pos >= 0
+            if padded:
+                mask &= k_pos < kv_len
+            s = jnp.where(mask, s, _NEG_INF)
         m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))   # [bq,1]
         p = jnp.exp(s - m_new)                                  # [bq,bk]
         corr = jnp.exp(m - m_new)                               # [bq,1]
@@ -101,7 +119,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal: bool,
     lse_ref[0, 0] = m + jnp.log(l)
 
 
-def _fwd(q, k, v, causal, block_q, block_k, interpret):
+def _fwd(q, k, v, causal, block_q, block_k, interpret, kv_len):
     """q/k/v: [B, H, T, D] → (o [B,H,T,D], lse [B,H,T])."""
     b, h, t, d = q.shape
     bq = _pick_block(t, block_q)
@@ -109,7 +127,7 @@ def _fwd(q, k, v, causal, block_q, block_k, interpret):
     scale = 1.0 / (d ** 0.5)
     grid = (b, h, t // bq)
     kernel = functools.partial(_fwd_kernel, causal=causal, block_k=bk,
-                               scale=scale)
+                               scale=scale, kv_len=kv_len)
     return pl.pallas_call(
         kernel,
         grid=grid,
@@ -134,7 +152,7 @@ def _fwd(q, k, v, causal, block_q, block_k, interpret):
 # backward
 # ---------------------------------------------------------------------------
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-               causal: bool, block_k: int, scale: float):
+               causal: bool, block_k: int, scale: float, kv_len: int):
     """dQ for one q block: dS = P∘(dPᵀV − Δ); dQ = scale · dS·K."""
     q = q_ref[0, 0].astype(jnp.float32) * scale
     do = do_ref[0, 0].astype(jnp.float32)
@@ -142,6 +160,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     delta = delta_ref[0, 0]                                 # [bq,1]
     bq, d = q.shape
     t_k = k_ref.shape[2]
+    padded = kv_len < t_k
     num_kb = t_k // block_k
     qi = pl.program_id(2)
     q_pos = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
@@ -152,9 +171,12 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
         v = v_ref[0, 0, pl.ds(k0, block_k), :].astype(jnp.float32)
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)
-        if causal:
+        if causal or padded:
             k_pos = k0 + lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
-            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+            mask = k_pos <= q_pos if causal else k_pos >= 0
+            if padded:
+                mask &= k_pos < kv_len
+            s = jnp.where(mask, s, _NEG_INF)
         p = jnp.exp(s - lse)                                # recomputed probs
         dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
@@ -172,12 +194,14 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, *, causal: bool, block_q: int, scale: float):
+                dk_ref, dv_ref, *, causal: bool, block_q: int, scale: float,
+                kv_len: int):
     """dK/dV for one k block: dV = PᵀdO; dK = scale · dSᵀQ."""
     k = k_ref[0, 0].astype(jnp.float32)
     v = v_ref[0, 0].astype(jnp.float32)
     bk, d = k.shape
     t_q = q_ref.shape[2]
+    padded = kv_len < t_q
     num_qb = t_q // block_q
     ki = pl.program_id(2)
     k_pos = ki * bk + lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
@@ -191,9 +215,12 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[0, 0, pl.ds(q0, block_q), :]      # [bq,1]
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)  # [bq,bk]
-        if causal:
+        if causal or padded:
             q_pos = q0 + lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
-            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+            mask = k_pos <= q_pos if causal else k_pos >= 0
+            if padded:
+                mask &= k_pos < kv_len
+            s = jnp.where(mask, s, _NEG_INF)
         p = jnp.exp(s - lse)
         dv = dv + lax.dot_general(p, do, (((0,), (0,)), ((), ())),
                                   preferred_element_type=jnp.float32)
@@ -217,7 +244,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[0, 0] = dv.astype(dv_ref.dtype)
 
 
-def _bwd(q, k, v, o, lse, do, causal, block_q, block_k, interpret):
+def _bwd(q, k, v, o, lse, do, causal, block_q, block_k, interpret, kv_len):
     b, h, t, d = q.shape
     bq = _pick_block(t, block_q)
     bk = _pick_block(t, block_k)
@@ -234,7 +261,8 @@ def _bwd(q, k, v, o, lse, do, causal, block_q, block_k, interpret):
     rowf_spec = pl.BlockSpec((1, 1, t, 1), lambda bi, hi, i: (bi, hi, 0, 0))
 
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, causal=causal, block_k=bk, scale=scale),
+        functools.partial(_dq_kernel, causal=causal, block_k=bk, scale=scale,
+                          kv_len=kv_len),
         grid=(b, h, t // bq),
         in_specs=[qb_spec, full_spec, full_spec, qb_spec, rowq_spec,
                   rowq_spec],
@@ -245,7 +273,7 @@ def _bwd(q, k, v, o, lse, do, causal, block_q, block_k, interpret):
 
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, causal=causal, block_q=bq,
-                          scale=scale),
+                          scale=scale, kv_len=kv_len),
         grid=(b, h, t // bk),
         in_specs=[full_spec, kb_spec, kb_spec, full_spec, rowf_spec,
                   rowf_spec],
@@ -260,20 +288,21 @@ def _bwd(q, k, v, o, lse, do, causal, block_q, block_k, interpret):
 # ---------------------------------------------------------------------------
 # public op ([B, T, H, D] layout, custom VJP)
 # ---------------------------------------------------------------------------
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, block_q, block_k, interpret):
-    o, _ = _fwd(q, k, v, causal, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, block_q, block_k, interpret, kv_len):
+    o, _ = _fwd(q, k, v, causal, block_q, block_k, interpret, kv_len)
     return o
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
-    o, lse = _fwd(q, k, v, causal, block_q, block_k, interpret)
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret, kv_len):
+    o, lse = _fwd(q, k, v, causal, block_q, block_k, interpret, kv_len)
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd(causal, block_q, block_k, interpret, res, do):
+def _flash_bwd(causal, block_q, block_k, interpret, kv_len, res, do):
     q, k, v, o, lse = res
-    return _bwd(q, k, v, o, lse, do, causal, block_q, block_k, interpret)
+    return _bwd(q, k, v, o, lse, do, causal, block_q, block_k, interpret,
+                kv_len)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -283,11 +312,22 @@ def flash_attention(q, k, v, causal: bool = False, *,
                     block_q: int = _DEFAULT_BLOCK,
                     block_k: int = _DEFAULT_BLOCK,
                     interpret: Optional[bool] = None) -> jax.Array:
-    """Drop-in ``attn_fn(q, k, v, causal)`` on ``[B, T, H, D]`` tensors."""
+    """Drop-in ``attn_fn(q, k, v, causal)`` on ``[B, T, H, D]`` tensors.
+
+    Sequences whose length is not MXU-tileable are zero-padded to the next
+    tileable length (masked inside the kernels; the pad is sliced off), so
+    any length compiles on real TPU."""
     if interpret is None:
         interpret = _use_interpret()
+    t = q.shape[1]
+    tp = _pad_len(t, interpret)
     qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))  # → [B,H,T,D]
-    o = _flash(qt, kt, vt, causal, block_q, block_k, interpret)
+    if tp != t:
+        pad = [(0, 0), (0, 0), (0, tp - t), (0, 0)]
+        qt, kt, vt = (jnp.pad(x, pad) for x in (qt, kt, vt))
+    o = _flash(qt, kt, vt, causal, block_q, block_k, interpret, t)
+    if tp != t:
+        o = o[:, :, :t, :]
     return o.transpose(0, 2, 1, 3)
 
 
@@ -302,7 +342,13 @@ def make_flash_attention(mesh: Optional[Mesh] = None, *,
     is a compiler black box GSPMD would otherwise all-gather around.  The
     ``seq`` axis is not handled here: compose with ring attention
     (``parallel/ring_attention.py``) for sequence parallelism.
+
+    The interpret-mode decision is resolved HERE, at construction — not at
+    trace time — so the product behaves identically under AOT lowering and
+    multi-backend use.
     """
+    if interpret is None:
+        interpret = _use_interpret()
     kw = dict(block_q=block_q, block_k=block_k, interpret=interpret)
 
     @functools.lru_cache(maxsize=None)

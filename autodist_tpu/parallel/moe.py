@@ -58,6 +58,10 @@ def _top2_dispatch(probs: jax.Array, capacity: int
     probs_wo1 = probs * (1.0 - mask1)
     idx2 = jnp.argmax(probs_wo1, axis=-1)
     mask2 = jax.nn.one_hot(idx2, e, dtype=probs.dtype)
+    if e == 1:
+        # Single expert: argmax over all-zero probs_wo1 re-selects expert 0,
+        # which would double-book two capacity slots per token.
+        mask2 = jnp.zeros_like(mask2)
 
     # Positions within each expert's buffer, first-come-first-served along
     # the token axis; second choices queue after all first choices.

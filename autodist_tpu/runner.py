@@ -107,8 +107,10 @@ class DistributedSession:
     def _dump_programs(self, batch) -> None:
         """Staged program dumps at first run, when concrete shapes exist:
         the traced StableHLO (transformed program) and the XLA-optimized
-        HLO (what executes — sharded, fused, collectives inserted).  The
-        compile is shared with the run via jit's cache."""
+        HLO (what executes — sharded, fused, collectives inserted).  Note
+        AOT lower().compile() is not guaranteed to seed jit's dispatch
+        cache, so the first run may compile the step a second time —
+        a debug-only cost, paid only under AUTODIST_DUMP_GRAPHS=1."""
         lowered = self._step.step_fn.lower(self._params, self._opt_state,
                                            self._sync_state, batch)
         tracing.dump_stage(self._run_id, "2-step-stablehlo",

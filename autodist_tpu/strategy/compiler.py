@@ -309,13 +309,16 @@ class StrategyCompiler:
                 continue
             plans[var.name] = self._compile_node(node, var, model_axis)
 
-        # Untouched trainable vars: replicate + psum (safe default).
+        # Untouched trainable vars: replicate + psum (safe default) — but
+        # structural pipe/expert axes still apply, so a pipeline/expert stack
+        # missing from a hand-built strategy keeps its stage/expert sharding.
         grad_axes = self._grad_axes()
         for name, var in known.items():
             if var.trainable and name not in plans:
+                spec = self._apply_structural_specs(var, P())
                 plans[name] = VarPlan(
-                    var_name=name, sync_kind="AllReduce", param_spec=P(),
-                    opt_spec=P(), grad_reduce_axes=grad_axes)
+                    var_name=name, sync_kind="AllReduce", param_spec=spec,
+                    opt_spec=spec, grad_reduce_axes=grad_axes)
         return CompiledStrategy(strategy=strategy, mesh=self.mesh,
                                 var_plans=plans, batch_axes=grad_axes)
 

@@ -1,30 +1,88 @@
-"""Benchmark: ResNet-50 training throughput (images/sec) on the local device.
+"""Benchmark: ResNet-50 training throughput (images/sec) + MFU.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+
+Robustness (the TPU tunnel in this image can hang for hours — see
+``__graft_entry__.py`` for the steering trick):
+
+* The actual measurement runs in a **child process** (``--child``) so a hung
+  PJRT tunnel can never hang the benchmark: the parent enforces timeouts and
+  always prints a parseable JSON line (rc=0 when a metric was measured, even
+  on the CPU fallback; rc=1 only when no measurement succeeded anywhere).
+* A cheap probe child (``--probe``) verifies the TPU does a real matmul
+  before the parent commits to the expensive run; while the tunnel is down
+  the parent retries with backoff, then falls back to CPU.
+
+MFU: model FLOPs per step are taken from XLA's compiled cost analysis
+(exact for the program that ran) with an analytic ResNet-50 fallback
+(~8.2 GFLOP fwd/image at 224**2, x3 for the backward pass), divided by the
+chip's peak bf16 FLOP/s.
 
 Baseline note: the reference publishes charts, not numbers
-(docs/usage/performance.md; BASELINE.json.published is empty).  Until a
-published number exists, ``vs_baseline`` is the measured value normalized by
-``BASELINE_IMAGES_PER_SEC`` below — the round-1 recorded value on one
-v5e chip, so later rounds report their speedup against round 1.
+(docs/usage/performance.md; BASELINE.json.published is empty), so
+``vs_baseline`` normalizes by the round-1 recorded single-chip value below:
+later rounds report their speedup against round 1.
 """
 import json
 import os
+import subprocess
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
 
-# Round-1 measured reference point (one TPU v5e chip, bf16, batch 128):
-# ~2240 images/sec. vs_baseline therefore reports speedup relative to the
-# round-1 build.
+# Round-1 recorded reference point (one TPU v5e chip, bf16, batch 128).
 BASELINE_IMAGES_PER_SEC = 2240.0
 
 WARMUP_STEPS = 3
 MEASURE_STEPS = 20
 
+# Peak dense bf16 FLOP/s per chip, by PJRT device_kind substring.
+PEAK_FLOPS = {
+    "v5 lite": 197e12, "v5e": 197e12,
+    "v5p": 459e12, "v5": 459e12,
+    "v4": 275e12,
+    "v6 lite": 918e12, "v6e": 918e12, "trillium": 918e12,
+    "v3": 123e12, "v2": 46e12,
+}
 
-def main():
+PROBE_TIMEOUT_S = 150
+BENCH_TIMEOUT_S = 1500
+PROBE_BACKOFFS_S = (0, 45, 90)  # three probe attempts, ~4 min worst case
+
+
+def _steer(platform: str) -> None:
+    """Steer JAX to ``platform`` before first backend use.  The image's
+    sitecustomize registers a remote-TPU backend that env vars alone don't
+    override — jax.config.update is required (see __graft_entry__.py).
+    A failure here must propagate: silently proceeding would route the CPU
+    fallback to the dead TPU tunnel and hang until the parent's timeout."""
+    import jax
+    os.environ["JAX_PLATFORMS"] = platform
+    jax.config.update("jax_platforms", platform)
+
+
+def _peak_flops(device) -> float:
+    kind = (getattr(device, "device_kind", "") or "").lower()
+    for key, peak in PEAK_FLOPS.items():
+        if key in kind:
+            return peak
+    return 0.0
+
+
+def _analytic_step_flops(batch_size: int, image_size: int) -> float:
+    """ResNet-50 fwd ~= 8.2 GFLOP/image at 224**2 (conv FLOPs scale with
+    spatial area); training step ~= 3x forward."""
+    fwd = 8.2e9 * (image_size / 224.0) ** 2
+    return 3.0 * fwd * batch_size
+
+
+def run_child(platform: str) -> None:
+    """The measurement.  Prints one JSON line on success, exits nonzero on
+    failure (parent handles fallback + failure JSON)."""
+    if platform == "cpu":
+        _steer("cpu")
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -36,7 +94,8 @@ def main():
     from autodist_tpu.models.resnet import resnet50
     from autodist_tpu.strategy import AllReduce
 
-    on_tpu = jax.devices()[0].platform == "tpu"
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
     batch_size = 128 if on_tpu else 16
     image_size = 224 if on_tpu else 64
     dtype = jnp.bfloat16 if on_tpu else jnp.float32
@@ -75,13 +134,141 @@ def main():
     dt = time.perf_counter() - t0
 
     images_per_sec = batch_size * MEASURE_STEPS / dt
-    print(json.dumps({
+    result = {
         "metric": "resnet50_train_throughput",
         "value": round(images_per_sec, 2),
         "unit": "images/sec",
         "vs_baseline": round(images_per_sec / BASELINE_IMAGES_PER_SEC, 4),
-    }))
+        "mfu": None,
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", ""),
+        "batch_size": batch_size,
+        "image_size": image_size,
+        "step_time_ms": round(1e3 * dt / MEASURE_STEPS, 2),
+        "flops_per_step": _analytic_step_flops(batch_size, image_size),
+        "flops_source": "analytic",
+    }
+    # The throughput number is safe NOW — print it before any optional
+    # cost-analysis recompile so a hang there can't lose the metric; the
+    # parent takes the LAST valid JSON line.
+    _fill_mfu(result, dev, on_tpu, dt, sess, batch)
+    print(json.dumps(result), flush=True)
+
+
+def _fill_mfu(result, dev, on_tpu, dt, sess, batch) -> None:
+    """MFU = model FLOPs/s ÷ chip peak, from analytic ResNet-50 FLOPs (the
+    cheap, always-available estimate).  XLA's compiled cost analysis is
+    exact but AOT lower().compile() is not guaranteed to hit jit's cache —
+    a second compile this benchmark only pays when asked
+    (AUTODIST_BENCH_XLA_FLOPS=1)."""
+    peak = _peak_flops(dev) if on_tpu else 0.0
+    if peak:
+        result["mfu"] = round(
+            result["flops_per_step"] * MEASURE_STEPS / dt / peak, 4)
+    if not os.environ.get("AUTODIST_BENCH_XLA_FLOPS"):
+        return
+    print(json.dumps(result), flush=True)  # safety line before recompile
+    try:
+        lowered = sess._step.step_fn.lower(
+            sess.sharded_params, sess.opt_state, sess.sync_state, batch)
+        cost = lowered.compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        xla_flops = float(cost.get("flops", 0.0))
+        if xla_flops > 0:
+            result["flops_per_step"] = xla_flops
+            result["flops_source"] = "xla_cost_analysis"
+            if peak:
+                result["mfu"] = round(
+                    xla_flops * MEASURE_STEPS / dt / peak, 4)
+    except Exception as e:  # pragma: no cover - backend-dependent
+        print(f"bench: cost_analysis unavailable ({e!r}); "
+              f"keeping analytic FLOPs", file=sys.stderr, flush=True)
+
+
+def run_probe() -> None:
+    """Cheap TPU liveness check: real matmul, real sync."""
+    import jax
+    import jax.numpy as jnp
+    dev = jax.devices()[0]
+    if dev.platform != "tpu":
+        print(f"probe: first device is {dev.platform}, not tpu",
+              file=sys.stderr, flush=True)
+        sys.exit(2)
+    x = jnp.ones((512, 512), jnp.bfloat16)
+    (x @ x).block_until_ready()
+    print("probe: tpu matmul OK", flush=True)
+
+
+def _spawn(args, timeout_s):
+    """Run a child bench process; return (rc, stdout_text).  rc=124 on
+    timeout.  Child stderr passes through for driver logs."""
+    cmd = [sys.executable, "-u", os.path.abspath(__file__)] + args
+    try:
+        proc = subprocess.run(cmd, stdout=subprocess.PIPE, timeout=timeout_s)
+        return proc.returncode, proc.stdout.decode()
+    except subprocess.TimeoutExpired as e:
+        out = e.stdout.decode() if e.stdout else ""
+        return 124, out
+
+
+def _extract_json(text: str):
+    for line in reversed(text.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def main() -> int:
+    errors = []
+
+    # 1) Probe the TPU tunnel with retries/backoff.
+    tpu_alive = False
+    for backoff in PROBE_BACKOFFS_S:
+        if backoff:
+            print(f"bench: tunnel down, retrying probe in {backoff}s",
+                  file=sys.stderr, flush=True)
+            time.sleep(backoff)
+        rc, _ = _spawn(["--probe"], PROBE_TIMEOUT_S)
+        if rc == 0:
+            tpu_alive = True
+            break
+        errors.append(f"probe rc={rc}")
+        if rc == 2:  # backend up but routed to non-TPU: retries won't help
+            break
+
+    # 2) Measure: TPU when alive (one retry — first compile over the tunnel
+    #    is the slow part), else CPU fallback.
+    attempts = (["tpu", "tpu", "cpu"] if tpu_alive else ["cpu"])
+    for platform in attempts:
+        rc, out = _spawn(["--child", platform], BENCH_TIMEOUT_S)
+        # A timed-out child may still have printed a valid measurement
+        # (its optional post-measurement enrichment hung): use it.
+        result = _extract_json(out)
+        if result is not None and result.get("value") is not None:
+            print(json.dumps(result), flush=True)
+            return 0
+        errors.append(f"bench[{platform}] rc={rc}")
+
+    # 3) Nothing measured anywhere: parseable failure JSON, nonzero exit.
+    print(json.dumps({
+        "metric": "resnet50_train_throughput",
+        "value": None,
+        "unit": "images/sec",
+        "vs_baseline": None,
+        "error": "; ".join(errors),
+    }), flush=True)
+    return 1
 
 
 if __name__ == "__main__":
-    main()
+    if "--child" in sys.argv:
+        run_child(sys.argv[sys.argv.index("--child") + 1])
+    elif "--probe" in sys.argv:
+        run_probe()
+    else:
+        sys.exit(main())

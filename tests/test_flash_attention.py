@@ -60,6 +60,59 @@ def test_uneven_blocks_picks_divisor():
                                rtol=1e-5, atol=1e-5)
 
 
+def test_pad_len_policy():
+    from autodist_tpu.ops.flash_attention import _pad_len
+    assert _pad_len(23, True) == 23          # interpret: no constraint
+    assert _pad_len(23, False) == 24         # small: next multiple of 8
+    assert _pad_len(128, False) == 128
+    assert _pad_len(130, False) == 256       # large: next multiple of 128
+    assert _pad_len(1, False) == 8
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_padded_kernel_path_matches_dense(causal):
+    """Drive the kv_len<T masked branches of all three kernels (the
+    compiled-TPU padding path) in interpret mode: manually pad the inputs
+    and pass the true kv_len through the private op, forward and backward.
+    On real TPU `flash_attention` takes this path automatically for
+    non-tileable lengths."""
+    import importlib
+    fa = importlib.import_module("autodist_tpu.ops.flash_attention")
+    t = 23
+    q, k, v = _qkv(np.random.default_rng(4), t=t, d=8)
+    ref = dense_attention(q, k, v, causal)
+    pad = [(0, 0), (0, 0), (0, 24 - t), (0, 0)]
+    qt, kt, vt = (jnp.pad(x.transpose(0, 2, 1, 3), pad) for x in (q, k, v))
+
+    o = fa._flash(qt, kt, vt, causal, 8, 8, True, t)
+    np.testing.assert_allclose(
+        np.asarray(o[:, :, :t, :].transpose(0, 2, 1, 3)), np.asarray(ref),
+        rtol=2e-5, atol=2e-5)
+
+    w = jnp.asarray(np.random.default_rng(5).standard_normal(
+        ref.shape), jnp.float32).transpose(0, 2, 1, 3)
+
+    def loss_flash(qt, kt, vt):
+        return jnp.sum(
+            fa._flash(qt, kt, vt, causal, 8, 8, True, t)[:, :, :t, :]
+            * w[:, :, :t, :])
+
+    def loss_dense(q, k, v):
+        return jnp.sum(dense_attention(q, k, v, causal)
+                       * w[:, :, :t, :].transpose(0, 2, 1, 3))
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(qt, kt, vt)
+    g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for gf, gd, name in zip(g_flash, g_dense, "qkv"):
+        gf = np.asarray(gf[:, :, :t, :].transpose(0, 2, 1, 3))
+        np.testing.assert_allclose(gf, np.asarray(gd),
+                                   rtol=1e-4, atol=1e-4, err_msg=f"d{name}")
+        # Padded rows must carry zero gradient.
+    for gf in g_flash:
+        np.testing.assert_allclose(np.asarray(gf[:, :, t:, :]), 0.0,
+                                   atol=1e-6)
+
+
 def test_sharded_matches_dense():
     mesh = build_mesh({"data": 2, "model": 2, "seq": 1})
     attn = make_flash_attention(mesh, block_q=8, block_k=8)
