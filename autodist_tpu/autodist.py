@@ -72,18 +72,29 @@ class AutoDist:
         self._in_scope = False
         self._cluster: Cluster = make_cluster(self._resource_spec)
         self._coordinator: Optional[Coordinator] = None
+        self._implicit_record = None  # patch.CaptureRecord from the scope
 
     # -- capture -----------------------------------------------------------
     @contextlib.contextmanager
     def scope(self):
         """Context for building/capturing the model (reference
-        autodist.py:309-322).  With the functional API this mainly marks the
-        capture region and enforces the build-before-run ordering."""
+        autodist.py:309-322).  Marks the capture region, enforces the
+        build-before-run ordering, and — unless ``AUTODIST_PATCH=False`` —
+        installs the implicit-capture patches so a plain optax script is
+        captured without calling :meth:`capture`
+        (``autodist_tpu/patch.py``; reference ``autodist/patch.py:40-116``)."""
+        from autodist_tpu.patch import PatchOptax
+
         self._in_scope = True
+        patched = ENV.AUTODIST_PATCH.val
+        if patched:
+            PatchOptax.patch()
         try:
             yield self
         finally:
             self._in_scope = False
+            if patched:
+                self._implicit_record = PatchOptax.unpatch()
 
     def capture(self, params: Any, optimizer: Any = None,
                 loss_fn: Optional[Callable] = None,
@@ -119,11 +130,33 @@ class AutoDist:
         return self._session is not None
 
     # -- build pipeline (reference autodist.py:139-150) --------------------
+    def _assemble_implicit_graph_item(self) -> None:
+        """Build the GraphItem from the scope's implicit capture record when
+        ``capture()`` was never called (the reference's zero-code-change
+        path, ``autodist/patch.py:40-116``)."""
+        rec = self._implicit_record
+        if rec is None or (rec.params is None and rec.optimizer is None
+                           and rec.loss_fn is None):
+            raise RuntimeError(
+                "capture() the program before building a strategy (or build "
+                "the optimizer/opt.init(params)/jax.value_and_grad(loss_fn) "
+                "inside ad.scope() for implicit capture)")
+        if not rec.complete():
+            raise RuntimeError(
+                "implicit capture inside ad.scope() is incomplete; missing: "
+                + "; ".join(rec.missing()))
+        logging.info("implicit capture: params + optax.%s + loss_fn %r",
+                     rec.optimizer_factory,
+                     getattr(rec.loss_fn, "__name__", rec.loss_fn))
+        self._graph_item = GraphItem(
+            rec.params, optimizer=rec.optimizer, loss_fn=rec.loss_fn,
+            has_aux=rec.has_aux)
+
     def build_strategy(self) -> Strategy:
         """Chief builds the strategy; workers deserialize the chief's by id
         (reference _build_or_load_strategy, autodist.py:100-109)."""
         if self._graph_item is None:
-            raise RuntimeError("capture() the program before building a strategy")
+            self._assemble_implicit_graph_item()
         self._graph_item.prepare()
         strategy_id = ENV.AUTODIST_STRATEGY_ID.val
         if strategy_id:

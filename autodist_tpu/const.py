@@ -65,6 +65,10 @@ def _loglevel(v):
     return v or "INFO"
 
 
+def _bool_default_true(v):
+    return v not in ("False", "false", "0")
+
+
 class ENV(enum.Enum):
     """Typed environment-variable registry.
 
@@ -83,6 +87,10 @@ class ENV(enum.Enum):
     AUTODIST_MIN_LOG_LEVEL = ("AUTODIST_MIN_LOG_LEVEL", _loglevel)
     # extra assertions during tests
     AUTODIST_IS_TESTING = ("AUTODIST_IS_TESTING", _bool)
+    # implicit program capture inside ad.scope() (optax/jax.grad
+    # interception, autodist_tpu/patch.py); analog of the reference's
+    # AUTODIST_PATCH_TF gate (autodist/const.py:78)
+    AUTODIST_PATCH = ("AUTODIST_PATCH", _bool_default_true)
     # print launch commands instead of executing them
     AUTODIST_DEBUG_REMOTE = ("AUTODIST_DEBUG_REMOTE", _bool)
     # profiler-trace the first N session steps (0 = off); SURVEY §5.1 parity

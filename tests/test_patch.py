@@ -1,0 +1,167 @@
+"""Implicit program capture (autodist_tpu/patch.py).
+
+Parity target: reference ``PatchTensorFlow.patch_optimizers`` capturing a
+plain training script's optimizer + gradients without AutoDist API calls
+(``autodist/patch.py:40-116``, exercised by every reference integration case
+that just builds a model under ``ad.scope()``)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from autodist_tpu.autodist import AutoDist, _reset_default_autodist_for_testing
+from autodist_tpu.patch import PatchOptax
+from autodist_tpu.strategy import AllReduce
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    _reset_default_autodist_for_testing()
+    yield
+    # A failed test must not leave the global patches installed.
+    if PatchOptax.active_record() is not None:
+        PatchOptax.unpatch()
+
+
+def _params():
+    return {"w": jnp.arange(4.0), "b": jnp.zeros(())}
+
+
+def _loss(params, batch):
+    pred = batch["x"] @ params["w"] + params["b"]
+    return jnp.mean((pred - batch["y"]) ** 2)
+
+
+def _batch(n=8):
+    rng = np.random.RandomState(0)
+    x = rng.randn(n, 4).astype(np.float32)
+    return {"x": x, "y": (x @ np.arange(4.0) + 1.0).astype(np.float32)}
+
+
+def test_plain_script_is_captured_implicitly():
+    ad = AutoDist(strategy_builder=AllReduce())
+    with ad.scope():
+        # A plain optax training-script prefix — no AutoDist calls at all.
+        opt = optax.sgd(0.1)
+        opt.init(_params())
+        jax.value_and_grad(_loss)
+    sess = ad.create_distributed_session()
+    m1 = sess.run(_batch())
+    m2 = sess.run(_batch())
+    assert m2["loss"] < m1["loss"]  # actually training
+
+
+def test_implicit_matches_explicit_numerics():
+    batch = _batch()
+
+    ad1 = AutoDist(strategy_builder=AllReduce())
+    with ad1.scope():
+        opt = optax.adamw(1e-2)
+        opt.init(_params())
+        jax.value_and_grad(_loss)
+    s1 = ad1.create_distributed_session()
+
+    _reset_default_autodist_for_testing()
+    ad2 = AutoDist(strategy_builder=AllReduce())
+    with ad2.scope():
+        ad2.capture(params=_params(), optimizer=optax.adamw(1e-2),
+                    loss_fn=_loss)
+    s2 = ad2.create_distributed_session()
+
+    for _ in range(3):
+        l1 = s1.run(batch)["loss"]
+        l2 = s2.run(batch)["loss"]
+        np.testing.assert_allclose(l1, l2, rtol=1e-6)
+
+
+def test_chain_records_outermost_transformation():
+    ad = AutoDist(strategy_builder=AllReduce())
+    with ad.scope():
+        opt = optax.chain(optax.clip_by_global_norm(1.0), optax.sgd(0.1))
+        opt.init(_params())
+        jax.grad(_loss)
+    rec = ad._implicit_record
+    assert rec.optimizer_factory == "chain"
+    sess = ad.create_distributed_session()
+    assert np.isfinite(sess.run(_batch())["loss"])
+
+
+def test_has_aux_flag_is_captured():
+    def loss_aux(params, batch):
+        loss = _loss(params, batch)
+        return loss, {"l2": jnp.sum(params["w"] ** 2)}
+
+    ad = AutoDist(strategy_builder=AllReduce())
+    with ad.scope():
+        opt = optax.sgd(0.1)
+        opt.init(_params())
+        jax.value_and_grad(loss_aux, has_aux=True)
+    sess = ad.create_distributed_session()
+    metrics = sess.run(_batch())
+    assert "aux" in metrics and "l2" in metrics["aux"]
+
+
+def test_explicit_capture_wins_over_implicit():
+    ad = AutoDist(strategy_builder=AllReduce())
+    with ad.scope():
+        opt = optax.sgd(0.5)  # implicit record (would diverge)
+        opt.init({"w": jnp.ones(2), "b": jnp.zeros(())})
+        ad.capture(params=_params(), optimizer=optax.sgd(0.1), loss_fn=_loss)
+    sess = ad.create_distributed_session()
+    assert sess.params["w"].shape == (4,)
+
+
+def test_scope_exit_restores_namespaces():
+    orig_adam = optax.adam
+    orig_vg = jax.value_and_grad
+    ad = AutoDist(strategy_builder=AllReduce())
+    with ad.scope():
+        assert optax.adam is not orig_adam
+        assert jax.value_and_grad is not orig_vg
+    assert optax.adam is orig_adam
+    assert jax.value_and_grad is orig_vg
+
+
+def test_incomplete_capture_reports_whats_missing():
+    ad = AutoDist(strategy_builder=AllReduce())
+    with ad.scope():
+        opt = optax.sgd(0.1)
+        opt.init(_params())
+        # no jax.grad call → loss_fn missing
+    with pytest.raises(RuntimeError, match="loss_fn"):
+        ad.create_distributed_session()
+
+
+def test_nothing_captured_keeps_legacy_error():
+    ad = AutoDist(strategy_builder=AllReduce())
+    with ad.scope():
+        pass
+    with pytest.raises(RuntimeError, match="capture"):
+        ad.create_distributed_session()
+
+
+def test_tracer_params_are_not_captured():
+    ad = AutoDist(strategy_builder=AllReduce())
+    with ad.scope():
+        opt = optax.sgd(0.1)
+
+        @jax.jit
+        def init_under_jit(p):
+            return opt.init(p)  # tracer pytree: must not be recorded
+
+        init_under_jit(_params())
+        opt.init(_params())  # concrete: recorded
+        jax.grad(_loss)
+    rec = ad._implicit_record
+    assert rec.params is not None
+    assert not any(isinstance(x, jax.core.Tracer)
+                   for x in jax.tree_util.tree_leaves(rec.params))
+
+
+def test_patch_gate_env(monkeypatch):
+    monkeypatch.setenv("AUTODIST_PATCH", "False")
+    orig_adam = optax.adam
+    ad = AutoDist(strategy_builder=AllReduce())
+    with ad.scope():
+        assert optax.adam is orig_adam  # patching disabled
