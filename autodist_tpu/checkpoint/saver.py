@@ -79,8 +79,12 @@ class Saver:
         step = session.step_count if step is None else step
         path = self._step_dir(directory, step)
         os.makedirs(path, exist_ok=True)
-        self._save_item(os.path.join(path, "params"), session.sharded_params)
-        self._save_item(os.path.join(path, "opt_state"), session.opt_state)
+        # LOGICAL layout (pad-to-divisible sharding stripped): checkpoints
+        # stay interchangeable with single-device programs and across
+        # mesh topologies regardless of physical padding.
+        params_item, opt_item = session.export_state()
+        self._save_item(os.path.join(path, "params"), params_item)
+        self._save_item(os.path.join(path, "opt_state"), opt_item)
         has_sync = bool(jax.tree_util.tree_leaves(session.sync_state))
         if has_sync:
             self._save_item(os.path.join(path, "sync_state"),
@@ -99,12 +103,11 @@ class Saver:
         if session is None:
             raise ValueError("Saver has no bound session")
         path = os.path.abspath(path)
-        params = self._ckptr.restore(
-            os.path.join(path, "params"),
-            _abstract_like(session.sharded_params))
-        opt_state = self._ckptr.restore(
-            os.path.join(path, "opt_state"),
-            _abstract_like(session.opt_state))
+        params_target, opt_target = session.restore_targets()
+        params = self._ckptr.restore(os.path.join(path, "params"),
+                                     params_target)
+        opt_state = self._ckptr.restore(os.path.join(path, "opt_state"),
+                                        opt_target)
         meta = _read_meta(path)
         sync_state = None
         if meta.get("has_sync_state") and \
@@ -113,7 +116,7 @@ class Saver:
                 os.path.join(path, "sync_state"),
                 _abstract_like(session.sync_state))
         step = int(meta.get("step", 0))
-        session.load_state(params, opt_state, step, sync_state=sync_state)
+        session.import_state(params, opt_state, step, sync_state=sync_state)
         logging.info("checkpoint restored: %s (step %d)", path, step)
         return step
 

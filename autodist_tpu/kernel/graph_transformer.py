@@ -41,26 +41,81 @@ class DistributedStep:
     ``step_fn(params, opt_state, sync_state, batch)`` →
     ``(params, opt_state, sync_state, metrics)``.  ``sync_state`` carries
     per-device synchronizer state (compressor residuals etc.); it is an empty
-    dict on the GSPMD path."""
+    dict on the GSPMD path.
+
+    Pad-to-divisible sharding: when any variable carries a
+    ``VarPlan.pad_axis``, the step's state is PHYSICAL (padded) and
+    ``pad_info``/``opt_pad_info`` describe the boundary; ``place_params``
+    pads logical → physical, ``export_*``/``unpad_host`` recover the
+    logical view (so checkpoints keep the single-device interchange
+    invariant).  ``pad_info is None`` ⇒ all of these are identities."""
 
     step_fn: Callable
-    init_fn: Callable            # jitted params -> opt_state (sharded)
-    init_sync_state: Callable    # () -> sync-state pytree
-    param_shardings: Any         # pytree of NamedSharding
+    init_fn: Callable            # jitted physical params -> opt_state (sharded)
+    init_sync_state: Callable    # (params?) -> sync-state pytree
+    param_shardings: Any         # pytree of NamedSharding (physical layout)
     opt_shardings: Any
     mesh: Any
     compiled_strategy: CompiledStrategy
+    pad_info: Any = None             # params-shaped info tree, or None
+    opt_pad_info: Any = None         # opt-state-shaped info tree, or None
+    logical_param_shardings: Any = None  # pad axis dropped; None = physical
+    logical_opt_shardings: Any = None
     _placer: Optional[Callable] = None
+    _param_exporter: Optional[Callable] = None
+    _opt_exporter: Optional[Callable] = None
+    _opt_importer: Optional[Callable] = None
 
     def place_params(self, params):
-        # A jitted identity (not device_put): device_put may alias the
+        # A jitted pad+identity (not device_put): device_put may alias the
         # caller's buffers when layouts already match, and the step's
         # donation would then delete the user's original arrays.  Cached so
         # repeated placement (set_params/restore) compiles once.
         if self._placer is None:
-            self._placer = jax.jit(lambda p: p,
-                                   out_shardings=self.param_shardings)
+            info = self.pad_info
+            fn = (lambda p: su.pad_tree(p, info)) if info is not None \
+                else (lambda p: p)
+            self._placer = jax.jit(fn, out_shardings=self.param_shardings)
         return self._placer(params)
+
+    # -- logical/physical boundary ----------------------------------------
+    def export_params(self, phys_params):
+        """Physical (padded) params → logical sharded arrays (pad axis
+        gathered); identity when nothing is padded."""
+        if self.pad_info is None:
+            return phys_params
+        if self._param_exporter is None:
+            info = self.pad_info
+            self._param_exporter = jax.jit(
+                lambda p: su.unpad_tree(p, info),
+                out_shardings=self.logical_param_shardings)
+        return self._param_exporter(phys_params)
+
+    def export_opt_state(self, opt_state):
+        if self.pad_info is None:
+            return opt_state
+        if self._opt_exporter is None:
+            info = self.opt_pad_info
+            self._opt_exporter = jax.jit(
+                lambda s: su.unpad_tree(s, info),
+                out_shardings=self.logical_opt_shardings)
+        return self._opt_exporter(opt_state)
+
+    def import_opt_state(self, logical_opt_state):
+        if self.pad_info is None:
+            return logical_opt_state
+        if self._opt_importer is None:
+            info = self.opt_pad_info
+            self._opt_importer = jax.jit(
+                lambda s: su.pad_tree(s, info),
+                out_shardings=self.opt_shardings)
+        return self._opt_importer(logical_opt_state)
+
+    def unpad_host(self, host_params):
+        """Logical view of a host-gathered params tree (numpy in/out)."""
+        if self.pad_info is None:
+            return host_params
+        return su.unpad_host_tree(host_params, self.pad_info)
 
     def place_batch(self, batch):
         def put(x, sh):
@@ -123,6 +178,25 @@ class GraphTransformer:
             logging.info("compressors requested but mesh has no data axis; "
                          "using the GSPMD path (nothing to compress)")
 
+        # Pad-to-divisible sharding: vars whose partitioned dim doesn't
+        # divide the mesh axis are stored physically padded; the loss sees
+        # the logical view through an unpad slice (autodiff then scatters
+        # exactly-zero gradients into the pad rows).
+        pad_map = {name: (axis, self.graph_item.info.by_name(name).shape[axis],
+                          padded)
+                   for name, (axis, padded) in self.compiled.pad_plans().items()}
+        pad_info = su.pad_info_tree(params, pad_map) if pad_map else None
+        if pad_info is not None:
+            phys_params = jax.eval_shape(
+                lambda p: su.pad_tree(p, pad_info), params)
+            gi_loss = gi.loss_fn
+
+            def loss_fn(p, batch):
+                return gi_loss(su.unpad_tree(p, pad_info), batch)
+        else:
+            phys_params = params
+            loss_fn = gi.loss_fn
+
         param_spec_tree = su.spec_tree_for_params(params, self._param_specs())
         grad_spec_tree = su.spec_tree_for_params(params, self._opt_specs())
         param_sh = su.sharding_tree(mesh, param_spec_tree)
@@ -132,11 +206,12 @@ class GraphTransformer:
 
         # Optimizer-state layout: param-shaped blocks follow the per-variable
         # opt_spec (weight-update sharding for PS vars); scalars replicate.
-        opt_shape = jax.eval_shape(gi.optimizer.init, params)
-        opt_spec_tree = su.opt_spec_tree(opt_shape, params, grad_spec_tree)
+        # Shapes are PHYSICAL (the state the step carries is padded).
+        opt_shape = jax.eval_shape(gi.optimizer.init, phys_params)
+        opt_spec_tree = su.opt_spec_tree(opt_shape, phys_params, grad_spec_tree)
         opt_sh = su.sharding_tree(mesh, opt_spec_tree)
 
-        vg = jax.value_and_grad(gi.loss_fn, has_aux=gi.has_aux)
+        vg = jax.value_and_grad(loss_fn, has_aux=gi.has_aux)
         optimizer = gi.optimizer
         has_aux = gi.has_aux
 
@@ -165,6 +240,10 @@ class GraphTransformer:
                 grads, sync_state = stale.exchange(grads, sync_state)
             updates, opt_state = optimizer.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
+            if pad_info is not None:
+                # Keep pad rows exactly zero even for optimizers whose
+                # update is not zero-preserving (noise, non-zero decay).
+                params = su.mask_pad_tree(params, pad_info)
             # Fresh params return to their compute layout (all-gather for
             # WUS variables — "broadcast from the PS").
             params = su.constrain(params, param_sh)
@@ -181,7 +260,7 @@ class GraphTransformer:
         # applies) — leave them unspecified and let placed arguments carry
         # their own layout.
         sync_sh = None if stale is None \
-            else stale.state_shardings(mesh, params)
+            else stale.state_shardings(mesh, phys_params)
         step_fn = jax.jit(
             step,
             in_shardings=(param_sh, opt_sh, sync_sh, None),
@@ -200,8 +279,26 @@ class GraphTransformer:
             jit_init = jax.jit(stale.init_state, out_shardings=sync_sh)
 
             def init_sync_state(current_params=None):
-                return jit_init(params if current_params is None
-                                else current_params)
+                if current_params is None:
+                    # The rare explicit-None path takes LOGICAL params.
+                    current_params = params if pad_info is None \
+                        else su.pad_tree(params, pad_info)
+                return jit_init(current_params)
+
+        # Logical-layout sharding trees (pad axis gathered) for the
+        # checkpoint/export boundary — identical to physical when unpadded.
+        opt_pad_info = logical_param_sh = logical_opt_sh = None
+        if pad_info is not None:
+            opt_pad_info = su.opt_spec_tree(opt_shape, phys_params, pad_info,
+                                            default="")
+            logical_param_specs = self._logical_specs(self._param_specs())
+            logical_grad_specs = self._logical_specs(self._opt_specs())
+            logical_param_sh = su.sharding_tree(
+                mesh, su.spec_tree_for_params(params, logical_param_specs))
+            opt_shape_logical = jax.eval_shape(gi.optimizer.init, params)
+            logical_opt_sh = su.sharding_tree(mesh, su.opt_spec_tree(
+                opt_shape_logical, params,
+                su.spec_tree_for_params(params, logical_grad_specs)))
 
         logging.info(
             "GraphTransformer: compiled step over mesh %s (%d vars: %s)",
@@ -211,7 +308,27 @@ class GraphTransformer:
             step_fn=step_fn, init_fn=init_fn,
             init_sync_state=init_sync_state,
             param_shardings=param_sh, opt_shardings=opt_sh,
-            mesh=mesh, compiled_strategy=self.compiled)
+            mesh=mesh, compiled_strategy=self.compiled,
+            pad_info=pad_info, opt_pad_info=opt_pad_info,
+            logical_param_shardings=logical_param_sh,
+            logical_opt_shardings=logical_opt_sh)
+
+    def _logical_specs(self, specs: Dict[str, P]) -> Dict[str, P]:
+        """Per-variable specs with the pad axis entry dropped (the logical
+        view cannot be sharded along a dim that doesn't tile evenly)."""
+        from autodist_tpu.strategy.compiler import spec_from_entries
+
+        out: Dict[str, P] = {}
+        for name, spec in specs.items():
+            plan = self.compiled.var_plans[name]
+            if plan.pad_axis is None:
+                out[name] = spec
+                continue
+            entries = list(spec)
+            if plan.pad_axis < len(entries):
+                entries[plan.pad_axis] = None
+            out[name] = spec_from_entries(entries)
+        return out
 
     def _transform_explicit(self, extra_metrics_fn: Optional[Callable] = None
                             ) -> DistributedStep:

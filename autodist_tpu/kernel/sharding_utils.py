@@ -46,15 +46,18 @@ def _shapes_compatible(node: Any, params: Any) -> bool:
     return True
 
 
-def opt_spec_tree(opt_state: Any, params: Any, param_block_specs: Any) -> Any:
+def opt_spec_tree(opt_state: Any, params: Any, param_block_specs: Any,
+                  default: Any = P()) -> Any:
     """Build a PartitionSpec tree for an optax optimizer state.
 
     Any sub-pytree of ``opt_state`` that is isomorphic to ``params`` (same
     structure AND same leaf shapes — e.g. Adam's ``mu``/``nu``) receives the
     per-variable ``param_block_specs`` tree; every other leaf (step counts,
-    scalars) is replicated.  This is how weight-update sharding reaches the
-    optimizer slots (cf. arxiv 2004.13336; the reference instead re-created
-    the optimizer inside each PS scope, kernel/partitioner.py:481-574).
+    scalars) gets ``default`` (replicated specs unless overridden — also used
+    to project pad-info trees onto optimizer states).  This is how
+    weight-update sharding reaches the optimizer slots (cf. arxiv 2004.13336;
+    the reference instead re-created the optimizer inside each PS scope,
+    kernel/partitioner.py:481-574).
     """
     pstruct = jax.tree_util.tree_structure(params)
 
@@ -68,7 +71,7 @@ def opt_spec_tree(opt_state: Any, params: Any, param_block_specs: Any) -> Any:
 
     leaves, treedef = jax.tree_util.tree_flatten(
         opt_state, is_leaf=lambda x: is_param_block(x) or x is None)
-    mapped = [param_block_specs if is_param_block(leaf) else P()
+    mapped = [param_block_specs if is_param_block(leaf) else default
               for leaf in leaves]
     return jax.tree_util.tree_unflatten(treedef, mapped)
 
@@ -87,3 +90,89 @@ def constrain(tree: Any, sharding_or_spec_tree: Any) -> Any:
 def host_local(tree: Any) -> Any:
     """Fetch a (possibly sharded) pytree to host numpy arrays."""
     return jax.tree_util.tree_map(lambda x: np.asarray(jax.device_get(x)), tree)
+
+
+# -- pad-to-divisible sharding ------------------------------------------------
+# Variables whose partitioned dim does not divide the mesh axis are stored
+# PHYSICALLY padded to the next multiple (VarPlan.pad_axis/pad_dim) so jit's
+# even-tiling requirement is met; the loss consumes the LOGICAL view via an
+# unpad slice (whose autodiff scatters exactly-zero gradients into pad rows),
+# and updates are masked so pad rows stay zero.  Real lowering of the
+# reference's uneven partitioner (kernel/partitioner.py:376-426).
+#
+# Pad metadata rides in params-shaped "info trees" of strings
+# ("axis:logical:padded", or "" for unpadded leaves) — strings are pytree
+# leaves, so info trees map cleanly over params AND project onto optimizer
+# states through opt_spec_tree.
+
+def pad_info_tree(params: Any, pad_map: Dict[str, tuple]) -> Any:
+    """params-shaped info tree from ``{name: (axis, logical_dim, padded_dim)}``."""
+
+    def info_of(path, leaf):
+        entry = pad_map.get(path_name(path))
+        return "" if entry is None else f"{entry[0]}:{entry[1]}:{entry[2]}"
+
+    return jax.tree_util.tree_map_with_path(info_of, params)
+
+
+def _parse_info(info: str):
+    axis, logical, padded = (int(x) for x in info.split(":"))
+    return axis, logical, padded
+
+
+def pad_tree(tree: Any, info_tree: Any) -> Any:
+    """Zero-pad each annotated leaf to its physical (padded) shape."""
+    import jax.numpy as jnp
+
+    def pad_leaf(x, info):
+        if not info:
+            return x
+        axis, logical, padded = _parse_info(info)
+        widths = [(0, 0)] * jnp.ndim(x)
+        widths[axis] = (0, padded - x.shape[axis])
+        return jnp.pad(jnp.asarray(x), widths)
+
+    return jax.tree_util.tree_map(pad_leaf, tree, info_tree)
+
+
+def unpad_tree(tree: Any, info_tree: Any) -> Any:
+    """Slice each annotated leaf back to its logical shape (differentiable:
+    the backward pass scatters zeros into the pad region)."""
+
+    def unpad_leaf(x, info):
+        if not info:
+            return x
+        axis, logical, _ = _parse_info(info)
+        return jax.lax.slice_in_dim(x, 0, logical, axis=axis)
+
+    return jax.tree_util.tree_map(unpad_leaf, tree, info_tree)
+
+
+def mask_pad_tree(tree: Any, info_tree: Any) -> Any:
+    """Force the pad region of each annotated leaf to zero (keeps the
+    padded-rows-are-zero invariant exact even for optimizers whose update is
+    not zero-preserving)."""
+    import jax.numpy as jnp
+
+    def mask_leaf(x, info):
+        if not info:
+            return x
+        axis, logical, _ = _parse_info(info)
+        idx = jax.lax.broadcasted_iota(jnp.int32, jnp.shape(x), axis)
+        return jnp.where(idx < logical, x, jnp.zeros_like(x))
+
+    return jax.tree_util.tree_map(mask_leaf, tree, info_tree)
+
+
+def unpad_host_tree(tree: Any, info_tree: Any) -> Any:
+    """Host-side unpad: plain numpy slicing, numpy in → numpy out."""
+
+    def unpad_leaf(x, info):
+        if not info:
+            return x
+        axis, logical, _ = _parse_info(info)
+        index = [slice(None)] * np.ndim(x)
+        index[axis] = slice(0, logical)
+        return np.asarray(x)[tuple(index)]
+
+    return jax.tree_util.tree_map(unpad_leaf, tree, info_tree)

@@ -47,14 +47,33 @@ class DistributedSession:
     # -- state -------------------------------------------------------------
     @property
     def params(self):
-        """Current parameters, gathered to host numpy (original single-device
-        layout — the reference's checkpoint-compatibility invariant,
-        checkpoint/saver.py:42-58)."""
-        return su.host_local(self._params)
+        """Current parameters, gathered to host numpy in the original
+        single-device LOGICAL layout (pad rows stripped — the reference's
+        checkpoint-compatibility invariant, checkpoint/saver.py:42-58)."""
+        return self._step.unpad_host(su.host_local(self._params))
 
     @property
     def sharded_params(self):
+        """Device-resident parameters in the step's PHYSICAL layout (padded
+        when pad-to-divisible sharding is active)."""
         return self._params
+
+    def export_state(self):
+        """(params, opt_state) as sharded device arrays in the LOGICAL
+        layout — what checkpoints store, so they interchange with
+        single-device programs and across mesh topologies."""
+        return (self._step.export_params(self._params),
+                self._step.export_opt_state(self._opt_state))
+
+    def import_state(self, params, opt_state, step: int = 0,
+                     sync_state=None) -> None:
+        """Load LOGICAL-layout state (e.g. from a checkpoint): params and
+        optimizer state are padded/re-placed to the physical layout."""
+        self._params = self._step.place_params(params)
+        self._opt_state = self._step.import_opt_state(opt_state)
+        self._sync_state = (sync_state if sync_state is not None
+                            else self._step.init_sync_state(self._params))
+        self._step_count = step
 
     @property
     def opt_state(self):
@@ -153,6 +172,29 @@ class DistributedSession:
         dispatch; returns the last step's metrics on host (None for an
         empty iterable)."""
         return self.run_many(self.prefetch(batches, prefetch_depth))
+
+    def restore_targets(self):
+        """Abstract (ShapeDtypeStruct + sharding) trees of the LOGICAL
+        (params, opt_state) — the restore targets matching
+        :meth:`export_state`'s layout."""
+        st = self._step
+
+        def abs_like(tree):
+            return jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                               sharding=x.sharding), tree)
+
+        if st.pad_info is None:
+            return abs_like(self._params), abs_like(self._opt_state)
+        pa = jax.eval_shape(st.export_params, self._params)
+        oa = jax.eval_shape(st.export_opt_state, self._opt_state)
+        pa = jax.tree_util.tree_map(
+            lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+            pa, st.logical_param_shardings)
+        oa = jax.tree_util.tree_map(
+            lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+            oa, st.logical_opt_shardings)
+        return pa, oa
 
     def set_params(self, params) -> None:
         """Load new parameter values (e.g. from a checkpoint), re-placing

@@ -108,6 +108,13 @@ class VarPlan:
     partition_axis: Optional[int] = None
     num_shards: int = 1
     sparse: bool = False
+    # pad-to-divisible sharding: when the partitioned dim does not divide the
+    # mesh axis, the variable is physically padded to ``pad_dim`` along
+    # ``pad_axis`` (pad rows zero-masked every step); the kernel layer owns
+    # the pad/unpad boundary.  Real lowering of the reference's uneven
+    # partitioner (kernel/partitioner.py:376-426).
+    pad_axis: Optional[int] = None
+    pad_dim: int = 0
 
 
 @dataclass
@@ -125,6 +132,11 @@ class CompiledStrategy:
 
     def plan_for(self, name: str) -> VarPlan:
         return self.var_plans[name]
+
+    def pad_plans(self) -> Dict[str, Tuple[int, int]]:
+        """Vars needing pad-to-divisible sharding: name → (axis, padded_dim)."""
+        return {n: (p.pad_axis, p.pad_dim)
+                for n, p in self.var_plans.items() if p.pad_axis is not None}
 
     def batch_spec(self) -> P:
         return P(self.batch_axes)
@@ -242,32 +254,44 @@ class StrategyCompiler:
     _spec_from_entries = staticmethod(spec_from_entries)
 
     def _partition_spec(self, var: VarInfo, axis: Optional[int],
-                        shard_mesh_axis: Optional[str]) -> P:
-        """Shard ``var``'s ``axis`` over ``shard_mesh_axis`` — if the dim
-        divides the mesh axis evenly.  Uneven strategy shard counts (the
-        UnevenPartitionedPS family) do not map onto GSPMD's even tiling; such
-        variables stay replicated on the mesh, while the strategy IR retains
-        the uneven plan for spec parity."""
+                        shard_mesh_axis: Optional[str]
+                        ) -> Tuple[P, Optional[Tuple[int, int]]]:
+        """Shard ``var``'s ``axis`` over ``shard_mesh_axis``.
+
+        Returns ``(spec, pad)`` where ``pad`` is ``(axis, padded_dim)`` when
+        the dim does not divide the mesh axis: jit arg/out shardings require
+        even tiling, so indivisible dims are padded to the next multiple and
+        physically sharded, with pad rows masked to zero by the kernel layer
+        — the real lowering of the reference's uneven partitioner
+        (kernel/partitioner.py:376-426), and how indivisible embedding vocabs
+        shard instead of replicating."""
         if axis is None or shard_mesh_axis is None:
-            return P()
+            return P(), None
         axis_size = self.mesh.shape.get(shard_mesh_axis, 1)
         if axis_size <= 1:
-            return P()
-        if var.shape[axis] % axis_size != 0:
-            # jit arg/out shardings and device_put require even tiling (only
-            # with_sharding_constraint pads), so an indivisible dim must stay
-            # replicated. Loud warning: for embeddings the fix is padding the
-            # vocab to a multiple of the mesh axis (good for MXU tiling too).
-            logging.warning(
-                "variable %s dim %d (size %d) is not divisible by mesh axis "
-                "%r (size %d); keeping it replicated. Pad the dimension to a "
-                "multiple of %d to enable sharding.",
-                var.name, axis, var.shape[axis], shard_mesh_axis, axis_size,
-                axis_size)
-            return P()
+            return P(), None
         entries: List[Optional[str]] = [None] * len(var.shape)
         entries[axis] = shard_mesh_axis
-        return self._spec_from_entries(entries)
+        spec = self._spec_from_entries(entries)
+        dim = var.shape[axis]
+        if dim % axis_size != 0:
+            padded = -(-dim // axis_size) * axis_size
+            if padded >= 2 * dim:
+                # Padding would at least double the variable (tiny dims on a
+                # wide axis): replication is cheaper than the pad waste plus
+                # the extra all-gather.
+                _warn_once(
+                    "variable %s dim %d (size %d) would pad to %d on the %r "
+                    "axis (size %d) — more than doubling it; keeping it "
+                    "replicated", var.name, axis, dim, padded,
+                    shard_mesh_axis, axis_size)
+                return P(), None
+            logging.info(
+                "variable %s dim %d (size %d) padded to %d for even %r-axis "
+                "sharding (pad rows are zero-masked each step)",
+                var.name, axis, dim, padded, shard_mesh_axis)
+            return spec, (axis, padded)
+        return spec, None
 
     def _wus_opt_spec(self, var: VarInfo, param_spec: P) -> P:
         """Weight-update-sharding layout: shard the largest still-unsharded
@@ -379,7 +403,7 @@ class StrategyCompiler:
         if isinstance(sync, AllReduceSynchronizerConfig):
             # Shards stay colocated with replicas (reference layout) —
             # partition over 'model' only when the mesh has one.
-            spec = self._partition_spec(var, axis, model_axis)
+            spec, pad = self._partition_spec(var, axis, model_axis)
             spec = self._apply_structural_specs(var, spec)
             return VarPlan(
                 var_name=var.name, sync_kind="AllReduce",
@@ -387,16 +411,19 @@ class StrategyCompiler:
                 compressor=sync.compressor, group=sync.group,
                 partition_axis=axis if model_axis else None,
                 num_shards=num_shards if model_axis else 1,
-                sparse=var.sparse)
+                sparse=var.sparse,
+                pad_axis=pad[0] if pad else None,
+                pad_dim=pad[1] if pad else 0)
 
         if isinstance(sync, PSSynchronizerConfig):
             shard_axis = model_axis or (MESH_AXIS_DATA if axis is not None else None)
-            spec = self._partition_spec(var, axis, shard_axis)
+            spec, pad = self._partition_spec(var, axis, shard_axis)
             if (var.sparse and axis is None and var.shape
                     and not (var.pipeline or var.expert)):
                 # Sparse embedding on PS: shard the vocab axis so gradient
                 # scatter-adds land on the owning shard (Parallax lowering).
-                spec = self._partition_spec(var, 0, model_axis or MESH_AXIS_DATA)
+                spec, pad = self._partition_spec(
+                    var, 0, model_axis or MESH_AXIS_DATA)
             if var.pipeline or var.expert:
                 # Structural axes over pipe/expert, then WUS fills a free dim
                 # with data (no-op if the spec already carries 'data').
@@ -413,6 +440,8 @@ class StrategyCompiler:
                 staleness=sync.staleness,
                 local_replication=sync.local_replication,
                 partition_axis=axis, num_shards=num_shards,
-                sparse=var.sparse)
+                sparse=var.sparse,
+                pad_axis=pad[0] if pad else None,
+                pad_dim=pad[1] if pad else 0)
 
         raise ValueError(f"node {node.var_name} has no synchronizer")

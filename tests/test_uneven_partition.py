@@ -1,0 +1,185 @@
+"""Pad-to-divisible (uneven) partitioning.
+
+Parity target: the reference's uneven partitioner physically splits
+non-divisible shard counts (``autodist/kernel/partitioner.py:376-426``);
+here indivisible dims are padded to the next multiple of the mesh axis,
+physically sharded, and pad rows are masked to zero each step."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from autodist_tpu.autodist import AutoDist, _reset_default_autodist_for_testing
+from autodist_tpu.graph_item import GraphItem
+from autodist_tpu.mesh import build_mesh
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.strategy import (
+    Parallax,
+    PartitionedPS,
+    StrategyCompiler,
+    UnevenPartitionedPS,
+)
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    _reset_default_autodist_for_testing()
+
+
+def _spec():
+    return ResourceSpec(
+        resource_info={"nodes": [{"address": "localhost", "chips": 8}]})
+
+
+def _params7():
+    # dim0 = 7: not divisible by (and smaller than) the 8-way axis.
+    return {"linear": {"w": jnp.arange(21.0).reshape(7, 3) / 10.0,
+                       "b": jnp.zeros(3)}}
+
+
+def _loss(params, batch):
+    pred = batch["x"] @ params["linear"]["w"] + params["linear"]["b"]
+    return jnp.mean((pred - batch["y"]) ** 2)
+
+
+def _batch(n=16, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 7).astype(np.float32)
+    y = rng.randn(n, 3).astype(np.float32)
+    return {"x": x, "y": y}
+
+
+def test_compiler_emits_pad_plan():
+    gi = GraphItem(_params7())
+    mesh = build_mesh({"data": 8})
+    cs = StrategyCompiler(mesh).compile(
+        UnevenPartitionedPS().build(gi, _spec()), gi)
+    plan = cs.plan_for("linear/w")
+    assert plan.param_spec == P("data")
+    assert plan.pad_axis == 0 and plan.pad_dim == 8
+    assert cs.pad_plans()["linear/w"] == (0, 8)
+
+
+def test_seven_rows_physically_sharded_on_eight_way_axis():
+    """The VERDICT done-criterion: a (7, ...) variable physically sharded
+    on an 8-way axis."""
+    ad = AutoDist(strategy_builder=UnevenPartitionedPS())
+    with ad.scope():
+        ad.capture(params=_params7(), optimizer=optax.adam(1e-2),
+                   loss_fn=_loss)
+    sess = ad.create_distributed_session()
+    w_phys = sess.sharded_params["linear"]["w"]
+    assert w_phys.shape == (8, 3)                      # physical: padded
+    shard_shapes = {s.data.shape for s in w_phys.addressable_shards}
+    assert shard_shapes == {(1, 3)}                    # one row per device
+    assert sess.params["linear"]["w"].shape == (7, 3)  # logical view
+
+
+def test_uneven_training_matches_single_device():
+    batch = _batch()
+    ad = AutoDist(strategy_builder=UnevenPartitionedPS())
+    with ad.scope():
+        ad.capture(params=_params7(), optimizer=optax.adam(1e-2),
+                   loss_fn=_loss)
+    sess = ad.create_distributed_session()
+
+    opt = optax.adam(1e-2)
+    p = _params7()
+    s = opt.init(p)
+    for i in range(5):
+        dist_loss = sess.run(batch)["loss"]
+        (ref_loss, g) = jax.value_and_grad(_loss)(p, batch)
+        u, s = opt.update(g, s, p)
+        p = optax.apply_updates(p, u)
+        np.testing.assert_allclose(dist_loss, ref_loss, rtol=2e-5)
+    np.testing.assert_allclose(
+        sess.params["linear"]["w"], p["linear"]["w"], rtol=2e-5, atol=1e-6)
+
+
+def test_pad_rows_stay_zero():
+    ad = AutoDist(strategy_builder=UnevenPartitionedPS())
+    with ad.scope():
+        ad.capture(params=_params7(),
+                   optimizer=optax.chain(
+                       optax.add_decayed_weights(1e-2), optax.sgd(0.1)),
+                   loss_fn=_loss)
+    sess = ad.create_distributed_session()
+    for _ in range(3):
+        sess.run(_batch())
+    w_phys = np.asarray(jax.device_get(sess.sharded_params["linear"]["w"]))
+    np.testing.assert_array_equal(w_phys[7:], 0.0)
+
+
+def test_indivisible_sparse_embedding_shards():
+    """Parallax embeddings with vocab % mesh != 0 now shard (vocab padded)."""
+    vocab = 13
+    params = {"emb": {"table": jnp.ones((vocab, 4))},
+              "dense": {"w": jnp.ones((4, 2))}}
+
+    def loss(params, batch):
+        h = params["emb"]["table"][batch["ids"]]
+        return jnp.mean((h @ params["dense"]["w"]) ** 2)
+
+    ad = AutoDist(strategy_builder=Parallax())
+    with ad.scope():
+        ad.capture(params=params, optimizer=optax.sgd(0.01), loss_fn=loss,
+                   sparse_vars=["emb/table"])
+    sess = ad.create_distributed_session()
+    t = sess.sharded_params["emb"]["table"]
+    assert t.shape == (16, 4)  # padded to 8-multiple
+    assert {s.data.shape for s in t.addressable_shards} == {(2, 4)}
+    ids = np.array([0, 3, 12, 7] * 4, np.int32).reshape(16)
+    loss0 = sess.run({"ids": ids})["loss"]
+    assert np.isfinite(loss0)
+    assert sess.params["emb"]["table"].shape == (vocab, 4)
+
+
+def test_checkpoint_interchange_with_padding(tmp_path):
+    """A padded 8-way run checkpoints in LOGICAL layout; a plain program and
+    a 2-way mesh both consume it (the reference's interchange invariant)."""
+    from autodist_tpu.checkpoint import Saver
+
+    batch = _batch()
+    ad = AutoDist(strategy_builder=UnevenPartitionedPS())
+    with ad.scope():
+        ad.capture(params=_params7(), optimizer=optax.adam(1e-2),
+                   loss_fn=_loss)
+    sess = ad.create_distributed_session()
+    for _ in range(2):
+        sess.run(batch)
+    w_after = sess.params["linear"]["w"]
+    saver = Saver(sess)
+    path = saver.save(str(tmp_path / "ckpt"))
+
+    # Plain-program interchange: logical shapes on restore.
+    restored = Saver.restore_params(path)
+    assert restored["linear"]["w"].shape == (7, 3)
+    np.testing.assert_allclose(restored["linear"]["w"], w_after, rtol=1e-6)
+
+    # Cross-topology restore: 2-way data mesh (7 pads to 8 differently).
+    _reset_default_autodist_for_testing()
+    ad2 = AutoDist(strategy_builder=UnevenPartitionedPS(),
+                   mesh_axes={"data": 2})
+    with ad2.scope():
+        ad2.capture(params=_params7(), optimizer=optax.adam(1e-2),
+                    loss_fn=_loss)
+    sess2 = ad2.create_distributed_session(mesh=build_mesh({"data": 2}))
+    step = saver.restore(path, session=sess2)
+    assert step == 2
+    np.testing.assert_allclose(sess2.params["linear"]["w"], w_after,
+                               rtol=1e-6)
+    # Training continues identically from the restored state.
+    l1 = sess.run(batch)["loss"]
+    l2 = sess2.run(batch)["loss"]
+    np.testing.assert_allclose(l1, l2, rtol=2e-5)
+
+
+def test_divisible_vars_have_no_padding():
+    params = {"linear": {"w": jnp.ones((8, 4)), "b": jnp.zeros(4)}}
+    gi = GraphItem(params)
+    mesh = build_mesh({"data": 8})
+    cs = StrategyCompiler(mesh).compile(
+        PartitionedPS().build(gi, _spec()), gi)
+    assert cs.pad_plans() == {}
