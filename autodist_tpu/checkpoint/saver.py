@@ -30,6 +30,7 @@ import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
+from autodist_tpu.kernel.sharding_utils import abstract_like as _abstract_like
 from autodist_tpu.utils import logging
 
 _STEP_RE = re.compile(r"^step_(\d+)$")
@@ -112,9 +113,20 @@ class Saver:
         sync_state = None
         if meta.get("has_sync_state") and \
                 jax.tree_util.tree_leaves(session.sync_state):
-            sync_state = self._ckptr.restore(
-                os.path.join(path, "sync_state"),
-                _abstract_like(session.sync_state))
+            # sync_state (proxy mirrors, delay queues, residuals) is saved in
+            # the step's PHYSICAL layout, which is mesh-dependent when
+            # pad-to-divisible sharding is active — a cross-topology restore
+            # can shape-mismatch.  Fall back to reinitialization (resume is
+            # then approximate, as documented on load_state) rather than
+            # failing the params/opt restore that IS topology-portable.
+            try:
+                sync_state = self._ckptr.restore(
+                    os.path.join(path, "sync_state"),
+                    _abstract_like(session.sync_state))
+            except Exception as e:
+                logging.warning(
+                    "sync_state in %s does not match this session's layout "
+                    "(%s); reinitializing it — resume is approximate", path, e)
         step = int(meta.get("step", 0))
         session.import_state(params, opt_state, step, sync_state=sync_state)
         logging.info("checkpoint restored: %s (step %d)", path, step)
@@ -154,12 +166,6 @@ def save_params(path: str, params: Any) -> str:
     return path
 
 
-def _abstract_like(tree: Any) -> Any:
-    return jax.tree_util.tree_map(
-        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
-        if hasattr(x, "sharding") else jax.ShapeDtypeStruct(
-            np.shape(x), np.asarray(x).dtype),
-        tree)
 
 
 def _read_meta(path: str) -> dict:

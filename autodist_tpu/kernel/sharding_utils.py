@@ -92,6 +92,16 @@ def host_local(tree: Any) -> Any:
     return jax.tree_util.tree_map(lambda x: np.asarray(jax.device_get(x)), tree)
 
 
+def abstract_like(tree: Any) -> Any:
+    """ShapeDtypeStruct tree mirroring ``tree`` (shardings kept when
+    present) — the restore-target shape for checkpointing."""
+    return jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+        if hasattr(x, "sharding") else jax.ShapeDtypeStruct(
+            np.shape(x), np.asarray(x).dtype),
+        tree)
+
+
 # -- pad-to-divisible sharding ------------------------------------------------
 # Variables whose partitioned dim does not divide the mesh axis are stored
 # PHYSICALLY padded to the next multiple (VarPlan.pad_axis/pad_dim) so jit's

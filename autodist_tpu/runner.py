@@ -178,14 +178,9 @@ class DistributedSession:
         (params, opt_state) — the restore targets matching
         :meth:`export_state`'s layout."""
         st = self._step
-
-        def abs_like(tree):
-            return jax.tree_util.tree_map(
-                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
-                                               sharding=x.sharding), tree)
-
         if st.pad_info is None:
-            return abs_like(self._params), abs_like(self._opt_state)
+            return (su.abstract_like(self._params),
+                    su.abstract_like(self._opt_state))
         pa = jax.eval_shape(st.export_params, self._params)
         oa = jax.eval_shape(st.export_opt_state, self._opt_state)
         pa = jax.tree_util.tree_map(

@@ -1,0 +1,117 @@
+"""Live multi-process training script (chief + worker on localhost CPU).
+
+The pytest driver (``tests/test_multiprocess.py``) launches this script once
+as the CHIEF; the real :class:`~autodist_tpu.coordinator.Coordinator` then
+re-launches it on the "other node" (also localhost) exactly the way the
+reference chief re-ran the user script on every worker host
+(``autodist/coordinator.py:46-90``, exercised by
+``tests/integration/test_dist.py:1-43`` on a real 2-machine cluster).
+
+Covers, live: strategy build → serialize → ship → worker deserialize
+(``AUTODIST_STRATEGY_ID``), env plumbing, ``Cluster.start()`` actually
+calling ``jax.distributed.initialize`` (PJRT coordination service +
+gloo collectives on CPU), and lockstep SPMD training across two OS
+processes with 2 local devices each.
+
+Result protocol: each process writes ``$AUTODIST_RESULT_FILE[.worker]``
+with its observed losses and topology facts.
+"""
+import json
+import os
+import sys
+
+# 2 local CPU devices per process -> 4 global devices over 2 processes.
+# Env vars alone are NOT enough: the image's sitecustomize pins
+# JAX_PLATFORMS=axon (remote TPU), so steer via jax.config before any
+# backend init (same trick as tests/conftest.py / __graft_entry__.py).
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.environ.get("AUTODIST_REPO_ROOT",
+                                  os.path.dirname(os.path.dirname(
+                                      os.path.dirname(
+                                          os.path.abspath(__file__))))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)
+
+import numpy as np  # noqa: E402
+
+from autodist_tpu.autodist import AutoDist  # noqa: E402
+from autodist_tpu.const import ENV  # noqa: E402
+from autodist_tpu.resource_spec import ResourceSpec  # noqa: E402
+from autodist_tpu.strategy import AllReduce, PSLoadBalancing  # noqa: E402
+
+STEPS = 4
+LR = 0.1
+
+
+def make_batch():
+    rng = np.random.RandomState(42)
+    x = rng.randn(32, 3).astype(np.float32)
+    y = (x @ np.array([1.0, -2.0, 0.5], np.float32) + 0.25).astype(np.float32)
+    return {"x": x, "y": y}
+
+
+def loss_fn(params, batch):
+    import jax.numpy as jnp
+
+    pred = batch["x"] @ params["w"] + params["b"]
+    return jnp.mean((pred - batch["y"]) ** 2)
+
+
+def main():
+    import optax
+
+    builder = {"AllReduce": AllReduce,
+               "PSLoadBalancing": PSLoadBalancing}[
+                   os.environ.get("AUTODIST_TEST_BUILDER", "AllReduce")]()
+    # Two "nodes", both local: the chief fans the script out with
+    # subprocess+env exactly as it would over SSH to a remote host.
+    spec = ResourceSpec(resource_info={
+        "nodes": [{"address": "127.0.0.1", "chips": 2, "chief": True},
+                  {"address": "localhost", "chips": 2}]})
+
+    # Params as numpy: no jax computation may run before
+    # jax.distributed.initialize (see Cluster.start).
+    params = {"w": np.zeros(3, np.float32), "b": np.zeros((), np.float32)}
+
+    ad = AutoDist(resource_spec=spec, strategy_builder=builder)
+    with ad.scope():
+        ad.capture(params=params, optimizer=optax.sgd(LR), loss_fn=loss_fn)
+    sess = ad.create_distributed_session()
+
+    import jax
+
+    batch = make_batch()
+    losses = [float(sess.run(batch)["loss"]) for _ in range(STEPS)]
+
+    result = {
+        "role": "worker" if ENV.AUTODIST_WORKER.val else "chief",
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "global_devices": len(jax.devices()),
+        "local_devices": len(jax.local_devices()),
+        "mesh": dict(sess.mesh.shape),
+        "strategy_id": ad._strategy.id,
+        "losses": losses,
+        "final_w": np.asarray(sess.params["w"]).tolist(),
+    }
+    out = os.environ["AUTODIST_RESULT_FILE"]
+    if ENV.AUTODIST_WORKER.val:
+        out += ".worker"
+    with open(out, "w", encoding="utf-8") as f:
+        json.dump(result, f)
+    print(f"[{result['role']}] done: losses={losses}", flush=True)
+
+    # Explicit shutdown BEFORE the chief joins the worker: jax's atexit
+    # shutdown runs a coordination-service barrier, so a chief blocked in
+    # join() while the worker waits in that barrier would deadlock.
+    jax.distributed.shutdown()
+    if ad.coordinator is not None:
+        ad.coordinator.join()
+
+
+if __name__ == "__main__":
+    main()
