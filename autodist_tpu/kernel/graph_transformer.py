@@ -21,6 +21,7 @@ synchronizer state such as compressor residuals; empty on the GSPMD path).
 """
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Optional
 
@@ -261,11 +262,26 @@ class GraphTransformer:
         # their own layout.
         sync_sh = None if stale is None \
             else stale.state_shardings(mesh, phys_params)
+        jit_kwargs = {}
+        combiner = self._combiner_bytes()
+        flag = os.environ.get("AUTODIST_COMBINER_FLAG")
+        if combiner and flag and mesh.devices.flat[0].platform == "tpu":
+            # Strategy `group`/chunk_size lowered as XLA's all-reduce
+            # combiner threshold: the compiler merges the grouped psums into
+            # fused collectives — the TPU-native form of the reference's
+            # scoped-allocator chunk merge (all_reduce_strategy.py:21-90).
+            # Env-gated: accepted option names vary by compile service (the
+            # remote-TPU AOT path rejects xla_tpu_*); XLA's DEFAULT combiner
+            # already merges same-program psums (verified in HLO), so the
+            # flag only tunes the threshold.  Set e.g.
+            # AUTODIST_COMBINER_FLAG=xla_gpu_all_reduce_combine_threshold_bytes.
+            jit_kwargs["compiler_options"] = {flag: combiner}
         step_fn = jax.jit(
             step,
             in_shardings=(param_sh, opt_sh, sync_sh, None),
             out_shardings=(param_sh, opt_sh, sync_sh, None),
             donate_argnums=(0, 1) if stale is None else (0, 1, 2),
+            **jit_kwargs,
         )
         init_fn = jax.jit(gi.optimizer.init, out_shardings=opt_sh)
         if stale is None:
@@ -312,6 +328,17 @@ class GraphTransformer:
             pad_info=pad_info, opt_pad_info=opt_pad_info,
             logical_param_shardings=logical_param_sh,
             logical_opt_shardings=logical_opt_sh)
+
+    def _combiner_bytes(self) -> int:
+        """Largest collective-group byte sum — the all-reduce combiner
+        threshold that lets XLA merge each strategy group into one fused
+        collective.  0 when no group has ≥2 members (grouping inert)."""
+        best = 0
+        for names in self.compiled.fusable_groups().values():
+            total = sum(self.graph_item.info.by_name(n).byte_size
+                        for n in names)
+            best = max(best, total)
+        return best
 
     def _logical_specs(self, specs: Dict[str, P]) -> Dict[str, P]:
         """Per-variable specs with the pad axis entry dropped (the logical

@@ -39,8 +39,14 @@ from autodist_tpu.utils import logging
 
 
 def uses_explicit_path(compiled: CompiledStrategy) -> bool:
-    return any(plan.compressor not in ("", "NoneCompressor")
-               for plan in compiled.var_plans.values())
+    """Compressors need manual collectives; fused grouping needs them too
+    (one concat-and-pmean per group — the reference's scoped-allocator
+    merge done literally)."""
+    if any(plan.compressor not in ("", "NoneCompressor")
+           for plan in compiled.var_plans.values()):
+        return True
+    return (any(plan.fused for plan in compiled.var_plans.values())
+            and bool(compiled.fusable_groups()))
 
 
 def _compressors_for(gi: GraphItem, compiled: CompiledStrategy
@@ -72,6 +78,22 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy,
     optimizer = gi.optimizer
     has_aux = gi.has_aux
 
+    # Trace-time fusion table (reference chunk merge): vars in the same
+    # group are concatenated into ONE pmean.  Split by dtype — a fused
+    # vector must be homogeneous.
+    fuse_member: Dict[str, tuple] = {}
+    if d > 1:
+        leaves = gi.name_to_leaf()
+        for group, names in compiled.fusable_groups().items():
+            by_dtype: Dict[str, list] = {}
+            for n in names:
+                by_dtype.setdefault(str(jnp.asarray(leaves[n]).dtype),
+                                    []).append(n)
+            for dt, ns in by_dtype.items():
+                if len(ns) >= 2:
+                    for n in ns:
+                        fuse_member[n] = (group, dt)
+
     # -- sync state --------------------------------------------------------
     def init_sync_state(current_params=None):
         # Compressor residuals start at zero regardless of parameter values,
@@ -96,9 +118,14 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy,
 
         flat, treedef = jax.tree_util.tree_flatten_with_path(grads)
         new_sync = dict(sync_state)
-        synced = []
-        for path, g in flat:
+        synced = [None] * len(flat)
+        fused_parts: Dict[tuple, list] = {}
+        for i, (path, g) in enumerate(flat):
             name = path_name(path)
+            key = fuse_member.get(name)
+            if key is not None:
+                fused_parts.setdefault(key, []).append((i, g))
+                continue
             st = sync_state.get(name)
             local_st = None if st is None else jax.tree_util.tree_map(
                 lambda x: jnp.squeeze(x, 0), st)
@@ -106,7 +133,16 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy,
             if st2 is not None and name in new_sync:
                 new_sync[name] = jax.tree_util.tree_map(
                     lambda x: jnp.expand_dims(x, 0), st2)
-            synced.append(g2)
+            synced[i] = g2
+        # One pmean per fused group: concat raveled grads, reduce, split.
+        for parts in fused_parts.values():
+            vec = jnp.concatenate([jnp.ravel(g) for _, g in parts])
+            vec = lax.pmean(vec, MESH_AXIS_DATA)
+            offset = 0
+            for i, g in parts:
+                size = g.size
+                synced[i] = jnp.reshape(vec[offset:offset + size], g.shape)
+                offset += size
         grads = jax.tree_util.tree_unflatten(
             treedef, synced) if synced else grads
 
@@ -122,10 +158,17 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy,
                 extra_metrics_fn(params, batch)))
         return params, opt_state, new_sync, metrics
 
+    # check_vma=False: this path OWNS its collectives.  With vma tracking on
+    # (the jax 0.9 default), replicated (P()) params get pvary'd on entry and
+    # the loss's backward transpose AUTO-INSERTS a psum per variable — the
+    # gradients would arrive pre-summed and the compressor pmean would then
+    # scale them by the data-axis size (d x too large), while the real
+    # collective escapes the compressor entirely.
     mapped = jax.shard_map(
         local_step, mesh=mesh,
         in_specs=(P(), P(), P(MESH_AXIS_DATA), P(MESH_AXIS_DATA)),
-        out_specs=(P(), P(), P(MESH_AXIS_DATA), P()))
+        out_specs=(P(), P(), P(MESH_AXIS_DATA), P()),
+        check_vma=False)
     step_fn = jax.jit(mapped, donate_argnums=(0, 1, 2))
 
     replicated = NamedSharding(mesh, P())

@@ -47,11 +47,15 @@ class AllReduceSynchronizerConfig:
     ``spec`` keeps the reference's AUTO/RING/NCCL vocabulary as a hint; on
     TPU all variants lower to ``psum`` over the data axis and XLA picks the
     ICI algorithm.  ``group`` merges small variables into one fused collective
-    (the reference's scoped-allocator chunking, all_reduce_strategy.py:21-90)."""
+    (the reference's scoped-allocator chunking, all_reduce_strategy.py:21-90):
+    on the GSPMD path it sets XLA's all-reduce combiner threshold; with
+    ``fused`` the program routes through the explicit shard_map path where
+    each group is concatenated into ONE ``pmean``."""
 
     spec: str = "AUTO"  # AUTO | RING | NCCL (hint only on TPU)
     compressor: str = "NoneCompressor"  # NoneCompressor | HorovodCompressor | HorovodCompressorEF
     group: int = 0
+    fused: bool = False  # explicit concat-and-pmean group fusion
 
     kind: str = "AllReduce"
 

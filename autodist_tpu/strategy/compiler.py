@@ -101,6 +101,7 @@ class VarPlan:
     grad_reduce_axes: Tuple[str, ...]  # mesh axes the gradient is summed over
     compressor: str = "NoneCompressor"
     group: int = 0
+    fused: bool = False                # explicit concat-and-pmean group fusion
     reduction_destination: str = ""
     destination_coords: Optional[Dict[str, int]] = None
     staleness: int = 0
@@ -137,6 +138,19 @@ class CompiledStrategy:
         """Vars needing pad-to-divisible sharding: name → (axis, padded_dim)."""
         return {n: (p.pad_axis, p.pad_dim)
                 for n, p in self.var_plans.items() if p.pad_axis is not None}
+
+    def fusable_groups(self) -> Dict[int, List[str]]:
+        """Collective groups with ≥2 uncompressed replicated AllReduce vars —
+        candidates for concat-and-pmean fusion (the reference's
+        scoped-allocator chunk merge, all_reduce_strategy.py:21-90)."""
+        by_group: Dict[int, List[str]] = {}
+        for name, plan in self.var_plans.items():
+            if plan.sync_kind != "AllReduce" or plan.param_spec != P():
+                continue
+            if (plan.compressor or "NoneCompressor") != "NoneCompressor":
+                continue
+            by_group.setdefault(plan.group, []).append(name)
+        return {g: ns for g, ns in by_group.items() if len(ns) >= 2}
 
     def batch_spec(self) -> P:
         return P(self.batch_axes)
@@ -409,6 +423,7 @@ class StrategyCompiler:
                 var_name=var.name, sync_kind="AllReduce",
                 param_spec=spec, opt_spec=spec, grad_reduce_axes=grad_axes,
                 compressor=sync.compressor, group=sync.group,
+                fused=getattr(sync, "fused", False),
                 partition_axis=axis if model_axis else None,
                 num_shards=num_shards if model_axis else 1,
                 sparse=var.sparse,
