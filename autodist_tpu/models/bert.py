@@ -6,7 +6,7 @@ encoder stack + MLM head with tied decoder weights.
 """
 from __future__ import annotations
 
-from typing import Callable
+from typing import Callable, Optional
 
 import flax.linen as nn
 import jax
@@ -26,6 +26,7 @@ class BertModel(nn.Module):
     max_len: int
     type_vocab: int = 2
     dtype: jnp.dtype = jnp.float32
+    attn_fn: Optional[Callable] = None  # None -> backend default
 
     @nn.compact
     def __call__(self, tokens, segment_ids):
@@ -40,8 +41,11 @@ class BertModel(nn.Module):
              + pos[None, :tokens.shape[1]]
              + jnp.take(seg, segment_ids, axis=0))
         x = nn.LayerNorm(name="embeddings_ln", use_bias=False)(x)
+        from autodist_tpu.models.transformer import default_attention
+
         x = TransformerStack(self.num_layers, self.num_heads, self.head_dim,
-                             self.d_ff, causal=False, name="encoder")(x)
+                             self.d_ff, causal=False, name="encoder",
+                             attn_fn=self.attn_fn or default_attention())(x)
         # MLM head: transform + tied decoder.
         h = nn.Dense(d_model, name="mlm_transform")(x)
         h = nn.gelu(h)
@@ -51,10 +55,16 @@ class BertModel(nn.Module):
 
 def bert(vocab_size: int = 30528, num_layers: int = 12, num_heads: int = 12,
          head_dim: int = 64, d_ff: int = 3072, max_len: int = 512,
-         seq_len: int = 128, dtype=jnp.float32) -> ModelSpec:
-    """BERT-base defaults (vocab padded 30522→30528 for sharding/MXU)."""
+         seq_len: int = 128, dtype=jnp.float32,
+         attn_fn: Optional[Callable] = None) -> ModelSpec:
+    """BERT-base defaults (vocab padded 30522→30528 for sharding/MXU).
+
+    ``attn_fn=None`` → backend default (flash kernel on TPU)."""
+    from autodist_tpu.models.transformer import default_attention
+
     model = BertModel(vocab_size, num_layers, num_heads, head_dim, d_ff,
-                      max_len, dtype=dtype)
+                      max_len, dtype=dtype,
+                      attn_fn=attn_fn or default_attention())
 
     def init(rng):
         t = jnp.zeros((2, seq_len), jnp.int32)

@@ -18,6 +18,47 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def _resolve_default_attention(mesh=None) -> Callable:
+    if jax.devices()[0].platform == "tpu":
+        from autodist_tpu.ops.flash_attention import make_flash_attention
+
+        return make_flash_attention(mesh)
+    return dense_attention
+
+
+def default_attention(mesh=None) -> Callable:
+    """The attention implementation for the current backend: the Pallas
+    flash kernel on TPU — the hot-op fast path
+    (``autodist_tpu/ops/flash_attention.py``) — and dense softmax attention
+    elsewhere.  Model factories use this when no explicit ``attn_fn`` is
+    passed.
+
+    Resolved at CONSTRUCTION time when the backend is already up (the
+    AOT-friendly behavior).  When no backend has been initialized yet —
+    a multi-node script building its model BEFORE
+    ``jax.distributed.initialize`` — probing devices here would initialize
+    the local backend and break the distributed bootstrap
+    (``cluster.py:128-146``), so the decision is deferred to the first
+    call and cached."""
+    try:
+        from jax._src import xla_bridge
+
+        initialized = xla_bridge.backends_are_initialized()
+    except Exception:  # pragma: no cover - private-API drift
+        initialized = True
+    if initialized:
+        return _resolve_default_attention(mesh)
+
+    resolved: list = []
+
+    def lazy_attn(q, k, v, causal: bool):
+        if not resolved:
+            resolved.append(_resolve_default_attention(mesh))
+        return resolved[0](q, k, v, causal)
+
+    return lazy_attn
+
+
 def dense_attention(q, k, v, causal: bool) -> jax.Array:
     """Reference attention: softmax(QKᵀ/√d)V.  [B, T, H, D] layout."""
     depth = q.shape[-1]

@@ -53,10 +53,16 @@ class TransformerLM(nn.Module):
 def transformer_lm(vocab_size: int = 32128, num_layers: int = 12,
                    num_heads: int = 12, head_dim: int = 64,
                    d_ff: int = 3072, max_len: int = 1024,
-                   attn_fn: Callable = dense_attention,
+                   attn_fn: Optional[Callable] = None,
                    dtype=jnp.float32, seq_len: Optional[int] = None
                    ) -> ModelSpec:
-    """GPT-2-small-ish defaults; shrink for tests."""
+    """GPT-2-small-ish defaults; shrink for tests.
+
+    ``attn_fn=None`` → backend default: the Pallas flash kernel on TPU,
+    dense softmax elsewhere (``models/transformer.py:default_attention``)."""
+    from autodist_tpu.models.transformer import default_attention
+
+    attn_fn = attn_fn or default_attention()
     seq_len = seq_len or max_len
     model = TransformerLM(vocab_size, num_layers, num_heads, head_dim, d_ff,
                           max_len, attn_fn=attn_fn, dtype=dtype)

@@ -153,6 +153,59 @@ def run_child(platform: str) -> None:
     # parent takes the LAST valid JSON line.
     _fill_mfu(result, dev, on_tpu, dt, sess, batch)
     print(json.dumps(result), flush=True)
+    if on_tpu:
+        _fill_lm(result)  # flagship-LM tokens/sec + flash-vs-dense delta
+        print(json.dumps(result), flush=True)
+
+
+def _fill_lm(result) -> None:
+    """Secondary metric: flagship TransformerLM training throughput with
+    the Pallas flash-attention kernel (the TPU default) vs dense attention.
+    Best-effort — a failure here never loses the primary metric."""
+    try:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        import optax
+
+        from autodist_tpu.models.transformer import dense_attention
+        from autodist_tpu.models.transformer_lm import transformer_lm
+        from autodist_tpu.ops.flash_attention import make_flash_attention
+
+        batch_size, seq = 8, 2048
+        steps = 8
+
+        def measure(attn_fn):
+            spec = transformer_lm(num_layers=12, num_heads=12, head_dim=64,
+                                  d_ff=3072, max_len=seq, seq_len=seq,
+                                  attn_fn=attn_fn, dtype=jnp.bfloat16)
+            params = spec.init(jax.random.PRNGKey(0))
+            batch = spec.sample_batch(batch_size)
+            opt = optax.sgd(1e-3)
+
+            @jax.jit
+            def step(params, opt_state, batch):
+                loss, g = jax.value_and_grad(spec.loss_fn)(params, batch)
+                up, opt_state = opt.update(g, opt_state, params)
+                return optax.apply_updates(params, up), opt_state, loss
+
+            state = opt.init(params)
+            params, state, loss = step(params, state, batch)
+            float(loss)  # hard sync (block_until_ready is unreliable here)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                params, state, loss = step(params, state, batch)
+            float(loss)
+            return batch_size * seq * steps / (time.perf_counter() - t0)
+
+        flash_tps = measure(make_flash_attention())
+        result["lm_tokens_per_sec"] = round(flash_tps, 1)
+        result["lm_seq_len"] = seq
+        dense_tps = measure(dense_attention)
+        result["lm_flash_speedup_vs_dense"] = round(flash_tps / dense_tps, 3)
+    except Exception as e:  # pragma: no cover - best-effort enrichment
+        print(f"bench: LM secondary metric unavailable ({e!r})",
+              file=sys.stderr, flush=True)
 
 
 def _fill_mfu(result, dev, on_tpu, dt, sess, batch) -> None:
