@@ -116,8 +116,17 @@ def build_hybrid_mesh(ici_axes: Dict[str, int], dcn_axes: Dict[str, int],
     ``ici_axes`` shard within a slice (over ICI).  The reference's
     inter-node/intra-node split (gRPC between hosts, NCCL within,
     ``autodist/kernel/synchronization/ps_synchronizer.py:248-329``) maps to
-    exactly this DCN/ICI distinction."""
-    from jax.experimental import mesh_utils
+    exactly this DCN/ICI distinction.
+
+    On real multi-slice TPU hardware the per-slice topology is read from
+    device attributes (``mesh_utils.create_hybrid_device_mesh``).  Devices
+    without slice metadata (CPU test meshes, single-slice fleets) get an
+    emulated layout: the device list is split into ``prod(dcn_axes)`` equal
+    "slices" in order, preserving the same axis semantics — each combined
+    axis is (DCN-outer, ICI-inner)."""
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
 
     merged = dict(dcn_axes)
     for k, v in ici_axes.items():
@@ -125,9 +134,35 @@ def build_hybrid_mesh(ici_axes: Dict[str, int], dcn_axes: Dict[str, int],
     names = list(_canonical_axes(merged).keys())
     ici_shape = [ici_axes.get(name, 1) for name in names]
     dcn_shape = [dcn_axes.get(name, 1) for name in names]
-    mesh_devices = mesh_utils.create_hybrid_device_mesh(
-        tuple(ici_shape), tuple(dcn_shape), devices=devices)
-    return Mesh(mesh_devices, tuple(names))
+
+    num_slices = math.prod(dcn_shape)
+    slice_ids = {getattr(d, "slice_index", None) for d in devices}
+    if None not in slice_ids:
+        # Real slice metadata present: always delegate — a shape/topology
+        # mismatch must fail LOUDLY there, never silently emulate (axes the
+        # user declared ICI would cross real DCN boundaries).
+        from jax.experimental import mesh_utils
+
+        mesh_devices = mesh_utils.create_hybrid_device_mesh(
+            tuple(ici_shape), tuple(dcn_shape), devices=devices)
+        return Mesh(mesh_devices, tuple(names))
+
+    # No slice metadata (CPU test meshes, single-slice fleets):
+    # emulated layout — contiguous equal slices, DCN-outer / ICI-inner.
+    if len(devices) != num_slices * math.prod(ici_shape):
+        raise ValueError(
+            f"hybrid mesh {dict(zip(names, dcn_shape))} x "
+            f"{dict(zip(names, ici_shape))} needs "
+            f"{num_slices * math.prod(ici_shape)} devices, "
+            f"have {len(devices)}")
+    arr = np.asarray(devices).reshape(tuple(dcn_shape) + tuple(ici_shape))
+    k = len(names)
+    perm: List[int] = []
+    for i in range(k):
+        perm += [i, k + i]
+    arr = arr.transpose(perm).reshape(
+        [dcn_shape[i] * ici_shape[i] for i in range(k)])
+    return Mesh(arr, tuple(names))
 
 
 def data_axis_size(mesh: Mesh) -> int:

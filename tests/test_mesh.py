@@ -60,3 +60,95 @@ def test_device_spec_sortable():
     devs = [DeviceSpec("b", DeviceType.TPU, 0), DeviceSpec("a", DeviceType.CPU, 1),
             DeviceSpec("a", DeviceType.TPU, 0)]
     assert sorted(devs)[0].host_address == "a"
+
+
+class TestHybridMesh:
+    """build_hybrid_mesh: DCN-outer/ICI-inner construction on a 2-slice
+    virtual mesh, and PS destination-coord placement across slices
+    (reference inter-node/intra-node split,
+    ps_synchronizer.py:248-329)."""
+
+    def test_two_slice_construction(self):
+        import jax
+
+        mesh = mesh_lib.build_hybrid_mesh({"model": 4}, {"data": 2})
+        assert dict(mesh.shape) == {"data": 2, "model": 4}
+        devs = jax.devices()
+        # DCN-outer: slice 0 = first 4 devices = data row 0.
+        assert list(mesh.devices[0]) == devs[:4]
+        assert list(mesh.devices[1]) == devs[4:]
+
+    def test_shared_axis_dcn_times_ici(self):
+        mesh = mesh_lib.build_hybrid_mesh({"data": 2, "model": 2}, {"data": 2})
+        # data axis = 2 (DCN) x 2 (ICI) = 4, model = 2.
+        assert dict(mesh.shape) == {"data": 4, "model": 2}
+        import jax
+
+        devs = jax.devices()
+        # Within a data row, devices come from one slice's ICI group first:
+        # data index (dcn, ici)-major → rows 0,1 from slice 0.
+        slice_of = {d: i // 4 for i, d in enumerate(devs)}
+        for row in range(4):
+            row_slices = {slice_of[d] for d in mesh.devices[row]}
+            assert row_slices == {row // 2}  # DCN-outer ordering
+
+    def test_wrong_device_count_raises(self):
+        with pytest.raises(ValueError, match="needs"):
+            mesh_lib.build_hybrid_mesh({"model": 4}, {"data": 4})
+
+    def test_destination_coords_map_to_slices(self):
+        """PS reduction destinations resolve to the owning slice's data
+        coordinate on a hybrid mesh."""
+        import jax.numpy as jnp
+
+        from autodist_tpu.graph_item import GraphItem
+        from autodist_tpu.strategy import PS, PSLoadBalancing
+        from autodist_tpu.strategy.compiler import StrategyCompiler
+
+        spec = ResourceSpec(resource_info={"nodes": [
+            {"address": "host-a", "chips": 4, "chief": True},
+            {"address": "host-b", "chips": 4}]})
+        mesh = mesh_lib.build_hybrid_mesh({"model": 4}, {"data": 2})
+        gi = GraphItem({"w": jnp.zeros((8, 4)), "b": jnp.zeros((8,))})
+        cs = StrategyCompiler(mesh, resource_spec=spec).compile(
+            PS().build(gi, spec), gi)
+        # PS builder targets the first CPU (host-a) → slice/data coord 0.
+        assert cs.plan_for("w").destination_coords == {"data": 0}
+
+        cs2 = StrategyCompiler(mesh, resource_spec=spec).compile(
+            PSLoadBalancing().build(gi, spec), gi)
+        coords = {p.destination_coords["data"]
+                  for p in cs2.var_plans.values()}
+        assert coords == {0, 1}  # balanced across the two slices
+
+    def test_training_runs_on_hybrid_mesh(self, monkeypatch):
+        import jax.numpy as jnp
+        import numpy as np
+        import optax
+
+        from autodist_tpu.autodist import (
+            AutoDist, _reset_default_autodist_for_testing)
+        from autodist_tpu.strategy import PSLoadBalancing
+
+        # 2-node spec in one test process: log the worker fan-out instead
+        # of SSHing to the fictional second host.
+        monkeypatch.setenv("AUTODIST_DEBUG_REMOTE", "True")
+        _reset_default_autodist_for_testing()
+        spec = ResourceSpec(resource_info={"nodes": [
+            {"address": "host-a", "chips": 4, "chief": True},
+            {"address": "host-b", "chips": 4}]})
+        mesh = mesh_lib.build_hybrid_mesh({"data": 2, "model": 2}, {"data": 2})
+
+        def loss(p, b):
+            return jnp.mean((b["x"] @ p["w"] - b["y"]) ** 2)
+
+        rng = np.random.RandomState(0)
+        batch = {"x": rng.randn(16, 8).astype(np.float32),
+                 "y": rng.randn(16, 2).astype(np.float32)}
+        ad = AutoDist(resource_spec=spec, strategy_builder=PSLoadBalancing())
+        with ad.scope():
+            ad.capture(params={"w": jnp.zeros((8, 2))},
+                       optimizer=optax.sgd(0.1), loss_fn=loss)
+        sess = ad.create_distributed_session(mesh=mesh)
+        losses = [float(sess.run(batch)["loss"]) for _ in range(3)]
+        assert losses[2] < losses[0]
