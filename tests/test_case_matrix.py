@@ -1,0 +1,167 @@
+"""Closed-form case matrix: cases × all 8 builders × 2 mesh shapes.
+
+Parity target: the reference's integration case matrix
+(``tests/integration/test_all.py:1-70`` — 10 builders × cases c0–c8,
+with c0's closed-form numeric assertion ``cases/c0.py:88-124``).  The
+cases here widen round-1's single least-squares model to the reference's
+breadth: sparse embeddings (c2), a ``lax.scan`` recurrent model (c6's
+dynamic-LSTM analog), and bf16 + rematerialization variants — every case
+trained for multiple steps through a full DistributedSession and checked
+numerically against a single-device loop.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from autodist_tpu.autodist import AutoDist, _reset_default_autodist_for_testing
+from autodist_tpu.mesh import build_mesh
+from autodist_tpu.strategy import (
+    AllReduce,
+    Parallax,
+    PartitionedAR,
+    PartitionedPS,
+    PS,
+    PSLoadBalancing,
+    RandomAxisPartitionAR,
+    UnevenPartitionedPS,
+)
+
+BUILDERS = [PS, PSLoadBalancing, PartitionedPS, UnevenPartitionedPS,
+            AllReduce, PartitionedAR, RandomAxisPartitionAR, Parallax]
+MESHES = [{"data": 8}, {"data": 4, "model": 2}]
+STEPS = 3
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    _reset_default_autodist_for_testing()
+
+
+# -- cases -------------------------------------------------------------------
+def case_sparse():
+    """Embedding model (reference c2): vocab ≫ batch, sparse grads."""
+    vocab, dim = 96, 16
+    params = {"emb": {"table": jnp.asarray(
+        np.linspace(-1, 1, vocab * dim).reshape(vocab, dim), jnp.float32)},
+        "head": {"w": jnp.ones((dim, 4)) * 0.1}}
+
+    def loss_fn(p, batch):
+        h = jnp.take(p["emb"]["table"], batch["ids"], axis=0)
+        pred = jnp.mean(h, axis=1) @ p["head"]["w"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    rng = np.random.RandomState(0)
+    batch = {"ids": rng.randint(0, vocab, (16, 5)).astype(np.int32),
+             "y": rng.randn(16, 4).astype(np.float32)}
+    return params, loss_fn, batch, dict(sparse_vars=["emb/table"]), 1e-4
+
+
+def case_scan():
+    """lax.scan recurrent model (reference c6: dynamic LSTM/while-loop)."""
+    d_in, d_h = 8, 16
+    k = jax.random.PRNGKey(0)
+    k1, k2, k3 = jax.random.split(k, 3)
+    params = {"cell": {"w_x": jax.random.normal(k1, (d_in, d_h)) * 0.3,
+                       "w_h": jax.random.normal(k2, (d_h, d_h)) * 0.3},
+              "proj": {"w": jax.random.normal(k3, (d_h, 4)) * 0.3}}
+
+    def loss_fn(p, batch):
+        def step(h, x_t):
+            h = jnp.tanh(x_t @ p["cell"]["w_x"] + h @ p["cell"]["w_h"])
+            return h, h
+
+        x = jnp.swapaxes(batch["x"], 0, 1)          # [T, B, d_in]
+        h0 = jnp.zeros((batch["x"].shape[0], d_h))
+        _, hs = jax.lax.scan(step, h0, x)
+        pred = hs[-1] @ p["proj"]["w"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    rng = np.random.RandomState(1)
+    batch = {"x": rng.randn(16, 12, d_in).astype(np.float32),
+             "y": rng.randn(16, 4).astype(np.float32)}
+    return params, loss_fn, batch, {}, 1e-4
+
+
+def case_bf16_remat():
+    """bf16 compute + gradient rematerialization (remat='dots')."""
+    params = {"l1": {"w": jnp.asarray(
+        np.linspace(-0.5, 0.5, 8 * 16).reshape(8, 16), jnp.bfloat16)},
+        "l2": {"w": jnp.asarray(
+            np.linspace(-0.5, 0.5, 16 * 4).reshape(16, 4), jnp.bfloat16)}}
+
+    def loss_fn(p, batch):
+        h = jnp.tanh(batch["x"] @ p["l1"]["w"])
+        pred = h @ p["l2"]["w"]
+        return jnp.mean((pred.astype(jnp.float32)
+                         - batch["y"].astype(jnp.float32)) ** 2)
+
+    rng = np.random.RandomState(2)
+    batch = {"x": rng.randn(16, 8).astype(jnp.bfloat16),
+             "y": rng.randn(16, 4).astype(np.float32)}
+    return params, loss_fn, batch, dict(remat="dots"), 2e-2
+
+CASES = {"sparse": case_sparse, "scan": case_scan,
+         "bf16_remat": case_bf16_remat}
+
+
+def _single_device_losses(params, loss_fn, batch, capture_kw):
+    from autodist_tpu.graph_item import GraphItem
+
+    gi = GraphItem(params, optimizer=optax.adam(1e-2), loss_fn=loss_fn,
+                   **{k: v for k, v in capture_kw.items()
+                      if k in ("remat", "sparse_vars")})
+    opt = optax.adam(1e-2)
+    p, s = params, opt.init(params)
+    losses = []
+    vg = jax.value_and_grad(gi.loss_fn)
+    for _ in range(STEPS):
+        loss, g = vg(p, batch)
+        u, s = opt.update(g, s, p)
+        p = optax.apply_updates(p, u)
+        losses.append(float(loss))
+    return losses
+
+
+@pytest.mark.parametrize("mesh_axes", MESHES,
+                         ids=["dp8", "dp4tp2"])
+@pytest.mark.parametrize("builder_cls", BUILDERS,
+                         ids=[b.__name__ for b in BUILDERS])
+@pytest.mark.parametrize("case", list(CASES), ids=list(CASES))
+def test_case_matrix(case, builder_cls, mesh_axes):
+    params, loss_fn, batch, capture_kw, rtol = CASES[case]()
+    ref_losses = _single_device_losses(params, loss_fn, batch, capture_kw)
+
+    _reset_default_autodist_for_testing()
+    ad = AutoDist(strategy_builder=builder_cls(), mesh_axes=mesh_axes)
+    with ad.scope():
+        ad.capture(params=params, optimizer=optax.adam(1e-2),
+                   loss_fn=loss_fn, **capture_kw)
+    sess = ad.create_distributed_session(mesh=build_mesh(mesh_axes))
+    losses = [float(sess.run(batch)["loss"]) for _ in range(STEPS)]
+    np.testing.assert_allclose(losses, ref_losses, rtol=rtol)
+
+
+def test_sparse_gradient_update_runs_sharded():
+    """The vocab-sharded embedding's update computation executes on shards:
+    the optimized HLO carries shard-shaped [vocab/8, dim] tensors for the
+    table, and the table's gradient layout is the sharded opt_spec — the
+    gradient never materializes as one replicated dense table on the
+    update path (reference c2's sparse-grad property)."""
+    params, loss_fn, batch, capture_kw, _ = case_sparse()
+    ad = AutoDist(strategy_builder=Parallax())
+    with ad.scope():
+        ad.capture(params=params, optimizer=optax.adam(1e-2),
+                   loss_fn=loss_fn, **capture_kw)
+    sess = ad.create_distributed_session()
+    plan = sess._step.compiled_strategy.plan_for("emb/table")
+    from jax.sharding import PartitionSpec as P
+
+    assert plan.param_spec == P("data")
+    assert plan.opt_spec == P("data")
+    placed = sess.place_batch(batch)
+    hlo = sess._step.step_fn.lower(
+        sess.sharded_params, sess.opt_state, sess.sync_state,
+        placed).compile().as_text()
+    assert "f32[12,16]" in hlo  # 96/8 = 12-row shard computations exist
