@@ -273,6 +273,6 @@ class TPUPodCluster(Cluster):
 def make_cluster(resource_spec: ResourceSpec) -> Cluster:
     """Choose the cluster flavor for a spec: TPU-pod metadata discovery when
     requested via env, SSH fan-out otherwise."""
-    if os.environ.get("AUTODIST_TPU_POD"):
+    if ENV.AUTODIST_TPU_POD.val:
         return TPUPodCluster(resource_spec)
     return SSHCluster(resource_spec)

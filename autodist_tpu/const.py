@@ -100,6 +100,8 @@ class ENV(enum.Enum):
     # parity with the reference's per-stage graph dumps
     # (kernel/graph_transformer.py:62-90)
     AUTODIST_DUMP_GRAPHS = ("AUTODIST_DUMP_GRAPHS", _bool)
+    # Cloud-TPU pod slice: rendezvous via TPU metadata (TPUPodCluster)
+    AUTODIST_TPU_POD = ("AUTODIST_TPU_POD", _bool)
     # jax.distributed coordinator (host:port)
     AUTODIST_COORDINATOR_ADDRESS = ("AUTODIST_COORDINATOR_ADDRESS", _str)
     AUTODIST_NUM_PROCESSES = ("AUTODIST_NUM_PROCESSES", _int1)

@@ -77,6 +77,10 @@ class Coordinator:
             env = {
                 ENV.AUTODIST_WORKER.name: node.address,
                 ENV.AUTODIST_STRATEGY_ID.name: self._strategy.id,
+                # Launcher plumbing: a worker script constructing a bare
+                # AutoDist() finds the shipped spec via env (run.py CLI).
+                **({ENV.SYS_RESOURCE_PATH.name: spec.source_file}
+                   if spec.source_file else {}),
                 ENV.AUTODIST_COORDINATOR_ADDRESS.name:
                     self._cluster.coordinator_address,
                 ENV.AUTODIST_NUM_PROCESSES.name:
@@ -91,7 +95,8 @@ class Coordinator:
             # workers pointed at a nonexistent coordination service.  Same
             # for the workdir — the worker must deserialize the strategy
             # from the directory the chief copied it into.
-            for passthrough in ("AUTODIST_TPU_POD", "AUTODIST_TPU_WORKDIR"):
+            for passthrough in (ENV.AUTODIST_TPU_POD.name,
+                                "AUTODIST_TPU_WORKDIR"):
                 if os.environ.get(passthrough):
                     env[passthrough] = os.environ[passthrough]
             proc = self._cluster.remote_exec(

@@ -122,11 +122,14 @@ class DistributedStep:
         def put(x, sh):
             import numpy as np
             if isinstance(x, np.ndarray) and not x.flags.owndata:
-                # The CPU backend zero-copy aliases non-owning numpy views
-                # (e.g. the native DataLoader's ring-buffer batches); the
-                # source buffer may be recycled while the step still reads
-                # it.  Force an owning copy so device_put's documented
-                # copy semantics hold.
+                # Non-owning views (e.g. the native DataLoader's ring-buffer
+                # batches) must be copied on EVERY backend: the CPU backend
+                # zero-copy aliases them, and on TPU device_put's host→HBM
+                # DMA is ASYNC — the loader may recycle and rewrite the slot
+                # while the transfer is still in flight (prefetch() exists
+                # precisely to overlap those transfers with compute).
+                # Reclaiming this copy requires synchronizing the loader's
+                # slot release with transfer completion, not skipping it.
                 x = np.array(x, copy=True)
             return jax.device_put(x, sh)
 

@@ -129,6 +129,16 @@ class ResourceSpec:
         self.source_file: Optional[str] = (
             os.path.abspath(resource_file) if resource_file else None)
 
+        if resource_file is None and resource_info is None:
+            # Launcher plumbing (reference const.py SYS_RESOURCE_PATH): the
+            # `python -m autodist_tpu.run` CLI ships the spec path via env
+            # so user scripts can construct a bare AutoDist().
+            from autodist_tpu.const import ENV
+
+            env_path = ENV.SYS_RESOURCE_PATH.val
+            if env_path:
+                resource_file = env_path
+                self.source_file = os.path.abspath(env_path)
         if resource_info is None and resource_file is not None:
             if not os.path.exists(resource_file):
                 raise ResourceSpecError(f"Resource spec file not found: {resource_file}")

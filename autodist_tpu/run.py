@@ -1,0 +1,71 @@
+"""``python -m autodist_tpu.run`` — the multi-host launcher CLI.
+
+The reference's execution model re-runs the SAME user script on every
+worker host (``autodist/coordinator.py:46-90``); the chief-side
+:class:`~autodist_tpu.autodist.AutoDist` already performs that fan-out at
+``create_distributed_session``.  What the launcher adds is the missing
+front door (SURVEY §2.9: an "``ad run``-style launcher"): it binds a
+resource spec to an UNMODIFIED training script and executes it as the
+chief, so
+
+    python -m autodist_tpu.run -r pod.yml train.py --epochs 3
+
+distributes a script whose only framework code is ``AutoDist()`` +
+``scope()`` (or nothing at all beyond plain optax, with implicit capture).
+The spec path rides the reference's own ``SYS_RESOURCE_PATH`` env
+(``autodist/const.py:55-89``), consumed by a bare ``ResourceSpec()``.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import runpy
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m autodist_tpu.run",
+        description="Run a training script under autodist_tpu: the script "
+                    "executes as the chief; worker hosts are launched "
+                    "automatically when the resource spec has them.")
+    parser.add_argument("-r", "--resource-spec", metavar="YAML",
+                        help="cluster resource spec (omit for single-host "
+                             "auto-derivation from local devices)")
+    parser.add_argument("--tpu-pod", action="store_true",
+                        help="Cloud-TPU pod slice: rendezvous via TPU "
+                             "metadata (jax.distributed.initialize() "
+                             "without arguments)")
+    parser.add_argument("--debug-remote", action="store_true",
+                        help="print worker launch commands instead of "
+                             "executing them (AUTODIST_DEBUG_REMOTE)")
+    parser.add_argument("script", help="training script to run")
+    parser.add_argument("script_args", nargs=argparse.REMAINDER,
+                        help="arguments passed to the script")
+    args = parser.parse_args(argv)
+
+    from autodist_tpu.const import ENV
+
+    if args.resource_spec:
+        path = os.path.abspath(args.resource_spec)
+        if not os.path.exists(path):
+            parser.error(f"resource spec not found: {path}")
+        os.environ[ENV.SYS_RESOURCE_PATH.name] = path
+    if args.tpu_pod:
+        os.environ[ENV.AUTODIST_TPU_POD.name] = "1"
+    if args.debug_remote:
+        os.environ[ENV.AUTODIST_DEBUG_REMOTE.name] = "True"
+
+    script = os.path.abspath(args.script)
+    if not os.path.exists(script):
+        parser.error(f"script not found: {script}")
+    # The Coordinator re-launches `sys.argv` on workers; make argv[0] the
+    # SCRIPT (workers re-enter through plain `python script.py`, with env
+    # carrying worker identity + the shipped spec path).
+    sys.argv = [script] + list(args.script_args)
+    runpy.run_path(script, run_name="__main__")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

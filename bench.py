@@ -96,7 +96,8 @@ def run_child(platform: str) -> None:
 
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
-    batch_size = 128 if on_tpu else 16
+    batch_size = int(os.environ.get("AUTODIST_BENCH_BATCH",
+                                    128 if on_tpu else 16))
     image_size = 224 if on_tpu else 64
     dtype = jnp.bfloat16 if on_tpu else jnp.float32
 

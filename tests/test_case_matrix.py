@@ -165,3 +165,45 @@ def test_sparse_gradient_update_runs_sharded():
         sess.sharded_params, sess.opt_state, sess.sync_state,
         placed).compile().as_text()
     assert "f32[12,16]" in hlo  # 96/8 = 12-row shard computations exist
+
+
+# The reference's exact 10-strategy integration list (variants included):
+# tests/integration/test_all.py:35-45.
+REFERENCE_VARIANTS = [
+    lambda: PS(),
+    lambda: PartitionedPS(local_proxy_variable=True),
+    lambda: AllReduce(chunk_size=1, all_reduce_spec="NCCL",
+                      compressor="NoneCompressor"),
+    lambda: AllReduce(chunk_size=1, all_reduce_spec="NCCL",
+                      compressor="HorovodCompressor"),
+    lambda: AllReduce(chunk_size=1, all_reduce_spec="RING",
+                      compressor="HorovodCompressorEF"),
+    lambda: PSLoadBalancing(local_proxy_variable=True),
+    lambda: Parallax(local_proxy_variable=True),
+    lambda: PSLoadBalancing(),
+    lambda: UnevenPartitionedPS(local_proxy_variable=True),
+    lambda: RandomAxisPartitionAR(chunk_size=4),
+]
+
+
+@pytest.mark.parametrize("variant_idx", range(len(REFERENCE_VARIANTS)))
+def test_reference_strategy_variant_matrix(variant_idx):
+    """The reference's full 10-config strategy list (proxy variables,
+    compressors, chunk sizes) trains the scan case through a
+    DistributedSession.  Lossy-compressor and proxy configs get loose
+    tolerances; exact configs are pinned tight."""
+    params, loss_fn, batch, capture_kw, _ = case_scan()
+    ref_losses = _single_device_losses(params, loss_fn, batch, capture_kw)
+
+    _reset_default_autodist_for_testing()
+    builder = REFERENCE_VARIANTS[variant_idx]()
+    ad = AutoDist(strategy_builder=builder, mesh_axes={"data": 8})
+    with ad.scope():
+        ad.capture(params=params, optimizer=optax.adam(1e-2),
+                   loss_fn=loss_fn, **capture_kw)
+    sess = ad.create_distributed_session(mesh=build_mesh({"data": 8}))
+    losses = [float(sess.run(batch)["loss"]) for _ in range(STEPS)]
+    lossy = variant_idx in (3, 4)          # bf16-wire compressors
+    proxy = getattr(builder, "_local_proxy", False)
+    rtol = 5e-2 if (lossy or proxy) else 1e-4
+    np.testing.assert_allclose(losses, ref_losses, rtol=rtol)
