@@ -254,15 +254,20 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[0, 0] = dv.astype(dv_ref.dtype)
 
 
-def _bwd(q, k, v, o, lse, do, causal, block_q, block_k, interpret, kv_len):
+def _bwd(q, k, v, o, lse, do, causal, block_q, block_k, interpret, kv_len,
+         dlse=None):
     b, h, t, d = q.shape
     bq = _pick_block(t, block_q)
     bk = _pick_block(t, block_k)
     scale = 1.0 / (d ** 0.5)
     # Δ_i = Σ_d dO_id · O_id — the softmax-normalization gradient term;
     # a cheap elementwise reduce, left to XLA fusion.  [B,H,T,1] like lse.
+    # An lse cotangent folds in here: dS_ij = P_ij (dP_ij − Δ_i + dlse_i),
+    # so passing Δ' = Δ − dlse reuses the kernels unchanged.
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
                     keepdims=True)
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)
 
     qb_spec = pl.BlockSpec((1, 1, bq, d), lambda bi, hi, i: (bi, hi, i, 0))
     kb_spec = pl.BlockSpec((1, 1, bk, d), lambda bi, hi, i: (bi, hi, i, 0))
@@ -300,22 +305,38 @@ def _bwd(q, k, v, o, lse, do, causal, block_q, block_k, interpret, kv_len):
 # ---------------------------------------------------------------------------
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, causal, block_q, block_k, interpret, kv_len):
-    o, _ = _fwd(q, k, v, causal, block_q, block_k, interpret, kv_len)
-    return o
+    return _fwd(q, k, v, causal, block_q, block_k, interpret, kv_len)
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_k, interpret, kv_len):
     o, lse = _fwd(q, k, v, causal, block_q, block_k, interpret, kv_len)
-    return o, (q, k, v, o, lse)
+    return (o, lse), (q, k, v, o, lse)
 
 
-def _flash_bwd(causal, block_q, block_k, interpret, kv_len, res, do):
+def _flash_bwd(causal, block_q, block_k, interpret, kv_len, res, cts):
     q, k, v, o, lse = res
+    do, dlse = cts
     return _bwd(q, k, v, o, lse, do, causal, block_q, block_k, interpret,
-                kv_len)
+                kv_len, dlse=dlse)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _pad_and_run(q, k, v, causal, block_q, block_k, interpret):
+    """[B,T,H,D] public layout → padded [B,H,T,D] kernel run → sliced
+    (o [B,T,H,D], lse [B,H,T])."""
+    t = q.shape[1]
+    tp = _pad_len(t, interpret)
+    qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))  # → [B,H,T,D]
+    if tp != t:
+        pad = [(0, 0), (0, 0), (0, tp - t), (0, 0)]
+        qt, kt, vt = (jnp.pad(x, pad) for x in (qt, kt, vt))
+    o, lse = _flash(qt, kt, vt, causal, block_q, block_k, interpret, t)
+    if tp != t:
+        o = o[:, :, :t, :]
+        lse = lse[:, :, :t, :]
+    return o.transpose(0, 2, 1, 3), lse[..., 0]
 
 
 def flash_attention(q, k, v, causal: bool = False, *,
@@ -329,16 +350,22 @@ def flash_attention(q, k, v, causal: bool = False, *,
     any length compiles on real TPU."""
     if interpret is None:
         interpret = _use_interpret()
-    t = q.shape[1]
-    tp = _pad_len(t, interpret)
-    qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))  # → [B,H,T,D]
-    if tp != t:
-        pad = [(0, 0), (0, 0), (0, tp - t), (0, 0)]
-        qt, kt, vt = (jnp.pad(x, pad) for x in (qt, kt, vt))
-    o = _flash(qt, kt, vt, causal, block_q, block_k, interpret, t)
-    if tp != t:
-        o = o[:, :, :t, :]
-    return o.transpose(0, 2, 1, 3)
+    return _pad_and_run(q, k, v, causal, block_q, block_k, interpret)[0]
+
+
+def flash_attention_with_lse(q, k, v, causal: bool = False, *,
+                             block_q: int = _DEFAULT_BLOCK,
+                             block_k: int = _DEFAULT_BLOCK,
+                             interpret: Optional[bool] = None):
+    """Like :func:`flash_attention` but also returns the log-sum-exp of the
+    attention logits, ``lse [B, H, T]`` (f32) — the quantity blockwise/ring
+    compositions merge partial attention outputs with (Liu et al. 2023).
+    Fully differentiable in both outputs: the backward folds the lse
+    cotangent into the softmax-normalization term (``Δ − dlse``), reusing
+    the same Pallas kernels."""
+    if interpret is None:
+        interpret = _use_interpret()
+    return _pad_and_run(q, k, v, causal, block_q, block_k, interpret)
 
 
 def make_flash_attention(mesh: Optional[Mesh] = None, *,

@@ -19,7 +19,7 @@ import numpy as np
 from autodist_tpu.graph_item import GraphItem
 from autodist_tpu.kernel import sharding_utils as su
 from autodist_tpu.kernel.graph_transformer import DistributedStep
-from autodist_tpu.utils import logging, tracing
+from autodist_tpu.utils import logging, metrics, tracing
 
 
 class DistributedSession:
@@ -36,6 +36,9 @@ class DistributedSession:
         self._opt_state = dist_step.init_fn(self._params)
         self._sync_state = dist_step.init_sync_state(self._params)
         self._step_count = 0
+        self._meter = metrics.ThroughputMeter()
+        self._last_batch = None     # for on-demand FLOPs estimation
+        self._flops_per_step: Optional[float] = None
         # Tracing/dumps (SURVEY §5.1): keyed by the strategy id, the same
         # run identifier the reference used for its artifact paths.
         self._run_id = dist_step.compiled_strategy.strategy.id
@@ -114,14 +117,19 @@ class DistributedSession:
         if self._step_count == 0 and tracing.dumps_enabled():
             self._dump_programs(batch)
         with self._tracer.step(self._step_count):
-            self._params, self._opt_state, self._sync_state, metrics = \
+            self._params, self._opt_state, self._sync_state, out = \
                 self._step.step_fn(self._params, self._opt_state,
                                    self._sync_state, batch)
         self._tracer.after_step(self._step_count)
         self._step_count += 1
+        # Shapes/dtypes only — retaining the real batch would pin multi-GB
+        # host buffers for the session lifetime.
+        self._last_batch = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(np.shape(x), x.dtype), batch)
+        self._meter.tick()
         if not sync:
-            return metrics
-        return jax.tree_util.tree_map(lambda x: np.asarray(x), metrics)
+            return out
+        return jax.tree_util.tree_map(lambda x: np.asarray(x), out)
 
     def _dump_programs(self, batch) -> None:
         """Staged program dumps at first run, when concrete shapes exist:
@@ -144,12 +152,12 @@ class DistributedSession:
     def run_many(self, batches) -> Dict[str, Any]:
         """Run a sequence of batches with async dispatch (no host round-trip
         per step); returns the last step's metrics on host."""
-        metrics = None
+        out = None
         for b in batches:
-            metrics = self.run(b, sync=False)
-        if metrics is None:
+            out = self.run(b, sync=False)
+        if out is None:
             return None
-        return jax.tree_util.tree_map(lambda x: np.asarray(x), metrics)
+        return jax.tree_util.tree_map(lambda x: np.asarray(x), out)
 
     def prefetch(self, batches, depth: int = 2):
         """Yield device-placed batches keeping ``depth`` host→device
@@ -172,6 +180,35 @@ class DistributedSession:
         dispatch; returns the last step's metrics on host (None for an
         empty iterable)."""
         return self.run_many(self.prefetch(batches, prefetch_depth))
+
+    # -- instrumentation (SURVEY §5: the reference only measured throughput
+    # in example scripts; here it's a session feature) ----------------------
+    def throughput(self, items_per_step: Optional[int] = None
+                   ) -> Dict[str, Any]:
+        """Sliding-window step timing: step_time_ms / steps_per_sec (+
+        items_per_sec given a batch size).  With async dispatch this
+        converges to true step time once the pipeline fills."""
+        return self._meter.stats(items_per_step)
+
+    def flops_per_step(self) -> Optional[float]:
+        """Model FLOPs of the compiled step from XLA's cost analysis
+        (cached; needs at least one run).  None when unavailable."""
+        if self._flops_per_step is None and self._last_batch is not None:
+            self._flops_per_step = metrics.step_flops(
+                self._step.step_fn, self._params, self._opt_state,
+                self._sync_state, self._last_batch)
+        return self._flops_per_step
+
+    def mfu(self) -> Optional[float]:
+        """Model-FLOPs utilization of the last measurement window
+        (None off-TPU / before 2 steps).  XLA's cost analysis reports
+        PER-DEVICE flops for an SPMD program, so the denominator is a
+        single chip's peak — the ratio is the whole mesh's utilization."""
+        st = self._meter.step_time()
+        flops = self.flops_per_step()
+        if st is None or flops is None:
+            return None
+        return metrics.mfu(flops, st, [self.mesh.devices.flat[0]])
 
     def restore_targets(self):
         """Abstract (ShapeDtypeStruct + sharding) trees of the LOGICAL

@@ -38,15 +38,6 @@ BASELINE_IMAGES_PER_SEC = 2240.0
 WARMUP_STEPS = 3
 MEASURE_STEPS = 20
 
-# Peak dense bf16 FLOP/s per chip, by PJRT device_kind substring.
-PEAK_FLOPS = {
-    "v5 lite": 197e12, "v5e": 197e12,
-    "v5p": 459e12, "v5": 459e12,
-    "v4": 275e12,
-    "v6 lite": 918e12, "v6e": 918e12, "trillium": 918e12,
-    "v3": 123e12, "v2": 46e12,
-}
-
 PROBE_TIMEOUT_S = 150
 BENCH_TIMEOUT_S = 1500
 PROBE_BACKOFFS_S = (0, 45, 90)  # three probe attempts, ~4 min worst case
@@ -64,11 +55,9 @@ def _steer(platform: str) -> None:
 
 
 def _peak_flops(device) -> float:
-    kind = (getattr(device, "device_kind", "") or "").lower()
-    for key, peak in PEAK_FLOPS.items():
-        if key in kind:
-            return peak
-    return 0.0
+    from autodist_tpu.utils.metrics import peak_flops_per_chip
+
+    return peak_flops_per_chip(device)
 
 
 def _analytic_step_flops(batch_size: int, image_size: int) -> float:
