@@ -192,12 +192,15 @@ class DistributedSession:
 
     def flops_per_step(self) -> Optional[float]:
         """Model FLOPs of the compiled step from XLA's cost analysis
-        (cached; needs at least one run).  None when unavailable."""
+        (cached — including the unavailable outcome, so polling mfu() never
+        re-runs the AOT compile; needs at least one run).  None when
+        unavailable."""
         if self._flops_per_step is None and self._last_batch is not None:
-            self._flops_per_step = metrics.step_flops(
+            flops = metrics.step_flops(
                 self._step.step_fn, self._params, self._opt_state,
                 self._sync_state, self._last_batch)
-        return self._flops_per_step
+            self._flops_per_step = False if flops is None else flops
+        return self._flops_per_step or None
 
     def mfu(self) -> Optional[float]:
         """Model-FLOPs utilization of the last measurement window
@@ -205,8 +208,10 @@ class DistributedSession:
         PER-DEVICE flops for an SPMD program, so the denominator is a
         single chip's peak — the ratio is the whole mesh's utilization."""
         st = self._meter.step_time()
+        if st is None:  # before the compile-triggering flops lookup
+            return None
         flops = self.flops_per_step()
-        if st is None or flops is None:
+        if flops is None:
             return None
         return metrics.mfu(flops, st, [self.mesh.devices.flat[0]])
 

@@ -1,5 +1,8 @@
-"""Strategy builders — parity with ``autodist/strategy/`` (9 modules)."""
+"""Strategy builders — parity with ``autodist/strategy/`` (9 modules),
+plus :class:`AutoStrategy` (heuristic automatic selection, beyond the OSS
+reference's surface)."""
 from autodist_tpu.strategy.all_reduce_strategy import AllReduce
+from autodist_tpu.strategy.auto_strategy import AutoStrategy
 from autodist_tpu.strategy.base import (
     AllReduceSynchronizerConfig,
     GraphConfig,
@@ -25,7 +28,8 @@ from autodist_tpu.strategy.random_axis_partition_all_reduce_strategy import (
 from autodist_tpu.strategy.uneven_partition_ps_strategy import UnevenPartitionedPS
 
 __all__ = [
-    "AllReduce", "AllReduceSynchronizerConfig", "CompiledStrategy",
+    "AllReduce", "AllReduceSynchronizerConfig", "AutoStrategy",
+    "CompiledStrategy",
     "GraphConfig", "PS", "PSLoadBalancing", "PSSynchronizerConfig", "Parallax",
     "PartitionedAR", "PartitionedPS", "RandomAxisPartitionAR", "Strategy",
     "StrategyBuilder", "StrategyCompiler", "UnevenPartitionedPS", "VarConfig",

@@ -1,0 +1,105 @@
+"""AutoStrategy: heuristic per-variable strategy selection.
+
+The AutoDist paper's core pitch is automatic, per-variable strategy choice;
+the OSS reference shipped only fixed builders (``autodist/strategy/``) and
+left the learned strategizer out.  This builder is the heuristic stand-in —
+BEYOND the OSS reference's surface — using the standard TPU cost model:
+
+* **sparse embeddings** → vocab-sharded PS: the gradient scatter-add lands
+  on the owning shard; all-reducing a dense ``[vocab, d]`` gradient would
+  move orders of magnitude more bytes (the Parallax rule,
+  ``parallax_strategy.py:24-71``).
+* **large dense variables** (``>= partition_threshold`` bytes) →
+  axis-partitioned PS: weight-update sharding spreads optimizer state and
+  update FLOPs, and the partitioner shards the largest axis so fresh
+  parameters all-gather instead of all-reducing gradients twice.
+* **small dense variables** → AllReduce, chunk-grouped: one fused psum has
+  lower launch latency than per-variable reductions, and replicated
+  optimizer state for small tensors costs almost nothing.
+
+Byte-size load balancing across reduction destinations follows the
+reference's greedy rule (``ps_lb_strategy.py:91-117``).
+"""
+from __future__ import annotations
+
+from autodist_tpu.graph_item import GraphItem
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.strategy.base import (
+    AllReduceSynchronizerConfig,
+    GraphConfig,
+    PSSynchronizerConfig,
+    Strategy,
+    StrategyBuilder,
+    VarConfig,
+)
+from autodist_tpu.strategy.partition_utils import (
+    greedy_load_balance,
+    partition_str,
+)
+
+
+class AutoStrategy(StrategyBuilder):
+    """Pick a per-variable strategy from variable structure and size.
+
+    Args:
+      partition_threshold: dense variables at least this many bytes get
+        axis-partitioned weight-update sharding (default 1 MiB).
+      chunk_size: collective group width for the small-variable AllReduce
+        tier (reference chunking semantics).
+      compressor: optional gradient compressor for the AllReduce tier.
+    """
+
+    def __init__(self, partition_threshold: int = 1 << 20,
+                 chunk_size: int = 128,
+                 compressor: str = "NoneCompressor"):
+        if partition_threshold < 1:
+            raise ValueError("partition_threshold must be >= 1")
+        if chunk_size < 1:
+            raise ValueError("chunk_size must be >= 1")
+        self._threshold = partition_threshold
+        self._chunk_size = chunk_size
+        self._compressor = compressor
+
+    def build(self, graph_item: GraphItem,
+              resource_spec: ResourceSpec) -> Strategy:
+        ps_devices = self.reduction_device_names(resource_spec)
+        variables = graph_item.trainable_var_infos
+
+        ps_vars = [v for v in variables
+                   if v.sparse or v.byte_size >= self._threshold]
+        assignment, _ = greedy_load_balance(
+            [v.byte_size for v in ps_vars], len(ps_devices))
+        destination = {v.name: ps_devices[b]
+                       for v, b in zip(ps_vars, assignment)}
+
+        node_config = []
+        n_small = 0
+        for var in variables:
+            if var.name in destination:
+                partitioner = ""
+                if not var.sparse and len(var.shape) >= 1:
+                    # Shard the largest axis; the compiler lowers onto the
+                    # mesh axis (padding indivisible dims) — the shard count
+                    # here is the IR-level intent, sized to the chip count.
+                    axis = max(range(len(var.shape)),
+                               key=lambda i: var.shape[i])
+                    shards = min(var.shape[axis],
+                                 max(2, resource_spec.num_chips))
+                    if var.shape[axis] >= 2:
+                        partitioner = partition_str(var.shape, axis, shards)
+                node_config.append(VarConfig(
+                    var_name=var.name,
+                    synchronizer=PSSynchronizerConfig(
+                        reduction_destination=destination[var.name]),
+                    partitioner=partitioner))
+            else:
+                node_config.append(VarConfig(
+                    var_name=var.name,
+                    synchronizer=AllReduceSynchronizerConfig(
+                        compressor=self._compressor,
+                        group=n_small // self._chunk_size)))
+                n_small += 1
+        return Strategy(
+            node_config=node_config,
+            graph_config=GraphConfig(
+                replicas=self.replica_devices(resource_spec)))

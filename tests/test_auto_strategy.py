@@ -1,0 +1,98 @@
+"""AutoStrategy: heuristic per-variable strategy selection (beyond the OSS
+reference's fixed builders; the paper's auto-strategizer motivates it)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from autodist_tpu.autodist import AutoDist, _reset_default_autodist_for_testing
+from autodist_tpu.graph_item import GraphItem
+from autodist_tpu.mesh import build_mesh
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.strategy import AutoStrategy, StrategyCompiler
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    _reset_default_autodist_for_testing()
+
+
+def _spec():
+    return ResourceSpec(
+        resource_info={"nodes": [{"address": "localhost", "chips": 8}]})
+
+
+def _params():
+    return {
+        "emb": {"table": jnp.zeros((512, 16))},           # sparse
+        "big": {"w": jnp.zeros((512, 640))},              # 1.25 MiB dense
+        "small": {"w": jnp.zeros((16, 8)), "b": jnp.zeros(8)},
+    }
+
+
+def test_tier_assignment():
+    gi = GraphItem(_params(), sparse_vars=["emb/table"])
+    s = AutoStrategy().build(gi, _spec())
+    kinds = {n.var_name: (n.synchronizer.kind, n.partitioner)
+             for n in s.node_config}
+    assert kinds["emb/table"][0] == "PS"          # sparse -> PS
+    assert kinds["emb/table"][1] == ""            # vocab sharding by compiler
+    assert kinds["big/w"][0] == "PS"              # large dense -> PS
+    assert kinds["big/w"][1] != ""                # partitioned on largest axis
+    assert kinds["small/w"][0] == "AllReduce"     # small dense -> AR
+    assert kinds["small/b"][0] == "AllReduce"
+
+
+def test_lowering_shards_big_and_sparse():
+    gi = GraphItem(_params(), sparse_vars=["emb/table"])
+    mesh = build_mesh({"data": 8})
+    cs = StrategyCompiler(mesh).compile(AutoStrategy().build(gi, _spec()), gi)
+    assert cs.plan_for("emb/table").param_spec == P("data")
+    big = cs.plan_for("big/w")
+    assert big.param_spec != P()                  # physically partitioned
+    small = cs.plan_for("small/w")
+    assert small.param_spec == P()                # replicated, psum'd
+
+
+def test_auto_strategy_trains_to_parity():
+    params = _params()
+
+    def loss(p, b):
+        h = jnp.take(p["emb"]["table"], b["ids"], axis=0).mean(axis=1)
+        h = jnp.tanh(h @ p["small"]["w"] + p["small"]["b"])
+        z = (h @ p["big"]["w"][:8, :8].T)          # touch the big var
+        return jnp.mean((z - b["y"]) ** 2)
+
+    rng = np.random.RandomState(0)
+    batch = {"ids": rng.randint(0, 512, (16, 4)).astype(np.int32),
+             "y": rng.randn(16, 8).astype(np.float32)}
+
+    opt = optax.adam(1e-2)
+    p, s = params, opt.init(params)
+    ref = []
+    for _ in range(3):
+        l, g = jax.value_and_grad(loss)(p, batch)
+        u, s = opt.update(g, s, p)
+        p = optax.apply_updates(p, u)
+        ref.append(float(l))
+
+    ad = AutoDist(strategy_builder=AutoStrategy())
+    with ad.scope():
+        ad.capture(params=params, optimizer=optax.adam(1e-2), loss_fn=loss,
+                   sparse_vars=["emb/table"])
+    sess = ad.create_distributed_session()
+    losses = [float(sess.run(batch)["loss"]) for _ in range(3)]
+    np.testing.assert_allclose(losses, ref, rtol=1e-4)
+
+
+def test_threshold_moves_the_boundary():
+    gi = GraphItem(_params(), sparse_vars=["emb/table"])
+    s = AutoStrategy(partition_threshold=64).build(gi, _spec())
+    kinds = {n.var_name: n.synchronizer.kind for n in s.node_config}
+    assert kinds["small/w"] == "PS"   # now above the tiny threshold
+    s2 = AutoStrategy(partition_threshold=1 << 30).build(gi, _spec())
+    kinds2 = {n.var_name: n.synchronizer.kind for n in s2.node_config}
+    assert kinds2["big/w"] == "AllReduce"  # below the huge threshold
+    assert kinds2["emb/table"] == "PS"     # sparse stays PS regardless
