@@ -17,7 +17,7 @@ ring step the local shard is ``[B, T_local, H, D]``.
 from __future__ import annotations
 
 import functools
-from typing import Callable
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -134,7 +134,7 @@ def _ring_flash_local(q, k, v, *, axis_name: str, causal: bool,
 def make_ring_attention(mesh: Mesh, axis_name: str = MESH_AXIS_SEQ,
                         inner: str = "auto", block_q: int = 512,
                         block_k: int = 512,
-                        interpret: bool = None) -> Callable:
+                        interpret: Optional[bool] = None) -> Callable:
     """Returns an ``attn_fn(q, k, v, causal)`` drop-in for
     :func:`autodist_tpu.models.transformer.dense_attention`, sequence-parallel
     over ``axis_name``.  Call it on GLOBAL [B, T, H, D] tensors inside jit —

@@ -13,7 +13,7 @@ Absent from the reference (SURVEY §5.7); new first-class scope.
 from __future__ import annotations
 
 import functools
-from typing import Callable
+from typing import Callable, Optional
 
 import jax
 from jax import lax
@@ -41,7 +41,7 @@ def _ulysses_local(q, k, v, *, axis_name: str, causal: bool,
 def make_ulysses_attention(mesh: Mesh, axis_name: str = MESH_AXIS_SEQ,
                            inner: str = "auto", block_q: int = 512,
                            block_k: int = 512,
-                           interpret: bool = None) -> Callable:
+                           interpret: Optional[bool] = None) -> Callable:
     """Returns an ``attn_fn(q, k, v, causal)`` drop-in for dense_attention,
     sequence-parallel via all-to-all.  Requires num_heads divisible by the
     seq axis size.

@@ -200,7 +200,8 @@ class DistributedSession:
                 self._step.step_fn, self._params, self._opt_state,
                 self._sync_state, self._last_batch)
             self._flops_per_step = False if flops is None else flops
-        return self._flops_per_step or None
+        return None if self._flops_per_step in (None, False) \
+            else self._flops_per_step
 
     def mfu(self) -> Optional[float]:
         """Model-FLOPs utilization of the last measurement window

@@ -83,9 +83,8 @@ class AutoStrategy(StrategyBuilder):
                     # here is the IR-level intent, sized to the chip count.
                     axis = max(range(len(var.shape)),
                                key=lambda i: var.shape[i])
-                    shards = min(var.shape[axis],
-                                 max(2, resource_spec.num_chips))
-                    if var.shape[axis] >= 2:
+                    shards = min(var.shape[axis], resource_spec.num_chips)
+                    if shards >= 2:  # single-chip specs stay unpartitioned
                         partitioner = partition_str(var.shape, axis, shards)
                 node_config.append(VarConfig(
                     var_name=var.name,
