@@ -199,9 +199,12 @@ class DistributedSession:
             flops = metrics.step_flops(
                 self._step.step_fn, self._params, self._opt_state,
                 self._sync_state, self._last_batch)
+            # step_flops never yields 0.0 (it maps flops<=0 to None), so
+            # False is an unambiguous unavailable-sentinel.
             self._flops_per_step = False if flops is None else flops
-        return None if self._flops_per_step in (None, False) \
-            else self._flops_per_step
+        if self._flops_per_step is None or self._flops_per_step is False:
+            return None
+        return self._flops_per_step
 
     def mfu(self) -> Optional[float]:
         """Model-FLOPs utilization of the last measurement window

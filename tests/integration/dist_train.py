@@ -80,6 +80,18 @@ def main():
     ad = AutoDist(resource_spec=spec, strategy_builder=builder)
     with ad.scope():
         ad.capture(params=params, optimizer=optax.sgd(LR), loss_fn=loss_fn)
+
+    # Fault-injection hook (tests/test_multiprocess.py): the worker dies
+    # AFTER deserializing the chief's strategy but before rendezvous, while
+    # the chief blocks in jax.distributed.initialize — the watcher thread
+    # must abort the whole job (reference fail-fast, coordinator.py:98-110).
+    if (os.environ.get("AUTODIST_TEST_CRASH_WORKER")
+            and ENV.AUTODIST_WORKER.val):
+        strategy = ad.build_strategy()
+        print(f"[worker] injected crash after loading strategy "
+              f"{strategy.id}", flush=True)
+        sys.exit(17)
+
     sess = ad.create_distributed_session()
 
     import jax

@@ -49,7 +49,8 @@ def _reference_losses(steps=4, lr=0.1):
     return losses, w
 
 
-def _run_chief(tmp_path, builder: str):
+def _chief_env(tmp_path, builder: str, **extra):
+    """Chief subprocess environment (single source for every chief test)."""
     result_file = str(tmp_path / f"result_{builder}.json")
     env = dict(os.environ)
     env.pop("AUTODIST_WORKER", None)
@@ -62,6 +63,12 @@ def _run_chief(tmp_path, builder: str):
         "AUTODIST_TPU_WORKDIR": str(tmp_path / "workdir"),
         "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
     })
+    env.update(extra)
+    return env, result_file
+
+
+def _run_chief(tmp_path, builder: str):
+    env, result_file = _chief_env(tmp_path, builder)
     proc = subprocess.run(
         [sys.executable, "-u", SCRIPT], env=env, timeout=300,
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
@@ -97,3 +104,24 @@ def test_two_process_training_parity(tmp_path, builder):
     np.testing.assert_allclose(chief["final_w"], ref_w, rtol=1e-4)
 
     assert "jax.distributed initialized" in out
+
+
+def test_worker_crash_aborts_chief(tmp_path):
+    """Fail-fast failure propagation (reference coordinator.py:98-110): a
+    worker dying mid-bootstrap must abort the chief instead of leaving it
+    hung in rendezvous."""
+    env, result_file = _chief_env(tmp_path, "AllReduce",
+                                  AUTODIST_TEST_CRASH_WORKER="1")
+    import time
+
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, "-u", SCRIPT], env=env, timeout=240,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    elapsed = time.monotonic() - t0
+    out = proc.stdout.decode()
+    assert proc.returncode != 0, f"chief should abort, got rc=0:\n{out[-2000:]}"
+    assert "injected crash" in out
+    assert "aborting job" in out          # the watcher fired
+    assert not os.path.exists(result_file)  # chief never finished training
+    assert elapsed < 200, f"abort took {elapsed:.0f}s — watcher too slow"
