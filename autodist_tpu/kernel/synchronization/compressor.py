@@ -125,9 +125,62 @@ class PowerSGDCompressor(Compressor):
         return approx, {"q": new_q, "residual": new_residual}
 
 
+class Int8Compressor(Compressor):
+    """Tensor-scaled int8 quantized all-reduce with error feedback
+    (EQuARX-style, arxiv 2506.17615: quantized collectives cut ICI/DCN
+    bytes ~4x vs f32 at negligible quality loss when error-compensated).
+
+    The all-reduce is built MANUALLY so int8 is what actually crosses the
+    wire (a dtype round-trip in front of ``psum`` would still move 4
+    bytes/element): quantized reduce-scatter via ``all_to_all``, local
+    dequantize-and-sum in f32, then a re-quantized ``all_gather`` — the
+    EQuARX double-quantization scheme.  Scales are shared via scalar
+    ``pmax`` so every shard uses one grid.  Stage-1 quantization error is
+    carried as local error-feedback state (Karimireddy et al., 2019);
+    stage-2 (post-aggregation) error is uncompensated, as in EQuARX.
+    """
+
+    name = "Int8Compressor"
+
+    def init_state(self, var_value):
+        return jnp.zeros_like(var_value)
+
+    @staticmethod
+    def _quantize(x, axis_name):
+        amax = lax.pmax(jnp.max(jnp.abs(x)), axis_name)
+        scale = jnp.maximum(amax / 127.0, 1e-30)
+        q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+        return q, scale
+
+    def reduce(self, grad, state, axis_name):
+        n = lax.axis_size(axis_name)
+        corrected = (grad + state).astype(jnp.float32)
+        flat = corrected.ravel()
+        pad = (-flat.size) % n
+        if pad:
+            flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
+
+        q, scale = self._quantize(flat, axis_name)
+        err = flat - q.astype(jnp.float32) * scale            # stage-1 error
+        new_state = err[:grad.size].reshape(grad.shape).astype(grad.dtype)
+
+        # Quantized reduce-scatter: chunk j of every shard lands on shard j
+        # (int8 wire), then dequantize + sum in f32 locally.
+        recv = lax.all_to_all(q.reshape(n, -1), axis_name,
+                              split_axis=0, concat_axis=0)
+        owned_sum = jnp.sum(recv.astype(jnp.float32), axis=0) * scale
+
+        # Re-quantized all-gather of the aggregated chunk (int8 wire again).
+        q2, scale2 = self._quantize(owned_sum, axis_name)
+        gathered = lax.all_gather(q2, axis_name, axis=0).reshape(-1)
+        mean = gathered.astype(jnp.float32) * (scale2 / n)
+        return mean[:grad.size].reshape(grad.shape).astype(grad.dtype), \
+            new_state
+
+
 _REGISTRY: Dict[str, type] = {
     c.name: c for c in (NoneCompressor, HorovodCompressor, HorovodCompressorEF,
-                        PowerSGDCompressor)
+                        PowerSGDCompressor, Int8Compressor)
 }
 
 

@@ -150,3 +150,53 @@ def test_compressor_on_modelonly_mesh_falls_back():
     ref_losses = _reference_losses(params, loss_fn, batch, 0.1, 3)[1]
     losses = [float(sess.run(batch)["loss"]) for _ in range(3)]
     np.testing.assert_allclose(losses, ref_losses, rtol=1e-5)
+
+
+def test_int8_compressor_unit_semantics():
+    """Exact on grid values, and the WIRE collectives are int8: the jitted
+    program's all_to_all/all_gather operate on i8 tensors (no int8-typed
+    psum/all-reduce fallback)."""
+    from jax.sharding import Mesh
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("data",))
+    comp = get_compressor("Int8Compressor")
+
+    # Values exactly representable on the shared grid: max=127 -> scale 1,
+    # and the aggregated sums are also grid-exact.
+    g_local = np.tile(np.arange(-127, 127, 2, np.float32)[None], (8, 1))
+
+    f = jax.jit(jax.shard_map(
+        lambda g: comp.reduce(g, jnp.zeros_like(g), "data")[0],
+        mesh=mesh, in_specs=jax.sharding.PartitionSpec("data"),
+        out_specs=jax.sharding.PartitionSpec(), check_vma=False))
+    out = f(g_local)
+    np.testing.assert_allclose(np.asarray(out),
+                               g_local.mean(0, keepdims=True), atol=1e-5)
+    txt = f.lower(g_local).as_text()
+    assert "all_to_all" in txt and "i8" in txt  # int8 is on the wire
+
+
+def test_int8_error_feedback_carries_quantization_error():
+    comp = get_compressor("Int8Compressor")
+    from jax.sharding import Mesh
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("data",))
+    # Off-grid interior values: max=1.0 sets the grid; 0.3 lies between
+    # steps (scale = 1/127, 0.3*127 = 38.1) -> genuine quantization error.
+    g_local = np.full((8, 8), 0.3, np.float32)
+    g_local[:, 0] = 1.0
+
+    out, st = jax.jit(jax.shard_map(
+        lambda g: comp.reduce(g, jnp.zeros_like(g), "data"),
+        mesh=mesh, in_specs=jax.sharding.PartitionSpec("data"),
+        out_specs=(jax.sharding.PartitionSpec(),
+                   jax.sharding.PartitionSpec("data")),
+        check_vma=False))(g_local)
+    st = np.asarray(st)
+    # residual ~ distance to the nearest grid point (|0.3 - 38/127| ~ 8e-4)
+    assert 1e-4 < np.abs(st[:, 1:]).max() < 1.0 / 127
+    np.testing.assert_allclose(np.asarray(out)[:, 1:], 0.3, rtol=2e-2)
+    np.testing.assert_allclose(np.asarray(out)[:, 0], 1.0, rtol=2e-2)
+
+
+def test_int8_compressor_converges():
+    sess, losses = _run_with_compressor("Int8Compressor", steps=60)
+    assert losses[-1] < losses[0] * 0.05, losses
