@@ -88,8 +88,23 @@ def constrain(tree: Any, sharding_or_spec_tree: Any) -> Any:
 
 
 def host_local(tree: Any) -> Any:
-    """Fetch a (possibly sharded) pytree to host numpy arrays."""
-    return jax.tree_util.tree_map(lambda x: np.asarray(jax.device_get(x)), tree)
+    """Fetch a (possibly sharded) pytree to host numpy arrays.
+
+    Multi-controller safe: arrays with non-addressable shards (variables
+    sharded across processes) are gathered collectively first — every
+    process must therefore call this at the same point, which the SPMD
+    execution model already guarantees (all processes run the same
+    script)."""
+
+    def fetch(x):
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            from jax.experimental import multihost_utils
+
+            return np.asarray(multihost_utils.process_allgather(x,
+                                                                tiled=True))
+        return np.asarray(jax.device_get(x))
+
+    return jax.tree_util.tree_map(fetch, tree)
 
 
 def abstract_like(tree: Any) -> Any:

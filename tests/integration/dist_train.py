@@ -64,8 +64,11 @@ def loss_fn(params, batch):
 def main():
     import optax
 
+    from autodist_tpu.strategy import PartitionedPS
+
     builder = {"AllReduce": AllReduce,
-               "PSLoadBalancing": PSLoadBalancing}[
+               "PSLoadBalancing": PSLoadBalancing,
+               "PartitionedPS": PartitionedPS}[
                    os.environ.get("AUTODIST_TEST_BUILDER", "AllReduce")]()
     # Two "nodes", both local: the chief fans the script out with
     # subprocess+env exactly as it would over SSH to a remote host.

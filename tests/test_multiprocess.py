@@ -81,7 +81,14 @@ def _run_chief(tmp_path, builder: str):
     return chief, worker, out
 
 
-@pytest.mark.parametrize("builder", ["AllReduce", "PSLoadBalancing"])
+@pytest.mark.parametrize("builder", [
+    "AllReduce",
+    "PSLoadBalancing",
+    # PartitionedPS shards w (dim 3 -> padded to 4) ACROSS the two
+    # processes: exercises pad-to-divisible + the collective host gather
+    # behind sess.params for non-addressable shards.
+    "PartitionedPS",
+])
 def test_two_process_training_parity(tmp_path, builder):
     chief, worker, out = _run_chief(tmp_path, builder)
 
