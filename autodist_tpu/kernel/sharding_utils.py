@@ -97,11 +97,14 @@ def host_local(tree: Any) -> Any:
     script)."""
 
     def fetch(x):
-        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        if (isinstance(x, jax.Array) and not x.is_fully_addressable
+                and not x.is_fully_replicated):
             from jax.experimental import multihost_utils
 
             return np.asarray(multihost_utils.process_allgather(x,
                                                                 tiled=True))
+        # Fully-replicated arrays need no collective even when some shards
+        # live on other processes: the local shard already holds the value.
         return np.asarray(jax.device_get(x))
 
     return jax.tree_util.tree_map(fetch, tree)

@@ -41,7 +41,8 @@ import numpy as np  # noqa: E402
 from autodist_tpu.autodist import AutoDist  # noqa: E402
 from autodist_tpu.const import ENV  # noqa: E402
 from autodist_tpu.resource_spec import ResourceSpec  # noqa: E402
-from autodist_tpu.strategy import AllReduce, PSLoadBalancing  # noqa: E402
+from autodist_tpu.strategy import (  # noqa: E402
+    AllReduce, PartitionedPS, PSLoadBalancing)
 
 STEPS = 4
 LR = 0.1
@@ -63,8 +64,6 @@ def loss_fn(params, batch):
 
 def main():
     import optax
-
-    from autodist_tpu.strategy import PartitionedPS
 
     builder = {"AllReduce": AllReduce,
                "PSLoadBalancing": PSLoadBalancing,

@@ -102,8 +102,82 @@ def case_bf16_remat():
              "y": rng.randn(16, 4).astype(np.float32)}
     return params, loss_fn, batch, dict(remat="dots"), 2e-2
 
+def case_while_loop():
+    """Data-dependent ``lax.while_loop`` in the step (reference c4:
+    ``tf.while_loop``): an input-normalization loop with a value-dependent
+    stopping predicate (global max-reduce in ``cond`` — a collective when
+    the batch is data-sharded).  It runs on the non-differentiated data
+    path: ``lax.while_loop`` has no reverse-mode rule, so the TPU-native
+    translation of a differentiated dynamic loop is scan+mask (see
+    :func:`case_dynamic_lstm`); the data-dependent trip count stays legal
+    on forward values."""
+    d = 8
+    params = {"lin": {"w": jnp.asarray(
+        np.linspace(-0.4, 0.4, d * d).reshape(d, d), jnp.float32)}}
+
+    def loss_fn(p, batch):
+        def cond(carry):
+            i, v = carry
+            return jnp.logical_and(i < 8, jnp.max(jnp.abs(v)) > 1.05)
+
+        def body(carry):
+            i, v = carry
+            return i + 1, 0.7 * v
+
+        _, v = jax.lax.while_loop(
+            cond, body, (0, jax.lax.stop_gradient(batch["x"])))
+        pred = jnp.tanh(v) @ p["lin"]["w"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    rng = np.random.RandomState(3)
+    batch = {"x": rng.randn(16, d).astype(np.float32) * 3.0,
+             "y": rng.randn(16, d).astype(np.float32)}
+    return params, loss_fn, batch, {}, 1e-4
+
+
+def case_dynamic_lstm():
+    """Dynamic-length LSTM (reference c6: ``dynamic_rnn`` + TensorArray):
+    a gated LSTM cell scanned over padded sequences with PER-EXAMPLE
+    lengths — state updates masked past each row's length, final state
+    gathered at the length boundary (the TensorArray read)."""
+    d_in, d_h, t_max = 4, 8, 10
+    k = jax.random.PRNGKey(4)
+    kx, kh, kp = jax.random.split(k, 3)
+    params = {"lstm": {"w_x": jax.random.normal(kx, (d_in, 4 * d_h)) * 0.3,
+                       "w_h": jax.random.normal(kh, (d_h, 4 * d_h)) * 0.3,
+                       "b": jnp.zeros((4 * d_h,))},
+              "proj": {"w": jax.random.normal(kp, (d_h, 3)) * 0.3}}
+
+    def loss_fn(p, batch):
+        def step(carry, xs):
+            h, c = carry
+            x_t, live = xs                           # [B,d_in], [B]
+            z = x_t @ p["lstm"]["w_x"] + h @ p["lstm"]["w_h"] + p["lstm"]["b"]
+            i, f, g, o = jnp.split(z, 4, axis=-1)
+            nc = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+            nh = jax.nn.sigmoid(o) * jnp.tanh(nc)
+            m = live[:, None]                        # freeze finished rows
+            return (m * nh + (1 - m) * h, m * nc + (1 - m) * c), None
+
+        x = jnp.swapaxes(batch["x"], 0, 1)           # [T,B,d_in]
+        live = (jnp.arange(t_max)[:, None]
+                < batch["len"][None, :]).astype(x.dtype)   # [T,B]
+        b = batch["x"].shape[0]
+        h0 = jnp.zeros((b, d_h))
+        (h, _), _ = jax.lax.scan(step, (h0, h0), (x, live))
+        pred = h @ p["proj"]["w"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    rng = np.random.RandomState(5)
+    batch = {"x": rng.randn(16, t_max, d_in).astype(np.float32),
+             "len": rng.randint(1, t_max + 1, (16,)).astype(np.int32),
+             "y": rng.randn(16, 3).astype(np.float32)}
+    return params, loss_fn, batch, {}, 1e-4
+
+
 CASES = {"sparse": case_sparse, "scan": case_scan,
-         "bf16_remat": case_bf16_remat}
+         "bf16_remat": case_bf16_remat, "while_loop": case_while_loop,
+         "dynamic_lstm": case_dynamic_lstm}
 
 
 def _single_device_losses(params, loss_fn, batch, capture_kw):
