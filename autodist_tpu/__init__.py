@@ -13,7 +13,8 @@ from autodist_tpu.resource_spec import DeviceSpec, ResourceSpec  # noqa: F401
 
 __version__ = "0.1.0"
 
-__all__ = ["AutoDist", "ResourceSpec", "DeviceSpec", "ENV", "__version__"]
+__all__ = ["AutoDist", "ResourceSpec", "DeviceSpec", "ENV", "Callback",
+           "TimeHistory", "History", "__version__"]
 
 
 def __getattr__(name):
@@ -21,4 +22,7 @@ def __getattr__(name):
     if name == "AutoDist":
         from autodist_tpu.autodist import AutoDist
         return AutoDist
+    if name in ("Callback", "TimeHistory", "History"):
+        from autodist_tpu import fit as _fit
+        return getattr(_fit, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -181,6 +181,14 @@ class DistributedSession:
         empty iterable)."""
         return self.run_many(self.prefetch(batches, prefetch_depth))
 
+    def fit(self, data, **kwargs):
+        """High-level epochs×steps training loop with callbacks, periodic
+        logging, and checkpoint/resume — the reference's ``Model.fit``
+        path (see :mod:`autodist_tpu.fit` for arguments)."""
+        from autodist_tpu import fit as _fit
+
+        return _fit.fit(self, data, **kwargs)
+
     # -- instrumentation (SURVEY §5: the reference only measured throughput
     # in example scripts; here it's a session feature) ----------------------
     def throughput(self, items_per_step: Optional[int] = None
