@@ -21,6 +21,7 @@ Design constraints (why this isn't a 5-line loop):
 """
 from __future__ import annotations
 
+import itertools
 import time
 from typing import Any, Callable, Dict, Iterable, Optional, Sequence, Union
 
@@ -150,6 +151,11 @@ def fit(session, data: DataArg, epochs: int = 1,
                 logging.info("fit: resumed from %s at step %d",
                              latest, step)
 
+    if isinstance(data, dict):
+        # One repeated batch: place it once — re-placing a placed batch is
+        # a no-op, so the per-step host→device transfer disappears.
+        data = session.place_batch(data)
+
     hist = History()
     for cb in callbacks:
         cb.on_train_begin(session)
@@ -159,12 +165,15 @@ def fit(session, data: DataArg, epochs: int = 1,
         for cb in callbacks:
             cb.on_epoch_begin(epoch)
         it = _epoch_iter(data, steps_per_epoch)
+        if steps_per_epoch:
+            # Cap BEFORE prefetch: capping inside the loop would let the
+            # prefetcher pull (and drop) batches beyond the cap — silently
+            # skipping data when one shared iterator spans epochs.
+            it = itertools.islice(it, steps_per_epoch)
         out = None
         epoch_steps = 0
         last_sampled_step = None
         for batch in session.prefetch(it, prefetch_depth):
-            if steps_per_epoch and epoch_steps >= steps_per_epoch:
-                break
             out = session.run(batch, sync=False)
             epoch_steps += 1
             hist.steps_run += 1

@@ -17,7 +17,7 @@ import optax
 
 from autodist_tpu.models.bert import bert, bert_base, bert_large
 from examples.benchmark.common import benchmark_args, make_autodist, \
-    run_benchmark
+    run_selected_benchmark
 
 SIZES = {
     "tiny": lambda **kw: bert(num_layers=2, num_heads=2, head_dim=32,
@@ -42,8 +42,7 @@ def main():
                    optimizer=optax.adamw(args.lr),
                    loss_fn=spec.loss_fn, sparse_vars=spec.sparse_vars)
     sess = ad.create_distributed_session()
-    run_benchmark(spec, sess, args.batch_size, args.steps, args.warmup,
-                  unit="samples")
+    run_selected_benchmark(spec, sess, args, unit="samples")
 
 
 if __name__ == "__main__":

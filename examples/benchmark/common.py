@@ -23,6 +23,10 @@ def benchmark_args(description: str) -> argparse.ArgumentParser:
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--warmup", type=int, default=3)
     p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--epochs", type=int, default=0,
+                   help="when >0, train through session.fit (epochs x "
+                        "--steps fresh batches) with the TimeHistory "
+                        "callback instead of the single-batch timing loop")
     return p
 
 
@@ -57,3 +61,40 @@ def run_benchmark(spec, sess, batch_size: int, steps: int, warmup: int,
           f"loss {loss:.4f} -> {float(metrics['loss']):.4f}")
     assert np.isfinite(float(metrics["loss"]))
     return rate
+
+
+def run_fit_benchmark(spec, sess, batch_size: int, steps_per_epoch: int,
+                      epochs: int, unit: str = "samples",
+                      items_per_batch: int = None):
+    """Epoch-style benchmark through ``session.fit`` — the reference's
+    ``model.fit(..., callbacks=[TimeHistory()])`` measurement shape
+    (examples/benchmark/imagenet.py:85-120), with fresh batches each
+    epoch flowing through the prefetch pipeline."""
+    from autodist_tpu import TimeHistory
+
+    def epoch_batches():
+        rng = np.random.RandomState(0)
+        return (spec.make_batch(rng, batch_size)
+                for _ in range(steps_per_epoch))
+
+    th = TimeHistory(items_per_step=items_per_batch or batch_size)
+    hist = sess.fit(epoch_batches, epochs=epochs, callbacks=[th])
+    for e, (dt, rate) in enumerate(zip(th.epoch_times, th.items_per_sec)):
+        print(f"{spec.name}: epoch {e}: {rate:,.1f} {unit}/sec "
+              f"({dt:.2f}s), loss {hist.history['epoch_loss'][e]:.4f}")
+    assert np.isfinite(hist.history["epoch_loss"][-1])
+    return th.items_per_sec[-1]
+
+
+def run_selected_benchmark(spec, sess, args, unit: str = "samples",
+                           items_per_batch: int = None):
+    """Dispatch on ``--epochs``: the fit/TimeHistory path when set, the
+    single-batch timing loop otherwise — so every benchmark script honors
+    the shared flag."""
+    if getattr(args, "epochs", 0):
+        return run_fit_benchmark(spec, sess, args.batch_size, args.steps,
+                                 args.epochs, unit=unit,
+                                 items_per_batch=items_per_batch)
+    return run_benchmark(spec, sess, args.batch_size, args.steps,
+                         args.warmup, unit=unit,
+                         items_per_batch=items_per_batch)

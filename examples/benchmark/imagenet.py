@@ -19,7 +19,7 @@ import optax
 
 from autodist_tpu import models
 from examples.benchmark.common import benchmark_args, make_autodist, \
-    run_benchmark
+    run_selected_benchmark
 
 
 def main():
@@ -42,8 +42,7 @@ def main():
                    loss_fn=spec.loss_fn,
                    untrainable_vars=spec.untrainable_vars)
     sess = ad.create_distributed_session()
-    run_benchmark(spec, sess, args.batch_size, args.steps, args.warmup,
-                  unit="images")
+    run_selected_benchmark(spec, sess, args, unit="images")
 
 
 if __name__ == "__main__":

@@ -18,7 +18,7 @@ import optax
 
 from autodist_tpu.models.ncf import ncf
 from examples.benchmark.common import benchmark_args, make_autodist, \
-    run_benchmark
+    run_selected_benchmark
 
 
 def main():
@@ -36,8 +36,7 @@ def main():
         ad.capture(params=params, optimizer=optax.adam(args.lr),
                    loss_fn=spec.loss_fn, sparse_vars=spec.sparse_vars)
     sess = ad.create_distributed_session()
-    run_benchmark(spec, sess, args.batch_size, args.steps, args.warmup,
-                  unit="samples")
+    run_selected_benchmark(spec, sess, args, unit="samples")
 
 
 if __name__ == "__main__":

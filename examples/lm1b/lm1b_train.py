@@ -20,7 +20,7 @@ import optax
 
 from autodist_tpu.models.lm1b import lm1b
 from examples.benchmark.common import benchmark_args, make_autodist, \
-    run_benchmark
+    run_selected_benchmark
 
 
 def main():
@@ -41,9 +41,9 @@ def main():
         ad.capture(params=params, optimizer=optax.adagrad(args.lr),
                    loss_fn=spec.loss_fn, sparse_vars=spec.sparse_vars)
     sess = ad.create_distributed_session()
-    run_benchmark(spec, sess, args.batch_size, args.steps, args.warmup,
-                  unit="words",
-                  items_per_batch=args.batch_size * args.seq_len)
+    run_selected_benchmark(
+        spec, sess, args, unit="words",
+        items_per_batch=args.batch_size * args.seq_len)
 
 
 if __name__ == "__main__":

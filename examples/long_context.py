@@ -22,7 +22,7 @@ from autodist_tpu.mesh import build_mesh
 from autodist_tpu.models.transformer_lm import transformer_lm
 from autodist_tpu.parallel import make_ring_attention
 from examples.benchmark.common import benchmark_args, make_autodist, \
-    run_benchmark
+    run_selected_benchmark
 
 
 def main():
@@ -46,9 +46,9 @@ def main():
         ad.capture(params=params, optimizer=optax.adamw(args.lr),
                    loss_fn=spec.loss_fn, sparse_vars=spec.sparse_vars)
     sess = ad.create_distributed_session(mesh=mesh)
-    run_benchmark(spec, sess, args.batch_size, args.steps, args.warmup,
-                  unit="tokens",
-                  items_per_batch=args.batch_size * args.seq_len)
+    run_selected_benchmark(
+        spec, sess, args, unit="tokens",
+        items_per_batch=args.batch_size * args.seq_len)
 
 
 if __name__ == "__main__":

@@ -20,7 +20,7 @@ import optax
 from autodist_tpu.mesh import build_mesh
 from autodist_tpu.models.pipelined_moe_lm import pipelined_moe_transformer_lm
 from examples.benchmark.common import benchmark_args, make_autodist, \
-    run_benchmark
+    run_selected_benchmark
 
 
 def main():
@@ -47,9 +47,9 @@ def main():
                    pipeline_vars=spec.pipeline_vars,
                    expert_vars=spec.expert_vars)
     sess = ad.create_distributed_session(mesh=mesh)
-    run_benchmark(spec, sess, args.batch_size, args.steps, args.warmup,
-                  unit="tokens",
-                  items_per_batch=args.batch_size * args.seq_len)
+    run_selected_benchmark(
+        spec, sess, args, unit="tokens",
+        items_per_batch=args.batch_size * args.seq_len)
 
 
 if __name__ == "__main__":

@@ -62,3 +62,12 @@ def test_imagenet_benchmark():
     _run_example("examples/benchmark/imagenet.py",
                  ("--model", "resnet50", "--image-size", "32",
                   "--batch-size", "8", "--steps", "2", "--warmup", "1"))
+
+
+@pytest.mark.integration
+def test_imagenet_benchmark_fit_epochs():
+    out = _run_example("examples/benchmark/imagenet.py",
+                       ("--model", "resnet50", "--image-size", "32",
+                        "--batch-size", "8", "--steps", "2",
+                        "--epochs", "2"))
+    assert "epoch 1:" in out

@@ -154,6 +154,27 @@ def test_fit_empty_epoch_warns_not_crashes():
     assert ends == [None, None]  # begin/end pairing holds on empty epochs
 
 
+def test_fit_shared_iterator_chunks_without_dropping_batches():
+    """One iterator spanning epochs via steps_per_epoch: every batch is
+    trained exactly once, in order — the prefetcher must not pull-and-drop
+    batches past the epoch cap."""
+    sess, batches = _make_session()
+    data = batches(6)
+    seen = []
+    orig_run = sess.run
+
+    def spy_run(batch, sync=True):
+        seen.append(float(np.asarray(batch["x"][0, 0])))
+        return orig_run(batch, sync=sync)
+
+    sess.run = spy_run
+    hist = sess.fit(iter(data), epochs=3, steps_per_epoch=2,
+                    prefetch_depth=2)
+    assert hist.steps_run == 6
+    assert hist.epochs_run == 3
+    assert seen == [float(b["x"][0, 0]) for b in data]
+
+
 def test_fit_exhausted_iterator_stops_cleanly():
     """A one-shot iterator trains one epoch, then fit stops instead of
     spinning through empty epochs (and epochs_run reflects reality)."""
