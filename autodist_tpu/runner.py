@@ -46,6 +46,8 @@ class DistributedSession:
         if tracing.dumps_enabled():
             tracing.dump_stage(self._run_id, "1-strategy-plans",
                                tracing.plan_table(dist_step.compiled_strategy))
+            from autodist_tpu.utils import visualization
+            visualization.log_shardings(self)
 
     # -- state -------------------------------------------------------------
     @property

@@ -1,0 +1,79 @@
+"""Sharding visualization — the TPU analog of the reference's graph
+visualizer (``autodist/utils/visualization_util.py:24-36``, which wrote
+TensorBoard event files of each transform stage).
+
+A sharded-training program's "graph picture" is its placement: which mesh
+coordinates hold which slice of every variable.  ``sharding_table``
+renders that as text — one row per variable with its PartitionSpec,
+physical shard shape, and per-shard device map — and
+``log_shardings`` writes it through the tracing dump machinery next to
+the plan-table/StableHLO/HLO artifacts.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+
+def _leaf_rows(path: str, arr: Any) -> str:
+    sh = getattr(arr, "sharding", None)
+    spec = getattr(sh, "spec", None)
+    shards = getattr(arr, "addressable_shards", None)
+    if not shards:
+        # None: a host value (unplaced).  Empty: every shard lives on
+        # another process's devices (multi-controller) — still a row, not
+        # a crash.
+        tag = "(unplaced)" if shards is None else "(no local shards)"
+        return f"{path:<40} {str(np.shape(arr)):<18} {tag}"
+    shard_shape = tuple(shards[0].data.shape)
+    n_dev = len(getattr(sh, "device_set", ())) or len(shards)
+    dev0 = shards[0].device
+    kind = getattr(dev0, "platform", "?")
+    return (f"{path:<40} {str(tuple(arr.shape)):<18} "
+            f"spec={str(spec):<28} shard={str(shard_shape):<18} "
+            f"{n_dev}x{kind}")
+
+
+def sharding_table(tree: Any, title: str = "shardings") -> str:
+    """Text table of every leaf's global shape, PartitionSpec, physical
+    shard shape, and device count."""
+    lines = [f"# {title}",
+             f"{'variable':<40} {'global':<18} "
+             f"{'spec':<33} {'shard':<24} devices"]
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    for path, leaf in flat:
+        name = "/".join(str(getattr(k, "key", k)) for k in path)
+        lines.append(_leaf_rows(name, leaf))
+    return "\n".join(lines) + "\n"
+
+
+def ascii_device_grid(arr: Any) -> str:
+    """Per-shard device map of one array (a text
+    ``jax.debug.visualize_array_sharding``): each addressable shard's
+    index range and device."""
+    shards = getattr(arr, "addressable_shards", None)
+    if not shards:
+        return "(no addressable shards)"
+    out = []
+    for s in shards:
+        idx = tuple(
+            f"{sl.start or 0}:{sl.stop if sl.stop is not None else 'end'}"
+            if isinstance(sl, slice) else str(sl)
+            for sl in (s.index if isinstance(s.index, tuple) else (s.index,)))
+        out.append(f"  [{', '.join(idx) or ':'}] -> {s.device}")
+    return "\n".join(out)
+
+
+def log_shardings(session, tag: str = "4-placement") -> Optional[str]:
+    """Write the session's parameter-placement table through the staged
+    dump machinery (enabled by ``AUTODIST_DUMP_GRAPHS``); returns the
+    dump path, or None when dumps are disabled."""
+    from autodist_tpu.utils import tracing
+
+    if not tracing.dumps_enabled():
+        return None
+    table = sharding_table(session.sharded_params,
+                           title=f"mesh={dict(session.mesh.shape)}")
+    return tracing.dump_stage(session._run_id, tag, table)

@@ -113,15 +113,7 @@ def run_child(platform: str) -> None:
     # its loss to host — a hard sync that (unlike block_until_ready over the
     # remote-TPU tunnel) reliably waits for the whole chain.
     batch = sess.place_batch(batch)
-    for _ in range(WARMUP_STEPS):
-        sess.run(batch, sync=False)
-    sess.run(batch)
-
-    t0 = time.perf_counter()
-    for _ in range(MEASURE_STEPS - 1):
-        sess.run(batch, sync=False)
-    sess.run(batch)
-    dt = time.perf_counter() - t0
+    dt = _measure_session(sess, batch, WARMUP_STEPS, MEASURE_STEPS)
 
     images_per_sec = batch_size * MEASURE_STEPS / dt
     result = {
@@ -144,13 +136,24 @@ def run_child(platform: str) -> None:
     _fill_mfu(result, dev, on_tpu, dt, sess, batch)
     print(json.dumps(result), flush=True)
     if on_tpu:
-        _fill_lm(result)  # flagship-LM tokens/sec + flash-vs-dense delta
+        # Each enrichment prints the running result line when done, so a
+        # parent timeout mid-enrichment keeps everything measured so far
+        # (the parent takes the LAST valid JSON line).  Ordered by value:
+        # the dense-attention comparison (extra compiles) goes last.
+        lm_cmp = _fill_lm(result)  # flagship-LM tokens/sec (flash)
         print(json.dumps(result), flush=True)
+        _fill_bert(result)  # BASELINE.json parity config: BERT-base
+        print(json.dumps(result), flush=True)
+        if lm_cmp is not None:
+            lm_cmp()       # flash-vs-dense speedup ratio
+            print(json.dumps(result), flush=True)
 
 
-def _fill_lm(result) -> None:
+def _fill_lm(result):
     """Secondary metric: flagship TransformerLM training throughput with
-    the Pallas flash-attention kernel (the TPU default) vs dense attention.
+    the Pallas flash-attention kernel (the TPU default).  Returns a
+    thunk that fills the dense-attention comparison (so the caller can
+    defer those extra compiles), or None on failure.
     Best-effort — a failure here never loses the primary metric."""
     try:
         import jax
@@ -191,26 +194,90 @@ def _fill_lm(result) -> None:
         flash_tps = measure(make_flash_attention(), batch_size)
         result["lm_tokens_per_sec"] = round(flash_tps, 1)
         result["lm_seq_len"] = seq
-        # Dense attention materializes f32[B,H,T,T] score tensors (1.5 GB
-        # per layer at B=8, T=2048) and can OOM where flash runs — itself
-        # the headline.  Fall back to smaller dense batches; the ratio is
-        # apples-to-apples because flash is re-measured at the SAME batch.
-        for dense_bs in (batch_size, 2, 1):
-            try:
-                dense_tps = measure(dense_attention, dense_bs)
-                flash_at_bs = flash_tps if dense_bs == batch_size \
-                    else measure(make_flash_attention(), dense_bs)
-                result["lm_flash_speedup_vs_dense"] = round(
-                    flash_at_bs / dense_tps, 3)
-                result["lm_dense_batch"] = dense_bs
-                break
-            except Exception as de:
-                result["lm_dense_oom_at_batch"] = dense_bs
-                print(f"bench: dense attention failed at batch {dense_bs} "
-                      f"({type(de).__name__}); flash ran at {batch_size}",
-                      file=sys.stderr, flush=True)
+
+        def compare_dense():
+            # Dense attention materializes f32[B,H,T,T] score tensors
+            # (1.5 GB per layer at B=8, T=2048) and can OOM where flash
+            # runs — itself the headline.  Fall back to smaller dense
+            # batches; the ratio is apples-to-apples because flash is
+            # re-measured at the SAME batch.
+            for dense_bs in (batch_size, 2, 1):
+                try:
+                    dense_tps = measure(dense_attention, dense_bs)
+                    flash_at_bs = flash_tps if dense_bs == batch_size \
+                        else measure(make_flash_attention(), dense_bs)
+                    result["lm_flash_speedup_vs_dense"] = round(
+                        flash_at_bs / dense_tps, 3)
+                    result["lm_dense_batch"] = dense_bs
+                    return
+                except Exception as de:
+                    result["lm_dense_oom_at_batch"] = dense_bs
+                    print(f"bench: dense attention failed at batch "
+                          f"{dense_bs} ({type(de).__name__}); flash ran "
+                          f"at {batch_size}", file=sys.stderr, flush=True)
+
+        return compare_dense
     except Exception as e:  # pragma: no cover - best-effort enrichment
         print(f"bench: LM secondary metric unavailable ({e!r})",
+              file=sys.stderr, flush=True)
+        return None
+
+
+def _measure_session(sess, placed_batch, warmup: int, steps: int) -> float:
+    """Warmup + async-dispatch timing over a pre-placed batch; the final
+    step's host fetch is the hard sync closing the window (reliable over
+    the remote-TPU tunnel where block_until_ready is not).  Returns
+    elapsed seconds for ``steps`` steps."""
+    for _ in range(warmup):
+        sess.run(placed_batch, sync=False)
+    sess.run(placed_batch)
+    t0 = time.perf_counter()
+    for _ in range(steps - 1):
+        sess.run(placed_batch, sync=False)
+    sess.run(placed_batch)
+    return time.perf_counter() - t0
+
+
+def _fill_bert(result) -> None:
+    """Secondary metric: BERT-base MLM pre-training samples/sec through the
+    full AutoDist path with the PartitionedAR strategy — the BASELINE.json
+    parity config ('BERT-base — PartitionedAR').  Best-effort."""
+    try:
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from autodist_tpu.autodist import AutoDist, \
+            _reset_default_autodist_for_testing
+        from autodist_tpu.models.bert import bert_base
+        from autodist_tpu.strategy import PartitionedAR
+
+        batch_size, seq, steps = 64, 128, 10
+        spec = bert_base(seq_len=seq, dtype=jnp.bfloat16)
+        params = spec.init(jax.random.PRNGKey(0))
+        params = jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.bfloat16)
+            if x.dtype == jnp.float32 else x, params)
+        batch = spec.sample_batch(batch_size)
+
+        _reset_default_autodist_for_testing()
+        ad = AutoDist(strategy_builder=PartitionedAR())
+        with ad.scope():
+            ad.capture(params=params, optimizer=optax.adamw(1e-4),
+                       loss_fn=spec.loss_fn)
+        sess = ad.create_distributed_session()
+        batch = sess.place_batch(batch)
+        dt = _measure_session(sess, batch, 3, steps)
+        result["bert_samples_per_sec"] = round(batch_size * steps / dt, 1)
+        result["bert_seq_len"] = seq
+        result["bert_batch_size"] = batch_size
+        # Free the BERT state before the caller's dense-attention
+        # comparison: params + AdamW slots pinned in HBM would shrink the
+        # room the OOM-prone dense program has to compile into.
+        del sess, ad, params, batch
+        _reset_default_autodist_for_testing()
+    except Exception as e:  # pragma: no cover - best-effort enrichment
+        print(f"bench: BERT secondary metric unavailable ({e!r})",
               file=sys.stderr, flush=True)
 
 

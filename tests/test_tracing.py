@@ -56,15 +56,36 @@ def test_dumps_and_trace(tracing_env):
     names = sorted(os.path.basename(p) for p in
                    glob.glob(run_dirs[0] + "/*.txt"))
     assert names == ["1-strategy-plans.txt", "2-step-stablehlo.txt",
-                     "3-step-optimized-hlo.txt"]
+                     "3-step-optimized-hlo.txt", "4-placement.txt"]
     plans = open(run_dirs[0] + "/1-strategy-plans.txt").read()
     assert "decoder/layers_0/attn/query/kernel" in plans
     assert "stablehlo" in open(run_dirs[0] + "/2-step-stablehlo.txt").read()
+    placement = open(run_dirs[0] + "/4-placement.txt").read()
+    assert "decoder/layers_0/attn/query/kernel" in placement
+    assert "spec=" in placement and "8xcpu" in placement
 
     # Profiler trace captured the first 2 steps and closed cleanly.
     trace_files = glob.glob(str(tracing_env / "traces" / "**" / "*"),
                             recursive=True)
     assert any(os.path.isfile(f) for f in trace_files)
+
+
+def test_ascii_device_grid_shows_shard_ranges():
+    """Direct visualization-util check: a data-sharded array renders one
+    row per shard with its index range and device."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from autodist_tpu.utils.visualization import (ascii_device_grid,
+                                                  sharding_table)
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(8), ("data",))
+    x = jax.device_put(np.arange(32.0).reshape(16, 2),
+                       NamedSharding(mesh, P("data")))
+    grid = ascii_device_grid(x)
+    assert grid.count("->") == 8
+    assert "[0:2, 0:end]" in grid or "[0:2, :]" in grid.replace("0:end", ":")
+    table = sharding_table({"v": x})
+    assert "PartitionSpec('data'" in table and "(2, 2)" in table
 
 
 def test_tracing_off_writes_nothing(tmp_path, monkeypatch):
