@@ -210,6 +210,13 @@ class AutoDist:
         self._session = DistributedSession(self._graph_item, dist_step)
         logging.info("distributed session created: strategy=%s mesh=%s",
                      self._strategy.id, dict(mesh.shape))
+        try:
+            from autodist_tpu.strategy.cost_model import estimate_cost
+            logging.info("estimated sync cost: %s", estimate_cost(
+                self._strategy, self._graph_item,
+                self._resource_spec).summary())
+        except Exception:  # pragma: no cover - advisory only
+            pass
         return self._session
 
     # -- TF2-style one-liner (reference autodist.py:204-289) ---------------

@@ -17,6 +17,11 @@ from autodist_tpu.strategy.compiler import (
     VarPlan,
     parse_partitioner,
 )
+from autodist_tpu.strategy.cost_model import (
+    CostReport,
+    estimate_cost,
+    rank_strategies,
+)
 from autodist_tpu.strategy.parallax_strategy import Parallax
 from autodist_tpu.strategy.partitioned_all_reduce_strategy import PartitionedAR
 from autodist_tpu.strategy.partitioned_ps_strategy import PartitionedPS
@@ -29,9 +34,9 @@ from autodist_tpu.strategy.uneven_partition_ps_strategy import UnevenPartitioned
 
 __all__ = [
     "AllReduce", "AllReduceSynchronizerConfig", "AutoStrategy",
-    "CompiledStrategy",
+    "CompiledStrategy", "CostReport",
     "GraphConfig", "PS", "PSLoadBalancing", "PSSynchronizerConfig", "Parallax",
     "PartitionedAR", "PartitionedPS", "RandomAxisPartitionAR", "Strategy",
     "StrategyBuilder", "StrategyCompiler", "UnevenPartitionedPS", "VarConfig",
-    "VarPlan", "parse_partitioner",
+    "VarPlan", "estimate_cost", "parse_partitioner", "rank_strategies",
 ]
