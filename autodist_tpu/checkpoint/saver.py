@@ -45,9 +45,24 @@ class Saver:
     save/restore is also allowed.
     """
 
-    def __init__(self, session=None):
+    def __init__(self, session=None, async_save: bool = False):
+        """``async_save=True`` overlaps checkpoint persistence with
+        training: the device→host snapshot is synchronous (so saved values
+        are consistent even though the training loop immediately
+        donates/overwrites the live buffers) while ALL items persist in
+        one background commit.  ``wait()`` — or the next save/restore
+        through this Saver — blocks until the previous save is durable.
+
+        Every checkpoint is ONE composite Orbax save (params + opt_state
+        [+ sync_state] + meta), committed atomically: a crash mid-save
+        leaves no half-checkpoint for :meth:`latest_step` to pick up."""
         self._session = session
-        self._ckptr = ocp.StandardCheckpointer()
+        self._async = async_save
+        self._ckptr = ocp.AsyncCheckpointer(ocp.CompositeCheckpointHandler())
+
+    def wait(self) -> None:
+        """Block until any in-flight async save is durable on disk."""
+        self._ckptr.wait_until_finished()
 
     # -- helpers -----------------------------------------------------------
     @staticmethod
@@ -58,8 +73,18 @@ class Saver:
     def latest_step(directory: str) -> Optional[int]:
         if not os.path.isdir(directory):
             return None
-        steps = [int(m.group(1)) for name in os.listdir(directory)
-                 if (m := _STEP_RE.match(name))]
+        steps = []
+        for name in os.listdir(directory):
+            m = _STEP_RE.match(name)
+            if not m:
+                continue
+            # Only committed checkpoints count: the whole composite save
+            # (params + opt_state + meta) lands in one atomic Orbax
+            # commit, so an interrupted async save leaves step_N without
+            # the final `params` item — resume falls back to the previous
+            # complete step.
+            if os.path.isdir(os.path.join(directory, name, "params")):
+                steps.append(int(m.group(1)))
         return max(steps) if steps else None
 
     @staticmethod
@@ -67,33 +92,35 @@ class Saver:
         step = Saver.latest_step(directory)
         return None if step is None else Saver._step_dir(directory, step)
 
-    def _save_item(self, path: str, item: Any) -> None:
-        self._ckptr.save(os.path.abspath(path), item, force=True)
-        self._ckptr.wait_until_finished()
-
     # -- save --------------------------------------------------------------
     def save(self, directory: str, step: Optional[int] = None,
              session=None) -> str:
         session = session or self._session
         if session is None:
             raise ValueError("Saver has no bound session")
+        self._ckptr.wait_until_finished()   # one async save in flight max
         step = session.step_count if step is None else step
         path = self._step_dir(directory, step)
-        os.makedirs(path, exist_ok=True)
         # LOGICAL layout (pad-to-divisible sharding stripped): checkpoints
         # stay interchangeable with single-device programs and across
         # mesh topologies regardless of physical padding.
         params_item, opt_item = session.export_state()
-        self._save_item(os.path.join(path, "params"), params_item)
-        self._save_item(os.path.join(path, "opt_state"), opt_item)
         has_sync = bool(jax.tree_util.tree_leaves(session.sync_state))
+        items = dict(
+            params=ocp.args.StandardSave(params_item),
+            opt_state=ocp.args.StandardSave(opt_item),
+            autodist_meta=ocp.args.JsonSave(
+                {"step": step, "has_sync_state": has_sync}),
+        )
         if has_sync:
-            self._save_item(os.path.join(path, "sync_state"),
-                            session.sync_state)
-        with open(os.path.join(path, "autodist_meta.json"), "w",
-                  encoding="utf-8") as f:
-            json.dump({"step": step, "has_sync_state": has_sync}, f)
-        logging.info("checkpoint saved: %s (step %d)", path, step)
+            items["sync_state"] = ocp.args.StandardSave(session.sync_state)
+        self._ckptr.save(os.path.abspath(path),
+                         args=ocp.args.Composite(**items), force=True)
+        if not self._async:
+            self._ckptr.wait_until_finished()
+        logging.info("checkpoint %s: %s (step %d)",
+                     "saving in background" if self._async else "saved",
+                     path, step)
         return path
 
     # -- restore -----------------------------------------------------------
@@ -103,13 +130,19 @@ class Saver:
         session = session or self._session
         if session is None:
             raise ValueError("Saver has no bound session")
+        self._ckptr.wait_until_finished()   # don't read an in-flight save
         path = os.path.abspath(path)
         params_target, opt_target = session.restore_targets()
-        params = self._ckptr.restore(os.path.join(path, "params"),
-                                     params_target)
-        opt_state = self._ckptr.restore(os.path.join(path, "opt_state"),
-                                        opt_target)
-        meta = _read_meta(path)
+        restored = self._ckptr.restore(path, args=ocp.args.Composite(
+            params=ocp.args.StandardRestore(params_target),
+            opt_state=ocp.args.StandardRestore(opt_target)))
+        params, opt_state = restored["params"], restored["opt_state"]
+        try:
+            meta = self._ckptr.restore(path, args=ocp.args.Composite(
+                autodist_meta=ocp.args.JsonRestore()))["autodist_meta"]
+        except Exception:
+            meta = None   # pre-composite checkpoint: meta is a plain file
+        meta = meta or _read_meta(path)
         sync_state = None
         if meta.get("has_sync_state") and \
                 jax.tree_util.tree_leaves(session.sync_state):
@@ -121,8 +154,9 @@ class Saver:
             # failing the params/opt restore that IS topology-portable.
             try:
                 sync_state = self._ckptr.restore(
-                    os.path.join(path, "sync_state"),
-                    _abstract_like(session.sync_state))
+                    path, args=ocp.args.Composite(
+                        sync_state=ocp.args.StandardRestore(
+                            _abstract_like(session.sync_state))))["sync_state"]
             except Exception as e:
                 logging.warning(
                     "sync_state in %s does not match this session's layout "

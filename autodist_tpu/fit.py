@@ -118,7 +118,8 @@ def fit(session, data: DataArg, epochs: int = 1,
         steps_per_epoch: Optional[int] = None,
         callbacks: Sequence[Callback] = (), log_every: int = 0,
         checkpoint_dir: Optional[str] = None, checkpoint_every: int = 1,
-        resume: bool = True, prefetch_depth: int = 2) -> History:
+        resume: bool = True, async_checkpoints: bool = False,
+        prefetch_depth: int = 2) -> History:
     """Train ``epochs`` × (``steps_per_epoch`` or len(data)) steps.
 
     Args:
@@ -134,6 +135,10 @@ def fit(session, data: DataArg, epochs: int = 1,
         ``checkpoint_every`` epochs, and — with ``resume`` — restore the
         latest checkpoint before training (exact resume: optimizer slots
         and sync state included, step counter advanced).
+      async_checkpoints: persist checkpoint files in the background of
+        training (the device→host snapshot stays synchronous, so saved
+        values are consistent); ``fit`` waits for the last save to be
+        durable before returning.
       prefetch_depth: host→device transfers kept in flight ahead of
         compute (see ``DistributedSession.prefetch``).
 
@@ -143,7 +148,7 @@ def fit(session, data: DataArg, epochs: int = 1,
     if checkpoint_dir is not None:
         from autodist_tpu.checkpoint import Saver
 
-        saver = Saver(session)
+        saver = Saver(session, async_save=async_checkpoints)
         if resume:
             latest = Saver.latest_checkpoint(checkpoint_dir)
             if latest is not None:
@@ -227,6 +232,8 @@ def fit(session, data: DataArg, epochs: int = 1,
             and last_saved_step != session.step_count):
         # Never lose the tail epochs to the checkpoint_every stride.
         saver.save(checkpoint_dir, step=session.step_count)
+    if saver is not None:
+        saver.wait()   # async saves must be durable before fit returns
 
     for cb in callbacks:
         cb.on_train_end(hist)

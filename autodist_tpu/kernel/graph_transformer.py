@@ -136,6 +136,56 @@ class DistributedStep:
         return jax.tree_util.tree_map(
             put, batch, self.compiled_strategy.batch_shardings(batch))
 
+    def place_local_batch(self, local_batch):
+        """Assemble a GLOBAL batch from this process's LOCAL shard.
+
+        ``place_batch`` requires every process to hold the identical global
+        batch (the reference's feed model — the same feed_dict re-split by
+        the Remapper, remapper.py:81-123).  Multi-host input pipelines
+        instead read disjoint shards per host; this is the
+        ``jax.make_array_from_process_local_data`` path: each process
+        passes its local rows and the result is one global array whose
+        leading dim is the concatenation over the data axis.  Scalars and
+        already-placed leaves pass through."""
+        import numpy as np
+
+        # Sharding decisions (data-axis divisibility, seq-dim detection)
+        # must see the GLOBAL shapes: leading dims are per-process here,
+        # so scale them by process_count before consulting the strategy.
+        pcount = jax.process_count()
+
+        def global_like(x):
+            shape = np.shape(x)
+            if isinstance(x, jax.Array) or len(shape) == 0:
+                return x
+            return jax.ShapeDtypeStruct((shape[0] * pcount,) + shape[1:],
+                                        np.asarray(x).dtype)
+
+        shardings = self.compiled_strategy.batch_shardings(
+            jax.tree_util.tree_map(global_like, local_batch))
+
+        def put(x, sh):
+            if isinstance(x, jax.Array):
+                return x                      # already placed
+            x = np.asarray(x)
+            if x.ndim == 0:
+                return jax.device_put(x, sh)  # scalars replicate
+            if pcount > 1 and sh.spec == jax.sharding.PartitionSpec():
+                # A replicated layout would stamp each process's DIFFERENT
+                # local rows as "the same" global array — silent
+                # cross-process divergence.  Replicated feeds must go
+                # through place_batch with identical global data.
+                raise ValueError(
+                    "place_local_batch: this leaf lowers to a replicated "
+                    f"layout (global shape {(x.shape[0] * pcount,) + x.shape[1:]} "
+                    "does not shard on the data axis); feed it identically "
+                    "on every process via place_batch instead")
+            if not x.flags.owndata:
+                x = np.array(x, copy=True)  # same DMA-lifetime rule as above
+            return jax.make_array_from_process_local_data(sh, x)
+
+        return jax.tree_util.tree_map(put, local_batch, shardings)
+
 
 class GraphTransformer:
     """Builds a :class:`DistributedStep` from strategy + program."""

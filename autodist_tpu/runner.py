@@ -106,6 +106,13 @@ class DistributedSession:
         is a no-op)."""
         return self._step.place_batch(batch)
 
+    def place_local_batch(self, local_batch: Any) -> Any:
+        """Assemble a global batch from this PROCESS-LOCAL shard (each host
+        reads disjoint rows; leading dims concatenate over the data axis) —
+        the multi-host input-pipeline path.  See
+        :meth:`DistributedStep.place_local_batch`."""
+        return self._step.place_local_batch(local_batch)
+
     def run(self, batch: Any, sync: bool = True) -> Dict[str, Any]:
         """Run one training step on a global batch.
 

@@ -100,6 +100,18 @@ def main():
 
     batch = make_batch()
     losses = [float(sess.run(batch)["loss"]) for _ in range(STEPS)]
+    final_w = np.asarray(sess.params["w"]).tolist()  # before the extra step
+
+    # Multi-host input path: each process feeds only ITS half of the global
+    # batch (disjoint rows) through place_local_batch — the
+    # make_array_from_process_local_data translation of the reference's
+    # feed-splitting Remapper.  The resulting loss must equal evaluating
+    # the same global batch fed identically from every process.
+    pidx, pcount = jax.process_index(), jax.process_count()
+    rows = batch["x"].shape[0] // pcount
+    local = {k: v[pidx * rows:(pidx + 1) * rows] for k, v in batch.items()}
+    sharded_loss = float(sess.run(sess.place_local_batch(local),
+                                  sync=True)["loss"])
 
     result = {
         "role": "worker" if ENV.AUTODIST_WORKER.val else "chief",
@@ -110,7 +122,8 @@ def main():
         "mesh": dict(sess.mesh.shape),
         "strategy_id": ad._strategy.id,
         "losses": losses,
-        "final_w": np.asarray(sess.params["w"]).tolist(),
+        "sharded_input_loss": sharded_loss,
+        "final_w": final_w,
     }
     out = os.environ["AUTODIST_RESULT_FILE"]
     if ENV.AUTODIST_WORKER.val:

@@ -64,6 +64,15 @@ def test_imagenet_benchmark():
                   "--batch-size", "8", "--steps", "2", "--warmup", "1"))
 
 
+def test_input_pipeline(tmp_path):
+    out = _run_example("examples/input_pipeline.py",
+                       ("--epochs", "2", "--rows", "512",
+                        "--batch-size", "32",
+                        "--checkpoint-dir", str(tmp_path / "ck")))
+    assert "final loss" in out
+    assert (tmp_path / "ck").is_dir()
+
+
 @pytest.mark.integration
 def test_imagenet_benchmark_fit_epochs():
     out = _run_example("examples/benchmark/imagenet.py",

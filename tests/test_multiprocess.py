@@ -110,6 +110,15 @@ def test_two_process_training_parity(tmp_path, builder):
     np.testing.assert_allclose(chief["losses"], ref_losses, rtol=1e-4)
     np.testing.assert_allclose(chief["final_w"], ref_w, rtol=1e-4)
 
+    # Multi-host input path: each process fed only its DISJOINT half of
+    # the batch via place_local_batch; the resulting global step must
+    # match the closed-form 5th step on the full batch.
+    ref5, _ = _reference_losses(steps=5)
+    np.testing.assert_allclose(chief["sharded_input_loss"], ref5[4],
+                               rtol=1e-4)
+    np.testing.assert_allclose(worker["sharded_input_loss"], ref5[4],
+                               rtol=1e-4)
+
     assert "jax.distributed initialized" in out
 
 
