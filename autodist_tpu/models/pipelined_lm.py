@@ -59,7 +59,8 @@ def pipelined_transformer_lm(
         dtype=jnp.float32, seq_len: Optional[int] = None,
         num_stages: Optional[int] = None,
         num_microbatches: Optional[int] = None,
-        num_virtual_stages: int = 1) -> ModelSpec:
+        num_virtual_stages: int = 1, remat: bool = False
+        ) -> ModelSpec:
     """Stage-stacked GPT-style LM pipelined over ``mesh``'s ``pipe`` axis.
 
     ``num_virtual_stages > 1`` selects the interleaved schedule: each device
@@ -106,7 +107,8 @@ def pipelined_transformer_lm(
             params["stack"])
         x = pipeline_apply(stage_fn, stacked, x, mesh,
                            num_microbatches=num_microbatches,
-                           num_virtual_stages=num_virtual_stages)
+                           num_virtual_stages=num_virtual_stages,
+                           remat=remat)
         x = _layer_norm(x, params["ln_final"]["scale"])
         return jnp.einsum("btd,vd->btv", x, params["embed"])
 

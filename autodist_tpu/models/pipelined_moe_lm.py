@@ -40,7 +40,8 @@ def pipelined_moe_transformer_lm(
         aux_weight: float = 1e-2, dtype=jnp.float32,
         seq_len: Optional[int] = None, num_stages: Optional[int] = None,
         num_microbatches: Optional[int] = None,
-        num_virtual_stages: int = 1) -> ModelSpec:
+        num_virtual_stages: int = 1, remat: bool = False
+        ) -> ModelSpec:
     seq_len = seq_len or max_len
     d_model = num_heads * head_dim
     stages = num_stages or mesh.shape.get("pipe", 1) or 1
@@ -90,7 +91,8 @@ def pipelined_moe_transformer_lm(
         xa = jnp.concatenate([x, jnp.zeros_like(x[..., :1])], axis=-1)
         xa = pipeline_apply(stage_fn, stacked, xa, mesh,
                             num_microbatches=num_microbatches,
-                            num_virtual_stages=num_virtual_stages)
+                            num_virtual_stages=num_virtual_stages,
+                            remat=remat)
         x, aux = xa[..., :-1], jnp.mean(xa[..., -1])
         x = _layer_norm(x, params["ln_final"])
         logits = jnp.einsum("btd,vd->btv", x, params["embed"])

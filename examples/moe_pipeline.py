@@ -30,6 +30,9 @@ def main():
     p.add_argument("--experts", type=int, default=4)
     p.add_argument("--num-layers", type=int, default=4)
     p.add_argument("--seq-len", type=int, default=128)
+    p.add_argument("--remat", action="store_true",
+                   help="rematerialize stage internals in backward "
+                        "(cuts stashed activation memory)")
     args = p.parse_args()
 
     axes = {"pipe": args.pipe, "expert": 2, "data": 2}
@@ -37,7 +40,7 @@ def main():
     spec = pipelined_moe_transformer_lm(
         mesh, vocab_size=2048, num_layers=args.num_layers, num_heads=4,
         head_dim=32, d_ff=512, num_experts=args.experts,
-        max_len=args.seq_len, seq_len=args.seq_len)
+        max_len=args.seq_len, seq_len=args.seq_len, remat=args.remat)
     params = spec.init(jax.random.PRNGKey(0))
 
     ad = make_autodist(args, mesh_axes=axes)

@@ -258,3 +258,96 @@ def test_pipeline_ps_partitioner_no_duplicate_data_axis():
     NamedSharding(mesh, plan.param_spec)
     NamedSharding(mesh, plan.opt_spec)
     assert plan.param_spec[0] == "pipe"
+
+
+def test_remat_matches_values_and_gradients():
+    """remat=True recomputes stage internals in backward — values and
+    gradients stay bit-identical to the non-remat schedule."""
+    mesh = build_mesh({"pipe": S, "data": 1})
+    rng = np.random.default_rng(7)
+    stages, stacked, x = _make(rng)
+
+    def loss(stacked_p, x, remat):
+        y = pipeline_apply(_stage_fn, stacked_p, x, mesh, remat=remat)
+        return jnp.sum(y ** 2)
+
+    v0, g0 = jax.value_and_grad(lambda p: loss(p, x, False))(stacked)
+    v1, g1 = jax.value_and_grad(lambda p: loss(p, x, True))(stacked)
+    np.testing.assert_allclose(v0, v1, rtol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5), g0, g1)
+
+
+def test_remat_reduces_stashed_activation_memory():
+    """The point of remat: the differentiated schedule stashes fewer
+    residual bytes.  Compare XLA's temp-buffer sizes for a taller stage
+    (several matmuls) — remat must not be larger, and the grad still
+    matches."""
+    mesh = build_mesh({"pipe": S, "data": 1})
+    rng = np.random.default_rng(8)
+
+    def tall_stage(params, x):
+        for i in range(4):
+            x = jnp.tanh(x @ params[f"w{i}"])
+        return x
+
+    stages = [{f"w{i}": jnp.asarray(rng.standard_normal((D, D)) * 0.3,
+                                    jnp.float32) for i in range(4)}
+              for _ in range(S)]
+    stacked = stack_stage_params(stages)
+    x = jnp.asarray(rng.standard_normal((32, D)), jnp.float32)
+
+    def make_grad(remat):
+        def loss(p, x):
+            return jnp.sum(pipeline_apply(tall_stage, p, x, mesh,
+                                          num_microbatches=8,
+                                          remat=remat) ** 2)
+        return jax.jit(jax.grad(loss))
+
+    g_plain = make_grad(False)
+    g_remat = make_grad(True)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5),
+        g_plain(stacked, x), g_remat(stacked, x))
+
+    def temp_bytes(fn):
+        mem = fn.lower(stacked, x).compile().memory_analysis()
+        assert mem is not None and hasattr(mem, "temp_size_in_bytes"), \
+            "memory_analysis unavailable — the regression guard below " \
+            "would be vacuous"
+        return mem.temp_size_in_bytes
+
+    plain, remat = temp_bytes(g_plain), temp_bytes(g_remat)
+    # Strict: losing the jax.checkpoint wrap in a refactor keeps values
+    # and gradients identical, so THIS inequality is the feature's only
+    # guard (measured ~29% cut for this program: 58,208 vs 81,632 bytes).
+    assert remat < plain, (remat, plain)
+
+
+def test_pipelined_lm_remat_trains():
+    """remat threads through the pipelined LM spec and trains."""
+    import optax
+
+    from autodist_tpu.models.pipelined_lm import pipelined_transformer_lm
+
+    mesh = build_mesh({"pipe": 4, "data": 2})
+    spec = pipelined_transformer_lm(
+        mesh, vocab_size=64, num_layers=4, num_heads=2, head_dim=8,
+        d_ff=32, max_len=16, seq_len=16, remat=True)
+    params = spec.init(jax.random.PRNGKey(0))
+    batch = spec.sample_batch(8)
+    opt = optax.sgd(0.1)
+    state = opt.init(params)
+
+    @jax.jit
+    def step(p, s, b):
+        loss, g = jax.value_and_grad(spec.loss_fn)(p, b)
+        up, s = opt.update(g, s, p)
+        return optax.apply_updates(p, up), s, loss
+
+    p, s = params, state
+    losses = []
+    for _ in range(3):
+        p, s, l = step(p, s, batch)
+        losses.append(float(l))
+    assert losses[-1] < losses[0]
