@@ -123,6 +123,7 @@ class ResourceSpec:
         self._nodes: List[NodeSpec] = []
         self._ssh_configs: Dict[str, SSHConfig] = {}
         self.network_bandwidth_gbps: float = 1.0
+        self.ici_connected: bool = False
         self.mesh_hint: Dict[str, int] = {}
         # Remembered so the Coordinator can ship the spec file to workers
         # (the reference relied on shared paths; we copy explicitly).
@@ -180,6 +181,11 @@ class ResourceSpec:
                 env={str(k): str(v) for k, v in (raw.get("shared_envs") or {}).items()},
             )
         self.network_bandwidth_gbps = float(info.get("network_bandwidth", 1.0))
+        # TPU pod slice: hosts are ICI-connected (one interconnect domain),
+        # so cross-host collectives do NOT drop to NIC/DCN bandwidth — the
+        # defining difference from the reference's GPU clusters.  Yaml key:
+        # `ici_connected: true`.
+        self.ici_connected = bool(info.get("ici_connected", False))
         self.mesh_hint = {str(k): int(v) for k, v in (info.get("mesh") or {}).items()}
         # Reference behavior: exactly-one-chief check, defaulting the single
         # node to chief (resource_spec.py:120-150).

@@ -24,8 +24,11 @@ Model (standard ring-collective algebra, cf. the scaling-book recipe):
   ``parallax_strategy.py:24-71``);
 * each collective pays a launch latency ``alpha``; grouped AllReduce
   variables share one launch (the reference's chunking rationale);
-* bandwidth: ICI within one node, the yaml's ``network_bandwidth`` (DCN)
-  as the bottleneck when replicas span nodes.
+* bandwidth: ICI within one host — and across hosts on a TPU pod slice
+  (``ici_connected: true`` in the yaml: one interconnect domain); the
+  yaml's ``network_bandwidth`` (NIC/DCN) is the bottleneck only for
+  multi-node clusters WITHOUT that flag (the reference's GPU world, or
+  multi-slice TPU).
 
 Byte counts are exact given the hints; times are order-of-magnitude
 estimates for *ranking*, not predictions of wall clock.
@@ -116,7 +119,12 @@ def estimate_cost(strategy: Strategy, graph_item: GraphItem,
     """
     d = max(resource_spec.num_chips, 1)
     ring = _ring_factor(d)
-    multi_node = resource_spec.num_nodes > 1
+    # Bandwidth clock: ICI within one host — and ACROSS hosts on a TPU pod
+    # slice (`ici_connected: true`, one interconnect domain); only
+    # NIC/DCN-connected multi-node clusters (the reference's GPU world, or
+    # multi-slice TPU) drop to the yaml's network_bandwidth.
+    multi_node = (resource_spec.num_nodes > 1
+                  and not resource_spec.ici_connected)
     dcn = resource_spec.network_bandwidth_gbps * 1e9 / 8
     bandwidth = min(ici_bandwidth, dcn) if multi_node else ici_bandwidth
 

@@ -134,6 +134,10 @@ def run_child(platform: str) -> None:
     # cost-analysis recompile so a hang there can't lose the metric; the
     # parent takes the LAST valid JSON line.
     _fill_mfu(result, dev, on_tpu, dt, sess, batch)
+    if on_tpu:
+        # TPU-only like the other enrichments: a projection built on a
+        # CPU-fallback step time would be a fabricated pod number.
+        _fill_scaling_projection(result, sess)
     print(json.dumps(result), flush=True)
     if on_tpu:
         # Each enrichment prints the running result line when done, so a
@@ -221,6 +225,38 @@ def _fill_lm(result):
         print(f"bench: LM secondary metric unavailable ({e!r})",
               file=sys.stderr, flush=True)
         return None
+
+
+def _fill_scaling_projection(result, sess) -> None:
+    """Model-based multi-chip scaling projection (clearly labeled as a
+    projection — one chip is all this environment can attach).  Uses the
+    analytic cost model (strategy/cost_model.py) on a hypothetical
+    64-chip v5e pod: projected efficiency = t_compute / (t_compute +
+    t_sync) with the MEASURED single-chip step time as t_compute and the
+    ring-allreduce wire estimate as unoverlapped worst-case t_sync.  XLA
+    overlaps collectives with backward compute, so the true number lands
+    between this floor and 1.0; BASELINE.json's north star is >=90%."""
+    try:
+        from autodist_tpu.resource_spec import ResourceSpec
+        from autodist_tpu.strategy.cost_model import estimate_cost
+
+        spec64 = ResourceSpec(resource_info={
+            "nodes": [{"address": f"10.0.0.{i}", "chips": 4,
+                       **({"chief": True} if i == 0 else {})}
+                      for i in range(16)],
+            "ici_connected": True,    # one v5e-64 pod slice: ICI domain
+            "network_bandwidth": 200})
+        gi = sess._gi
+        report = estimate_cost(sess._step.compiled_strategy.strategy, gi,
+                               spec64)
+        t_compute = result["step_time_ms"] / 1e3
+        eff = t_compute / (t_compute + report.time_s)
+        result["projected_scaling_efficiency_64chip"] = round(eff, 4)
+        result["projected_sync_ms_64chip"] = round(report.time_s * 1e3, 3)
+        result["scaling_projection_basis"] = "analytic-cost-model"
+    except Exception as e:  # pragma: no cover - advisory only
+        print(f"bench: scaling projection unavailable ({e!r})",
+              file=sys.stderr, flush=True)
 
 
 def _measure_session(sess, placed_batch, warmup: int, steps: int) -> float:

@@ -91,6 +91,26 @@ def test_ps_shards_optimizer_state(spec8):
     assert ps.opt_state_bytes < ar.opt_state_bytes
 
 
+def test_ici_connected_pod_keeps_ici_bandwidth(spec8):
+    """A TPU pod slice spans hosts on ONE interconnect domain
+    (`ici_connected: true`): cross-host collectives must not be clocked
+    at NIC/DCN bandwidth like the reference's GPU clusters."""
+    gi = make_gi()
+    pod = ResourceSpec(resource_info={
+        "nodes": [{"address": "a", "chips": 4, "chief": True},
+                  {"address": "b", "chips": 4}],
+        "ici_connected": True, "network_bandwidth": 1})
+    nic = ResourceSpec(resource_info={
+        "nodes": [{"address": "a", "chips": 4, "chief": True},
+                  {"address": "b", "chips": 4}],
+        "network_bandwidth": 1})
+    t_pod = estimate_cost(AllReduce().build(gi, pod), gi, pod).time_s
+    t_nic = estimate_cost(AllReduce().build(gi, nic), gi, nic).time_s
+    t_one = estimate_cost(AllReduce().build(gi, spec8), gi, spec8).time_s
+    assert t_pod == pytest.approx(t_one)     # same ring volume, ICI clock
+    assert t_nic > 10 * t_pod                # 1 Gbps NIC vs ICI
+
+
 def test_dcn_bottleneck_slows_multinode(spec8, spec2x4):
     gi = make_gi()
     strat = AllReduce().build(gi, spec8)
