@@ -16,7 +16,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from autodist_tpu.models.base import ModelSpec, cross_entropy_loss
+from autodist_tpu.models.base import ModelSpec
+from autodist_tpu.ops.chunked_xent import chunked_softmax_cross_entropy
 
 
 class LSTMLM(nn.Module):
@@ -25,25 +26,34 @@ class LSTMLM(nn.Module):
     hidden_dim: int
     num_layers: int
 
-    @nn.compact
-    def __call__(self, tokens):
-        emb = self.param("embedding", nn.initializers.normal(0.1),
-                         (self.vocab_size, self.emb_dim))
-        x = jnp.take(emb, tokens, axis=0)  # [B, T, E]
+    def setup(self):
+        init = nn.initializers.normal(0.1)
+        self.embedding = self.param("embedding", init,
+                                    (self.vocab_size, self.emb_dim))
+        self.softmax_embedding = self.param("softmax_embedding", init,
+                                            (self.vocab_size, self.emb_dim))
         for i in range(self.num_layers):
-            x = nn.RNN(nn.OptimizedLSTMCell(self.hidden_dim),
-                       name=f"lstm_{i}")(x)
-        # project to softmax dim and tie with an output embedding
-        x = nn.Dense(self.emb_dim, name="proj")(x)
-        softmax_emb = self.param("softmax_embedding",
-                                 nn.initializers.normal(0.1),
-                                 (self.vocab_size, self.emb_dim))
-        return jnp.einsum("bte,ve->btv", x, softmax_emb)
+            setattr(self, f"lstm_{i}",
+                    nn.RNN(nn.OptimizedLSTMCell(self.hidden_dim)))
+        self.proj = nn.Dense(self.emb_dim)
+
+    def features(self, tokens):
+        """Pre-softmax activations ``[B, T, E]`` — the training loss pairs
+        these with the softmax table through the chunked cross entropy so
+        the ``[B, T, vocab]`` logits never materialize."""
+        x = jnp.take(self.embedding, tokens, axis=0)  # [B, T, E]
+        for i in range(self.num_layers):
+            x = getattr(self, f"lstm_{i}")(x)
+        return self.proj(x)
+
+    def __call__(self, tokens):
+        return jnp.einsum("bte,ve->btv", self.features(tokens),
+                          self.softmax_embedding)
 
 
 def lm1b(vocab_size: int = 793472, emb_dim: int = 512,
          hidden_dim: int = 2048, num_layers: int = 2,
-         seq_len: int = 20) -> ModelSpec:
+         seq_len: int = 20, xent_chunk: int = 8192) -> ModelSpec:
     model = LSTMLM(vocab_size, emb_dim, hidden_dim, num_layers)
 
     def init(rng):
@@ -53,8 +63,14 @@ def lm1b(vocab_size: int = 793472, emb_dim: int = 512,
         return model.apply({"params": params}, tokens)
 
     def loss_fn(params, batch):
-        logits = apply_fn(params, batch["tokens"])
-        return cross_entropy_loss(logits[:, :-1], batch["tokens"][:, 1:])
+        # Chunked-vocab loss: the [B, T, 793k] logits (16 GB at batch 256)
+        # never materialize — unlike the reference, which resorted to a
+        # SAMPLED softmax for this model, this is the exact loss.
+        feats = model.apply({"params": params}, batch["tokens"],
+                            method=LSTMLM.features)
+        return chunked_softmax_cross_entropy(
+            feats[:, :-1], params["softmax_embedding"],
+            batch["tokens"][:, 1:], chunk=xent_chunk)
 
     def make_batch(rng: np.random.RandomState, batch_size: int):
         return {"tokens": rng.randint(

@@ -5,6 +5,9 @@ fusion cannot produce (blockwise attention with online softmax) live here as
 Pallas kernels.  Everything degrades gracefully off-TPU via interpret mode so
 the CPU test mesh exercises the same code path.
 """
+from autodist_tpu.ops.chunked_xent import (  # noqa: F401
+    chunked_softmax_cross_entropy,
+)
 from autodist_tpu.ops.flash_attention import (  # noqa: F401
     flash_attention,
     make_flash_attention,

@@ -119,10 +119,8 @@ def estimate_cost(strategy: Strategy, graph_item: GraphItem,
     """
     d = max(resource_spec.num_chips, 1)
     ring = _ring_factor(d)
-    # Bandwidth clock: ICI within one host — and ACROSS hosts on a TPU pod
-    # slice (`ici_connected: true`, one interconnect domain); only
-    # NIC/DCN-connected multi-node clusters (the reference's GPU world, or
-    # multi-slice TPU) drop to the yaml's network_bandwidth.
+    # Bandwidth clock per the module docstring; `ici_connected` semantics
+    # are defined at ResourceSpec._parse.
     multi_node = (resource_spec.num_nodes > 1
                   and not resource_spec.ici_connected)
     dcn = resource_spec.network_bandwidth_gbps * 1e9 / 8
