@@ -116,6 +116,8 @@ def _epoch_iter(data: DataArg, steps_per_epoch: Optional[int]):
 
 def fit(session, data: DataArg, epochs: int = 1,
         steps_per_epoch: Optional[int] = None,
+        validation_data: Optional[DataArg] = None,
+        validation_steps: Optional[int] = None,
         callbacks: Sequence[Callback] = (), log_every: int = 0,
         checkpoint_dir: Optional[str] = None, checkpoint_every: int = 1,
         resume: bool = True, async_checkpoints: bool = False,
@@ -126,6 +128,12 @@ def fit(session, data: DataArg, epochs: int = 1,
       session: a :class:`~autodist_tpu.runner.DistributedSession`.
       data: per-epoch batches — iterable, generator factory, or one batch
         dict (see :func:`_epoch_iter`).
+      validation_data: same forms; when set, ``session.evaluate`` runs at
+        each epoch end (no parameter update), its mean loss lands in
+        ``history["val_loss"]`` and in the ``on_epoch_end`` logs as
+        ``val_loss`` (the Keras ``fit(validation_data=...)`` shape).
+      validation_steps: cap on validation batches per epoch (required for
+        a single-dict ``validation_data``).
       callbacks: :class:`Callback` objects.
       log_every: sync the loss to host (and log it) every N steps; 0 =
         only at epoch ends.  Small N serializes dispatch — keep ≥10 for
@@ -160,6 +168,15 @@ def fit(session, data: DataArg, epochs: int = 1,
         # One repeated batch: place it once — re-placing a placed batch is
         # a no-op, so the per-step host→device transfer disappears.
         data = session.place_batch(data)
+    if isinstance(validation_data, dict):
+        if not validation_steps:
+            # Fail BEFORE training an epoch, with the right argument name
+            # (the generic _epoch_iter error would only fire at epoch end
+            # and talk about steps_per_epoch).
+            raise ValueError(
+                "a single-batch validation_data dict requires "
+                "validation_steps")
+        validation_data = session.place_batch(validation_data)
 
     hist = History()
     for cb in callbacks:
@@ -222,6 +239,21 @@ def fit(session, data: DataArg, epochs: int = 1,
         hist.epochs_run += 1
         logs = {"loss": loss, "epoch_steps": epoch_steps,
                 "step": session.step_count}
+        if validation_data is not None:
+            val_it = _epoch_iter(validation_data, validation_steps)
+            if validation_steps:
+                val_it = itertools.islice(val_it, validation_steps)
+            val = session.evaluate(val_it)
+            if val is None:
+                logging.warning(
+                    "fit: validation_data yielded no batches at epoch %d "
+                    "— a one-shot generator is exhausted after the first "
+                    "epoch; pass a re-iterable or a generator factory",
+                    epoch)
+            else:
+                logs["val_loss"] = float(np.asarray(val["loss"]))
+                hist.history.setdefault("val_loss", []).append(
+                    logs["val_loss"])
         for cb in callbacks:
             cb.on_epoch_end(epoch, logs)
         if saver is not None and (epoch + 1) % checkpoint_every == 0:

@@ -58,6 +58,7 @@ class DistributedStep:
     opt_shardings: Any
     mesh: Any
     compiled_strategy: CompiledStrategy
+    eval_fn: Optional[Callable] = None  # (params, batch) -> metrics; no update
     pad_info: Any = None             # params-shaped info tree, or None
     opt_pad_info: Any = None         # opt-state-shaped info tree, or None
     logical_param_shardings: Any = None  # pad axis dropped; None = physical
@@ -336,6 +337,11 @@ class GraphTransformer:
             donate_argnums=(0, 1) if stale is None else (0, 1, 2),
             **jit_kwargs,
         )
+
+        # Same loss_fn as training (the pad-aware wrapper), so padded rows
+        # contribute nothing to evaluation.
+        eval_fn = jax.jit(_make_eval_step(loss_fn, has_aux),
+                          in_shardings=(param_sh, None))
         init_fn = jax.jit(gi.optimizer.init, out_shardings=opt_sh)
         if stale is None:
             def init_sync_state(current_params=None):
@@ -378,6 +384,7 @@ class GraphTransformer:
             init_sync_state=init_sync_state,
             param_shardings=param_sh, opt_shardings=opt_sh,
             mesh=mesh, compiled_strategy=self.compiled,
+            eval_fn=eval_fn,
             pad_info=pad_info, opt_pad_info=opt_pad_info,
             logical_param_shardings=logical_param_sh,
             logical_opt_shardings=logical_opt_sh)
@@ -424,13 +431,26 @@ class GraphTransformer:
             explicit_sync.make_explicit_step(gi, self.compiled, has_partitioned,
                                              extra_metrics_fn=extra_metrics_fn)
         param_sh = jax.tree_util.tree_map(lambda _: replicated, gi.params)
+        eval_fn = jax.jit(_make_eval_step(gi.loss_fn, gi.has_aux))
         logging.info(
             "GraphTransformer: compiled EXPLICIT step over mesh %s (%d vars)",
             dict(mesh.shape), len(self.compiled.var_plans))
         return DistributedStep(
             step_fn=step_fn, init_fn=init_fn, init_sync_state=init_sync,
             param_shardings=param_sh, opt_shardings=replicated,
-            mesh=mesh, compiled_strategy=self.compiled)
+            mesh=mesh, compiled_strategy=self.compiled, eval_fn=eval_fn)
+
+
+def _make_eval_step(loss_fn: Callable, has_aux: bool) -> Callable:
+    """Fetch-only metrics step (the reference's ``sess.run(loss)``): loss
+    on the current params, no state change."""
+    def eval_step(params, batch):
+        if has_aux:
+            loss, aux = loss_fn(params, batch)
+            return {"loss": loss, "aux": aux}
+        return {"loss": loss_fn(params, batch)}
+
+    return eval_step
 
 
 def _plan_summary(compiled: CompiledStrategy) -> Dict[str, int]:

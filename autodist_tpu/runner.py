@@ -158,6 +158,28 @@ class DistributedSession:
         except Exception as e:  # pragma: no cover - backend-dependent
             logging.warning("optimized-HLO dump unavailable: %r", e)
 
+    def evaluate(self, batches, sync: bool = True
+                 ) -> Optional[Dict[str, Any]]:
+        """Loss (and aux) on the CURRENT parameters with NO state change —
+        the reference's fetch-only ``sess.run(loss)``.  ``batches`` is one
+        batch dict or an iterable; an iterable returns the MEAN of every
+        metric over batches (each batch weighted equally, numeric aux
+        included).  Returns None for an empty iterable."""
+        if isinstance(batches, dict):
+            batches = [batches]
+        acc, n = None, 0
+        for b in batches:
+            out = self._step.eval_fn(self._params, self._step.place_batch(b))
+            acc = out if acc is None else jax.tree_util.tree_map(
+                lambda a, x: a + x, acc, out)
+            n += 1
+        if acc is None:
+            return None
+        acc = jax.tree_util.tree_map(lambda a: a / n, acc)
+        if not sync:
+            return acc
+        return jax.tree_util.tree_map(lambda x: np.asarray(x), acc)
+
     def run_many(self, batches) -> Dict[str, Any]:
         """Run a sequence of batches with async dispatch (no host round-trip
         per step); returns the last step's metrics on host."""

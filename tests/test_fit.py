@@ -210,3 +210,52 @@ def test_fit_requires_steps_for_batch_dict():
     sess, batches = _make_session()
     with pytest.raises(ValueError, match="steps_per_epoch"):
         sess.fit(batches(1)[0], epochs=1)
+
+
+def test_evaluate_no_state_change_and_matches():
+    """sess.evaluate computes the loss on current params without any
+    update; a second evaluate returns the identical value."""
+    sess, batches = _make_session()
+    data = batches(3)
+    sess.fit(data, epochs=1)
+    w_before = np.asarray(sess.params["w"]).copy()
+    e1 = float(sess.evaluate(data[0])["loss"])
+    e2 = float(sess.evaluate(data[0])["loss"])
+    assert e1 == e2
+    np.testing.assert_array_equal(np.asarray(sess.params["w"]), w_before)
+    assert sess.step_count == 3          # evaluate didn't count as steps
+    # mean over an iterable equals the mean of singles
+    singles = [float(sess.evaluate(b)["loss"]) for b in data]
+    np.testing.assert_allclose(float(sess.evaluate(data)["loss"]),
+                               np.mean(singles), rtol=1e-6)
+
+
+def test_fit_validation_data():
+    sess, batches = _make_session()
+    train, val = batches(4), batches(2)
+    logs_seen = []
+
+    class Val(Callback):
+        def on_epoch_end(self, epoch, logs):
+            logs_seen.append(logs.get("val_loss"))
+
+    hist = sess.fit(train, epochs=3, validation_data=val,
+                    callbacks=[Val()])
+    assert len(hist.history["val_loss"]) == 3
+    assert logs_seen == hist.history["val_loss"]
+    # training on a convex problem: val loss decreases across epochs
+    assert hist.history["val_loss"][-1] < hist.history["val_loss"][0]
+
+
+def test_fit_validation_dict_requires_steps_up_front():
+    sess, batches = _make_session()
+    with pytest.raises(ValueError, match="validation_steps"):
+        sess.fit(batches(2), epochs=2, validation_data=batches(1)[0])
+
+
+def test_fit_validation_exhausted_generator_warns_not_crashes():
+    sess, batches = _make_session()
+    hist = sess.fit(batches(2), epochs=3,
+                    validation_data=iter(batches(2)))
+    assert len(hist.history.get("val_loss", [])) == 1  # only epoch 0
+    assert hist.epochs_run == 3                        # training unaffected
