@@ -34,32 +34,43 @@ class TransformerLM(nn.Module):
     attn_fn: Callable = staticmethod(dense_attention)
     dtype: jnp.dtype = jnp.float32
 
-    @nn.compact
-    def __call__(self, tokens):
+    def setup(self):
         d_model = self.num_heads * self.head_dim
-        emb = self.param("embed", nn.initializers.normal(0.02),
-                         (self.vocab_size, d_model), self.dtype)
-        pos = self.param("pos_embed", nn.initializers.normal(0.02),
-                         (self.max_len, d_model), self.dtype)
-        x = jnp.take(emb, tokens, axis=0) + pos[None, :tokens.shape[1]]
-        x = TransformerStack(self.num_layers, self.num_heads, self.head_dim,
-                             self.d_ff, causal=True, attn_fn=self.attn_fn,
-                             name="decoder")(x)
+        self.embed = self.param("embed", nn.initializers.normal(0.02),
+                                (self.vocab_size, d_model), self.dtype)
+        self.pos_embed = self.param("pos_embed", nn.initializers.normal(0.02),
+                                    (self.max_len, d_model), self.dtype)
+        self.decoder = TransformerStack(
+            self.num_layers, self.num_heads, self.head_dim, self.d_ff,
+            causal=True, attn_fn=self.attn_fn)
+
+    def features(self, tokens):
+        """Pre-logits activations ``[B, T, D]`` — paired with the tied
+        embedding through the chunked cross entropy when the training
+        loss must not materialize ``[B, T, vocab]`` logits."""
+        x = (jnp.take(self.embed, tokens, axis=0)
+             + self.pos_embed[None, :tokens.shape[1]])
+        return self.decoder(x)
+
+    def __call__(self, tokens):
         # Tied output head: logits against the embedding table — keeps the
         # only vocab-sized variable the (sparse) embedding.
-        return jnp.einsum("btd,vd->btv", x, emb)
+        return jnp.einsum("btd,vd->btv", self.features(tokens), self.embed)
 
 
 def transformer_lm(vocab_size: int = 32128, num_layers: int = 12,
                    num_heads: int = 12, head_dim: int = 64,
                    d_ff: int = 3072, max_len: int = 1024,
                    attn_fn: Optional[Callable] = None,
-                   dtype=jnp.float32, seq_len: Optional[int] = None
-                   ) -> ModelSpec:
+                   dtype=jnp.float32, seq_len: Optional[int] = None,
+                   xent_chunk: Optional[int] = None) -> ModelSpec:
     """GPT-2-small-ish defaults; shrink for tests.
 
     ``attn_fn=None`` → backend default: the Pallas flash kernel on TPU,
-    dense softmax elsewhere (``models/transformer.py:default_attention``)."""
+    dense softmax elsewhere (``models/transformer.py:default_attention``).
+    ``xent_chunk`` → train with the chunked-vocab cross entropy
+    (``ops/chunked_xent.py``): the ``[B, T, vocab]`` logits never
+    materialize — worth ~2 GB of peak HBM at batch 16 × seq 2048."""
     from autodist_tpu.models.transformer import default_attention
 
     attn_fn = attn_fn or default_attention()
@@ -74,9 +85,20 @@ def transformer_lm(vocab_size: int = 32128, num_layers: int = 12,
     def apply_fn(params, tokens):
         return model.apply({"params": params}, tokens)
 
-    def loss_fn(params, batch):
-        logits = apply_fn(params, batch["tokens"])
-        return cross_entropy_loss(logits[:, :-1], batch["tokens"][:, 1:])
+    if xent_chunk:
+        from autodist_tpu.ops.chunked_xent import \
+            chunked_softmax_cross_entropy
+
+        def loss_fn(params, batch):
+            feats = model.apply({"params": params}, batch["tokens"],
+                                method=TransformerLM.features)
+            return chunked_softmax_cross_entropy(
+                feats[:, :-1], params["embed"], batch["tokens"][:, 1:],
+                chunk=xent_chunk)
+    else:
+        def loss_fn(params, batch):
+            logits = apply_fn(params, batch["tokens"])
+            return cross_entropy_loss(logits[:, :-1], batch["tokens"][:, 1:])
 
     def make_batch(rng: np.random.RandomState, batch_size: int):
         return {"tokens": rng.randint(
