@@ -69,6 +69,16 @@ def main():
                "PSLoadBalancing": PSLoadBalancing,
                "PartitionedPS": PartitionedPS}[
                    os.environ.get("AUTODIST_TEST_BUILDER", "AllReduce")]()
+    # Optional mesh override (e.g. "model=4"): with model as the ONLY
+    # axis it necessarily spans the two processes — cross-process tensor
+    # parallelism, beyond the reference's data-parallel-only multi-machine
+    # matrix.  (In "data=2,model=2" canonical ordering, data would be the
+    # process-spanning axis.)
+    mesh_axes = None
+    if os.environ.get("AUTODIST_TEST_MESH"):
+        mesh_axes = {k: int(v) for k, v in
+                     (kv.split("=") for kv in
+                      os.environ["AUTODIST_TEST_MESH"].split(","))}
     # Two "nodes", both local: the chief fans the script out with
     # subprocess+env exactly as it would over SSH to a remote host.
     spec = ResourceSpec(resource_info={
@@ -79,7 +89,8 @@ def main():
     # jax.distributed.initialize (see Cluster.start).
     params = {"w": np.zeros(3, np.float32), "b": np.zeros((), np.float32)}
 
-    ad = AutoDist(resource_spec=spec, strategy_builder=builder)
+    ad = AutoDist(resource_spec=spec, strategy_builder=builder,
+                  mesh_axes=mesh_axes)
     with ad.scope():
         ad.capture(params=params, optimizer=optax.sgd(LR), loss_fn=loss_fn)
 
@@ -108,10 +119,16 @@ def main():
     # feed-splitting Remapper.  The resulting loss must equal evaluating
     # the same global batch fed identically from every process.
     pidx, pcount = jax.process_index(), jax.process_count()
-    rows = batch["x"].shape[0] // pcount
-    local = {k: v[pidx * rows:(pidx + 1) * rows] for k, v in batch.items()}
-    sharded_loss = float(sess.run(sess.place_local_batch(local),
-                                  sync=True)["loss"])
+    if sess.mesh.shape.get("data", 1) > 1:
+        rows = batch["x"].shape[0] // pcount
+        local = {k: v[pidx * rows:(pidx + 1) * rows]
+                 for k, v in batch.items()}
+        sharded_loss = float(sess.run(sess.place_local_batch(local),
+                                      sync=True)["loss"])
+    else:
+        # No multi-way data axis (pure-TP mesh): batches replicate, so
+        # disjoint local shards have no sharded layout to land in.
+        sharded_loss = None
 
     result = {
         "role": "worker" if ENV.AUTODIST_WORKER.val else "chief",

@@ -67,8 +67,8 @@ def _chief_env(tmp_path, builder: str, **extra):
     return env, result_file
 
 
-def _run_chief(tmp_path, builder: str):
-    env, result_file = _chief_env(tmp_path, builder)
+def _run_chief(tmp_path, builder: str, **extra):
+    env, result_file = _chief_env(tmp_path, builder, **extra)
     proc = subprocess.run(
         [sys.executable, "-u", SCRIPT], env=env, timeout=300,
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
@@ -120,6 +120,29 @@ def test_two_process_training_parity(tmp_path, builder):
                                rtol=1e-4)
 
     assert "jax.distributed initialized" in out
+
+
+def test_two_process_tensor_parallel_mesh(tmp_path):
+    """A model-ONLY mesh (model=4 over 2 processes × 2 devices): the
+    model axis necessarily crosses the OS-process boundary, so weight
+    shards and their tensor-parallel collectives live on different
+    machines — cross-process TENSOR parallelism, beyond the reference's
+    data-parallel-only multi-machine matrix.  (A data=2,model=2 mesh
+    would NOT cover this: canonical axis ordering makes `data` the
+    process-spanning axis.)  Numeric parity with the closed-form
+    single-process run must still hold; batches replicate (no data
+    axis)."""
+    chief, worker, _ = _run_chief(
+        tmp_path, "PartitionedPS",
+        AUTODIST_TEST_MESH="model=4")
+    assert chief["mesh"] == {"model": 4}
+    assert chief["process_count"] == 2
+    np.testing.assert_allclose(chief["losses"], worker["losses"],
+                               rtol=1e-6)
+    ref_losses, ref_w = _reference_losses()
+    np.testing.assert_allclose(chief["losses"], ref_losses, rtol=1e-4)
+    np.testing.assert_allclose(chief["final_w"], ref_w, rtol=1e-4)
+    assert chief["sharded_input_loss"] is None  # pure TP: no data axis
 
 
 def test_worker_crash_aborts_chief(tmp_path):
