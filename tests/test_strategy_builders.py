@@ -138,3 +138,24 @@ def test_strategy_serialize_roundtrip(gi, spec2, tmp_path):
     assert [n.to_dict() for n in s2.node_config] == \
            [n.to_dict() for n in s.node_config]
     assert s2.graph_config.replicas == s.graph_config.replicas
+
+
+def test_every_builder_roundtrips_exactly(gi, spec2, tmp_path):
+    """IR fidelity across ALL nine builders (the chief-serializes /
+    worker-deserializes contract must lose nothing for any of them —
+    partitioner strings, compressors, groups, destinations, staleness)."""
+    from autodist_tpu.strategy import AutoStrategy
+
+    builders = [PS(), PSLoadBalancing(), PartitionedPS(),
+                UnevenPartitionedPS(),
+                AllReduce(chunk_size=2, compressor="Int8Compressor"),
+                PartitionedAR(), RandomAxisPartitionAR(seed=3), Parallax(),
+                AutoStrategy(partition_threshold=64)]
+    for b in builders:
+        s = b.build(gi, spec2)
+        s.serialize(str(tmp_path / s.id))
+        s2 = Strategy.deserialize(s.id, base_dir=str(tmp_path))
+        assert [n.to_dict() for n in s2.node_config] == \
+               [n.to_dict() for n in s.node_config], type(b).__name__
+        assert s2.graph_config.replicas == s.graph_config.replicas
+        assert s2.graph_config.mesh_axes == s.graph_config.mesh_axes
