@@ -100,6 +100,11 @@ class ENV(enum.Enum):
     # parity with the reference's per-stage graph dumps
     # (kernel/graph_transformer.py:62-90)
     AUTODIST_DUMP_GRAPHS = ("AUTODIST_DUMP_GRAPHS", _bool)
+    # XLA compiler-option name for the all-reduce combiner threshold;
+    # when set (and the strategy carries fusable groups), the group byte
+    # size is passed through as that option's value — see
+    # kernel/graph_transformer.py:_combiner_bytes
+    AUTODIST_COMBINER_FLAG = ("AUTODIST_COMBINER_FLAG", _str)
     # Cloud-TPU pod slice: rendezvous via TPU metadata (TPUPodCluster)
     AUTODIST_TPU_POD = ("AUTODIST_TPU_POD", _bool)
     # jax.distributed coordinator (host:port)

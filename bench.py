@@ -153,6 +153,15 @@ def run_child(platform: str) -> None:
             print(json.dumps(result), flush=True)
 
 
+def _transformer_mfu(tokens_per_sec: float, n_params: float, seq: int,
+                     n_layers: int, d_model: int, peak: float) -> float:
+    """Model-FLOPs utilization for a decoder step: 6·N per token
+    (fwd+bwd matmuls) + 12·L·d·T causal-attention term (PaLM appendix-B
+    accounting)."""
+    flops_per_token = 6.0 * n_params + 12.0 * n_layers * d_model * seq * 0.5
+    return tokens_per_sec * flops_per_token / peak
+
+
 def _fill_lm(result):
     """Secondary metric: flagship TransformerLM training throughput with
     the Pallas flash-attention kernel (the TPU default).  Returns a
@@ -198,6 +207,11 @@ def _fill_lm(result):
         flash_tps = measure(make_flash_attention(), batch_size)
         result["lm_tokens_per_sec"] = round(flash_tps, 1)
         result["lm_seq_len"] = seq
+        peak = _peak_flops(jax.devices()[0])
+        if peak:
+            # 12L x d768: ~124M params (incl. 32128-vocab tied embedding).
+            result["lm_mfu"] = round(_transformer_mfu(
+                flash_tps, 124e6, seq, 12, 768, peak), 4)
 
         def compare_dense():
             # Dense attention materializes f32[B,H,T,T] score tensors
@@ -307,6 +321,12 @@ def _fill_bert(result) -> None:
         result["bert_samples_per_sec"] = round(batch_size * steps / dt, 1)
         result["bert_seq_len"] = seq
         result["bert_batch_size"] = batch_size
+        peak = _peak_flops(jax.devices()[0])
+        if peak:
+            # BERT-base ~110M params; bidirectional attention (no causal /2).
+            tps = batch_size * steps / dt * seq
+            flops_per_token = 6.0 * 110e6 + 12.0 * 12 * 768 * seq
+            result["bert_mfu"] = round(tps * flops_per_token / peak, 4)
         # Free the BERT state before the caller's dense-attention
         # comparison: params + AdamW slots pinned in HBM would shrink the
         # room the OOM-prone dense program has to compile into.
