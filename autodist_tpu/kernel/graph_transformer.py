@@ -318,7 +318,8 @@ class GraphTransformer:
             else stale.state_shardings(mesh, phys_params)
         jit_kwargs = {}
         combiner = self._combiner_bytes()
-        flag = os.environ.get("AUTODIST_COMBINER_FLAG")
+        from autodist_tpu.const import ENV
+        flag = ENV.AUTODIST_COMBINER_FLAG.val
         if combiner and flag and mesh.devices.flat[0].platform == "tpu":
             # Strategy `group`/chunk_size lowered as XLA's all-reduce
             # combiner threshold: the compiler merges the grouped psums into

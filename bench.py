@@ -154,12 +154,13 @@ def run_child(platform: str) -> None:
 
 
 def _transformer_mfu(tokens_per_sec: float, n_params: float, seq: int,
-                     n_layers: int, d_model: int, peak: float) -> float:
-    """Model-FLOPs utilization for a decoder step: 6·N per token
-    (fwd+bwd matmuls) + 12·L·d·T causal-attention term (PaLM appendix-B
-    accounting)."""
-    flops_per_token = 6.0 * n_params + 12.0 * n_layers * d_model * seq * 0.5
-    return tokens_per_sec * flops_per_token / peak
+                     n_layers: int, d_model: int, peak: float,
+                     causal: bool = True) -> float:
+    """Model-FLOPs utilization for a transformer train step: 6·N per
+    token (fwd+bwd matmuls) + 12·L·d·T attention term, halved for causal
+    masking (PaLM appendix-B accounting)."""
+    attn = 12.0 * n_layers * d_model * seq * (0.5 if causal else 1.0)
+    return tokens_per_sec * (6.0 * n_params + attn) / peak
 
 
 def _fill_lm(result):
@@ -321,12 +322,13 @@ def _fill_bert(result) -> None:
         result["bert_samples_per_sec"] = round(batch_size * steps / dt, 1)
         result["bert_seq_len"] = seq
         result["bert_batch_size"] = batch_size
-        peak = _peak_flops(jax.devices()[0])
+        # Session throughput is AGGREGATE over the mesh: divide by the
+        # whole mesh's peak, not one chip's.
+        peak = sum(_peak_flops(d) for d in sess.mesh.devices.flat)
         if peak:
-            # BERT-base ~110M params; bidirectional attention (no causal /2).
             tps = batch_size * steps / dt * seq
-            flops_per_token = 6.0 * 110e6 + 12.0 * 12 * 768 * seq
-            result["bert_mfu"] = round(tps * flops_per_token / peak, 4)
+            result["bert_mfu"] = round(_transformer_mfu(
+                tps, 110e6, seq, 12, 768, peak, causal=False), 4)
         # Free the BERT state before the caller's dense-attention
         # comparison: params + AdamW slots pinned in HBM would shrink the
         # room the OOM-prone dense program has to compile into.
