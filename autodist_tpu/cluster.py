@@ -31,7 +31,6 @@ import os
 import shlex
 import signal
 import subprocess
-import sys
 from typing import Dict, List, Optional
 
 from autodist_tpu.const import ENV

@@ -25,7 +25,6 @@ import itertools
 import time
 from typing import Any, Callable, Dict, Iterable, Optional, Sequence, Union
 
-import jax
 import numpy as np
 
 from autodist_tpu.utils import logging

@@ -3,8 +3,7 @@ benchmark).  Faithful block structure (A/B/C/D/E mixed blocks per Szegedy et
 al. 2015), GroupNorm for statelessness."""
 from __future__ import annotations
 
-from functools import partial
-from typing import Sequence, Tuple
+from typing import Tuple
 
 import flax.linen as nn
 import jax.numpy as jnp

@@ -9,10 +9,8 @@ shards evenly on TPU meshes.
 """
 from __future__ import annotations
 
-from typing import Tuple
 
 import flax.linen as nn
-import jax
 import jax.numpy as jnp
 import numpy as np
 

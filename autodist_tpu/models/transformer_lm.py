@@ -12,11 +12,9 @@ machinery requires.
 """
 from __future__ import annotations
 
-from functools import partial
 from typing import Callable, Optional
 
 import flax.linen as nn
-import jax
 import jax.numpy as jnp
 import numpy as np
 

@@ -21,7 +21,6 @@ Peak extra memory: ``N·chunk`` fp32 instead of ``N·V`` logits.
 from __future__ import annotations
 
 import functools
-from typing import Tuple
 
 import jax
 import jax.numpy as jnp

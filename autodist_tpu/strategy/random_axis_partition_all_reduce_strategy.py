@@ -9,8 +9,6 @@ from __future__ import annotations
 
 import random
 
-from autodist_tpu.graph_item import GraphItem
-from autodist_tpu.resource_spec import ResourceSpec
 from autodist_tpu.strategy.partitioned_all_reduce_strategy import PartitionedAR
 from autodist_tpu.strategy.partition_utils import smallest_divisor_gt_one
 
