@@ -80,3 +80,10 @@ def test_imagenet_benchmark_fit_epochs():
                         "--batch-size", "8", "--steps", "2",
                         "--epochs", "2"))
     assert "epoch 1:" in out
+
+
+def test_image_classifier():
+    out = _run_example("examples/image_classifier.py",
+                       ("--image-size", "32", "--batch-size", "8",
+                        "--steps", "3"))
+    assert "step 2: loss" in out
