@@ -103,10 +103,14 @@ class AutoDist:
                 pipeline_vars: Sequence[str] = (),
                 expert_vars: Sequence[str] = (),
                 remat: Optional[str] = None,
-                has_aux: bool = False) -> GraphItem:
+                has_aux: bool = False,
+                metrics_fn: Optional[Callable] = None) -> GraphItem:
         """Capture the training program (the explicit analog of the
         reference's optimizer/gradient monkeypatch hooks,
-        graph_item.py:72-108)."""
+        graph_item.py:72-108).  ``metrics_fn(params, batch) -> dict``
+        merges extra metrics (e.g. accuracy) into every step's and
+        ``evaluate``'s outputs — the reference's extra ``sess.run``
+        fetches / Keras ``compile(metrics=...)``."""
         if self.is_built():
             raise RuntimeError(
                 "Cannot capture after the distributed session was created "
@@ -115,7 +119,7 @@ class AutoDist:
             params, optimizer=optimizer, loss_fn=loss_fn,
             sparse_vars=sparse_vars, untrainable_vars=untrainable_vars,
             pipeline_vars=pipeline_vars, expert_vars=expert_vars,
-            remat=remat, has_aux=has_aux)
+            remat=remat, has_aux=has_aux, metrics_fn=metrics_fn)
         return self._graph_item
 
     @property
@@ -206,7 +210,8 @@ class AutoDist:
         compiled = StrategyCompiler(
             mesh, resource_spec=self._resource_spec).compile(
                 self._strategy, self._graph_item)
-        dist_step = GraphTransformer(compiled, self._graph_item).transform()
+        dist_step = GraphTransformer(compiled, self._graph_item).transform(
+            extra_metrics_fn=self._graph_item.metrics_fn)
         self._session = DistributedSession(self._graph_item, dist_step)
         logging.info("distributed session created: strategy=%s mesh=%s",
                      self._strategy.id, dict(mesh.shape))

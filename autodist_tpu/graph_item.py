@@ -173,12 +173,17 @@ class GraphItem:
                  pipeline_vars: Sequence[str] = (),
                  expert_vars: Sequence[str] = (),
                  remat: Optional[str] = None,
-                 has_aux: bool = False):
+                 has_aux: bool = False,
+                 metrics_fn: Optional[Callable] = None):
         self.params = params
         self.optimizer = optimizer
         self.loss_fn = _apply_remat(loss_fn, remat)
         self.remat = remat
         self.has_aux = has_aux
+        # (params, batch) -> dict of extra metrics, merged into every
+        # step's / evaluate's outputs (the Keras compile(metrics=...)
+        # analog; the reference fetched extra tensors via sess.run).
+        self.metrics_fn = metrics_fn
         self._sparse_patterns = tuple(sparse_vars)
         self._untrainable_patterns = tuple(untrainable_vars)
         self._pipeline_patterns = tuple(pipeline_vars)

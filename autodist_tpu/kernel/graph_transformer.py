@@ -248,6 +248,12 @@ class GraphTransformer:
 
             def loss_fn(p, batch):
                 return gi_loss(su.unpad_tree(p, pad_info), batch)
+            if extra_metrics_fn is not None:
+                # metrics_fn, like the loss, sees the LOGICAL param view.
+                user_metrics = extra_metrics_fn
+
+                def extra_metrics_fn(p, batch):  # noqa: F811
+                    return user_metrics(su.unpad_tree(p, pad_info), batch)
         else:
             phys_params = params
             loss_fn = gi.loss_fn
@@ -308,7 +314,8 @@ class GraphTransformer:
             if aux is not None:
                 metrics["aux"] = aux
             if extra_metrics_fn is not None:
-                metrics.update(extra_metrics_fn(params, batch))
+                metrics = _merge_metrics(metrics, extra_metrics_fn(params,
+                                                                   batch))
             return params, opt_state, sync_state, metrics
 
         # Batch shardings are per-leaf (data on dim 0, seq on dim 1 where it
@@ -341,8 +348,9 @@ class GraphTransformer:
 
         # Same loss_fn as training (the pad-aware wrapper), so padded rows
         # contribute nothing to evaluation.
-        eval_fn = jax.jit(_make_eval_step(loss_fn, has_aux),
-                          in_shardings=(param_sh, None))
+        eval_fn = jax.jit(
+            _make_eval_step(loss_fn, has_aux, extra_metrics_fn),
+            in_shardings=(param_sh, None))
         init_fn = jax.jit(gi.optimizer.init, out_shardings=opt_sh)
         if stale is None:
             def init_sync_state(current_params=None):
@@ -428,11 +436,29 @@ class GraphTransformer:
         mesh = self.compiled.mesh
         has_partitioned = any(p.param_spec != P()
                               for p in self.compiled.var_plans.values())
+        # extra metrics run OUTSIDE shard_map, on the updated params and the
+        # GLOBAL batch — identical semantics to the GSPMD path (inside the
+        # mapped step they would see only the local data shard and get
+        # pmean-averaged, silently changing non-mean metrics).
         step_fn, init_fn, init_sync, replicated = \
-            explicit_sync.make_explicit_step(gi, self.compiled, has_partitioned,
-                                             extra_metrics_fn=extra_metrics_fn)
+            explicit_sync.make_explicit_step(gi, self.compiled,
+                                             has_partitioned)
+        if extra_metrics_fn is not None:
+            inner_step = step_fn
+
+            def wrapped(params, opt_state, sync_state, batch):
+                params, opt_state, sync_state, metrics = inner_step(
+                    params, opt_state, sync_state, batch)
+                metrics = _merge_metrics(metrics,
+                                         extra_metrics_fn(params, batch))
+                return params, opt_state, sync_state, metrics
+
+            # Donation must live on the OUTER jit (the inner jit inlines
+            # under tracing and its donate_argnums are ignored).
+            step_fn = jax.jit(wrapped, donate_argnums=(0, 1, 2))
         param_sh = jax.tree_util.tree_map(lambda _: replicated, gi.params)
-        eval_fn = jax.jit(_make_eval_step(gi.loss_fn, gi.has_aux))
+        eval_fn = jax.jit(
+            _make_eval_step(gi.loss_fn, gi.has_aux, extra_metrics_fn))
         logging.info(
             "GraphTransformer: compiled EXPLICIT step over mesh %s (%d vars)",
             dict(mesh.shape), len(self.compiled.var_plans))
@@ -442,16 +468,34 @@ class GraphTransformer:
             mesh=mesh, compiled_strategy=self.compiled, eval_fn=eval_fn)
 
 
-def _make_eval_step(loss_fn: Callable, has_aux: bool) -> Callable:
+def _make_eval_step(loss_fn: Callable, has_aux: bool,
+                    metrics_fn: Optional[Callable] = None) -> Callable:
     """Fetch-only metrics step (the reference's ``sess.run(loss)``): loss
-    on the current params, no state change."""
+    (+ captured ``metrics_fn`` extras) on the current params, no state
+    change."""
     def eval_step(params, batch):
         if has_aux:
             loss, aux = loss_fn(params, batch)
-            return {"loss": loss, "aux": aux}
-        return {"loss": loss_fn(params, batch)}
+            out = {"loss": loss, "aux": aux}
+        else:
+            out = {"loss": loss_fn(params, batch)}
+        if metrics_fn is not None:
+            out = _merge_metrics(out, metrics_fn(params, batch))
+        return out
 
     return eval_step
+
+
+def _merge_metrics(metrics: Dict, extra: Dict) -> Dict:
+    """Merge user metrics, refusing to clobber the framework's keys."""
+    overlap = set(metrics) & set(extra)
+    if overlap:
+        raise ValueError(
+            f"metrics_fn returned reserved metric key(s) {sorted(overlap)}; "
+            "rename them — 'loss' and 'aux' are produced by the step itself")
+    out = dict(metrics)
+    out.update(extra)
+    return out
 
 
 def _plan_summary(compiled: CompiledStrategy) -> Dict[str, int]:

@@ -21,7 +21,7 @@ replication (with a warning) for such variables when a compressor is active.
 """
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Dict
 
 import jax
 import jax.numpy as jnp
@@ -60,8 +60,7 @@ def _compressors_for(gi: GraphItem, compiled: CompiledStrategy
 
 
 def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy,
-                       has_partitioned_vars: bool,
-                       extra_metrics_fn: Optional[Callable] = None):
+                       has_partitioned_vars: bool):
     """Returns (step_fn, init_opt_fn, init_sync_state_fn, shardings...)
     consumed by the GraphTransformer."""
     import optax
@@ -152,10 +151,8 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy,
         if aux is not None:
             metrics["aux"] = jax.tree_util.tree_map(
                 lambda x: lax.pmean(x, MESH_AXIS_DATA), aux)
-        if extra_metrics_fn is not None:
-            metrics.update(jax.tree_util.tree_map(
-                lambda x: lax.pmean(x, MESH_AXIS_DATA),
-                extra_metrics_fn(params, batch)))
+        # extra metrics_fn runs OUTSIDE this shard_map (graph_transformer
+        # wraps the step) so it sees the global batch, not a local shard.
         return params, opt_state, new_sync, metrics
 
     # check_vma=False: this path OWNS its collectives.  With vma tracking on
