@@ -1,0 +1,224 @@
+"""One-forward-one-backward (1F1B) pipeline schedule with a hand-built
+backward pass.
+
+``pipeline.py`` differentiates the GPipe tick-scan with whole-program
+autodiff: correct, but every microbatch's boundary activations stay
+stashed until the scan's backward runs — O(M) live activations (remat
+trims the per-tick internals, not the count).  The 1F1B schedule
+(PipeDream-flush / Megatron-LM) interleaves each microbatch's backward
+as soon as its forward clears the last stage, so a device holds at most
+``2·(S−1)`` in-flight boundary activations — O(S), independent of M.
+
+Schedule algebra (unit fwd+bwd per tick; V=1):
+
+* forward of microbatch ``j`` runs on device ``d`` at tick ``j + d``
+  (the GPipe ring — activations hop ``d → d+1`` via ``ppermute``);
+* the last stage computes the per-microbatch loss AND its cotangent at
+  the same tick its forward completes;
+* backward of microbatch ``j`` runs on device ``d`` at tick
+  ``j + 2(S−1) − d`` — cotangents hop ``d → d−1`` on a reverse ring,
+  one tick behind;
+* every tick a device does (at most) one forward AND one backward: the
+  eponymous 1F1B steady state.  Total ticks ``M + 2(S−1) + 1``.
+
+Each device keeps a circular buffer of its saved stage INPUTS (capacity
+``2S``, static); backward recomputes the stage forward under ``jax.vjp``
+from the saved input — the recompute-based 1F1B every large-scale
+implementation uses.
+
+The public entry returns ``(mean_loss, d_stage_params, d_x)`` directly —
+a manual value-and-grad over the pipeline — and is verified bit-close
+against autodiff through ``pipeline_apply`` in ``tests/test_pipeline_1f1b.py``.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from autodist_tpu.const import MESH_AXIS_PIPE
+
+
+def one_f_one_b(stage_fn: Callable, loss_fn: Callable, stage_params: Any,
+                x: jax.Array, targets: Any, mesh: Mesh, *,
+                num_microbatches: int,
+                axis_name: str = MESH_AXIS_PIPE
+                ) -> Tuple[jax.Array, Any, jax.Array]:
+    """Pipelined value-and-grad under the 1F1B schedule.
+
+    Args:
+      stage_fn: ``(params_one_stage, x_mb) -> y_mb``, activation-shape
+        homogeneous across stages (the ``pipeline_apply`` contract).
+      loss_fn: ``(y_mb, target_mb) -> scalar`` per-microbatch loss; the
+        total loss is the MEAN over microbatches.
+      stage_params: pytree with a leading ``[S]`` stage axis (pipeline
+        order), sharded over ``axis_name``.
+      x: global batch ``[B, ...]``; ``B % num_microbatches == 0``.
+      targets: pytree of arrays with leading dim ``B`` (what ``loss_fn``
+        consumes per microbatch).
+
+    Returns ``(loss, d_stage_params, d_x)`` — gradients for the stacked
+    stage params (same ``[S]``-leading layout) and for the batch input
+    (so upstream layers, e.g. embeddings, keep training).
+    """
+    s = mesh.shape.get(axis_name, 1)
+    m = num_microbatches
+    b = x.shape[0]
+    if b % m:
+        raise ValueError(f"batch {b} not divisible into {m} microbatches")
+    for leaf in jax.tree_util.tree_leaves(targets):
+        if leaf.shape[0] != b:
+            raise ValueError(
+                f"targets leading dim {leaf.shape[0]} != batch {b}")
+    if m < s:
+        raise ValueError(f"1F1B needs num_microbatches ({m}) >= stages ({s})")
+    if s > 1:
+        for leaf in jax.tree_util.tree_leaves(stage_params):
+            if leaf.shape[0] != s:
+                raise ValueError(
+                    f"stage_params leading dim {leaf.shape[0]} != pipe axis "
+                    f"{s} (interleaved virtual stages are not supported by "
+                    "1F1B here; use pipeline_apply for V>1)")
+
+    if s <= 1:
+        # No pipe axis: plain scan + autodiff (nothing to schedule).
+        def whole(sp, x):
+            def body(h, p):
+                return stage_fn(p, h), None
+            out, _ = lax.scan(body, x, sp)
+            return jnp.mean(_loss_over_microbatches(loss_fn, out, targets, m))
+        loss, (dsp, dx) = jax.value_and_grad(whole, argnums=(0, 1))(
+            stage_params, x)
+        return loss, dsp, dx
+
+    return _jitted_1f1b(stage_fn, loss_fn, mesh, m, axis_name)(
+        stage_params, x, targets)
+
+
+def _loss_over_microbatches(loss_fn, out, targets, m):
+    mb = out.reshape((m, out.shape[0] // m) + out.shape[1:])
+    tb = jax.tree_util.tree_map(
+        lambda t: t.reshape((m, t.shape[0] // m) + t.shape[1:]), targets)
+    return jax.vmap(loss_fn)(mb, tb)
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_1f1b(stage_fn: Callable, loss_fn: Callable, mesh: Mesh,
+                 num_microbatches: int, axis_name: str) -> Callable:
+    # Cache keyed on (stage_fn, loss_fn) identity — pass stable callables
+    # (same contract as pipeline._jitted_pipeline).
+    local = functools.partial(_local_1f1b, stage_fn, loss_fn,
+                              axis_name=axis_name, m=num_microbatches)
+    return jax.jit(jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(axis_name), P(), P()),
+        out_specs=(P(), P(axis_name), P()),
+        axis_names={axis_name}, check_vma=False,
+    ))
+
+
+def _local_1f1b(stage_fn: Callable, loss_fn: Callable, chunk_params: Any,
+                x: jax.Array, targets: Any, *, axis_name: str, m: int):
+    """Per-device 1F1B loop (inside shard_map over ``axis_name``)."""
+    s = lax.axis_size(axis_name)
+    d = lax.axis_index(axis_name)
+    params = jax.tree_util.tree_map(lambda p: jnp.squeeze(p, 0), chunk_params)
+
+    mb = x.reshape((m, x.shape[0] // m) + x.shape[1:])       # [M, mb, ...]
+    tgt = jax.tree_util.tree_map(
+        lambda t: t.reshape((m, t.shape[0] // m) + t.shape[1:]), targets)
+    zero_a = jnp.zeros_like(mb[0])
+    k = 2 * s                                                 # stash slots
+    stash0 = jnp.zeros((k,) + mb[0].shape, mb.dtype)
+    dparams0 = jax.tree_util.tree_map(
+        lambda p: jnp.zeros(p.shape, jnp.float32), params)
+    dx0 = jnp.zeros_like(mb, jnp.float32)                     # [M, mb, ...]
+
+    fwd_perm = [(i, (i + 1) % s) for i in range(s)]
+    bwd_perm = [(i, (i - 1) % s) for i in range(s)]
+    vary = lambda v: lax.pcast(v, axis_name, to="varying")  # noqa: E731
+    # Last useful event: backward of mb M-1 on device 0, tick M+2(S-1)-1.
+    ticks = m + 2 * (s - 1)
+
+    def stage_vjp(p, xin, ct):
+        y, pullback = jax.vjp(lambda pp, xx: stage_fn(pp, xx), p, xin)
+        dp, dxin = pullback(ct.astype(y.dtype))
+        return dp, dxin
+
+    def tick(carry, t):
+        a_in, g_in, stash, dparams, dx_bank, loss_acc = carry
+
+        # ---- forward phase ------------------------------------------------
+        jf = t - d                                   # mb this device fwd's
+        active_f = jnp.logical_and(jf >= 0, jf < m)
+        feed = lax.dynamic_index_in_dim(mb, jnp.clip(jf, 0, m - 1), 0,
+                                        keepdims=False)
+        x_in = jnp.where(d == 0, feed, a_in)
+        y = stage_fn(params, x_in)
+        # save this tick's stage INPUT for the backward recompute
+        slot_f = jnp.mod(t, k)
+        cur = lax.dynamic_index_in_dim(stash, slot_f, 0, keepdims=False)
+        stash = lax.dynamic_update_index_in_dim(
+            stash, jnp.where(active_f, x_in, cur), slot_f, 0)
+
+        # last stage: per-microbatch loss + its cotangent, entering the
+        # backward stream THIS tick (bwd of mb jf at device S-1 is tick
+        # jf + 2(S-1) - (S-1) = jf + S - 1 = t).
+        tgt_j = jax.tree_util.tree_map(
+            lambda tt: lax.dynamic_index_in_dim(
+                tt, jnp.clip(jf, 0, m - 1), 0, keepdims=False), tgt)
+        loss_j, loss_pull = jax.vjp(lambda yy: loss_fn(yy, tgt_j), y)
+        (dy_loss,) = loss_pull(jnp.float32(1.0 / m))
+        is_last = d == s - 1
+        loss_acc = loss_acc + jnp.where(
+            jnp.logical_and(is_last, active_f), loss_j / m, 0.0)
+
+        # ---- backward phase ----------------------------------------------
+        jb = t - 2 * (s - 1) + d                     # mb this device bwd's
+        active_b = jnp.logical_and(jb >= 0, jb < m)
+        # cotangent: locally generated on the last stage, ring-arriving else
+        ct = jnp.where(is_last, dy_loss.astype(jnp.float32),
+                       g_in.astype(jnp.float32))
+        # retrieve the saved input of mb jb (saved at tick jb + d)
+        slot_b = jnp.mod(jb + d, k)
+        x_saved = lax.dynamic_index_in_dim(stash, slot_b, 0, keepdims=False)
+        dp, dxin = stage_vjp(params, x_saved, ct)
+        # where-mask, not multiply: inactive ticks can compute on garbage
+        # (NaN-capable) values, and 0 * NaN = NaN would poison the sums.
+        dparams = jax.tree_util.tree_map(
+            lambda a, g: a + jnp.where(active_b, g.astype(jnp.float32), 0.0),
+            dparams, dp)
+        # device 0's dxin is the gradient w.r.t. the injected microbatch
+        bank = jnp.logical_and(d == 0, active_b)
+        slot_x = jnp.clip(jb, 0, m - 1)
+        cur_dx = lax.dynamic_index_in_dim(dx_bank, slot_x, 0, keepdims=False)
+        dx_bank = lax.dynamic_update_index_in_dim(
+            dx_bank, jnp.where(bank, dxin.astype(jnp.float32), cur_dx),
+            slot_x, 0)
+
+        a_next = lax.ppermute(y, axis_name, fwd_perm)
+        g_next = lax.ppermute(dxin.astype(jnp.float32), axis_name, bwd_perm)
+        return (a_next, g_next, stash, dparams, dx_bank, loss_acc), None
+
+    carry0 = (vary(zero_a), vary(jnp.zeros_like(zero_a, jnp.float32)),
+              vary(stash0), vary(dparams0), vary(dx0), vary(jnp.float32(0)))
+    (a, g, stash, dparams, dx_bank, loss_acc), _ = lax.scan(
+        tick, carry0, jnp.arange(ticks))
+
+    # loss lives on the last device; dx on device 0 — replicate via psum.
+    loss = lax.psum(jnp.where(d == s - 1, loss_acc, 0.0), axis_name)
+    dx = lax.psum(jnp.where(d == 0, dx_bank, jnp.zeros_like(dx_bank)),
+                  axis_name)
+    dx = dx.reshape((dx.shape[0] * dx.shape[1],) + dx.shape[2:])
+    # Accumulation ran in f32; return grads in the primal dtypes (what
+    # autodiff — and the s==1 fallback — would produce).
+    dx = dx.astype(x.dtype)
+    # dparams stays device-local: out_specs P(axis_name) restacks the [S]
+    # axis exactly like the incoming stage_params layout.
+    dparams = jax.tree_util.tree_map(
+        lambda g, p: g[None].astype(p.dtype), dparams, params)
+    return loss, dparams, dx
