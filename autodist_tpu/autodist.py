@@ -104,7 +104,8 @@ class AutoDist:
                 expert_vars: Sequence[str] = (),
                 remat: Optional[str] = None,
                 has_aux: bool = False,
-                metrics_fn: Optional[Callable] = None) -> GraphItem:
+                metrics_fn: Optional[Callable] = None,
+                grad_fn: Optional[Callable] = None) -> GraphItem:
         """Capture the training program (the explicit analog of the
         reference's optimizer/gradient monkeypatch hooks,
         graph_item.py:72-108).  ``metrics_fn(params, batch) -> dict``
@@ -119,7 +120,8 @@ class AutoDist:
             params, optimizer=optimizer, loss_fn=loss_fn,
             sparse_vars=sparse_vars, untrainable_vars=untrainable_vars,
             pipeline_vars=pipeline_vars, expert_vars=expert_vars,
-            remat=remat, has_aux=has_aux, metrics_fn=metrics_fn)
+            remat=remat, has_aux=has_aux, metrics_fn=metrics_fn,
+            grad_fn=grad_fn)
         return self._graph_item
 
     @property

@@ -174,7 +174,8 @@ class GraphItem:
                  expert_vars: Sequence[str] = (),
                  remat: Optional[str] = None,
                  has_aux: bool = False,
-                 metrics_fn: Optional[Callable] = None):
+                 metrics_fn: Optional[Callable] = None,
+                 grad_fn: Optional[Callable] = None):
         self.params = params
         self.optimizer = optimizer
         self.loss_fn = _apply_remat(loss_fn, remat)
@@ -184,6 +185,12 @@ class GraphItem:
         # step's / evaluate's outputs (the Keras compile(metrics=...)
         # analog; the reference fetched extra tensors via sess.run).
         self.metrics_fn = metrics_fn
+        # optional manual value-and-grad replacing jax.value_and_grad in
+        # the compiled step — (params, batch) -> (loss, grads).  The
+        # hand-scheduled 1F1B pipeline backward plugs in here.
+        if grad_fn is not None and has_aux:
+            raise ValueError("grad_fn does not support has_aux")
+        self.grad_fn = grad_fn
         self._sparse_patterns = tuple(sparse_vars)
         self._untrainable_patterns = tuple(untrainable_vars)
         self._pipeline_patterns = tuple(pipeline_vars)

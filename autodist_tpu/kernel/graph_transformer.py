@@ -218,6 +218,12 @@ class GraphTransformer:
         from autodist_tpu.const import MESH_AXIS_DATA
         from autodist_tpu.kernel.synchronization import explicit_sync
         if explicit_sync.uses_explicit_path(self.compiled):
+            if gi.grad_fn is not None:
+                raise ValueError(
+                    "capture(grad_fn=...) cannot combine with gradient "
+                    "compressors / fused groups (the explicit shard_map "
+                    "path owns the gradient computation); drop the "
+                    "compressor or the manual grad_fn")
             if mesh.shape.get(MESH_AXIS_DATA, 1) > 1:
                 from autodist_tpu.kernel.synchronization.stale_sync import \
                     uses_stale_path
@@ -272,7 +278,21 @@ class GraphTransformer:
         opt_spec_tree = su.opt_spec_tree(opt_shape, phys_params, grad_spec_tree)
         opt_sh = su.sharding_tree(mesh, opt_spec_tree)
 
-        vg = jax.value_and_grad(loss_fn, has_aux=gi.has_aux)
+        if gi.grad_fn is not None:
+            # Manual value-and-grad (e.g. the 1F1B pipeline backward):
+            # the contract is LOGICAL params in, LOGICAL grads out — under
+            # pad-to-divisible sharding unpad on entry and zero-pad the
+            # returned grads (pad rows stay untrained, matching the masked
+            # update).
+            user_grad = gi.grad_fn
+            if pad_info is not None:
+                def vg(p, batch):
+                    loss, g = user_grad(su.unpad_tree(p, pad_info), batch)
+                    return loss, su.pad_tree(g, pad_info)
+            else:
+                vg = user_grad
+        else:
+            vg = jax.value_and_grad(loss_fn, has_aux=gi.has_aux)
         optimizer = gi.optimizer
         has_aux = gi.has_aux
 

@@ -29,6 +29,10 @@ class ModelSpec:
     loss_fn: Callable              # (params, batch) -> scalar loss
     apply_fn: Callable             # (params, inputs) -> outputs (serving)
     make_batch: Callable           # (rng, batch_size) -> batch pytree
+    # optional manual value-and-grad: (params, batch) -> (loss, grads);
+    # when set, capture(grad_fn=spec.grad_fn) replaces autodiff (e.g. the
+    # hand-scheduled 1F1B pipeline backward)
+    grad_fn: Any = None
     sparse_vars: Tuple[str, ...] = ()
     untrainable_vars: Tuple[str, ...] = ()
     pipeline_vars: Tuple[str, ...] = ()  # leading dim = pipeline-stage axis

@@ -33,6 +33,7 @@ from autodist_tpu.models.base import (
 )
 from autodist_tpu.models.transformer import TransformerLayer, dense_attention
 from autodist_tpu.parallel.pipeline import (
+    default_num_microbatches,
     interleaved_stage_order,
     pipeline_apply,
     stack_stage_params,
@@ -59,12 +60,25 @@ def pipelined_transformer_lm(
         dtype=jnp.float32, seq_len: Optional[int] = None,
         num_stages: Optional[int] = None,
         num_microbatches: Optional[int] = None,
-        num_virtual_stages: int = 1, remat: bool = False
-        ) -> ModelSpec:
+        num_virtual_stages: int = 1, remat: bool = False,
+        schedule: str = "gpipe") -> ModelSpec:
     """Stage-stacked GPT-style LM pipelined over ``mesh``'s ``pipe`` axis.
 
     ``num_virtual_stages > 1`` selects the interleaved schedule: each device
-    holds that many chunks and the bubble shrinks proportionally."""
+    holds that many chunks and the bubble shrinks proportionally.
+    ``schedule="1f1b"`` trains through the hand-scheduled 1F1B backward
+    (``parallel/pipeline_1f1b.py``, O(S) activation memory): the spec's
+    ``grad_fn`` replaces autodiff — pass it to ``capture(grad_fn=...)``
+    (``loss_fn`` stays the autodiff version for evaluation).  Caveat:
+    the tied-embedding head rides ``loss_params``, which is replicated
+    with a dense f32 gradient carried through the schedule — fine for
+    norms/small heads, but for a large tied vocab the GPipe schedule's
+    sparse/sharded embed gradients are cheaper; weigh activation memory
+    (1F1B) against head-gradient traffic (GPipe) for your config."""
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"unknown schedule {schedule!r}")
+    if schedule == "1f1b" and num_virtual_stages != 1:
+        raise ValueError("1F1B supports num_virtual_stages=1 only")
     seq_len = seq_len or max_len
     d_model = num_heads * head_dim
     stages = num_stages or mesh.shape.get("pipe", 1) or 1
@@ -120,12 +134,56 @@ def pipelined_transformer_lm(
         return {"tokens": rng.randint(
             0, vocab_size, (batch_size, seq_len)).astype(np.int32)}
 
+    grad_fn = None
+    if schedule == "1f1b":
+        from autodist_tpu.parallel.pipeline_1f1b import one_f_one_b
+
+        def head_loss(lp, y_mb, tok_mb):
+            h = _layer_norm(y_mb, lp["ln_final"]["scale"])
+            logits = jnp.einsum("btd,vd->btv", h, lp["embed"])
+            return cross_entropy_loss(logits[:, :-1], tok_mb[:, 1:])
+
+        def grad_fn(params, batch):
+            tokens = batch["tokens"]
+            # per-DATA-SHARD microbatch count (one_f_one_b semantics);
+            # reuse the divisibility-aware default.
+            local_b = tokens.shape[0] // max(mesh.shape.get("data", 1), 1)
+            m = num_microbatches or default_num_microbatches(stages, local_b)
+
+            def embed_fn(ep):
+                return (jnp.take(ep["embed"], tokens, axis=0)
+                        + ep["pos_embed"][None, :tokens.shape[1]])
+
+            ep = {"embed": params["embed"],
+                  "pos_embed": params["pos_embed"]}
+            x, embed_vjp = jax.vjp(embed_fn, ep)
+            stacked = jax.tree_util.tree_map(
+                lambda a: a.reshape((stages, num_layers // stages)
+                                    + a.shape[1:]), params["stack"])
+            lp = {"ln_final": params["ln_final"], "embed": params["embed"]}
+            loss, dstack, dlp, dx = one_f_one_b(
+                stage_fn, head_loss, stacked, x, tokens, mesh,
+                num_microbatches=m, loss_params=lp)
+            (dep,) = embed_vjp(dx)
+            # the tied embedding gets gradient from BOTH sides: the input
+            # lookup (via dx) and the softmax head (loss-side params).
+            return loss, {
+                "embed": dep["embed"] + dlp["embed"],
+                "pos_embed": dep["pos_embed"],
+                "stack": jax.tree_util.tree_map(
+                    lambda g, p: g.reshape(p.shape), dstack,
+                    params["stack"]),
+                "ln_final": dlp["ln_final"],
+            }
+
     return ModelSpec(
         name="pipelined_transformer_lm",
         init=init, loss_fn=loss_fn, apply_fn=apply_fn, make_batch=make_batch,
+        grad_fn=grad_fn,
         sparse_vars=("embed",),
         pipeline_vars=("stack",),
         config=dict(vocab_size=vocab_size, num_layers=num_layers,
                     num_heads=num_heads, head_dim=head_dim, d_ff=d_ff,
-                    max_len=max_len, seq_len=seq_len, num_stages=stages),
+                    max_len=max_len, seq_len=seq_len, num_stages=stages,
+                    schedule=schedule),
     )

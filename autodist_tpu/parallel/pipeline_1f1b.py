@@ -19,7 +19,7 @@ Schedule algebra (unit fwd+bwd per tick; V=1):
   ``j + 2(S−1) − d`` — cotangents hop ``d → d−1`` on a reverse ring,
   one tick behind;
 * every tick a device does (at most) one forward AND one backward: the
-  eponymous 1F1B steady state.  Total ticks ``M + 2(S−1) + 1``.
+  eponymous 1F1B steady state.  Total ticks ``M + 2(S−1)``.
 
 Each device keeps a circular buffer of its saved stage INPUTS (capacity
 ``2S``, static); backward recomputes the stage forward under ``jax.vjp``
@@ -33,37 +33,46 @@ against autodiff through ``pipeline_apply`` in ``tests/test_pipeline_1f1b.py``.
 from __future__ import annotations
 
 import functools
-from typing import Any, Callable, Tuple
+from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from autodist_tpu.const import MESH_AXIS_PIPE
+from autodist_tpu.const import MESH_AXIS_DATA, MESH_AXIS_PIPE
 
 
 def one_f_one_b(stage_fn: Callable, loss_fn: Callable, stage_params: Any,
                 x: jax.Array, targets: Any, mesh: Mesh, *,
-                num_microbatches: int,
-                axis_name: str = MESH_AXIS_PIPE
-                ) -> Tuple[jax.Array, Any, jax.Array]:
+                num_microbatches: int, loss_params: Any = None,
+                axis_name: str = MESH_AXIS_PIPE):
     """Pipelined value-and-grad under the 1F1B schedule.
 
     Args:
       stage_fn: ``(params_one_stage, x_mb) -> y_mb``, activation-shape
         homogeneous across stages (the ``pipeline_apply`` contract).
-      loss_fn: ``(y_mb, target_mb) -> scalar`` per-microbatch loss; the
-        total loss is the MEAN over microbatches.
+      loss_fn: ``(y_mb, target_mb) -> scalar`` per-microbatch loss — or,
+        with ``loss_params``, ``(loss_params, y_mb, target_mb) -> scalar``
+        (the head/norm/logits that live AFTER the pipeline; their
+        gradients accumulate on the last stage).  The total loss is the
+        MEAN over microbatches.
       stage_params: pytree with a leading ``[S]`` stage axis (pipeline
         order), sharded over ``axis_name``.
-      x: global batch ``[B, ...]``; ``B % num_microbatches == 0``.
+      x: global batch ``[B, ...]``; ``B % num_microbatches == 0``.  When
+        the mesh carries a ``data`` axis the batch is data-sharded and
+        the schedule composes with data parallelism: each shard runs its
+        own 1F1B over its rows (``num_microbatches`` applies PER SHARD)
+        and gradients/loss pmean over ``data``.
       targets: pytree of arrays with leading dim ``B`` (what ``loss_fn``
         consumes per microbatch).
+      loss_params: optional pytree consumed by ``loss_fn``; replicated.
 
-    Returns ``(loss, d_stage_params, d_x)`` — gradients for the stacked
-    stage params (same ``[S]``-leading layout) and for the batch input
-    (so upstream layers, e.g. embeddings, keep training).
+    Returns ``(loss, d_stage_params, d_x)`` — or, with ``loss_params``,
+    ``(loss, d_stage_params, d_loss_params, d_x)`` — gradients for the
+    stacked stage params (same ``[S]``-leading layout), the loss-side
+    params, and the batch input (so upstream layers, e.g. embeddings,
+    keep training).
     """
     s = mesh.shape.get(axis_name, 1)
     m = num_microbatches
@@ -86,17 +95,36 @@ def one_f_one_b(stage_fn: Callable, loss_fn: Callable, stage_params: Any,
 
     if s <= 1:
         # No pipe axis: plain scan + autodiff (nothing to schedule).
-        def whole(sp, x):
+        def whole(sp, lp, x):
             def body(h, p):
                 return stage_fn(p, h), None
             out, _ = lax.scan(body, x, sp)
-            return jnp.mean(_loss_over_microbatches(loss_fn, out, targets, m))
-        loss, (dsp, dx) = jax.value_and_grad(whole, argnums=(0, 1))(
-            stage_params, x)
-        return loss, dsp, dx
+            fn = loss_fn if loss_params is None \
+                else functools.partial(loss_fn, lp)
+            return jnp.mean(_loss_over_microbatches(fn, out, targets, m))
+        loss, (dsp, dlp, dx) = jax.value_and_grad(whole, argnums=(0, 1, 2))(
+            stage_params, loss_params, x)
+        if loss_params is None:
+            return loss, dsp, dx
+        return loss, dsp, dlp, dx
 
-    return _jitted_1f1b(stage_fn, loss_fn, mesh, m, axis_name)(
-        stage_params, x, targets)
+    dp_axis = MESH_AXIS_DATA if (axis_name != MESH_AXIS_DATA and
+                                 mesh.shape.get(MESH_AXIS_DATA, 1) > 1) \
+        else None
+    if dp_axis is not None:
+        dsize = mesh.shape[MESH_AXIS_DATA]
+        if b % (dsize * m):
+            raise ValueError(
+                f"batch {b} not divisible into {dsize} data shards x {m} "
+                "microbatches")
+    lp = {} if loss_params is None else loss_params
+    out = _jitted_1f1b(stage_fn, loss_fn, mesh, m,
+                       loss_params is not None, dp_axis, axis_name)(
+        stage_params, lp, x, targets)
+    if loss_params is None:
+        loss, dsp, _, dx = out
+        return loss, dsp, dx
+    return out
 
 
 def _loss_over_microbatches(loss_fn, out, targets, m):
@@ -108,22 +136,33 @@ def _loss_over_microbatches(loss_fn, out, targets, m):
 
 @functools.lru_cache(maxsize=None)
 def _jitted_1f1b(stage_fn: Callable, loss_fn: Callable, mesh: Mesh,
-                 num_microbatches: int, axis_name: str) -> Callable:
+                 num_microbatches: int, has_loss_params: bool,
+                 dp_axis, axis_name: str) -> Callable:
     # Cache keyed on (stage_fn, loss_fn) identity — pass stable callables
-    # (same contract as pipeline._jitted_pipeline).
+    # (same contract as pipeline._jitted_pipeline).  Partial-manual over
+    # {pipe, data}: the batch additionally splits over ``dp_axis`` (each
+    # data shard runs its own 1F1B over its rows; grads pmean over data),
+    # while model/seq axes stay with GSPMD inside stage_fn.
     local = functools.partial(_local_1f1b, stage_fn, loss_fn,
-                              axis_name=axis_name, m=num_microbatches)
+                              axis_name=axis_name, m=num_microbatches,
+                              has_lp=has_loss_params, dp_axis=dp_axis)
+    bspec = P(dp_axis) if dp_axis else P()
+    manual = {axis_name} | ({dp_axis} if dp_axis else set())
     return jax.jit(jax.shard_map(
         local, mesh=mesh,
-        in_specs=(P(axis_name), P(), P()),
-        out_specs=(P(), P(axis_name), P()),
-        axis_names={axis_name}, check_vma=False,
+        in_specs=(P(axis_name), P(), bspec, bspec),
+        out_specs=(P(), P(axis_name), P(), bspec),
+        axis_names=manual, check_vma=False,
     ))
 
 
 def _local_1f1b(stage_fn: Callable, loss_fn: Callable, chunk_params: Any,
-                x: jax.Array, targets: Any, *, axis_name: str, m: int):
-    """Per-device 1F1B loop (inside shard_map over ``axis_name``)."""
+                loss_params: Any, x: jax.Array, targets: Any, *,
+                axis_name: str, m: int, has_lp: bool, dp_axis=None):
+    """Per-device 1F1B loop (inside full-manual shard_map): ``x`` and
+    ``targets`` arrive as this data shard's rows (replicated over the
+    pipe axis); the schedule runs over the LOCAL rows, and gradients /
+    loss pmean over ``dp_axis`` at the end."""
     s = lax.axis_size(axis_name)
     d = lax.axis_index(axis_name)
     params = jax.tree_util.tree_map(lambda p: jnp.squeeze(p, 0), chunk_params)
@@ -137,6 +176,8 @@ def _local_1f1b(stage_fn: Callable, loss_fn: Callable, chunk_params: Any,
     dparams0 = jax.tree_util.tree_map(
         lambda p: jnp.zeros(p.shape, jnp.float32), params)
     dx0 = jnp.zeros_like(mb, jnp.float32)                     # [M, mb, ...]
+    dlp0 = jax.tree_util.tree_map(
+        lambda p: jnp.zeros(jnp.shape(p), jnp.float32), loss_params)
 
     fwd_perm = [(i, (i + 1) % s) for i in range(s)]
     bwd_perm = [(i, (i - 1) % s) for i in range(s)]
@@ -150,7 +191,7 @@ def _local_1f1b(stage_fn: Callable, loss_fn: Callable, chunk_params: Any,
         return dp, dxin
 
     def tick(carry, t):
-        a_in, g_in, stash, dparams, dx_bank, loss_acc = carry
+        a_in, g_in, stash, dparams, dlp, dx_bank, loss_acc = carry
 
         # ---- forward phase ------------------------------------------------
         jf = t - d                                   # mb this device fwd's
@@ -171,9 +212,21 @@ def _local_1f1b(stage_fn: Callable, loss_fn: Callable, chunk_params: Any,
         tgt_j = jax.tree_util.tree_map(
             lambda tt: lax.dynamic_index_in_dim(
                 tt, jnp.clip(jf, 0, m - 1), 0, keepdims=False), tgt)
-        loss_j, loss_pull = jax.vjp(lambda yy: loss_fn(yy, tgt_j), y)
-        (dy_loss,) = loss_pull(jnp.float32(1.0 / m))
         is_last = d == s - 1
+        if has_lp:
+            loss_j, loss_pull = jax.vjp(
+                lambda lp, yy: loss_fn(lp, yy, tgt_j), loss_params, y)
+            dlp_j, dy_loss = loss_pull(jnp.float32(1.0 / m))
+            # loss-side param grads accumulate on the LAST stage only, at
+            # the microbatch's loss tick (where-mask: see below).
+            last_active = jnp.logical_and(is_last, active_f)
+            dlp = jax.tree_util.tree_map(
+                lambda a, g: a + jnp.where(last_active,
+                                           g.astype(jnp.float32), 0.0),
+                dlp, dlp_j)
+        else:
+            loss_j, loss_pull = jax.vjp(lambda yy: loss_fn(yy, tgt_j), y)
+            (dy_loss,) = loss_pull(jnp.float32(1.0 / m))
         loss_acc = loss_acc + jnp.where(
             jnp.logical_and(is_last, active_f), loss_j / m, 0.0)
 
@@ -202,11 +255,12 @@ def _local_1f1b(stage_fn: Callable, loss_fn: Callable, chunk_params: Any,
 
         a_next = lax.ppermute(y, axis_name, fwd_perm)
         g_next = lax.ppermute(dxin.astype(jnp.float32), axis_name, bwd_perm)
-        return (a_next, g_next, stash, dparams, dx_bank, loss_acc), None
+        return (a_next, g_next, stash, dparams, dlp, dx_bank, loss_acc), None
 
     carry0 = (vary(zero_a), vary(jnp.zeros_like(zero_a, jnp.float32)),
-              vary(stash0), vary(dparams0), vary(dx0), vary(jnp.float32(0)))
-    (a, g, stash, dparams, dx_bank, loss_acc), _ = lax.scan(
+              vary(stash0), vary(dparams0), vary(dlp0), vary(dx0),
+              vary(jnp.float32(0)))
+    (a, g, stash, dparams, dlp, dx_bank, loss_acc), _ = lax.scan(
         tick, carry0, jnp.arange(ticks))
 
     # loss lives on the last device; dx on device 0 — replicate via psum.
@@ -214,6 +268,20 @@ def _local_1f1b(stage_fn: Callable, loss_fn: Callable, chunk_params: Any,
     dx = lax.psum(jnp.where(d == 0, dx_bank, jnp.zeros_like(dx_bank)),
                   axis_name)
     dx = dx.reshape((dx.shape[0] * dx.shape[1],) + dx.shape[2:])
+    # loss-side grads live on the last device; replicate over pipe.
+    dlp = jax.tree_util.tree_map(
+        lambda g: lax.psum(jnp.where(d == s - 1, g, jnp.zeros_like(g)),
+                           axis_name), dlp)
+    if dp_axis is not None:
+        # Each data shard computed d(mean over ITS rows); the global loss
+        # is the mean over shards, so everything averages over data —
+        # except dx, whose rows are shard-local: scale by 1/D.
+        dsize = lax.axis_size(dp_axis)
+        loss = lax.pmean(loss, dp_axis)
+        dparams = jax.tree_util.tree_map(
+            lambda g: lax.pmean(g, dp_axis), dparams)
+        dlp = jax.tree_util.tree_map(lambda g: lax.pmean(g, dp_axis), dlp)
+        dx = dx / dsize
     # Accumulation ran in f32; return grads in the primal dtypes (what
     # autodiff — and the s==1 fallback — would produce).
     dx = dx.astype(x.dtype)
@@ -221,4 +289,6 @@ def _local_1f1b(stage_fn: Callable, loss_fn: Callable, chunk_params: Any,
     # axis exactly like the incoming stage_params layout.
     dparams = jax.tree_util.tree_map(
         lambda g, p: g[None].astype(p.dtype), dparams, params)
-    return loss, dparams, dx
+    dlp = jax.tree_util.tree_map(
+        lambda g, p: g.astype(jnp.result_type(p)), dlp, loss_params)
+    return loss, dparams, dlp, dx
