@@ -183,8 +183,9 @@ class PatchOptax:
             def wrapper(fun=None, *args, **kwargs):
                 rec = cls._record
                 if rec is not None and callable(fun):
-                    # Record the UNWRAPPED user function: the GraphItem
-                    # re-derives value_and_grad itself (graph_item.grad_fn).
+                    # Record the UNWRAPPED user function: the compiled step
+                    # re-derives jax.value_and_grad from it (NOT the manual
+                    # capture(grad_fn=...) path, which is explicit-only).
                     rec.loss_fn = fun
                     rec.has_aux = bool(kwargs.get("has_aux", False))
                     logging.debug("implicit capture: loss_fn %r via jax.%s",
