@@ -248,14 +248,6 @@ class GraphItem:
         self.info = self._build_info()
         return self
 
-    # -- grad/step helpers -------------------------------------------------
-    def grad_fn(self) -> Callable:
-        """``grad_fn(params, batch) -> (loss, grads)`` built from loss_fn."""
-        if self.loss_fn is None:
-            raise ValueError("GraphItem has no loss_fn")
-        vg = jax.value_and_grad(self.loss_fn, has_aux=self.has_aux)
-        return vg
-
     # -- serialization -----------------------------------------------------
     # The reference serializes the full GraphDef (graph_item.py:419-473).
     # Functionally the program lives in user code (re-run identically on every

@@ -186,8 +186,25 @@ class PatchOptax:
                     # Record the UNWRAPPED user function: the compiled step
                     # re-derives jax.value_and_grad from it (NOT the manual
                     # capture(grad_fn=...) path, which is explicit-only).
+                    if (rec.loss_fn is not None
+                            and rec.loss_fn is not fun):
+                        # last-write-wins (the one-optimizer convention),
+                        # but loudly: a diagnostic jax.grad inside the
+                        # scope would otherwise silently become the
+                        # training objective.
+                        logging.warning(
+                            "implicit capture: loss_fn %r replaces "
+                            "previously recorded %r — the LAST "
+                            "jax.grad/value_and_grad inside ad.scope() "
+                            "wins; use explicit capture() if that is not "
+                            "the training loss",
+                            getattr(fun, "__name__", fun),
+                            getattr(rec.loss_fn, "__name__", rec.loss_fn))
                     rec.loss_fn = fun
-                    rec.has_aux = bool(kwargs.get("has_aux", False))
+                    # has_aux may arrive positionally: (fun, argnums,
+                    # has_aux, ...).
+                    rec.has_aux = bool(args[1]) if len(args) >= 2 \
+                        else bool(kwargs.get("has_aux", False))
                     logging.debug("implicit capture: loss_fn %r via jax.%s",
                                   getattr(fun, "__name__", fun), name)
                 return fn(fun, *args, **kwargs)

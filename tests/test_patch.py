@@ -165,3 +165,54 @@ def test_patch_gate_env(monkeypatch):
     ad = AutoDist(strategy_builder=AllReduce())
     with ad.scope():
         assert optax.adam is orig_adam  # patching disabled
+
+
+def test_positional_has_aux_captured():
+    """jax.value_and_grad(fun, argnums, has_aux) passed POSITIONALLY must
+    still record has_aux."""
+    import jax
+    import jax.numpy as jnp
+
+    from autodist_tpu.patch import PatchOptax
+
+    def loss_aux(p, b):
+        return jnp.sum(p ** 2), {"n": jnp.sum(b)}
+
+    rec = PatchOptax.patch()
+    try:
+        jax.value_and_grad(loss_aux, 0, True)
+    finally:
+        out = PatchOptax.unpatch()
+    assert out is rec
+    assert rec.loss_fn is loss_aux
+    assert rec.has_aux is True
+
+
+def test_loss_fn_overwrite_warns(monkeypatch):
+    """A second jax.grad inside the scope wins but warns loudly.  (The
+    framework logger sets propagate=False, so spy on the warning call
+    instead of caplog.)"""
+    import jax
+    import jax.numpy as jnp
+
+    from autodist_tpu import patch as patch_mod
+    from autodist_tpu.patch import PatchOptax
+
+    warnings = []
+    monkeypatch.setattr(patch_mod.logging, "warning",
+                        lambda msg, *a: warnings.append(msg % a))
+
+    def train_loss(p, b):
+        return jnp.sum(p ** 2)
+
+    def diag(p, b):
+        return jnp.sum(p)
+
+    PatchOptax.patch()
+    try:
+        jax.value_and_grad(train_loss)
+        jax.grad(diag)
+    finally:
+        rec = PatchOptax.unpatch()
+    assert rec.loss_fn is diag
+    assert any("replaces previously recorded" in w for w in warnings)
