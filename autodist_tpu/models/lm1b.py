@@ -11,11 +11,13 @@ from __future__ import annotations
 
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from autodist_tpu.models.base import ModelSpec
 from autodist_tpu.ops.chunked_xent import chunked_softmax_cross_entropy
+from autodist_tpu.ops.sampled_xent import sampled_softmax_cross_entropy
 
 
 class LSTMLM(nn.Module):
@@ -51,7 +53,8 @@ class LSTMLM(nn.Module):
 
 def lm1b(vocab_size: int = 793472, emb_dim: int = 512,
          hidden_dim: int = 2048, num_layers: int = 2,
-         seq_len: int = 20, xent_chunk: int = 8192) -> ModelSpec:
+         seq_len: int = 20, xent_chunk: int = 8192,
+         sampled_softmax: int = 0) -> ModelSpec:
     model = LSTMLM(vocab_size, emb_dim, hidden_dim, num_layers)
 
     def init(rng):
@@ -61,11 +64,21 @@ def lm1b(vocab_size: int = 793472, emb_dim: int = 512,
         return model.apply({"params": params}, tokens)
 
     def loss_fn(params, batch):
-        # Chunked-vocab loss: the [B, T, 793k] logits (16 GB at batch 256)
-        # never materialize — unlike the reference, which resorted to a
-        # SAMPLED softmax for this model, this is the exact loss.
         feats = model.apply({"params": params}, batch["tokens"],
                             method=LSTMLM.features)
+        if sampled_softmax:
+            # The reference's actual lm1b loss (TF sampled_softmax_loss):
+            # k negatives instead of the 793k-way softmax.  The sample
+            # set is derived from the batch (deterministic per batch,
+            # varying across batches) so loss_fn stays pure.
+            rng = jax.random.fold_in(jax.random.PRNGKey(0),
+                                     jnp.sum(batch["tokens"]) & 0x7FFFFFFF)
+            return sampled_softmax_cross_entropy(
+                feats[:, :-1], params["softmax_embedding"],
+                batch["tokens"][:, 1:], rng, num_sampled=sampled_softmax)
+        # Default: chunked-vocab EXACT loss — the [B, T, 793k] logits
+        # (16 GB at batch 256) never materialize; unlike the reference,
+        # no sampling bias.
         return chunked_softmax_cross_entropy(
             feats[:, :-1], params["softmax_embedding"],
             batch["tokens"][:, 1:], chunk=xent_chunk)

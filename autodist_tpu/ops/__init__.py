@@ -8,6 +8,9 @@ the CPU test mesh exercises the same code path.
 from autodist_tpu.ops.chunked_xent import (  # noqa: F401
     chunked_softmax_cross_entropy,
 )
+from autodist_tpu.ops.sampled_xent import (  # noqa: F401
+    sampled_softmax_cross_entropy,
+)
 from autodist_tpu.ops.flash_attention import (  # noqa: F401
     flash_attention,
     make_flash_attention,
