@@ -87,3 +87,11 @@ def test_image_classifier():
                        ("--image-size", "32", "--batch-size", "8",
                         "--steps", "3"))
     assert "step 2: loss" in out
+
+
+@pytest.mark.integration
+def test_pipeline_1f1b_example():
+    out = _run_example("examples/pipeline_1f1b.py",
+                       ("--num-layers", "4", "--seq-len", "16",
+                        "--batch-size", "8", "--steps", "3"))
+    assert "max relative drift" in out
