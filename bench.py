@@ -1,6 +1,13 @@
-"""Benchmark: ResNet-50 training throughput (images/sec) + MFU.
+"""Benchmark: the full BASELINE.json parity matrix, framework path.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+The primary metric stays ResNet-50 training throughput; enrichment sections
+measure every other BASELINE.json parity config — flagship TransformerLM
+(flash attention), BERT-base + PartitionedAR, VGG16 + PartitionedPS,
+NCF + PSLoadBalancing, lm1b + Parallax (chunked-vocab exact loss) — each
+through the framework's own ``AutoDist → DistributedSession`` path (matching
+how the reference benchmarked through ``ad.scope()``,
+``/root/reference/examples/benchmark/imagenet.py:85-120``).
 
 Robustness (the TPU tunnel in this image can hang for hours — see
 ``__graft_entry__.py`` for the steering trick):
@@ -20,8 +27,10 @@ chip's peak bf16 FLOP/s.
 
 Baseline note: the reference publishes charts, not numbers
 (docs/usage/performance.md; BASELINE.json.published is empty), so
-``vs_baseline`` normalizes by the round-1 recorded single-chip value below:
-later rounds report their speedup against round 1.
+``vs_baseline`` normalizes by the BEST PRIOR VERIFIED round's driver-captured
+single-chip value (round 2: 2,468.8 images/sec, BENCH_r02.json): each round
+reports its speedup against the best number already on record, keeping the
+ratio meaningful instead of inflating forever against round 1.
 """
 import json
 import os
@@ -32,14 +41,15 @@ import time
 REPO = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, REPO)
 
-# Round-1 recorded reference point (one TPU v5e chip, bf16, batch 128).
-BASELINE_IMAGES_PER_SEC = 2240.0
+# Best prior verified round (round 2, BENCH_r02.json: one TPU v5e chip,
+# bf16, batch 128).  Round 1's 2240.0 is superseded.
+BASELINE_IMAGES_PER_SEC = 2468.8
 
 WARMUP_STEPS = 3
 MEASURE_STEPS = 20
 
 PROBE_TIMEOUT_S = 150
-BENCH_TIMEOUT_S = 1500
+BENCH_TIMEOUT_S = 3000
 PROBE_BACKOFFS_S = (0, 45, 90)  # three probe attempts, ~4 min worst case
 
 
@@ -144,10 +154,11 @@ def run_child(platform: str) -> None:
         # parent timeout mid-enrichment keeps everything measured so far
         # (the parent takes the LAST valid JSON line).  Ordered by value:
         # the dense-attention comparison (extra compiles) goes last.
-        lm_cmp = _fill_lm(result)  # flagship-LM tokens/sec (flash)
+        lm_cmp = _fill_lm(result)  # flagship-LM tokens/sec (flash, session)
         print(json.dumps(result), flush=True)
-        _fill_bert(result)  # BASELINE.json parity config: BERT-base
-        print(json.dumps(result), flush=True)
+        for fill in (_fill_bert, _fill_vgg, _fill_ncf, _fill_lm1b):
+            fill(result)   # remaining BASELINE.json parity configs
+            print(json.dumps(result), flush=True)
         if lm_cmp is not None:
             lm_cmp()       # flash-vs-dense speedup ratio
             print(json.dumps(result), flush=True)
@@ -163,52 +174,79 @@ def _transformer_mfu(tokens_per_sec: float, n_params: float, seq: int,
     return tokens_per_sec * (6.0 * n_params + attn) / peak
 
 
+def _session_throughput(spec, builder, optimizer, batch_size, steps, *,
+                        warmup=3, bf16_params=False, batch_cast=None):
+    """Measure one parity config through the framework's own path:
+    ``AutoDist(builder) → capture → create_distributed_session →
+    place_batch → run`` (matching how the reference benchmarked through
+    ``ad.scope()``, /root/reference/examples/benchmark/imagenet.py:85-120).
+    Returns ``(items_per_sec, dt, mesh_peak_flops)`` and frees the session
+    state before returning so sections don't accumulate HBM."""
+    import jax
+    import jax.numpy as jnp
+
+    from autodist_tpu.autodist import AutoDist, \
+        _reset_default_autodist_for_testing
+
+    params = spec.init(jax.random.PRNGKey(0))
+    if bf16_params:
+        params = jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.bfloat16)
+            if x.dtype == jnp.float32 else x, params)
+    batch = spec.sample_batch(batch_size)
+    if batch_cast is not None:
+        batch = batch_cast(batch)
+    _reset_default_autodist_for_testing()
+    ad = AutoDist(strategy_builder=builder)
+    with ad.scope():
+        ad.capture(params=params, optimizer=optimizer,
+                   loss_fn=spec.loss_fn, sparse_vars=spec.sparse_vars)
+    sess = ad.create_distributed_session()
+    placed = sess.place_batch(batch)
+    dt = _measure_session(sess, placed, warmup, steps)
+    peak = sum(_peak_flops(d) for d in sess.mesh.devices.flat)
+    del sess, ad, params, batch, placed
+    _reset_default_autodist_for_testing()
+    return batch_size * steps / dt, dt, peak
+
+
 def _fill_lm(result):
     """Secondary metric: flagship TransformerLM training throughput with
-    the Pallas flash-attention kernel (the TPU default).  Returns a
+    the Pallas flash-attention kernel (the TPU default), measured through
+    the framework session path like every other section.  Returns a
     thunk that fills the dense-attention comparison (so the caller can
     defer those extra compiles), or None on failure.
     Best-effort — a failure here never loses the primary metric."""
     try:
-        import jax
         import jax.numpy as jnp
-        import numpy as np
         import optax
 
         from autodist_tpu.models.transformer import dense_attention
         from autodist_tpu.models.transformer_lm import transformer_lm
         from autodist_tpu.ops.flash_attention import make_flash_attention
+        from autodist_tpu.strategy import AllReduce
 
         batch_size, seq = 8, 2048
         steps = 8
+
+        mesh_peak = [0.0]
 
         def measure(attn_fn, bs):
             spec = transformer_lm(num_layers=12, num_heads=12, head_dim=64,
                                   d_ff=3072, max_len=seq, seq_len=seq,
                                   attn_fn=attn_fn, dtype=jnp.bfloat16)
-            params = spec.init(jax.random.PRNGKey(0))
-            batch = spec.sample_batch(bs)
-            opt = optax.sgd(1e-3)
-
-            @jax.jit
-            def step(params, opt_state, batch):
-                loss, g = jax.value_and_grad(spec.loss_fn)(params, batch)
-                up, opt_state = opt.update(g, opt_state, params)
-                return optax.apply_updates(params, up), opt_state, loss
-
-            state = opt.init(params)
-            params, state, loss = step(params, state, batch)
-            float(loss)  # hard sync (block_until_ready is unreliable here)
-            t0 = time.perf_counter()
-            for _ in range(steps):
-                params, state, loss = step(params, state, batch)
-            float(loss)
-            return bs * seq * steps / (time.perf_counter() - t0)
+            samples_per_sec, _, peak = _session_throughput(
+                spec, AllReduce(), optax.sgd(1e-3), bs, steps)
+            mesh_peak[0] = peak
+            return samples_per_sec * seq
 
         flash_tps = measure(make_flash_attention(), batch_size)
         result["lm_tokens_per_sec"] = round(flash_tps, 1)
         result["lm_seq_len"] = seq
-        peak = _peak_flops(jax.devices()[0])
+        result["lm_path"] = "session"
+        # Session throughput is AGGREGATE over the mesh: divide by the
+        # whole mesh's peak, not one chip's.
+        peak = mesh_peak[0]
         if peak:
             # 12L x d768: ~124M params (incl. 32128-vocab tied embedding).
             result["lm_mfu"] = round(_transformer_mfu(
@@ -294,48 +332,105 @@ def _fill_bert(result) -> None:
     full AutoDist path with the PartitionedAR strategy — the BASELINE.json
     parity config ('BERT-base — PartitionedAR').  Best-effort."""
     try:
-        import jax
         import jax.numpy as jnp
         import optax
 
-        from autodist_tpu.autodist import AutoDist, \
-            _reset_default_autodist_for_testing
         from autodist_tpu.models.bert import bert_base
         from autodist_tpu.strategy import PartitionedAR
 
         batch_size, seq, steps = 64, 128, 10
         spec = bert_base(seq_len=seq, dtype=jnp.bfloat16)
-        params = spec.init(jax.random.PRNGKey(0))
-        params = jax.tree_util.tree_map(
-            lambda x: x.astype(jnp.bfloat16)
-            if x.dtype == jnp.float32 else x, params)
-        batch = spec.sample_batch(batch_size)
-
-        _reset_default_autodist_for_testing()
-        ad = AutoDist(strategy_builder=PartitionedAR())
-        with ad.scope():
-            ad.capture(params=params, optimizer=optax.adamw(1e-4),
-                       loss_fn=spec.loss_fn)
-        sess = ad.create_distributed_session()
-        batch = sess.place_batch(batch)
-        dt = _measure_session(sess, batch, 3, steps)
-        result["bert_samples_per_sec"] = round(batch_size * steps / dt, 1)
+        sps, dt, peak = _session_throughput(
+            spec, PartitionedAR(), optax.adamw(1e-4), batch_size, steps,
+            bf16_params=True)
+        result["bert_samples_per_sec"] = round(sps, 1)
         result["bert_seq_len"] = seq
         result["bert_batch_size"] = batch_size
-        # Session throughput is AGGREGATE over the mesh: divide by the
-        # whole mesh's peak, not one chip's.
-        peak = sum(_peak_flops(d) for d in sess.mesh.devices.flat)
         if peak:
-            tps = batch_size * steps / dt * seq
             result["bert_mfu"] = round(_transformer_mfu(
-                tps, 110e6, seq, 12, 768, peak, causal=False), 4)
-        # Free the BERT state before the caller's dense-attention
-        # comparison: params + AdamW slots pinned in HBM would shrink the
-        # room the OOM-prone dense program has to compile into.
-        del sess, ad, params, batch
-        _reset_default_autodist_for_testing()
+                sps * seq, 110e6, seq, 12, 768, peak, causal=False), 4)
     except Exception as e:  # pragma: no cover - best-effort enrichment
         print(f"bench: BERT secondary metric unavailable ({e!r})",
+              file=sys.stderr, flush=True)
+
+
+def _fill_vgg(result) -> None:
+    """BASELINE.json parity config: VGG16 + PartitionedPS (the variable-
+    partitioner showcase — its 4096-wide fc layers are what partitioning
+    was built for).  Best-effort."""
+    try:
+        import jax.numpy as jnp
+        import numpy as np
+        import optax
+
+        from autodist_tpu.models.vgg import vgg16
+        from autodist_tpu.strategy import PartitionedPS
+
+        batch_size, steps = 128, 10
+        spec = vgg16(num_classes=1000, image_size=224)
+
+        def cast(batch):
+            return {"images": batch["images"].astype(np.float32).astype(
+                jnp.bfloat16), "labels": batch["labels"]}
+
+        ips, dt, peak = _session_throughput(
+            spec, PartitionedPS(), optax.sgd(0.1, momentum=0.9),
+            batch_size, steps, bf16_params=True, batch_cast=cast)
+        result["vgg16_images_per_sec"] = round(ips, 1)
+        result["vgg16_batch_size"] = batch_size
+        if peak:
+            # VGG16 fwd ~= 15.5 GFLOP/image at 224**2; train ~= 3x fwd.
+            result["vgg16_mfu"] = round(
+                ips * 3.0 * 15.5e9 / peak, 4)
+    except Exception as e:  # pragma: no cover - best-effort enrichment
+        print(f"bench: VGG16 metric unavailable ({e!r})",
+              file=sys.stderr, flush=True)
+
+
+def _fill_ncf(result) -> None:
+    """BASELINE.json parity config: NCF (MovieLens-scale) + PSLoadBalancing
+    — embedding-dominated, the byte-balanced PS showcase.  Best-effort."""
+    try:
+        import optax
+
+        from autodist_tpu.models.ncf import ncf
+        from autodist_tpu.strategy import PSLoadBalancing
+
+        batch_size, steps = 4096, 20
+        spec = ncf()
+        sps, dt, _ = _session_throughput(
+            spec, PSLoadBalancing(), optax.adam(1e-3), batch_size, steps)
+        result["ncf_samples_per_sec"] = round(sps, 0)
+        result["ncf_batch_size"] = batch_size
+    except Exception as e:  # pragma: no cover - best-effort enrichment
+        print(f"bench: NCF metric unavailable ({e!r})",
+              file=sys.stderr, flush=True)
+
+
+def _fill_lm1b(result) -> None:
+    """BASELINE.json parity config: lm1b LSTM LM (793k vocab) + Parallax
+    hybrid — sparse embedding/softmax to sharded PS, dense LSTM weights to
+    AllReduce.  Uses the chunked-vocab EXACT cross entropy (the default,
+    ops/chunked_xent.py) at batch 256: the framework's best configuration —
+    the dense-logits loss OOMs there ([256, 19, 793k] f32 = 15.5 GB), and
+    chunking measured 28.3k vs 16.1k wps for dense at its best batch (r2).
+    Best-effort."""
+    try:
+        import optax
+
+        from autodist_tpu.models.lm1b import lm1b
+        from autodist_tpu.strategy import Parallax
+
+        batch_size, steps = 256, 10
+        spec = lm1b()          # default = chunked exact loss, 8192 chunks
+        seq = spec.config["seq_len"]
+        sps, dt, _ = _session_throughput(
+            spec, Parallax(), optax.adagrad(0.1), batch_size, steps)
+        result["lm1b_words_per_sec"] = round(sps * seq, 0)
+        result["lm1b_batch_size"] = batch_size
+        result["lm1b_loss"] = "chunked_xent_exact"
+    except Exception as e:  # pragma: no cover - best-effort enrichment
+        print(f"bench: lm1b metric unavailable ({e!r})",
               file=sys.stderr, flush=True)
 
 
