@@ -77,8 +77,6 @@ def pipelined_transformer_lm(
     (1F1B) against head-gradient traffic (GPipe) for your config."""
     if schedule not in ("gpipe", "1f1b"):
         raise ValueError(f"unknown schedule {schedule!r}")
-    if schedule == "1f1b" and num_virtual_stages != 1:
-        raise ValueError("1F1B supports num_virtual_stages=1 only")
     seq_len = seq_len or max_len
     d_model = num_heads * head_dim
     stages = num_stages or mesh.shape.get("pipe", 1) or 1
@@ -158,12 +156,13 @@ def pipelined_transformer_lm(
                   "pos_embed": params["pos_embed"]}
             x, embed_vjp = jax.vjp(embed_fn, ep)
             stacked = jax.tree_util.tree_map(
-                lambda a: a.reshape((stages, num_layers // stages)
+                lambda a: a.reshape((chunks, num_layers // chunks)
                                     + a.shape[1:]), params["stack"])
             lp = {"ln_final": params["ln_final"], "embed": params["embed"]}
             loss, dstack, dlp, dx = one_f_one_b(
                 stage_fn, head_loss, stacked, x, tokens, mesh,
-                num_microbatches=m, loss_params=lp)
+                num_microbatches=m, loss_params=lp,
+                num_virtual_stages=num_virtual_stages)
             (dep,) = embed_vjp(dx)
             # the tied embedding gets gradient from BOTH sides: the input
             # lookup (via dx) and the softmax head (loss-side params).

@@ -4,7 +4,11 @@ Sequence/context parallelism — ring attention (ring_attention.py) and
 Ulysses all-to-all (ulysses.py); pipeline parallelism (pipeline.py);
 expert parallelism / MoE (moe.py)."""
 from autodist_tpu.parallel.moe import init_moe_params, moe_ffn  # noqa: F401
-from autodist_tpu.parallel.pipeline_1f1b import one_f_one_b  # noqa: F401
+from autodist_tpu.parallel.pipeline_1f1b import (  # noqa: F401
+    bubble_fraction_1f1b,
+    one_f_one_b,
+    schedule_ticks_1f1b,
+)
 from autodist_tpu.parallel.pipeline import (  # noqa: F401
     pipeline_apply,
     stack_stage_params,

@@ -200,10 +200,134 @@ def test_1f1b_loss_params_gradients():
     np.testing.assert_allclose(dx, rdx, rtol=1e-5, atol=1e-6)
 
 
-def test_pipelined_lm_1f1b_trains_through_session():
-    """Full integration: pipelined LM with schedule='1f1b' trains through
-    an AutoDist session via capture(grad_fn=spec.grad_fn) — multi-step
-    loss parity with the autodiff (GPipe) spec on the same mesh."""
+def _interleaved_stack(rng, s, v):
+    from autodist_tpu.parallel.pipeline import interleaved_stage_order
+    stages_po = [{"w": jnp.asarray(rng.standard_normal((D, D)) * 0.3,
+                                   jnp.float32),
+                  "b": jnp.asarray(rng.standard_normal(D) * 0.1, jnp.float32)}
+                 for _ in range(s * v)]
+    order = interleaved_stage_order(s, v)
+    return stack_stage_params([stages_po[g] for g in order])
+
+
+@pytest.mark.parametrize("v,m,b", [(2, 8, 16), (4, 4, 16), (3, 8, 16),
+                                   (2, 6, 12)])  # m=6: M not a multiple of S
+def test_1f1b_interleaved_matches_autodiff(v, m, b):
+    """V>1 circular 1F1B vs autodiff through the interleaved-GPipe
+    pipeline (device-major stage layout shared between the two)."""
+    rng = np.random.default_rng(10 + v)
+    stacked = _interleaved_stack(rng, S, v)
+    x = jnp.asarray(rng.standard_normal((b, D)), jnp.float32)
+    t = jnp.asarray(rng.standard_normal((b, D)), jnp.float32)
+    mesh = build_mesh({"pipe": S, "data": 1})
+
+    def oracle(sp, x):
+        y = pipeline_apply(_stage_fn, sp, x, mesh, num_microbatches=m,
+                           num_virtual_stages=v)
+        mb = y.reshape((m, b // m, D))
+        tb = t.reshape((m, b // m, D))
+        return jnp.mean(jax.vmap(_loss_fn)(mb, tb))
+
+    rl, (rdsp, rdx) = jax.value_and_grad(oracle, argnums=(0, 1))(stacked, x)
+    loss, dsp, dx = one_f_one_b(_stage_fn, _loss_fn, stacked, x, t, mesh,
+                                num_microbatches=m, num_virtual_stages=v)
+    np.testing.assert_allclose(loss, rl, rtol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6),
+        dsp, rdsp)
+    np.testing.assert_allclose(dx, rdx, rtol=1e-5, atol=1e-6)
+
+
+def test_1f1b_interleaved_with_loss_params_and_data_axis():
+    """V=2 composed with data parallelism AND loss-side head params."""
+    rng = np.random.default_rng(20)
+    v, m = 2, 4                       # m is PER data shard
+    stacked = _interleaved_stack(rng, S, v)
+    x = jnp.asarray(rng.standard_normal((B, D)), jnp.float32)
+    t = jnp.asarray(rng.standard_normal((B, D)), jnp.float32)
+    head = {"w": jnp.asarray(rng.standard_normal((D, D)) * 0.3, jnp.float32)}
+
+    def head_loss(lp, y_mb, t_mb):
+        return jnp.mean((y_mb @ lp["w"] - t_mb) ** 2)
+
+    mesh = build_mesh({"pipe": S, "data": 2})
+    loss, dsp, dlp, dx = one_f_one_b(
+        _stage_fn, head_loss, stacked, x, t, mesh, num_microbatches=m,
+        num_virtual_stages=v, loss_params=head)
+
+    # Oracle: per-data-shard GPipe pipelines averaged (the dp semantics).
+    mesh1 = build_mesh({"pipe": S, "data": 1})
+
+    def ref(sp, lp, x):
+        losses = []
+        for sh in range(2):
+            rows = slice(sh * B // 2, (sh + 1) * B // 2)
+            y = pipeline_apply(_stage_fn, sp, x[rows], mesh1,
+                               num_microbatches=m, num_virtual_stages=v)
+            mb = y.reshape((m, B // 2 // m, D))
+            tb = t[rows].reshape((m, B // 2 // m, D))
+            losses.append(jnp.mean(
+                jax.vmap(lambda ym, tm: head_loss(lp, ym, tm))(mb, tb)))
+        return jnp.mean(jnp.stack(losses))
+
+    rl, (rdsp, rdlp, rdx) = jax.value_and_grad(ref, argnums=(0, 1, 2))(
+        stacked, head, x)
+    np.testing.assert_allclose(loss, rl, rtol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6),
+        dsp, rdsp)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6),
+        dlp, rdlp)
+    np.testing.assert_allclose(dx, rdx, rtol=1e-5, atol=1e-6)
+
+
+def test_1f1b_interleaved_tick_count_and_bubble():
+    """Schedule accounting: the documented tick formula, and interleaving
+    strictly shrinking the 1F1B bubble for the same microbatch count."""
+    from autodist_tpu.parallel.pipeline_1f1b import (bubble_fraction_1f1b,
+                                                     schedule_ticks_1f1b)
+    assert schedule_ticks_1f1b(4, 8, 1) == 8 + 2 * 3          # M + 2(S-1)
+    assert schedule_ticks_1f1b(4, 8, 2) == 8 + 3 + 2 * 7 + 1  # tj(M-1)+2(SV-1)+1
+    for s, m in ((4, 8), (4, 16), (8, 16)):
+        b1 = bubble_fraction_1f1b(s, m, 1)
+        b2 = bubble_fraction_1f1b(s, m, 2)
+        b4 = bubble_fraction_1f1b(s, m, 4)
+        assert b2 < b1 and b4 < b2, (s, m, b1, b2, b4)
+    # In stage-work units the V=2 warmup+drain is (3S-2)/2 vs 2(S-1):
+    # e.g. S=4: 5 < 6 stage units.
+    s = 4
+    overhead_v1 = (schedule_ticks_1f1b(s, 64, 1) - 64) * 1.0
+    overhead_v2 = (schedule_ticks_1f1b(s, 64, 2) - 128) / 2.0
+    assert overhead_v2 < overhead_v1
+
+
+def test_1f1b_interleaved_stash_is_O_SV_not_O_M():
+    """Interleaved 1F1B keeps the M-independent activation stash."""
+    mesh = build_mesh({"pipe": S, "data": 1})
+    rng = np.random.default_rng(21)
+    stacked = _interleaved_stack(rng, S, 2)
+
+    def temp_bytes(m):
+        bsz = 4 * m
+        x = jnp.asarray(rng.standard_normal((bsz, D)), jnp.float32)
+        t = jnp.asarray(rng.standard_normal((bsz, D)), jnp.float32)
+        fn = jax.jit(lambda sp, x, t: one_f_one_b(
+            _stage_fn, _loss_fn, sp, x, t, mesh, num_microbatches=m,
+            num_virtual_stages=2))
+        mem = fn.lower(stacked, x, t).compile().memory_analysis()
+        return mem.temp_size_in_bytes
+
+    small, big = temp_bytes(8), temp_bytes(32)
+    assert big < 2.5 * small, (small, big)
+
+
+@pytest.mark.parametrize("num_virtual", [1, 2])
+def test_pipelined_lm_1f1b_trains_through_session(num_virtual):
+    """Full integration: pipelined LM with schedule='1f1b' (incl. the
+    interleaved V=2 variant) trains through an AutoDist session via
+    capture(grad_fn=spec.grad_fn) — multi-step loss parity with the
+    autodiff (GPipe) spec on the same mesh and virtual-stage layout."""
     import optax
 
     from autodist_tpu.autodist import (AutoDist,
@@ -212,8 +336,9 @@ def test_pipelined_lm_1f1b_trains_through_session():
     from autodist_tpu.strategy import PSLoadBalancing
 
     mesh = build_mesh({"pipe": 4, "data": 2})
-    kw = dict(vocab_size=64, num_layers=4, num_heads=2, head_dim=8,
-              d_ff=32, max_len=16, seq_len=16, num_microbatches=4)
+    kw = dict(vocab_size=64, num_layers=8, num_heads=2, head_dim=8,
+              d_ff=32, max_len=16, seq_len=16, num_microbatches=4,
+              num_virtual_stages=num_virtual)
     spec_1f1b = pipelined_transformer_lm(mesh, schedule="1f1b", **kw)
     spec_ref = pipelined_transformer_lm(mesh, schedule="gpipe", **kw)
     assert spec_1f1b.grad_fn is not None and spec_ref.grad_fn is None
