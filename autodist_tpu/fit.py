@@ -120,8 +120,17 @@ def fit(session, data: DataArg, epochs: int = 1,
         callbacks: Sequence[Callback] = (), log_every: int = 0,
         checkpoint_dir: Optional[str] = None, checkpoint_every: int = 1,
         resume: bool = True, async_checkpoints: bool = False,
+        initial_epoch: Optional[int] = None,
         prefetch_depth: int = 2) -> History:
     """Train ``epochs`` × (``steps_per_epoch`` or len(data)) steps.
+
+    ``epochs`` is the TOTAL target, Keras-style: resuming an interrupted
+    ``fit(epochs=N)`` completes to N total epochs, not N more.  The
+    starting epoch comes from ``initial_epoch`` when given; otherwise,
+    after a checkpoint restore with ``steps_per_epoch`` set, it is
+    derived as ``restored_step // steps_per_epoch``.  When neither is
+    derivable (resumed, no ``steps_per_epoch``), the loop falls back to
+    running ``epochs`` more epochs and says so in the log.
 
     Args:
       session: a :class:`~autodist_tpu.runner.DistributedSession`.
@@ -142,6 +151,8 @@ def fit(session, data: DataArg, epochs: int = 1,
         ``checkpoint_every`` epochs, and — with ``resume`` — restore the
         latest checkpoint before training (exact resume: optimizer slots
         and sync state included, step counter advanced).
+      initial_epoch: epoch to start from (epochs below it are skipped);
+        overrides the step-derived default after a resume.
       async_checkpoints: persist checkpoint files in the background of
         training (the device→host snapshot stays synchronous, so saved
         values are consistent); ``fit`` waits for the last save to be
@@ -152,6 +163,7 @@ def fit(session, data: DataArg, epochs: int = 1,
     Returns a :class:`History`.
     """
     saver = None
+    resumed_step = None
     if checkpoint_dir is not None:
         from autodist_tpu.checkpoint import Saver
 
@@ -159,9 +171,34 @@ def fit(session, data: DataArg, epochs: int = 1,
         if resume:
             latest = Saver.latest_checkpoint(checkpoint_dir)
             if latest is not None:
-                step = saver.restore(latest)
+                resumed_step = saver.restore(latest)
                 logging.info("fit: resumed from %s at step %d",
-                             latest, step)
+                             latest, resumed_step)
+
+    if initial_epoch is None:
+        if resumed_step and steps_per_epoch:
+            # Complete to `epochs` TOTAL: skip the epochs the restored
+            # step already covers (Keras initial_epoch semantics).
+            initial_epoch = min(resumed_step // steps_per_epoch, epochs)
+            if resumed_step % steps_per_epoch:
+                # Mid-epoch checkpoints (the data-exhaustion tail save)
+                # resume at epoch granularity: the partial epoch re-runs.
+                logging.warning(
+                    "fit: restored step %d is mid-epoch (steps_per_epoch="
+                    "%d) — resuming from epoch %d re-runs its partial "
+                    "progress; pass initial_epoch to override",
+                    resumed_step, steps_per_epoch, initial_epoch)
+        else:
+            if resumed_step:
+                logging.warning(
+                    "fit: resumed at step %d without steps_per_epoch — "
+                    "cannot derive completed epochs, so running %d MORE "
+                    "epochs; pass initial_epoch (or steps_per_epoch) for "
+                    "train-to-N-total semantics", resumed_step, epochs)
+            initial_epoch = 0
+    if initial_epoch >= epochs and resumed_step:
+        logging.info("fit: restored step %d already covers %d epochs — "
+                     "nothing to train", resumed_step, epochs)
 
     if isinstance(data, dict):
         # One repeated batch: place it once — re-placing a placed batch is
@@ -182,7 +219,7 @@ def fit(session, data: DataArg, epochs: int = 1,
         cb.on_train_begin(session)
 
     last_saved_step = None
-    for epoch in range(epochs):
+    for epoch in range(initial_epoch, epochs):
         for cb in callbacks:
             cb.on_epoch_begin(epoch)
         it = _epoch_iter(data, steps_per_epoch)

@@ -31,6 +31,7 @@ from autodist_tpu.models.base import (
     cross_entropy_loss,
     layer_norm as _layer_norm,
 )
+from autodist_tpu.utils import logging
 from autodist_tpu.models.transformer import TransformerLayer, dense_attention
 from autodist_tpu.parallel.pipeline import (
     default_num_microbatches,
@@ -38,6 +39,10 @@ from autodist_tpu.parallel.pipeline import (
     pipeline_apply,
     stack_stage_params,
 )
+
+# Replicated-f32-head-gradient size above which schedule='1f1b' without a
+# 'model' mesh axis warns (the ADVICE threshold): 64 MB ~= a 16k x 1k head.
+_HEAD_GRAD_WARN_BYTES = 64 * 2**20
 
 
 def _device_major_layers(per_layer, stages: int, num_virtual: int):
@@ -65,18 +70,36 @@ def pipelined_transformer_lm(
     """Stage-stacked GPT-style LM pipelined over ``mesh``'s ``pipe`` axis.
 
     ``num_virtual_stages > 1`` selects the interleaved schedule: each device
-    holds that many chunks and the bubble shrinks proportionally.
+    holds that many chunks and the bubble shrinks proportionally (works
+    with both schedules — for 1F1B see the circular-interleaved algebra in
+    ``parallel/pipeline_1f1b.py``).
     ``schedule="1f1b"`` trains through the hand-scheduled 1F1B backward
-    (``parallel/pipeline_1f1b.py``, O(S) activation memory): the spec's
+    (``parallel/pipeline_1f1b.py``, O(S·V) activation memory): the spec's
     ``grad_fn`` replaces autodiff — pass it to ``capture(grad_fn=...)``
-    (``loss_fn`` stays the autodiff version for evaluation).  Caveat:
-    the tied-embedding head rides ``loss_params``, which is replicated
-    with a dense f32 gradient carried through the schedule — fine for
-    norms/small heads, but for a large tied vocab the GPipe schedule's
-    sparse/sharded embed gradients are cheaper; weigh activation memory
-    (1F1B) against head-gradient traffic (GPipe) for your config."""
+    (``loss_fn`` stays the autodiff version for evaluation).
+
+    Large-vocab note: the tied-embedding head rides ``loss_params`` into
+    the schedule.  With a ``model`` mesh axis and a vocab-sharding
+    strategy (any PS builder shards sparse vars over ``model``), GSPMD
+    keeps the table, its per-tick vjp gradient, and the f32 accumulator
+    sharded end-to-end — no replicated ``[vocab, d_model]`` buffer exists
+    (pinned by ``tests/test_pipeline_1f1b.py``), so 1F1B is the right
+    schedule for large vocabs *given a model axis*.  WITHOUT one, the
+    head gradient is a dense replicated f32 ``[vocab, d_model]`` carried
+    through the schedule; a warning fires above
+    ``_HEAD_GRAD_WARN_BYTES`` pointing at a model axis or GPipe."""
     if schedule not in ("gpipe", "1f1b"):
         raise ValueError(f"unknown schedule {schedule!r}")
+    if (schedule == "1f1b" and mesh.shape.get("model", 1) <= 1
+            and 4 * vocab_size * num_heads * head_dim
+            > _HEAD_GRAD_WARN_BYTES):
+        logging.warning(
+            "pipelined_transformer_lm(schedule='1f1b'): vocab %d x d_model "
+            "%d means a %.0f MB replicated f32 head gradient per device "
+            "(no 'model' mesh axis to shard it over). Add a model axis "
+            "with a vocab-sharding strategy, or use schedule='gpipe' "
+            "(sharded embed grads).", vocab_size, num_heads * head_dim,
+            4 * vocab_size * num_heads * head_dim / 2**20)
     seq_len = seq_len or max_len
     d_model = num_heads * head_dim
     stages = num_stages or mesh.shape.get("pipe", 1) or 1

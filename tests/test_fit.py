@@ -139,6 +139,39 @@ def test_fit_checkpoint_and_resume(tmp_path):
         np.abs(sess3.params["w"] - np.array([[1.0], [-2.0], [0.5]])) + 1e-9)
 
 
+def test_fit_resume_trains_to_total_epochs(tmp_path):
+    """epochs is the TOTAL target (Keras semantics): resuming an
+    interrupted fit(epochs=N) with steps_per_epoch derivable completes to
+    N total epochs instead of running N more."""
+    ckpt = str(tmp_path / "ckpt")
+    sess, batches = _make_session(PartitionedPS())
+    data = batches(5)
+    sess.fit(data, epochs=2, steps_per_epoch=5, checkpoint_dir=ckpt)
+    assert sess.step_count == 10
+
+    # Re-running the same fit target with more epochs: completes 2 -> 4.
+    _reset_default_autodist_for_testing()
+    sess2, _ = _make_session(PartitionedPS())
+    hist = sess2.fit(data, epochs=4, steps_per_epoch=5,
+                     checkpoint_dir=ckpt, resume=True)
+    assert sess2.step_count == 20          # epochs 2,3 only
+    assert hist.epochs_run == 2
+
+    # Target already met: restores and trains nothing.
+    _reset_default_autodist_for_testing()
+    sess3, _ = _make_session(PartitionedPS())
+    hist3 = sess3.fit(data, epochs=2, steps_per_epoch=5,
+                      checkpoint_dir=ckpt, resume=True)
+    assert sess3.step_count == 20 and hist3.steps_run == 0
+
+    # Explicit initial_epoch overrides the derivation.
+    _reset_default_autodist_for_testing()
+    sess4, _ = _make_session(PartitionedPS())
+    hist4 = sess4.fit(data, epochs=5, steps_per_epoch=5,
+                      checkpoint_dir=ckpt, resume=True, initial_epoch=4)
+    assert hist4.epochs_run == 1 and sess4.step_count == 25
+
+
 def test_fit_empty_epoch_warns_not_crashes():
     sess, _ = _make_session()
     ends = []

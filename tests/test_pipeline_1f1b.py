@@ -322,6 +322,91 @@ def test_1f1b_interleaved_stash_is_O_SV_not_O_M():
     assert big < 2.5 * small, (small, big)
 
 
+def test_1f1b_large_vocab_head_grads_sharded():
+    """The Megatron vocab-parallel answer to 1F1B head gradients: with a
+    'model' mesh axis and a vocab-sharding strategy, the tied-embedding
+    table, its per-tick vjp gradient, and the f32 accumulator all stay
+    sharded through the partial-manual shard_map — no replicated
+    [vocab, d_model] f32 buffer exists anywhere in the per-device HLO,
+    and the loss matches the autodiff GPipe spec."""
+    import re
+
+    import optax
+
+    from autodist_tpu.autodist import (AutoDist,
+                                       _reset_default_autodist_for_testing)
+    from autodist_tpu.models.pipelined_lm import pipelined_transformer_lm
+    from autodist_tpu.strategy import PSLoadBalancing
+
+    vocab, d_model = 32768, 16
+    mesh = build_mesh({"pipe": 2, "model": 2, "data": 2})
+    kw = dict(vocab_size=vocab, num_layers=4, num_heads=2, head_dim=8,
+              d_ff=32, max_len=16, seq_len=16, num_microbatches=2)
+    spec1 = pipelined_transformer_lm(mesh, schedule="1f1b", **kw)
+    spec0 = pipelined_transformer_lm(mesh, schedule="gpipe", **kw)
+    params = spec0.init(jax.random.PRNGKey(0))
+    batch = spec0.sample_batch(8)
+
+    def run(spec, use_gf):
+        _reset_default_autodist_for_testing()
+        ad = AutoDist(strategy_builder=PSLoadBalancing(),
+                      mesh_axes={"pipe": 2, "model": 2, "data": 2})
+        with ad.scope():
+            ad.capture(params=params, optimizer=optax.adam(1e-2),
+                       loss_fn=spec.loss_fn,
+                       grad_fn=spec.grad_fn if use_gf else None,
+                       sparse_vars=spec.sparse_vars,
+                       pipeline_vars=spec.pipeline_vars)
+        sess = ad.create_distributed_session(mesh=mesh)
+        return [float(sess.run(batch)["loss"]) for _ in range(3)], sess
+
+    l1, sess1 = run(spec1, True)
+    l0, _ = run(spec0, False)
+    np.testing.assert_allclose(l1, l0, rtol=3e-4)
+
+    step = sess1._step
+    txt = step.step_fn.lower(
+        sess1.sharded_params, sess1._opt_state, sess1._sync_state,
+        sess1.place_batch(batch)).compile().as_text()
+    assert not re.search(rf"f32\[{vocab},{d_model}\]", txt), \
+        "replicated full-vocab f32 head gradient found in per-device HLO"
+    assert re.search(rf"f32\[{vocab // 2},{d_model}\]", txt), \
+        "expected model-sharded f32 head-gradient buffers"
+
+
+def test_pipelined_lm_1f1b_warns_without_model_axis():
+    """ADVICE #3: a large tied vocab under schedule='1f1b' with no model
+    axis warns (dense replicated f32 head gradient), and stays silent
+    when a model axis is there to shard it."""
+    import logging as stdlib_logging
+
+    from autodist_tpu.models.pipelined_lm import pipelined_transformer_lm
+
+    records = []
+
+    class _Capture(stdlib_logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    handler = _Capture(level=stdlib_logging.WARNING)
+    logger = stdlib_logging.getLogger("autodist_tpu")
+    logger.addHandler(handler)
+    try:
+        big = dict(vocab_size=262144, num_layers=4, num_heads=2,
+                   head_dim=64, d_ff=32, max_len=16,
+                   seq_len=16)                 # 256k x 128 f32 = 128 MB
+        mesh = build_mesh({"pipe": 4, "data": 2})
+        pipelined_transformer_lm(mesh, schedule="1f1b", **big)
+        assert any("model" in m for m in records), records
+        records.clear()
+        mesh_tp = build_mesh({"pipe": 2, "model": 2, "data": 2})
+        pipelined_transformer_lm(mesh_tp, schedule="1f1b", **big)
+        pipelined_transformer_lm(mesh, schedule="gpipe", **big)
+        assert not [m for m in records if "head gradient" in m], records
+    finally:
+        logger.removeHandler(handler)
+
+
 @pytest.mark.parametrize("num_virtual", [1, 2])
 def test_pipelined_lm_1f1b_trains_through_session(num_virtual):
     """Full integration: pipelined LM with schedule='1f1b' (incl. the
