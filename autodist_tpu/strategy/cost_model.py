@@ -23,7 +23,11 @@ Model (standard ring-collective algebra, cf. the scaling-book recipe):
   densifies the gradient to the full table (the Parallax argument,
   ``parallax_strategy.py:24-71``);
 * each collective pays a launch latency ``alpha``; grouped AllReduce
-  variables share one launch (the reference's chunking rationale);
+  variables share one launch when the lowering fuses them — explicit
+  ``fused=True`` concat-and-pmean, or the default ``assume_combiner``
+  assumption that XLA's all-reduce combiner merges same-program psums
+  (the verified TPU behavior); ``assume_combiner=False`` costs one
+  launch per variable instead;
 * bandwidth: ICI within one host — and across hosts on a TPU pod slice
   (``ici_connected: true`` in the yaml: one interconnect domain); the
   yaml's ``network_bandwidth`` (NIC/DCN) is the bottleneck only for
@@ -109,13 +113,22 @@ def estimate_cost(strategy: Strategy, graph_item: GraphItem,
                   resource_spec: ResourceSpec, *,
                   sparse_rows_hint: int = 4096,
                   ici_bandwidth: float = ICI_BANDWIDTH,
-                  alpha: float = COLLECTIVE_ALPHA) -> CostReport:
+                  alpha: float = COLLECTIVE_ALPHA,
+                  assume_combiner: bool = True) -> CostReport:
     """Estimate one strategy's per-step sync cost on ``resource_spec``.
 
     Args:
       sparse_rows_hint: rows a batch touches in each sparse variable (an
         upper bound: capped at the vocab size); the model cannot know the
         batch, so callers with real input stats should pass them.
+      assume_combiner: when True (default), AllReduce variables sharing a
+        strategy group are costed as ONE collective launch — the TPU
+        reality, where XLA's all-reduce combiner merges same-program
+        psums (verified in HLO, ``graph_transformer.py`` combiner
+        lowering) and ``fused=True`` groups concat explicitly.  Pass
+        False to cost one launch per variable (a backend whose combiner
+        is disabled).  An explicit ASSUMPTION, not ambient env state —
+        the estimate must be reproducible.
     """
     d = max(resource_spec.num_chips, 1)
     ring = _ring_factor(d)
@@ -147,9 +160,17 @@ def estimate_cost(strategy: Strategy, graph_item: GraphItem,
             # (the reason Parallax exists); nbytes already is the table.
             vc = VarCost(cfg.var_name, "allreduce", wire,
                          _OPT_SLOTS * nbytes, group=sync.group)
-            if d > 1 and sync.group not in groups_seen:
-                groups_seen.add(sync.group)
-                report.num_collectives += 1
+            # Launch latency: a group shares ONE launch when the lowering
+            # fuses it — explicit concat-and-pmean (fused=True), or the
+            # assume_combiner default (XLA's combiner merges same-program
+            # psums on TPU).  Otherwise one launch per variable.
+            group_fuses = getattr(sync, "fused", False) or assume_combiner
+            if d > 1:
+                if not group_fuses:
+                    report.num_collectives += 1
+                elif sync.group not in groups_seen:
+                    groups_seen.add(sync.group)
+                    report.num_collectives += 1
         elif isinstance(sync, PSSynchronizerConfig):
             shards = max(_shard_count(cfg.partitioner), 1)
             if info.sparse:
