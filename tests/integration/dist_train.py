@@ -33,8 +33,14 @@ sys.path.insert(0, os.environ.get("AUTODIST_REPO_ROOT",
 
 import jax  # noqa: E402
 
+# Single-process oracle mode (AUTODIST_TEST_SINGLE=1): same script, same
+# case, same GLOBAL mesh shape, but one process with all 4 devices local
+# — the parity reference proving the process boundary changes nothing.
+SINGLE = os.environ.get("AUTODIST_TEST_SINGLE", "").lower() \
+    not in ("", "0", "false")
+
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)
+jax.config.update("jax_num_cpu_devices", 4 if SINGLE else 2)
 
 import numpy as np  # noqa: E402
 
@@ -62,13 +68,113 @@ def loss_fn(params, batch):
     return jnp.mean((pred - batch["y"]) ** 2)
 
 
+def _linear_case():
+    params = {"w": np.zeros(3, np.float32), "b": np.zeros((), np.float32)}
+    return params, loss_fn, make_batch(), {}
+
+
+def _sparse_case():
+    """Vocab-sharded embedding: the table shards over the process-spanning
+    data axis, so gradient scatter-adds cross the OS-process boundary
+    (the reference's sparse-PS distributed case, test_dist.py matrix)."""
+    vocab, dim = 64, 8
+    rng = np.random.RandomState(7)
+    params = {
+        "emb": (rng.randn(vocab, dim) * 0.1).astype(np.float32),
+        "head": (rng.randn(dim) * 0.1).astype(np.float32),
+    }
+
+    def sparse_loss(p, batch):
+        import jax.numpy as jnp
+
+        rows = jnp.take(p["emb"], batch["ids"], axis=0)
+        pred = rows @ p["head"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    batch = {"ids": rng.randint(0, vocab, (32,)).astype(np.int32),
+             "y": rng.randn(32).astype(np.float32)}
+    return params, sparse_loss, batch, {"sparse_vars": ("emb",)}
+
+
+def _pipeline_case(schedule):
+    """Stage-stacked pipelined model on a pipe-ONLY mesh: the pipe axis
+    spans the two processes, so every ppermute ring hop (and, for 1f1b,
+    the hand-scheduled backward's reverse ring) crosses the process
+    boundary.  Params are plain numpy (no jax before rendezvous); the
+    mesh is built lazily inside the traced loss/grad (after
+    jax.distributed.initialize)."""
+    s, d = 4, 8
+    rng = np.random.RandomState(11)
+    params = {"stack": {
+        "w": (rng.randn(s, d, d) * 0.3).astype(np.float32),
+        "b": (rng.randn(s, d) * 0.1).astype(np.float32),
+    }}
+    batch = {"x": rng.randn(8, d).astype(np.float32),
+             "y": rng.randn(8, d).astype(np.float32)}
+
+    def stage_fn(p, h):
+        import jax.numpy as jnp
+
+        return jnp.tanh(h @ p["w"] + p["b"])
+
+    def mse(y, t):
+        import jax.numpy as jnp
+
+        return jnp.mean((y - t) ** 2)
+
+    def pipe_loss(p, batch):
+        import jax.numpy as jnp
+
+        from autodist_tpu.mesh import build_mesh
+        from autodist_tpu.parallel.pipeline import pipeline_apply
+
+        mesh = build_mesh({"pipe": s})
+        y = pipeline_apply(stage_fn, p["stack"], batch["x"], mesh,
+                           num_microbatches=4)
+        mb = y.reshape((4, 2, d))
+        tb = batch["y"].reshape((4, 2, d))
+        return jnp.mean(jax.vmap(mse)(mb, tb))
+
+    kwargs = {"pipeline_vars": ("stack",)}
+    if schedule == "1f1b":
+        from autodist_tpu.mesh import build_mesh
+        from autodist_tpu.parallel.pipeline_1f1b import one_f_one_b
+
+        def grad_fn(p, batch):
+            mesh = build_mesh({"pipe": s})
+            loss, dstack, _ = one_f_one_b(
+                stage_fn, mse, p["stack"], batch["x"], batch["y"], mesh,
+                num_microbatches=4)
+            return loss, {"stack": dstack}
+
+        kwargs["grad_fn"] = grad_fn
+    return params, pipe_loss, batch, kwargs
+
+
+def make_case(name):
+    if name == "linear":
+        return _linear_case()
+    if name == "sparse":
+        return _sparse_case()
+    if name in ("pipeline", "pipeline1f1b"):
+        return _pipeline_case("1f1b" if name.endswith("1f1b") else "gpipe")
+    raise ValueError(f"unknown test case {name!r}")
+
+
 def main():
     import optax
 
     builder = {"AllReduce": AllReduce,
                "PSLoadBalancing": PSLoadBalancing,
-               "PartitionedPS": PartitionedPS}[
+               "PartitionedPS": PartitionedPS,
+               # Compressed explicit-shard_map sync across processes:
+               # bf16 wire format with error feedback, concat-and-pmean
+               # fused groups (the path test_allreduce_group.py covers
+               # single-process).
+               "AllReduceEF": lambda: AllReduce(
+                   compressor="HorovodCompressorEF", fused_groups=True)}[
                    os.environ.get("AUTODIST_TEST_BUILDER", "AllReduce")]()
+    case_name = os.environ.get("AUTODIST_TEST_CASE", "linear")
     # Optional mesh override (e.g. "model=4"): with model as the ONLY
     # axis it necessarily spans the two processes — cross-process tensor
     # parallelism, beyond the reference's data-parallel-only multi-machine
@@ -79,20 +185,26 @@ def main():
         mesh_axes = {k: int(v) for k, v in
                      (kv.split("=") for kv in
                       os.environ["AUTODIST_TEST_MESH"].split(","))}
-    # Two "nodes", both local: the chief fans the script out with
-    # subprocess+env exactly as it would over SSH to a remote host.
-    spec = ResourceSpec(resource_info={
-        "nodes": [{"address": "127.0.0.1", "chips": 2, "chief": True},
-                  {"address": "localhost", "chips": 2}]})
+    if SINGLE:
+        # One node holding all 4 devices: the parity oracle topology.
+        spec = ResourceSpec(resource_info={
+            "nodes": [{"address": "127.0.0.1", "chips": 4, "chief": True}]})
+    else:
+        # Two "nodes", both local: the chief fans the script out with
+        # subprocess+env exactly as it would over SSH to a remote host.
+        spec = ResourceSpec(resource_info={
+            "nodes": [{"address": "127.0.0.1", "chips": 2, "chief": True},
+                      {"address": "localhost", "chips": 2}]})
 
     # Params as numpy: no jax computation may run before
     # jax.distributed.initialize (see Cluster.start).
-    params = {"w": np.zeros(3, np.float32), "b": np.zeros((), np.float32)}
+    params, case_loss_fn, batch, capture_kwargs = make_case(case_name)
 
     ad = AutoDist(resource_spec=spec, strategy_builder=builder,
                   mesh_axes=mesh_axes)
     with ad.scope():
-        ad.capture(params=params, optimizer=optax.sgd(LR), loss_fn=loss_fn)
+        ad.capture(params=params, optimizer=optax.sgd(LR),
+                   loss_fn=case_loss_fn, **capture_kwargs)
 
     # Fault-injection hook (tests/test_multiprocess.py): the worker dies
     # AFTER deserializing the chief's strategy but before rendezvous, while
@@ -109,9 +221,14 @@ def main():
 
     import jax
 
-    batch = make_batch()
     losses = [float(sess.run(batch)["loss"]) for _ in range(STEPS)]
-    final_w = np.asarray(sess.params["w"]).tolist()  # before the extra step
+    final = sess.params           # before the extra step below
+    final_w = (np.asarray(final["w"]).tolist()
+               if "w" in final else None)
+    # Case-independent parity fingerprint over ALL trained parameters.
+    param_checksum = float(sum(
+        np.abs(np.asarray(leaf, np.float64)).sum()
+        for leaf in jax.tree_util.tree_leaves(final)))
 
     # Multi-host input path: each process feeds only ITS half of the global
     # batch (disjoint rows) through place_local_batch — the
@@ -119,19 +236,22 @@ def main():
     # feed-splitting Remapper.  The resulting loss must equal evaluating
     # the same global batch fed identically from every process.
     pidx, pcount = jax.process_index(), jax.process_count()
-    if sess.mesh.shape.get("data", 1) > 1:
-        rows = batch["x"].shape[0] // pcount
+    if sess.mesh.shape.get("data", 1) > 1 and pcount > 1:
+        nrows = next(iter(batch.values())).shape[0]
+        rows = nrows // pcount
         local = {k: v[pidx * rows:(pidx + 1) * rows]
                  for k, v in batch.items()}
         sharded_loss = float(sess.run(sess.place_local_batch(local),
                                       sync=True)["loss"])
     else:
-        # No multi-way data axis (pure-TP mesh): batches replicate, so
-        # disjoint local shards have no sharded layout to land in.
+        # No multi-way data axis (pure-TP/pipe mesh) or single process:
+        # batches replicate, so disjoint local shards have no sharded
+        # layout to land in (single mode skips for step-count parity).
         sharded_loss = None
 
     result = {
         "role": "worker" if ENV.AUTODIST_WORKER.val else "chief",
+        "case": case_name,
         "process_index": jax.process_index(),
         "process_count": jax.process_count(),
         "global_devices": len(jax.devices()),
@@ -141,6 +261,7 @@ def main():
         "losses": losses,
         "sharded_input_loss": sharded_loss,
         "final_w": final_w,
+        "param_checksum": param_checksum,
     }
     out = os.environ["AUTODIST_RESULT_FILE"]
     if ENV.AUTODIST_WORKER.val:
@@ -152,7 +273,9 @@ def main():
     # Explicit shutdown BEFORE the chief joins the worker: jax's atexit
     # shutdown runs a coordination-service barrier, so a chief blocked in
     # join() while the worker waits in that barrier would deadlock.
-    jax.distributed.shutdown()
+    # (Single-process oracle mode never initialized jax.distributed.)
+    if not SINGLE:
+        jax.distributed.shutdown()
     if ad.coordinator is not None:
         ad.coordinator.join()
 

