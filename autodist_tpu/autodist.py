@@ -13,6 +13,7 @@ gRPC cluster.
 from __future__ import annotations
 
 import contextlib
+import itertools
 from typing import Any, Callable, Dict, Optional, Sequence
 
 from autodist_tpu.cluster import Cluster, make_cluster
@@ -227,26 +228,48 @@ class AutoDist:
         return self._session
 
     # -- TF2-style one-liner (reference autodist.py:204-289) ---------------
-    def function(self, fn: Optional[Callable] = None):
+    def function(self, fn: Optional[Callable] = None, *,
+                 sync_every: int = 1):
         """Decorator parity with ``autodist.function``: wraps a per-batch
         step; the first call builds the session, later calls run steps.
 
         The decorated ``fn(batch)`` body is *declarative* in the reference
         (it defines the graph); here the captured loss_fn/optimizer define
         the step and ``fn``'s return value selects extra fetches from the
-        metrics dict (or None for all metrics)."""
+        metrics dict (or None for all metrics).
+
+        Beyond fetch selection, the wrapper owns the hot-loop cadence the
+        reference's remapper/session pairing owned: with ``sync_every=N``
+        only every N-th call syncs metrics to host numpy; in between,
+        steps dispatch back-to-back and return device arrays (JAX
+        futures).  The per-step host round-trip is the classic accidental
+        serializer on TPU (docs/performance.md); N≈10+ keeps dispatch
+        ahead.  (Placement is already automatic: ``session.run`` places
+        every batch, and placing a pre-placed/prefetched batch is a
+        no-op.)
+
+        Forms: bare ``@ad.function``, decorator factory
+        ``@ad.function(sync_every=10)``, or ``ad.function()`` /
+        ``ad.function(sync_every=10)(None)`` for a plain step runner
+        with no fetch selector.
+        """
 
         def wrap(user_fn):
+            calls = itertools.count(1)
+
             def run_fn(batch):
                 session = self.create_distributed_session()
-                metrics = session.run(batch)
+                sync = sync_every <= 1 or next(calls) % sync_every == 0
+                metrics = session.run(batch, sync=sync)
                 out = user_fn(metrics) if user_fn is not None else metrics
                 return out if out is not None else metrics
             return run_fn
 
         if fn is not None and not callable(fn):
             raise TypeError("ad.function expects a callable (or use @ad.function)")
-        return wrap(fn) if fn is not None else wrap(None)
+        # Bare @ad.function gets the wrapped step directly; with only
+        # kwargs (@ad.function(sync_every=N)) return the decorator.
+        return wrap(fn) if fn is not None else wrap
 
 
 def _reset_default_autodist_for_testing() -> None:

@@ -3,12 +3,16 @@
 Two specs of the same model train side by side: GPipe (autodiff through
 the tick-scan — O(M) stashed activations) and 1F1B
 (``parallel/pipeline_1f1b.py`` — backward interleaved into the ring,
-O(S) stashed activations, plugged in via ``capture(grad_fn=...)``).
+O(S·V) stashed activations, plugged in via ``capture(grad_fn=...)``).
 Their losses match step for step; the memory difference is what you buy.
+``--virtual-stages V`` selects the interleaved layout for BOTH schedules
+(each device holds V chunks; the warmup/drain bubble shrinks — see the
+algebra in ``parallel/pipeline_1f1b.py``).
 
 Run (CPU mesh):
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-        python examples/pipeline_1f1b.py
+        python examples/pipeline_1f1b.py --virtual-stages 2 --num-layers 8
+(num_layers must divide into pipe x virtual-stages chunks.)
 """
 import argparse
 import os
@@ -23,6 +27,7 @@ import optax
 def main():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--pipe", type=int, default=4)
+    p.add_argument("--virtual-stages", type=int, default=1)
     p.add_argument("--num-layers", type=int, default=4)
     p.add_argument("--seq-len", type=int, default=64)
     p.add_argument("--batch-size", type=int, default=16)
@@ -37,9 +42,13 @@ def main():
 
     axes = {"pipe": args.pipe, "data": 2}
     mesh = build_mesh(axes)
+    if args.num_layers % (args.pipe * args.virtual_stages):
+        p.error("--num-layers must divide into pipe x virtual-stages "
+                "chunks")
     kw = dict(vocab_size=2048, num_layers=args.num_layers, num_heads=4,
               head_dim=16, d_ff=64, max_len=args.seq_len,
-              seq_len=args.seq_len)
+              seq_len=args.seq_len,
+              num_virtual_stages=args.virtual_stages)
 
     losses = {}
     for sched in ("1f1b", "gpipe"):

@@ -95,3 +95,12 @@ def test_pipeline_1f1b_example():
                        ("--num-layers", "4", "--seq-len", "16",
                         "--batch-size", "8", "--steps", "3"))
     assert "max relative drift" in out
+
+
+@pytest.mark.integration
+def test_pipeline_1f1b_example_interleaved():
+    out = _run_example("examples/pipeline_1f1b.py",
+                       ("--virtual-stages", "2", "--num-layers", "8",
+                        "--seq-len", "16", "--batch-size", "8",
+                        "--steps", "3"))
+    assert "max relative drift" in out
