@@ -89,6 +89,18 @@ def run_child(platform: str) -> None:
     if platform == "cpu":
         _steer("cpu")
     import jax
+
+    # Persistent compilation cache: the parity matrix is ~8-10 programs at
+    # 1-4 min of (remote) compile each — cached, a re-run (or the retry
+    # attempt after a flaky tunnel drop) skips straight to measurement.
+    # (config.update, not env vars: this jax build ignores the env names.)
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          "/tmp/autodist_jax_cache")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+    except Exception as e:  # pragma: no cover - version-dependent knob
+        print(f"bench: compilation cache unavailable ({e!r})",
+              file=sys.stderr, flush=True)
     import jax.numpy as jnp
     import numpy as np
     import optax
