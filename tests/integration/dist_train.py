@@ -249,6 +249,27 @@ def main():
         # layout to land in (single mode skips for step-count parity).
         sharded_loss = None
 
+    # Live distributed checkpoint roundtrip (reference c10's saver-in-
+    # distributed-run, but with an exactness assertion): save mid-run,
+    # train 2 steps, restore, train the same 2 steps again — the loss
+    # pairs must match bit-for-bit if resume is exact.  Orbax saves are
+    # collective: every process participates in save AND restore.
+    ckpt_losses = None
+    if os.environ.get("AUTODIST_TEST_CHECKPOINT"):
+        from autodist_tpu.checkpoint import Saver
+
+        ckpt_dir = os.environ["AUTODIST_RESULT_FILE"] + ".ckpt"
+        saver = Saver(sess)
+        save_step = sess.step_count
+        path = saver.save(ckpt_dir, step=save_step)
+        after_save = [float(sess.run(batch)["loss"]) for _ in range(2)]
+        restored_step = saver.restore(path)
+        after_restore = [float(sess.run(batch)["loss"]) for _ in range(2)]
+        ckpt_losses = {"after_save": after_save,
+                       "after_restore": after_restore,
+                       "save_step": save_step,
+                       "restored_step": restored_step}
+
     result = {
         "role": "worker" if ENV.AUTODIST_WORKER.val else "chief",
         "case": case_name,
@@ -262,6 +283,7 @@ def main():
         "sharded_input_loss": sharded_loss,
         "final_w": final_w,
         "param_checksum": param_checksum,
+        "checkpoint": ckpt_losses,
     }
     out = os.environ["AUTODIST_RESULT_FILE"]
     if ENV.AUTODIST_WORKER.val:
