@@ -178,7 +178,8 @@ def run_child(platform: str) -> None:
         _reset_default_autodist_for_testing()
         lm_cmp = _fill_lm(result)  # flagship-LM tokens/sec (flash, session)
         print(json.dumps(result), flush=True)
-        for fill in (_fill_bert, _fill_vgg, _fill_ncf, _fill_lm1b):
+        for fill in (_fill_bert, _fill_vgg, _fill_ncf, _fill_lm1b,
+                     _fill_linreg):
             fill(result)   # remaining BASELINE.json parity configs
             print(json.dumps(result), flush=True)
         if lm_cmp is not None:
@@ -461,6 +462,44 @@ def _fill_input_pipeline(result, sess, batch_size, image_size) -> None:
                 f"{round(loader_ips)} img/s")
     except Exception as e:  # pragma: no cover - best-effort enrichment
         print(f"bench: input pipeline metric unavailable ({e!r})",
+              file=sys.stderr, flush=True)
+
+
+def _fill_linreg(result) -> None:
+    """BASELINE.json parity config #1: linear_regression + PS (the
+    reference's single-node smoke workload).  Steps/sec through the full
+    session path — trivial compute, so this measures the framework's
+    per-step dispatch floor.  Best-effort."""
+    try:
+        import jax.numpy as jnp
+        import numpy as np
+        import optax
+
+        from autodist_tpu.models.base import ModelSpec
+        from autodist_tpu.strategy import PS
+
+        rng = np.random.RandomState(0)
+        w_true = rng.randn(8, 1).astype(np.float32)
+
+        def loss_fn(p, batch):
+            return jnp.mean((batch["x"] @ p["w"] + p["b"]
+                             - batch["y"]) ** 2)
+
+        def make_batch(r, n):
+            x = r.randn(n, 8).astype(np.float32)
+            return {"x": x, "y": x @ w_true + 0.01
+                    * r.randn(n, 1).astype(np.float32)}
+
+        spec = ModelSpec(
+            name="linear_regression",
+            init=lambda _: {"w": jnp.zeros((8, 1)), "b": jnp.zeros((1,))},
+            loss_fn=loss_fn, apply_fn=None, make_batch=make_batch)
+        batch_size, steps = 256, 100
+        _, dt, _ = _session_throughput(spec, PS(), optax.sgd(0.1),
+                                       batch_size, steps, warmup=5)
+        result["linreg_steps_per_sec"] = round(steps / dt, 1)
+    except Exception as e:  # pragma: no cover - best-effort enrichment
+        print(f"bench: linear-regression metric unavailable ({e!r})",
               file=sys.stderr, flush=True)
 
 

@@ -217,11 +217,11 @@ def test_two_process_checkpoint_roundtrip(tmp_path):
         # (absolute value is 5: 4 training steps + the sharded-input
         # extra step precede the checkpoint block).
         assert ck["restored_step"] == ck["save_step"] == 5
-        np.testing.assert_allclose(ck["after_restore"], ck["after_save"],
-                                   rtol=1e-6)
-    np.testing.assert_allclose(chief["checkpoint"]["after_save"],
-                               worker["checkpoint"]["after_save"],
-                               rtol=1e-6)
+        # Exact equality: resume replays the SAME compiled steps from the
+        # SAME restored state, so any deviation at all is a restore bug.
+        assert ck["after_restore"] == ck["after_save"], ck
+    assert chief["checkpoint"]["after_save"] == \
+        worker["checkpoint"]["after_save"]
 
 
 def test_worker_crash_aborts_chief(tmp_path):
