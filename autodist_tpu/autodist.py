@@ -106,13 +106,19 @@ class AutoDist:
                 remat: Optional[str] = None,
                 has_aux: bool = False,
                 metrics_fn: Optional[Callable] = None,
-                grad_fn: Optional[Callable] = None) -> GraphItem:
+                grad_fn: Optional[Callable] = None,
+                accum_steps: int = 1) -> GraphItem:
         """Capture the training program (the explicit analog of the
         reference's optimizer/gradient monkeypatch hooks,
         graph_item.py:72-108).  ``metrics_fn(params, batch) -> dict``
         merges extra metrics (e.g. accuracy) into every step's and
         ``evaluate``'s outputs — the reference's extra ``sess.run``
-        fetches / Keras ``compile(metrics=...)``."""
+        fetches / Keras ``compile(metrics=...)``.  ``accum_steps=N``
+        accumulates gradients over N microbatches per step (effective
+        batch B at the live activation memory of B/N for the gradient
+        pass; a ``metrics_fn`` still runs one full-batch forward).  With
+        ``has_aux`` the per-step aux comes back STACKED along a leading
+        ``[N]`` axis (one entry per microbatch)."""
         if self.is_built():
             raise RuntimeError(
                 "Cannot capture after the distributed session was created "
@@ -122,7 +128,7 @@ class AutoDist:
             sparse_vars=sparse_vars, untrainable_vars=untrainable_vars,
             pipeline_vars=pipeline_vars, expert_vars=expert_vars,
             remat=remat, has_aux=has_aux, metrics_fn=metrics_fn,
-            grad_fn=grad_fn)
+            grad_fn=grad_fn, accum_steps=accum_steps)
         return self._graph_item
 
     @property

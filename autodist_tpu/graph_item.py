@@ -175,12 +175,21 @@ class GraphItem:
                  remat: Optional[str] = None,
                  has_aux: bool = False,
                  metrics_fn: Optional[Callable] = None,
-                 grad_fn: Optional[Callable] = None):
+                 grad_fn: Optional[Callable] = None,
+                 accum_steps: int = 1):
         self.params = params
         self.optimizer = optimizer
         self.loss_fn = _apply_remat(loss_fn, remat)
         self.remat = remat
         self.has_aux = has_aux
+        # Gradient accumulation: the step splits each batch into this many
+        # microbatches (leading dim) and averages their gradients before
+        # the single optimizer update — effective batch B at the live
+        # memory of B/accum_steps (assumes a row-mean loss, the standard
+        # contract; see GraphTransformer).
+        if accum_steps < 1:
+            raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+        self.accum_steps = accum_steps
         # (params, batch) -> dict of extra metrics, merged into every
         # step's / evaluate's outputs (the Keras compile(metrics=...)
         # analog; the reference fetched extra tensors via sess.run).

@@ -224,6 +224,12 @@ class GraphTransformer:
                     "compressors / fused groups (the explicit shard_map "
                     "path owns the gradient computation); drop the "
                     "compressor or the manual grad_fn")
+            if gi.accum_steps > 1:
+                raise ValueError(
+                    "capture(accum_steps=...) is not supported with "
+                    "gradient compressors / fused groups (the explicit "
+                    "shard_map path owns the gradient computation); drop "
+                    "the compressor or the accumulation")
             if mesh.shape.get(MESH_AXIS_DATA, 1) > 1:
                 from autodist_tpu.kernel.synchronization.stale_sync import \
                     uses_stale_path
@@ -295,6 +301,15 @@ class GraphTransformer:
             vg = jax.value_and_grad(loss_fn, has_aux=gi.has_aux)
         optimizer = gi.optimizer
         has_aux = gi.has_aux
+        if gi.accum_steps > 1:
+            vg = _accumulate_grads(vg, gi.accum_steps, has_aux)
+            if extra_metrics_fn is not None:
+                logging.warning(
+                    "accum_steps=%d with metrics_fn: metrics run one "
+                    "FULL-batch forward in the same step, so peak "
+                    "activation memory stays O(batch) — the accumulation "
+                    "memory win applies to the gradient pass only",
+                    gi.accum_steps)
 
         # Bounded staleness / proxy mirrors ride in sync_state (see
         # stale_sync module; the SSP translation of the reference's token
@@ -504,6 +519,58 @@ def _make_eval_step(loss_fn: Callable, has_aux: bool,
         return out
 
     return eval_step
+
+
+def _accumulate_grads(vg: Callable, accum: int, has_aux: bool) -> Callable:
+    """Wrap a value-and-grad so one step averages gradients over ``accum``
+    microbatches (leading-dim split) under a ``lax.scan`` — effective
+    batch B at the live activation memory of B/accum.  Exact for row-mean
+    losses (every bundled model): the mean of per-microbatch means equals
+    the full-batch mean, and likewise for their gradients.  With
+    ``has_aux`` the returned aux is STACKED along a leading [accum] axis.
+    """
+    from jax import lax
+
+    def vg_accum(params, batch):
+        leaves = jax.tree_util.tree_leaves(batch)
+        for leaf in leaves:
+            if leaf.shape[0] % accum:
+                raise ValueError(
+                    f"batch leading dim {leaf.shape[0]} not divisible "
+                    f"into accum_steps={accum} microbatches")
+        mbs = jax.tree_util.tree_map(
+            lambda x: x.reshape((accum, x.shape[0] // accum) + x.shape[1:]),
+            batch)
+
+        def body(carry, mb):
+            loss_acc, g_acc = carry
+            if has_aux:
+                (loss, aux), g = vg(params, mb)
+            else:
+                loss, g = vg(params, mb)
+                aux = None
+            g_acc = jax.tree_util.tree_map(
+                lambda a, b: a + b.astype(a.dtype), g_acc, g)
+            return (loss_acc + loss.astype(jax.numpy.float32), g_acc), aux
+
+        # f32 accumulators: microbatch grads may be bf16; summing accum of
+        # them in bf16 loses low bits the single-pass computation keeps.
+        # The final average casts back to the grad dtypes autodiff made.
+        g_shapes = jax.eval_shape(lambda p, b: vg(p, b)[1], params,
+                                  jax.tree_util.tree_map(
+                                      lambda x: x[0], mbs))
+        g0 = jax.tree_util.tree_map(
+            lambda s: jax.numpy.zeros(s.shape, jax.numpy.float32), g_shapes)
+        (loss_sum, g_sum), auxs = lax.scan(
+            body, (jax.numpy.float32(0.0), g0), mbs)
+        grads = jax.tree_util.tree_map(
+            lambda g, s: (g / accum).astype(s.dtype), g_sum, g_shapes)
+        loss = loss_sum / accum
+        if has_aux:
+            return (loss, auxs), grads
+        return loss, grads
+
+    return vg_accum
 
 
 def _merge_metrics(metrics: Dict, extra: Dict) -> Dict:
