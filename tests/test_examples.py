@@ -98,6 +98,14 @@ def test_pipeline_1f1b_example():
 
 
 @pytest.mark.integration
+def test_generate_text_example():
+    # The example enforces its own accuracy bar (assert acc > 0.9);
+    # a zero returncode from _run_example is the pass criterion here.
+    out = _run_example("examples/generate_text.py", ("--steps", "200"))
+    assert "continuation accuracy:" in out
+
+
+@pytest.mark.integration
 def test_pipeline_1f1b_example_interleaved():
     out = _run_example("examples/pipeline_1f1b.py",
                        ("--virtual-stages", "2", "--num-layers", "8",
