@@ -31,13 +31,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from autodist_tpu.models.base import ModelSpec
-
-
-def _ln(x, scale, eps=1e-6):
-    mu = x.mean(-1, keepdims=True)
-    var = ((x - mu) ** 2).mean(-1, keepdims=True)
-    return (x - mu) * lax.rsqrt(var + eps) * scale
+from autodist_tpu.models.base import ModelSpec, layer_norm as _ln
 
 
 def _token_step(layer_params, ln_final_scale, embed, x, k_cache, v_cache,
@@ -145,24 +139,22 @@ def make_generator(spec: ModelSpec):
             tick, (tokens0, k0, k0, rng0), jnp.arange(total - 1))
         return tokens, step_logits
 
-    def wrapped(params, prompt, max_new_tokens: int,
-                rng: Optional[jax.Array] = None,
-                temperature: float = 0.0):
-        if temperature > 0.0 and rng is None:
-            raise ValueError("temperature sampling needs an rng key")
-        tokens, _ = generate(params, prompt, int(max_new_tokens), rng,
-                             float(temperature))
-        return tokens
-
     def with_logits(params, prompt, max_new_tokens: int,
                     rng: Optional[jax.Array] = None,
                     temperature: float = 0.0):
-        """Like the main entry but also returns the per-position logits
-        ``[total-1, B, V]`` (scoring/evaluation use)."""
+        """Tokens plus the per-position logits ``[total-1, B, V]``
+        (scoring/evaluation use)."""
         if temperature > 0.0 and rng is None:
             raise ValueError("temperature sampling needs an rng key")
         return generate(params, prompt, int(max_new_tokens), rng,
                         float(temperature))
+
+    def wrapped(params, prompt, max_new_tokens: int,
+                rng: Optional[jax.Array] = None,
+                temperature: float = 0.0):
+        tokens, _ = with_logits(params, prompt, max_new_tokens, rng,
+                                temperature)
+        return tokens
 
     wrapped.with_logits = with_logits
     return wrapped
