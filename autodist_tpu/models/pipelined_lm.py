@@ -45,6 +45,60 @@ from autodist_tpu.parallel.pipeline import (
 _HEAD_GRAD_WARN_BYTES = 64 * 2**20
 
 
+def _warn_large_1f1b_head(mesh: Mesh, vocab_size: int, d_model: int) -> None:
+    """Shared schedule='1f1b' guard: a big tied-vocab head with no 'model'
+    mesh axis means a dense replicated f32 gradient through the schedule
+    (with a model axis the whole path stays sharded — docs/parallelism.md)."""
+    if (mesh.shape.get("model", 1) <= 1
+            and 4 * vocab_size * d_model > _HEAD_GRAD_WARN_BYTES):
+        logging.warning(
+            "schedule='1f1b': vocab %d x d_model %d means a %.0f MB "
+            "replicated f32 head gradient per device (no 'model' mesh "
+            "axis to shard it over). Add a model axis with a "
+            "vocab-sharding strategy, or use schedule='gpipe' (sharded "
+            "embed grads).", vocab_size, d_model,
+            4 * vocab_size * d_model / 2**20)
+
+
+def _tied_head_1f1b_grad_fn(mesh: Mesh, *, stages: int, chunks: int,
+                            num_layers: int, num_microbatches,
+                            num_virtual_stages: int, stage_fn: Callable,
+                            head_loss: Callable,
+                            make_embed_fn: Callable) -> Callable:
+    """The 1F1B value-and-grad shared by the pipelined LM family: embed
+    lookup under ``jax.vjp`` (``make_embed_fn(tokens) -> ep -> x``), the
+    hand-scheduled pipeline backward over the stacked layers, loss-side
+    head/norm gradients via ``loss_params``, and the tied embedding
+    receiving gradient from BOTH sides (input lookup + softmax head)."""
+    from autodist_tpu.parallel.pipeline_1f1b import one_f_one_b
+
+    def grad_fn(params, batch):
+        tokens = batch["tokens"]
+        # per-DATA-SHARD microbatch count (one_f_one_b semantics).
+        local_b = tokens.shape[0] // max(mesh.shape.get("data", 1), 1)
+        m = num_microbatches or default_num_microbatches(stages, local_b)
+        ep = {"embed": params["embed"], "pos_embed": params["pos_embed"]}
+        x, embed_vjp = jax.vjp(make_embed_fn(tokens), ep)
+        stacked = jax.tree_util.tree_map(
+            lambda a: a.reshape((chunks, num_layers // chunks)
+                                + a.shape[1:]), params["stack"])
+        lp = {"ln_final": params["ln_final"], "embed": params["embed"]}
+        loss, dstack, dlp, dx = one_f_one_b(
+            stage_fn, head_loss, stacked, x, tokens, mesh,
+            num_microbatches=m, loss_params=lp,
+            num_virtual_stages=num_virtual_stages)
+        (dep,) = embed_vjp(dx)
+        return loss, {
+            "embed": dep["embed"] + dlp["embed"],
+            "pos_embed": dep["pos_embed"],
+            "stack": jax.tree_util.tree_map(
+                lambda g, p: g.reshape(p.shape), dstack, params["stack"]),
+            "ln_final": dlp["ln_final"],
+        }
+
+    return grad_fn
+
+
 def _device_major_layers(per_layer, stages: int, num_virtual: int):
     """Reorder a pipeline-ordered layer list so the stored stack's leading
     axis is device-major (chunk block ``d·V + v`` = global stage ``v·S+d``)
@@ -90,16 +144,8 @@ def pipelined_transformer_lm(
     ``_HEAD_GRAD_WARN_BYTES`` pointing at a model axis or GPipe."""
     if schedule not in ("gpipe", "1f1b"):
         raise ValueError(f"unknown schedule {schedule!r}")
-    if (schedule == "1f1b" and mesh.shape.get("model", 1) <= 1
-            and 4 * vocab_size * num_heads * head_dim
-            > _HEAD_GRAD_WARN_BYTES):
-        logging.warning(
-            "pipelined_transformer_lm(schedule='1f1b'): vocab %d x d_model "
-            "%d means a %.0f MB replicated f32 head gradient per device "
-            "(no 'model' mesh axis to shard it over). Add a model axis "
-            "with a vocab-sharding strategy, or use schedule='gpipe' "
-            "(sharded embed grads).", vocab_size, num_heads * head_dim,
-            4 * vocab_size * num_heads * head_dim / 2**20)
+    if schedule == "1f1b":
+        _warn_large_1f1b_head(mesh, vocab_size, num_heads * head_dim)
     seq_len = seq_len or max_len
     d_model = num_heads * head_dim
     stages = num_stages or mesh.shape.get("pipe", 1) or 1
@@ -157,46 +203,22 @@ def pipelined_transformer_lm(
 
     grad_fn = None
     if schedule == "1f1b":
-        from autodist_tpu.parallel.pipeline_1f1b import one_f_one_b
-
         def head_loss(lp, y_mb, tok_mb):
             h = _layer_norm(y_mb, lp["ln_final"]["scale"])
             logits = jnp.einsum("btd,vd->btv", h, lp["embed"])
             return cross_entropy_loss(logits[:, :-1], tok_mb[:, 1:])
 
-        def grad_fn(params, batch):
-            tokens = batch["tokens"]
-            # per-DATA-SHARD microbatch count (one_f_one_b semantics);
-            # reuse the divisibility-aware default.
-            local_b = tokens.shape[0] // max(mesh.shape.get("data", 1), 1)
-            m = num_microbatches or default_num_microbatches(stages, local_b)
-
+        def make_embed_fn(tokens):
             def embed_fn(ep):
                 return (jnp.take(ep["embed"], tokens, axis=0)
                         + ep["pos_embed"][None, :tokens.shape[1]])
+            return embed_fn
 
-            ep = {"embed": params["embed"],
-                  "pos_embed": params["pos_embed"]}
-            x, embed_vjp = jax.vjp(embed_fn, ep)
-            stacked = jax.tree_util.tree_map(
-                lambda a: a.reshape((chunks, num_layers // chunks)
-                                    + a.shape[1:]), params["stack"])
-            lp = {"ln_final": params["ln_final"], "embed": params["embed"]}
-            loss, dstack, dlp, dx = one_f_one_b(
-                stage_fn, head_loss, stacked, x, tokens, mesh,
-                num_microbatches=m, loss_params=lp,
-                num_virtual_stages=num_virtual_stages)
-            (dep,) = embed_vjp(dx)
-            # the tied embedding gets gradient from BOTH sides: the input
-            # lookup (via dx) and the softmax head (loss-side params).
-            return loss, {
-                "embed": dep["embed"] + dlp["embed"],
-                "pos_embed": dep["pos_embed"],
-                "stack": jax.tree_util.tree_map(
-                    lambda g, p: g.reshape(p.shape), dstack,
-                    params["stack"]),
-                "ln_final": dlp["ln_final"],
-            }
+        grad_fn = _tied_head_1f1b_grad_fn(
+            mesh, stages=stages, chunks=chunks, num_layers=num_layers,
+            num_microbatches=num_microbatches,
+            num_virtual_stages=num_virtual_stages, stage_fn=stage_fn,
+            head_loss=head_loss, make_embed_fn=make_embed_fn)
 
     return ModelSpec(
         name="pipelined_transformer_lm",

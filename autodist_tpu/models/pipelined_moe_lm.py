@@ -40,8 +40,19 @@ def pipelined_moe_transformer_lm(
         aux_weight: float = 1e-2, dtype=jnp.float32,
         seq_len: Optional[int] = None, num_stages: Optional[int] = None,
         num_microbatches: Optional[int] = None,
-        num_virtual_stages: int = 1, remat: bool = False
-        ) -> ModelSpec:
+        num_virtual_stages: int = 1, remat: bool = False,
+        schedule: str = "gpipe") -> ModelSpec:
+    """``schedule="1f1b"`` trains through the hand-scheduled 1F1B backward
+    (``parallel/pipeline_1f1b.py``) — pipeline × expert × data with O(S·V)
+    activation memory; the MoE balancing aux rides the activation channel
+    through the schedule and the per-microbatch head loss peels it (mean
+    of per-microbatch means == the GPipe loss, pinned in tests/test_moe.py).
+    """
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"unknown schedule {schedule!r}")
+    if schedule == "1f1b":
+        from autodist_tpu.models.pipelined_lm import _warn_large_1f1b_head
+        _warn_large_1f1b_head(mesh, vocab_size, num_heads * head_dim)
     seq_len = seq_len or max_len
     d_model = num_heads * head_dim
     stages = num_stages or mesh.shape.get("pipe", 1) or 1
@@ -110,14 +121,44 @@ def pipelined_moe_transformer_lm(
         return {"tokens": rng.randint(
             0, vocab_size, (batch_size, seq_len)).astype(np.int32)}
 
+    grad_fn = None
+    if schedule == "1f1b":
+        from autodist_tpu.models.pipelined_lm import _tied_head_1f1b_grad_fn
+
+        def head_loss(lp, ya_mb, tok_mb):
+            y = ya_mb[..., :-1]
+            aux = jnp.mean(ya_mb[..., -1]) / num_layers
+            h = _layer_norm(y, lp["ln_final"])
+            logits = jnp.einsum("btd,vd->btv", h, lp["embed"])
+            ce = cross_entropy_loss(logits[:, :-1], tok_mb[:, 1:])
+            return ce + aux_weight * aux
+
+        def make_embed_fn(tokens):
+            def embed_fn(ep):
+                x = (jnp.take(ep["embed"], tokens, axis=0)
+                     + ep["pos_embed"][None, :tokens.shape[1]])
+                # aux-loss channel (zero at entry; stages accumulate into
+                # it — its input cotangent vanishes with the zeros input)
+                return jnp.concatenate([x, jnp.zeros_like(x[..., :1])],
+                                       axis=-1)
+            return embed_fn
+
+        grad_fn = _tied_head_1f1b_grad_fn(
+            mesh, stages=stages, chunks=chunks, num_layers=num_layers,
+            num_microbatches=num_microbatches,
+            num_virtual_stages=num_virtual_stages, stage_fn=stage_fn,
+            head_loss=head_loss, make_embed_fn=make_embed_fn)
+
     return ModelSpec(
         name="pipelined_moe_transformer_lm",
         init=init, loss_fn=loss_fn, apply_fn=apply_fn, make_batch=make_batch,
+        grad_fn=grad_fn,
         sparse_vars=("embed",),
         pipeline_vars=("stack",),
         expert_vars=("stack/moe/wi", "stack/moe/wo"),
         config=dict(vocab_size=vocab_size, num_layers=num_layers,
                     num_heads=num_heads, head_dim=head_dim, d_ff=d_ff,
                     num_experts=num_experts, max_len=max_len,
-                    seq_len=seq_len, num_stages=stages),
+                    seq_len=seq_len, num_stages=stages,
+                    schedule=schedule),
     )

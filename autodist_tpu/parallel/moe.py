@@ -121,10 +121,22 @@ def moe_ffn(params: dict, x: jax.Array, *,
 
     ep_sharding = None
     if mesh is not None and mesh.shape.get(MESH_AXIS_EXPERT, 1) > 1:
-        ep_sharding = NamedSharding(mesh, P(
-            MESH_AXIS_EXPERT,
-            MESH_AXIS_DATA if mesh.shape.get(MESH_AXIS_DATA, 1) > 1
-            and g % mesh.shape[MESH_AXIS_DATA] == 0 else None))
+        # Inside a partial-manual shard_map (e.g. the 1F1B schedule,
+        # manual over pipe/data) a constraint may only name AUTO axes —
+        # drop any axis the current trace has manualized (it is already
+        # device-local there).
+        try:
+            manual = set(jax.sharding.get_abstract_mesh().manual_axes)
+        except Exception:  # pragma: no cover - API drift
+            manual = set()
+        if MESH_AXIS_EXPERT in manual:
+            ep_sharding = None
+        else:
+            data_ok = (mesh.shape.get(MESH_AXIS_DATA, 1) > 1
+                       and MESH_AXIS_DATA not in manual
+                       and g % mesh.shape[MESH_AXIS_DATA] == 0)
+            ep_sharding = NamedSharding(mesh, P(
+                MESH_AXIS_EXPERT, MESH_AXIS_DATA if data_ok else None))
 
     expert_in = jnp.einsum("gsec,gsm->egcm", dispatch, x)   # [E,G,C,M]
     if ep_sharding is not None:
