@@ -89,6 +89,18 @@ def make_generator(spec: ModelSpec):
             f"{sorted(cfg)}")
     num_layers = cfg["num_layers"]
 
+    def _check_len(total):
+        if total > cfg["max_len"]:
+            raise ValueError(
+                f"prompt + max_new_tokens = {total} exceeds the model's "
+                f"max_len {cfg['max_len']}")
+
+    def _unpack(params):
+        layer_params = [params["decoder"][f"layers_{i}"]
+                        for i in range(num_layers)]
+        return (params["embed"], params["pos_embed"], layer_params,
+                params["decoder"]["ln_final"]["scale"])
+
     # max_new_tokens and temperature are static: they shape the scan and
     # select the sampling branch at trace time.
     @functools.partial(jax.jit, static_argnums=(2, 4))
@@ -96,15 +108,8 @@ def make_generator(spec: ModelSpec):
                  temperature=0.0):
         b, p_len = prompt.shape
         total = p_len + max_new_tokens
-        if total > cfg["max_len"]:
-            raise ValueError(
-                f"prompt + max_new_tokens = {total} exceeds the model's "
-                f"max_len {cfg['max_len']}")
-        embed = params["embed"]
-        pos_embed = params["pos_embed"]
-        layer_params = [params["decoder"][f"layers_{i}"]
-                       for i in range(num_layers)]
-        ln_final = params["decoder"]["ln_final"]["scale"]
+        _check_len(total)
+        embed, pos_embed, layer_params, ln_final = _unpack(params)
         heads, hd = cfg["num_heads"], cfg["head_dim"]
         dtype = embed.dtype
         k0 = jnp.zeros((num_layers, b, total, heads, hd), dtype)
@@ -156,5 +161,93 @@ def make_generator(spec: ModelSpec):
                                 temperature)
         return tokens
 
+    # Beam search: beams ride the batch dim ([B·W] rows through the same
+    # KV-cache tick); per-position, scores = beam logprob + log-softmax
+    # over the vocab, top-W of the W·V continuations survive, and the
+    # caches are gathered along the beam dim to follow their histories.
+    @functools.partial(jax.jit, static_argnums=(2, 3))
+    def beam_generate(params, prompt, max_new_tokens, num_beams):
+        b, p_len = prompt.shape
+        w = num_beams
+        total = p_len + max_new_tokens
+        _check_len(total)
+        embed, pos_embed, layer_params, ln_final = _unpack(params)
+        heads, hd = cfg["num_heads"], cfg["head_dim"]
+        tokens_b = jnp.concatenate(
+            [prompt, jnp.zeros((b, max_new_tokens), prompt.dtype)],
+            axis=1)                                       # [B, total]
+
+        # Phase 1 — prefill at batch B (no beam fan-out yet: all beams
+        # would be identical, so running W copies through the prompt
+        # would be W× wasted FLOPs and cache copies).
+        kb = jnp.zeros((num_layers, b, total, heads, hd), embed.dtype)
+
+        def prefill(carry, pos):
+            k_cache, v_cache = carry
+            tok = lax.dynamic_index_in_dim(tokens_b, pos, 1, keepdims=False)
+            x = jnp.take(embed, tok, axis=0) + pos_embed[pos]
+            _, k_cache, v_cache = _token_step(
+                layer_params, ln_final, embed, x, k_cache, v_cache, pos,
+                total)
+            return (k_cache, v_cache), None
+
+        (kb, vb), _ = lax.scan(prefill, (kb, kb),
+                               jnp.arange(max(p_len - 1, 0)))
+
+        # Fan out once: beams ride the batch dim ([B·W] rows).
+        tokens0 = jnp.repeat(tokens_b, w, axis=0)         # [B*W, total]
+        k0 = jnp.repeat(kb, w, axis=1)
+        v0 = jnp.repeat(vb, w, axis=1)
+        # identical beams: suppress duplicates by starting beams 1..W-1
+        # at -inf so the first free position fans out from beam 0.
+        lp0 = jnp.tile(jnp.array([0.0] + [-1e30] * (w - 1), jnp.float32),
+                       (b, 1))                            # [B, W]
+
+        # Phase 2 — beam ticks from the first free position on (pos+1 is
+        # never inside the prompt here, so no teacher-forcing branch).
+        def tick(carry, pos):
+            tokens, k_cache, v_cache, logprobs = carry
+            tok = lax.dynamic_index_in_dim(tokens, pos, 1, keepdims=False)
+            x = jnp.take(embed, tok, axis=0) + pos_embed[pos]
+            logits, k_cache, v_cache = _token_step(
+                layer_params, ln_final, embed, x, k_cache, v_cache, pos,
+                total)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            v = logp.shape[-1]
+            # scores over all W*V continuations of each batch row; the
+            # beam score is exactly the logprob of the GENERATED suffix
+            # (pinned in tests/test_generate.py against the full forward)
+            scores = (logprobs[..., None]
+                      + logp.reshape(b, w, v)).reshape(b, w * v)
+            logprobs, top_idx = lax.top_k(scores, w)      # [B, W]
+            beam_src = top_idx // v                       # which beam
+            new_tok = (top_idx % v).astype(tokens.dtype)  # which token
+            # gather histories: tokens + caches follow their source beam
+            flat_src = (jnp.arange(b)[:, None] * w + beam_src).reshape(-1)
+            tokens = jnp.take(tokens, flat_src, axis=0)
+            k_cache = jnp.take(k_cache, flat_src, axis=1)
+            v_cache = jnp.take(v_cache, flat_src, axis=1)
+            tokens = lax.dynamic_update_index_in_dim(
+                tokens, new_tok.reshape(-1), pos + 1, 1)
+            return (tokens, k_cache, v_cache, logprobs), None
+
+        (tokens, _, _, logprobs), _ = lax.scan(
+            tick, (tokens0, k0, v0, lp0),
+            jnp.arange(p_len - 1, total - 1))
+        best = jnp.argmax(logprobs, axis=-1)              # [B]
+        tokens = tokens.reshape(b, w, total)
+        return (jnp.take_along_axis(tokens, best[:, None, None], 1)[:, 0],
+                jnp.max(logprobs, axis=-1))
+
+    def beam_search(params, prompt, max_new_tokens: int,
+                    num_beams: int = 4):
+        """Beam-search decode; returns ``(tokens [B, P+N], logprob [B])``
+        — the total log-probability of the generated suffix."""
+        if num_beams < 1:
+            raise ValueError(f"num_beams must be >= 1, got {num_beams}")
+        return beam_generate(params, prompt, int(max_new_tokens),
+                             int(num_beams))
+
     wrapped.with_logits = with_logits
+    wrapped.beam_search = beam_search
     return wrapped

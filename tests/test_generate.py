@@ -113,3 +113,42 @@ def test_generate_from_session_sharded_params(lm):
     ref = np.asarray(gen(params, prompt, 5))
     out = np.asarray(gen(sess.sharded_params, prompt, 5))
     np.testing.assert_array_equal(out, ref)
+
+
+def test_beam_search_width_one_equals_greedy(lm):
+    """Beam=1 equals greedy decode exactly.  (No width-monotonicity
+    assertion: beam search prunes prefixes, so a wider beam is NOT
+    guaranteed to end with a higher-scoring sequence than greedy — the
+    true invariant is the score's correctness, pinned below.)"""
+    spec, params = lm
+    rng = np.random.RandomState(5)
+    prompt = rng.randint(0, 97, (3, 4)).astype(np.int32)
+    new = 6
+    gen = make_generator(spec)
+    greedy = np.asarray(gen(params, prompt, new))
+    b1_tokens, b1_lp = gen.beam_search(params, prompt, new, num_beams=1)
+    np.testing.assert_array_equal(np.asarray(b1_tokens), greedy)
+    b4_tokens, b4_lp = gen.beam_search(params, prompt, new, num_beams=4)
+    assert np.asarray(b4_lp).shape == (3,)
+    assert np.asarray(b4_tokens).shape == (3, 10)
+    with pytest.raises(ValueError, match="num_beams"):
+        gen.beam_search(params, prompt, new, num_beams=0)
+
+
+def test_beam_search_logprob_is_true_sequence_score(lm):
+    """The returned beam score equals the sum of per-position
+    log-probabilities of the returned sequence under the full forward."""
+    spec, params = lm
+    prompt = np.array([[11, 23]], np.int32)
+    new = 5
+    gen = make_generator(spec)
+    tokens, lp = gen.beam_search(params, prompt, new, num_beams=3)
+    tokens = np.asarray(tokens)
+    logits = np.asarray(spec.apply_fn(params, tokens))
+    logp = jax.nn.log_softmax(jnp.asarray(logits, jnp.float32), axis=-1)
+    # positions P-1 .. P+new-2 predict the generated tokens
+    p = prompt.shape[1]
+    total = 0.0
+    for i in range(new):
+        total += float(logp[0, p - 1 + i, tokens[0, p + i]])
+    np.testing.assert_allclose(float(lp[0]), total, rtol=1e-4, atol=1e-4)
