@@ -98,6 +98,15 @@ def test_pipeline_1f1b_example():
 
 
 @pytest.mark.integration
+def test_sentiment_classifier_example():
+    # Reference examples/sentiment_classifier.py parity; the example
+    # asserts its own convergence bar (final loss < 0.45 vs ~0.69 chance).
+    out = _run_example("examples/sentiment_classifier.py",
+                       ("--steps", "300"))
+    assert "final loss" in out
+
+
+@pytest.mark.integration
 def test_generate_text_example():
     # The example enforces its own accuracy bar (assert acc > 0.9);
     # a zero returncode from _run_example is the pass criterion here.
