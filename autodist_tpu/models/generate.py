@@ -77,6 +77,12 @@ def make_generator(spec: ModelSpec):
       rng: PRNG key for sampling; required when ``temperature > 0``.
       temperature: 0.0 = greedy argmax; > 0 scales logits before
         categorical sampling.
+      top_k / top_p: optional sampling filters (top-k truncation /
+        nucleus sampling); require ``temperature > 0``.
+
+    The returned function also carries ``.with_logits`` (adds the
+    per-position logits) and ``.beam_search`` (width-W beam decode
+    returning ``(tokens, suffix_logprob)``).
 
     Returns ``[B, P + max_new_tokens]`` tokens (prompt included).
     """
@@ -101,11 +107,11 @@ def make_generator(spec: ModelSpec):
         return (params["embed"], params["pos_embed"], layer_params,
                 params["decoder"]["ln_final"]["scale"])
 
-    # max_new_tokens and temperature are static: they shape the scan and
-    # select the sampling branch at trace time.
-    @functools.partial(jax.jit, static_argnums=(2, 4))
+    # max_new_tokens and the sampling knobs are static: they shape the
+    # scan and select the sampling branch at trace time.
+    @functools.partial(jax.jit, static_argnums=(2, 4, 5, 6))
     def generate(params, prompt, max_new_tokens, rng=None,
-                 temperature=0.0):
+                 temperature=0.0, top_k=0, top_p=0.0):
         b, p_len = prompt.shape
         total = p_len + max_new_tokens
         _check_len(total)
@@ -126,8 +132,23 @@ def make_generator(spec: ModelSpec):
                 total)
             key, sub = jax.random.split(key)
             if temperature and temperature > 0.0:
-                nxt = jax.random.categorical(
-                    sub, logits.astype(jnp.float32) / temperature, axis=-1)
+                scaled = logits.astype(jnp.float32) / temperature
+                if top_k:
+                    # keep only the top_k logits per row
+                    kth = lax.top_k(scaled, top_k)[0][..., -1:]
+                    scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+                if top_p and top_p > 0.0:
+                    # nucleus: smallest prefix of the sorted distribution
+                    # with cumulative probability >= top_p
+                    sorted_lp = jnp.sort(scaled, axis=-1)[..., ::-1]
+                    probs = jax.nn.softmax(sorted_lp, axis=-1)
+                    cum = jnp.cumsum(probs, axis=-1)
+                    # cutoff = last logit whose PRECEDING mass < top_p
+                    keep = cum - probs < top_p
+                    cutoff = jnp.min(jnp.where(keep, sorted_lp, jnp.inf),
+                                     axis=-1, keepdims=True)
+                    scaled = jnp.where(scaled < cutoff, -jnp.inf, scaled)
+                nxt = jax.random.categorical(sub, scaled, axis=-1)
             else:
                 nxt = jnp.argmax(logits, axis=-1)
             nxt = nxt.astype(tokens.dtype)
@@ -146,19 +167,24 @@ def make_generator(spec: ModelSpec):
 
     def with_logits(params, prompt, max_new_tokens: int,
                     rng: Optional[jax.Array] = None,
-                    temperature: float = 0.0):
+                    temperature: float = 0.0, top_k: int = 0,
+                    top_p: float = 0.0):
         """Tokens plus the per-position logits ``[total-1, B, V]``
-        (scoring/evaluation use)."""
+        (scoring/evaluation use).  ``top_k``/``top_p`` filter the
+        sampling distribution (only with ``temperature > 0``)."""
         if temperature > 0.0 and rng is None:
             raise ValueError("temperature sampling needs an rng key")
+        if (top_k or top_p) and temperature <= 0:
+            raise ValueError("top_k/top_p filtering needs temperature > 0")
         return generate(params, prompt, int(max_new_tokens), rng,
-                        float(temperature))
+                        float(temperature), int(top_k), float(top_p))
 
     def wrapped(params, prompt, max_new_tokens: int,
                 rng: Optional[jax.Array] = None,
-                temperature: float = 0.0):
+                temperature: float = 0.0, top_k: int = 0,
+                top_p: float = 0.0):
         tokens, _ = with_logits(params, prompt, max_new_tokens, rng,
-                                temperature)
+                                temperature, top_k, top_p)
         return tokens
 
     # Beam search: beams ride the batch dim ([B·W] rows through the same

@@ -152,3 +152,28 @@ def test_beam_search_logprob_is_true_sequence_score(lm):
     for i in range(new):
         total += float(logp[0, p - 1 + i, tokens[0, p + i]])
     np.testing.assert_allclose(float(lp[0]), total, rtol=1e-4, atol=1e-4)
+
+
+def test_top_k_and_top_p_sampling(lm):
+    """top_k=1 at any temperature is greedy (the filter keeps only the
+    argmax); top_p near 0 likewise; both validate their preconditions."""
+    spec, params = lm
+    rng_np = np.random.RandomState(9)
+    prompt = rng_np.randint(0, 97, (2, 4)).astype(np.int32)
+    new = 6
+    gen = make_generator(spec)
+    greedy = np.asarray(gen(params, prompt, new))
+    key = jax.random.PRNGKey(3)
+    k1 = np.asarray(gen(params, prompt, new, rng=key, temperature=1.0,
+                        top_k=1))
+    np.testing.assert_array_equal(k1, greedy)
+    p_tiny = np.asarray(gen(params, prompt, new, rng=key, temperature=1.0,
+                            top_p=1e-9))
+    np.testing.assert_array_equal(p_tiny, greedy)
+    # a real nucleus still produces valid tokens and differs run-to-run
+    # with different keys (sanity, not a distribution test)
+    a = np.asarray(gen(params, prompt, new, rng=jax.random.PRNGKey(1),
+                       temperature=1.0, top_p=0.9))
+    assert (a >= 0).all() and (a < 97).all()
+    with pytest.raises(ValueError, match="temperature"):
+        gen(params, prompt, new, top_k=5)
