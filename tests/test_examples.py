@@ -98,6 +98,17 @@ def test_pipeline_1f1b_example():
 
 
 @pytest.mark.integration
+def test_lm1b_train_example():
+    # The Parallax parity workload at toy sizes (793k-vocab default
+    # shrunk); exercises the chunked-xent default loss end-to-end.
+    out = _run_example("examples/lm1b/lm1b_train.py",
+                       ("--vocab-size", "512", "--emb-dim", "16",
+                        "--hidden-dim", "32", "--batch-size", "8",
+                        "--steps", "5", "--warmup", "1"))
+    assert "words" in out
+
+
+@pytest.mark.integration
 def test_sentiment_classifier_example():
     # Reference examples/sentiment_classifier.py parity; the example
     # asserts its own convergence bar (final loss < 0.45 vs ~0.69 chance).
