@@ -177,3 +177,20 @@ def test_top_k_and_top_p_sampling(lm):
     assert (a >= 0).all() and (a < 97).all()
     with pytest.raises(ValueError, match="temperature"):
         gen(params, prompt, new, top_k=5)
+
+
+def test_generate_from_exported_weights(lm, tmp_path):
+    """The serving story end-to-end: weights exported to disk
+    (checkpoint interchange layout), restored without a session, and
+    decoded — token-identical to the live params."""
+    from autodist_tpu.checkpoint.saver import Saver, save_params
+
+    spec, params = lm
+    path = save_params(str(tmp_path / "weights"), params)
+    restored = Saver.restore_params(path)
+    gen = make_generator(spec)
+    prompt = np.random.RandomState(11).randint(0, 97, (2, 4)).astype(
+        np.int32)
+    np.testing.assert_array_equal(
+        np.asarray(gen(restored, prompt, 5)),
+        np.asarray(gen(params, prompt, 5)))
