@@ -274,6 +274,25 @@ def make_generator(spec: ModelSpec):
         return beam_generate(params, prompt, int(max_new_tokens),
                              int(num_beams))
 
+    def score(params, tokens):
+        """Teacher-forced scoring: per-sequence log-likelihood of
+        ``tokens[:, 1:]`` given the prefix and the perplexity —
+        ``(log_likelihood [B], perplexity [B])``.  Uses ONE parallel
+        forward (``spec.apply_fn``), not the sequential decode scan —
+        scoring has no sequential dependence (the decode logits match it
+        position-for-position, pinned in tests/test_generate.py)."""
+        if tokens.shape[1] < 2:
+            raise ValueError("score needs sequences of length >= 2 "
+                             "(nothing to predict for a single token)")
+        logits = spec.apply_fn(params, tokens)[:, :-1]   # [B, T-1, V]
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        tok_lp = jnp.take_along_axis(
+            logp, tokens[:, 1:, None], axis=-1)[..., 0]  # [B, T-1]
+        ll = tok_lp.sum(axis=1)                          # [B]
+        ppl = jnp.exp(-ll / (tokens.shape[1] - 1))
+        return ll, ppl
+
     wrapped.with_logits = with_logits
     wrapped.beam_search = beam_search
+    wrapped.score = score
     return wrapped

@@ -194,3 +194,28 @@ def test_generate_from_exported_weights(lm, tmp_path):
     np.testing.assert_array_equal(
         np.asarray(gen(restored, prompt, 5)),
         np.asarray(gen(params, prompt, 5)))
+
+
+def test_score_matches_loss_fn(lm):
+    """gen.score's mean NLL over the batch equals the training loss_fn
+    (both are mean next-token cross entropy), and its perplexity is
+    exp(per-token NLL)."""
+    spec, params = lm
+    rng = np.random.RandomState(13)
+    tokens = rng.randint(0, 97, (4, 12)).astype(np.int32)
+    gen = make_generator(spec)
+    ll, ppl = gen.score(params, tokens)
+    assert np.asarray(ll).shape == (4,) and np.asarray(ppl).shape == (4,)
+    t = tokens.shape[1] - 1
+    mean_nll = float(-np.asarray(ll).mean() / t)
+    train_loss = float(spec.loss_fn(params, {"tokens": tokens}))
+    np.testing.assert_allclose(mean_nll, train_loss, rtol=2e-4)
+    np.testing.assert_allclose(np.asarray(ppl),
+                               np.exp(-np.asarray(ll) / t), rtol=1e-6)
+
+
+def test_score_rejects_single_token(lm):
+    spec, params = lm
+    gen = make_generator(spec)
+    with pytest.raises(ValueError, match="length >= 2"):
+        gen.score(params, np.zeros((2, 1), np.int32))
