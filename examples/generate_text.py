@@ -63,6 +63,17 @@ def main():
     print(f"ascending-run continuation accuracy: {acc:.2f}")
     assert acc > 0.9, acc
 
+    # The rest of the serving surface on the same generator:
+    beam_tokens, beam_lp = gen.beam_search(sess.sharded_params, prompt,
+                                           args.new_tokens, num_beams=4)
+    print("beam-4 suffix logprob:", [round(float(x), 3)
+                                     for x in np.asarray(beam_lp)])
+    ll, ppl = gen.score(sess.sharded_params, np.asarray(tokens))
+    print("self-scored perplexity of the generations:",
+          [round(float(x), 3) for x in np.asarray(ppl)])
+    # a trained pattern-follower should be near-certain of its own output
+    assert float(np.asarray(ppl).mean()) < 2.0
+
 
 if __name__ == "__main__":
     main()
