@@ -208,12 +208,23 @@ class AutoDist:
 
     def create_distributed_session(self, mesh=None) -> DistributedSession:
         """Full build pipeline: strategy → compile → transform → session
-        (reference _create_distributed_session, autodist.py:167-185)."""
+        (reference _create_distributed_session, autodist.py:167-185).
+
+        ``mesh`` may be a Mesh or a zero-arg callable returning one: on
+        multi-process runs the global device list only exists after the
+        cluster rendezvous (``_setup`` → ``jax.distributed.initialize``),
+        so a custom topology (e.g. ``build_hybrid_mesh``) must be built
+        lazily — the callable runs after rendezvous."""
         if self._session is not None:
             return self._session
         if self._strategy is None:
             self.build_strategy()
         self._setup()
+        from jax.sharding import Mesh as _Mesh
+        # NB: Mesh instances are themselves callable (context decorator),
+        # so the factory check must exclude them explicitly.
+        if mesh is not None and not isinstance(mesh, _Mesh) and callable(mesh):
+            mesh = mesh()
         if mesh is None:
             mesh = build_mesh(self._mesh_axes, resource_spec=self._resource_spec)
         compiled = StrategyCompiler(
@@ -255,12 +266,18 @@ class AutoDist:
         no-op.)
 
         Forms: bare ``@ad.function``, decorator factory
-        ``@ad.function(sync_every=10)``, or ``ad.function()`` /
+        ``@ad.function(sync_every=10)``, or ``ad.function()(None)`` /
         ``ad.function(sync_every=10)(None)`` for a plain step runner
-        with no fetch selector.
+        with no fetch selector.  (``ad.function()`` alone returns the
+        decorator, not a runner — calling it with a batch raises.)
         """
 
         def wrap(user_fn):
+            if user_fn is not None and not callable(user_fn):
+                raise TypeError(
+                    "ad.function()(...) expects a fetch-selector callable "
+                    f"or None, got {type(user_fn).__name__}; to run a "
+                    "step with no selector use ad.function()(None)")
             calls = itertools.count(1)
 
             def run_fn(batch):

@@ -224,12 +224,6 @@ class GraphTransformer:
                     "compressors / fused groups (the explicit shard_map "
                     "path owns the gradient computation); drop the "
                     "compressor or the manual grad_fn")
-            if gi.accum_steps > 1:
-                raise ValueError(
-                    "capture(accum_steps=...) is not supported with "
-                    "gradient compressors / fused groups (the explicit "
-                    "shard_map path owns the gradient computation); drop "
-                    "the compressor or the accumulation")
             if mesh.shape.get(MESH_AXIS_DATA, 1) > 1:
                 from autodist_tpu.kernel.synchronization.stale_sync import \
                     uses_stale_path
@@ -528,6 +522,10 @@ def _accumulate_grads(vg: Callable, accum: int, has_aux: bool) -> Callable:
     losses (every bundled model): the mean of per-microbatch means equals
     the full-batch mean, and likewise for their gradients.  With
     ``has_aux`` the returned aux is STACKED along a leading [accum] axis.
+
+    On the explicit compressor path this wrapper runs INSIDE shard_map,
+    so the leading dim it splits is the device's LOCAL batch slice
+    (global batch / data-axis size) — that is what must divide accum.
     """
     from jax import lax
 

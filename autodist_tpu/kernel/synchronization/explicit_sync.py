@@ -8,7 +8,9 @@ through a compressor (``all_reduce_synchronizer.py:100-127``,
 
 Semantics: the whole train step runs inside ``shard_map`` over the mesh.
 Parameters and optimizer state are replicated; the batch is sharded over
-``data``; each device computes local gradients, every variable's gradient is
+``data``; each device computes local gradients (accumulated over
+``capture(accum_steps=N)`` microbatches of its local slice when asked —
+still ONE compressed collective per step), every variable's gradient is
 averaged over ``data`` through its compressor, and the (identical) update is
 applied on all devices.  Per-device compressor state (error-feedback
 residuals, PowerSGD factors) is carried as a *sync state* pytree with a
@@ -74,6 +76,14 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy,
 
     comps = _compressors_for(gi, compiled)
     vg = jax.value_and_grad(gi.loss_fn, has_aux=gi.has_aux)
+    if gi.accum_steps > 1:
+        # Gradient accumulation composes with compression exactly where it
+        # matters most (bandwidth-starved links): the f32 accumulator scan
+        # runs INSIDE the shard_map step over the device's LOCAL microbatch
+        # slices, so the compressor still sees ONE averaged gradient — one
+        # compressed all-reduce per step, N microbatches of activations.
+        from autodist_tpu.kernel.graph_transformer import _accumulate_grads
+        vg = _accumulate_grads(vg, gi.accum_steps, gi.has_aux)
     optimizer = gi.optimizer
     has_aux = gi.has_aux
 

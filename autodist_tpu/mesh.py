@@ -137,18 +137,22 @@ def build_hybrid_mesh(ici_axes: Dict[str, int], dcn_axes: Dict[str, int],
 
     num_slices = math.prod(dcn_shape)
     slice_ids = {getattr(d, "slice_index", None) for d in devices}
-    if None not in slice_ids:
-        # Real slice metadata present: always delegate — a shape/topology
-        # mismatch must fail LOUDLY there, never silently emulate (axes the
-        # user declared ICI would cross real DCN boundaries).
+    if None not in slice_ids and (len(slice_ids) > 1 or num_slices == 1):
+        # Real multi-slice metadata present (or a trivial 1-slice request):
+        # always delegate — a shape/topology mismatch must fail LOUDLY
+        # there, never silently emulate (axes the user declared ICI would
+        # cross real DCN boundaries).
         from jax.experimental import mesh_utils
 
         mesh_devices = mesh_utils.create_hybrid_device_mesh(
             tuple(ici_shape), tuple(dcn_shape), devices=devices)
         return Mesh(mesh_devices, tuple(names))
 
-    # No slice metadata (CPU test meshes, single-slice fleets):
-    # emulated layout — contiguous equal slices, DCN-outer / ICI-inner.
+    # No slice metadata, or every device reports the SAME slice while a
+    # multi-slice topology was requested (CPU test meshes — incl.
+    # multi-process gloo runtimes whose CPU devices all carry
+    # slice_index=0 — and single-slice fleets): emulated layout —
+    # contiguous equal slices, DCN-outer / ICI-inner.
     if len(devices) != num_slices * math.prod(ici_shape):
         raise ValueError(
             f"hybrid mesh {dict(zip(names, dcn_shape))} x "

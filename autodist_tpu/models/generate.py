@@ -17,21 +17,26 @@ built TPU-first —
   with model-axis-sharded params the per-token einsums against the tied
   embedding stay GSPMD-sharded like the training program's.
 
-The decode math mirrors ``models/transformer.py`` exactly (flax
-LayerNorm(use_bias=False) semantics, pre-norm residual blocks, tied
-embedding head); parity with ``spec.apply_fn`` is pinned per-position in
-``tests/test_generate.py``.
+The decode math is not a mirror of ``models/transformer.py`` — it IS
+``models/transformer.py``: each tick applies the training-side
+``TransformerLayer`` flax module at ``[B, 1, D]`` with a KV-cached
+attention plugged into its pluggable ``attn_fn`` slot, so the block
+structure (pre-norm residuals, gelu, LayerNorm semantics, tied head) has
+exactly one definition and cannot drift.  Per-position parity with
+``spec.apply_fn`` stays pinned in ``tests/test_generate.py``.
 """
 from __future__ import annotations
 
 import functools
 from typing import Optional
 
+import flax.linen as nn
 import jax
 import jax.numpy as jnp
 from jax import lax
 
-from autodist_tpu.models.base import ModelSpec, layer_norm as _ln
+from autodist_tpu.models.base import ModelSpec
+from autodist_tpu.models.transformer import TransformerLayer
 
 
 def _token_step(layer_params, ln_final_scale, embed, x, k_cache, v_cache,
@@ -40,30 +45,42 @@ def _token_step(layer_params, ln_final_scale, embed, x, k_cache, v_cache,
     input; ``k_cache``/``v_cache``: [L, B, T, H, Dh], updated IN PLACE
     per layer (``.at[...].set`` with a traced position lowers to
     dynamic_update_slice on the scan carry — no per-token cache copy).
-    Returns logits [B, V] and the updated caches."""
+    Returns logits [B, V] and the updated caches.
+
+    The block math is the SHARED ``TransformerLayer`` module (projections,
+    residual order, gelu, LayerNorm) applied at sequence length 1; only
+    the attention itself is decode-specific (single query over the cache),
+    injected through the module's ``attn_fn`` seam.  The updated caches
+    are smuggled out of the functional ``apply`` through a closure cell —
+    standard under tracing (the arrays are traced values either way)."""
+    heads, hd = k_cache.shape[-2], k_cache.shape[-1]
+    d_ff = layer_params[0]["mlp"]["wi"]["kernel"].shape[1]
+    x = x[:, None, :]                                   # [B, 1, D]
     for i, lp in enumerate(layer_params):
-        h = _ln(x, lp["ln_attn"]["scale"])
-        q = jnp.einsum("bd,dhk->bhk", h, lp["attn"]["query"]["kernel"])
-        k = jnp.einsum("bd,dhk->bhk", h, lp["attn"]["key"]["kernel"])
-        v = jnp.einsum("bd,dhk->bhk", h, lp["attn"]["value"]["kernel"])
-        k_cache = k_cache.at[i, :, pos].set(k)
-        v_cache = v_cache.at[i, :, pos].set(v)
-        kc, vc = k_cache[i], v_cache[i]
-        # attention of the single query over cached positions <= pos
-        depth = q.shape[-1]
-        logits = jnp.einsum("bhk,bthk->bht", q, kc) / jnp.sqrt(
-            jnp.asarray(depth, q.dtype))
-        mask = jnp.arange(total_len)[None, None, :] <= pos
-        logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
-        probs = jax.nn.softmax(logits.astype(jnp.float32),
-                               axis=-1).astype(q.dtype)
-        attn = jnp.einsum("bht,bthk->bhk", probs, vc)
-        x = x + jnp.einsum("bhk,hkd->bd", attn, lp["attn"]["out"]["kernel"])
-        h = _ln(x, lp["ln_mlp"]["scale"])
-        m = jax.nn.gelu(jnp.einsum("bd,df->bf", h, lp["mlp"]["wi"]["kernel"]))
-        x = x + jnp.einsum("bf,fd->bd", m, lp["mlp"]["wo"]["kernel"])
-    x = _ln(x, ln_final_scale)
-    out_logits = jnp.einsum("bd,vd->bv", x, embed)
+        cache_out = {}
+
+        def cached_attn(q, k, v, causal, _i=i, _out=cache_out):
+            # q/k/v: [B, 1, H, K] — the single position's projections
+            # computed by the SHARED TransformerLayer code.  Write k/v
+            # into the cache, attend the query over positions <= pos.
+            kc = k_cache.at[_i, :, pos].set(k[:, 0])
+            vc = v_cache.at[_i, :, pos].set(v[:, 0])
+            _out["k"], _out["v"] = kc, vc
+            depth = q.shape[-1]
+            logits = jnp.einsum("bhk,bthk->bht", q[:, 0], kc[_i]) \
+                / jnp.sqrt(jnp.asarray(depth, q.dtype))
+            mask = jnp.arange(total_len)[None, None, :] <= pos
+            logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
+            probs = jax.nn.softmax(logits.astype(jnp.float32),
+                                   axis=-1).astype(q.dtype)
+            return jnp.einsum("bht,bthk->bhk", probs, vc[_i])[:, None]
+
+        x = TransformerLayer(heads, hd, d_ff, causal=True,
+                             attn_fn=cached_attn).apply({"params": lp}, x)
+        k_cache, v_cache = cache_out["k"], cache_out["v"]
+    x = nn.LayerNorm(use_bias=False).apply(
+        {"params": {"scale": ln_final_scale}}, x)
+    out_logits = jnp.einsum("bd,vd->bv", x[:, 0], embed)
     return out_logits, k_cache, v_cache
 
 
@@ -176,6 +193,10 @@ def make_generator(spec: ModelSpec):
             raise ValueError("temperature sampling needs an rng key")
         if (top_k or top_p) and temperature <= 0:
             raise ValueError("top_k/top_p filtering needs temperature > 0")
+        vocab = params["embed"].shape[0]
+        if top_k and not 0 < top_k <= vocab:
+            raise ValueError(
+                f"top_k must be in [1, vocab_size={vocab}], got {top_k}")
         return generate(params, prompt, int(max_new_tokens), rng,
                         float(temperature), int(top_k), float(top_p))
 
@@ -271,6 +292,12 @@ def make_generator(spec: ModelSpec):
         — the total log-probability of the generated suffix."""
         if num_beams < 1:
             raise ValueError(f"num_beams must be >= 1, got {num_beams}")
+        vocab = params["embed"].shape[0]
+        if num_beams > vocab:
+            # beyond V beams, the -1e30 duplicate-suppressed starter
+            # beams would survive the first top-k as degenerate beams
+            raise ValueError(
+                f"num_beams must be <= vocab_size={vocab}, got {num_beams}")
         return beam_generate(params, prompt, int(max_new_tokens),
                              int(num_beams))
 

@@ -26,8 +26,11 @@ Model (standard ring-collective algebra, cf. the scaling-book recipe):
   variables share one launch when the lowering fuses them — explicit
   ``fused=True`` concat-and-pmean, or the default ``assume_combiner``
   assumption that XLA's all-reduce combiner merges same-program psums
-  (the verified TPU behavior); ``assume_combiner=False`` costs one
-  launch per variable instead;
+  (the verified TPU behavior).  The combiner credit is applied at GROUP
+  granularity — a deliberately conservative bound: the real combiner
+  may merge across groups in one step program too, so multi-group
+  strategies are charged an upper-bound launch count.
+  ``assume_combiner=False`` costs one launch per variable instead;
 * bandwidth: ICI within one host — and across hosts on a TPU pod slice
   (``ici_connected: true`` in the yaml: one interconnect domain); the
   yaml's ``network_bandwidth`` (NIC/DCN) is the bottleneck only for
@@ -125,7 +128,11 @@ def estimate_cost(strategy: Strategy, graph_item: GraphItem,
         strategy group are costed as ONE collective launch — the TPU
         reality, where XLA's all-reduce combiner merges same-program
         psums (verified in HLO, ``graph_transformer.py`` combiner
-        lowering) and ``fused=True`` groups concat explicitly.  Pass
+        lowering) and ``fused=True`` groups concat explicitly.  The
+        credit is deliberately applied per GROUP, not per step program:
+        the real combiner can merge across groups too, so multi-group
+        strategies carry a conservative (upper-bound) launch count that
+        keeps the ranking sensitive to grouping quality.  Pass
         False to cost one launch per variable (a backend whose combiner
         is disabled).  An explicit ASSUMPTION, not ambient env state —
         the estimate must be reproducible.
@@ -163,7 +170,8 @@ def estimate_cost(strategy: Strategy, graph_item: GraphItem,
             # Launch latency: a group shares ONE launch when the lowering
             # fuses it — explicit concat-and-pmean (fused=True), or the
             # assume_combiner default (XLA's combiner merges same-program
-            # psums on TPU).  Otherwise one launch per variable.
+            # psums on TPU; counted per GROUP as a conservative bound —
+            # see estimate_cost docstring).  Otherwise one per variable.
             group_fuses = getattr(sync, "fused", False) or assume_combiner
             if d > 1:
                 if not group_fuses:

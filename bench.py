@@ -18,7 +18,12 @@ Robustness (the TPU tunnel in this image can hang for hours — see
   on the CPU fallback; rc=1 only when no measurement succeeded anywhere).
 * A cheap probe child (``--probe``) verifies the TPU does a real matmul
   before the parent commits to the expensive run; while the tunnel is down
-  the parent retries with backoff, then falls back to CPU.
+  the parent keeps re-probing (every ``AUTODIST_BENCH_PROBE_INTERVAL_S``,
+  default 120s) until ``AUTODIST_BENCH_PROBE_DEADLINE_S`` (default 7200s
+  — a late revival is cheap thanks to the compile cache, and a short fuse
+  burned round 3's artifact on a CPU number), then falls back to CPU with
+  a self-describing artifact (``tpu_unavailable: true``,
+  ``vs_baseline: null``).  Set the deadline low for interactive runs.
 
 MFU: model FLOPs per step are taken from XLA's compiled cost analysis
 (exact for the program that ran) with an analytic ResNet-50 fallback
@@ -56,7 +61,15 @@ PROBE_TIMEOUT_S = 150
 # fallback is quick.
 TPU_ATTEMPTS = (("tpu", 3300), ("tpu", 1800), ("cpu", 1200))
 CPU_ATTEMPTS = (("cpu", 1200),)
-PROBE_BACKOFFS_S = (0, 45, 90)  # three probe attempts, ~4 min worst case
+# Tunnel-outage lesson (BENCH_r03 burned a whole round's artifact on a
+# 135s probe budget): the driver invokes this once per round, and the
+# persistent compile cache makes a LATE pass cheap, so the probe keeps
+# retrying until a deadline that defaults to hours.  Env-tunable for
+# interactive runs.
+PROBE_DEADLINE_S = float(os.environ.get(
+    "AUTODIST_BENCH_PROBE_DEADLINE_S", 7200))
+PROBE_RETRY_INTERVAL_S = float(os.environ.get(
+    "AUTODIST_BENCH_PROBE_INTERVAL_S", 120))
 
 
 def _steer(platform: str) -> None:
@@ -144,11 +157,16 @@ def run_child(platform: str) -> None:
     dt = _measure_session(sess, batch, WARMUP_STEPS, MEASURE_STEPS)
 
     images_per_sec = batch_size * MEASURE_STEPS / dt
+    # vs_baseline only means something against the TPU baseline when the
+    # measurement itself ran on TPU: an outage round's CPU fallback must be
+    # self-describing (tpu_unavailable) instead of reading as a 400x
+    # "regression" against 2,468.8 img/s.
     result = {
         "metric": "resnet50_train_throughput",
         "value": round(images_per_sec, 2),
         "unit": "images/sec",
-        "vs_baseline": round(images_per_sec / BASELINE_IMAGES_PER_SEC, 4),
+        "vs_baseline": round(images_per_sec / BASELINE_IMAGES_PER_SEC, 4)
+        if on_tpu else None,
         "mfu": None,
         "platform": dev.platform,
         "device_kind": getattr(dev, "device_kind", ""),
@@ -176,10 +194,19 @@ def run_child(platform: str) -> None:
         print(json.dumps(result), flush=True)
         del sess, ad  # free the ResNet session before the LM sections
         _reset_default_autodist_for_testing()
-        lm_cmp = _fill_lm(result)  # flagship-LM tokens/sec (flash, session)
+        flash_ok = _check_flash_numerics(result)  # on-chip kernel check
+        print(json.dumps(result), flush=True)
+        if flash_ok:
+            lm_cmp = _fill_lm(result)  # flagship tokens/sec (flash, session)
+        else:
+            lm_cmp = None
+            print("bench: flash numerics failed; LM section blocked",
+                  file=sys.stderr, flush=True)
+        print(json.dumps(result), flush=True)
+        _fill_decode(result)           # serving decode tokens/sec
         print(json.dumps(result), flush=True)
         for fill in (_fill_bert, _fill_vgg, _fill_ncf, _fill_lm1b,
-                     _fill_linreg):
+                     _fill_linreg, _fill_auto_strategy):
             fill(result)   # remaining BASELINE.json parity configs
             print(json.dumps(result), flush=True)
         if lm_cmp is not None:
@@ -231,6 +258,139 @@ def _session_throughput(spec, builder, optimizer, batch_size, steps, *,
     del sess, ad, params, batch, placed
     _reset_default_autodist_for_testing()
     return batch_size * steps / dt, dt, peak
+
+
+def _check_flash_numerics(result) -> bool:
+    """VERDICT r3 #2: assert the COMPILED Pallas flash-attention kernels —
+    the real TPU lowering (block padding, VMEM tiling, custom-VJP bwd),
+    not interpret mode — against dense attention, fwd + bwd, causal and
+    full.  The suite's interpret-mode tests validate the algebra only;
+    this is the on-chip check.  Records ``flash_numerics_ok``; a failure
+    blocks the LM section (its throughput would be a number for a broken
+    kernel).  Tolerances allow the MXU's mixed-precision f32 matmul paths
+    (both sides run through the same hardware, but reduction orders
+    differ)."""
+    try:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from autodist_tpu.models.transformer import dense_attention
+        from autodist_tpu.ops.flash_attention import make_flash_attention
+
+        flash = make_flash_attention()
+        rng = np.random.RandomState(0)
+        b, t, h, d = 2, 512, 4, 64
+        q, k, v = (jnp.asarray(rng.randn(b, t, h, d) * 0.5, jnp.float32)
+                   for _ in range(3))
+        w = jnp.asarray(rng.randn(b, t, h, d), jnp.float32)  # fixed cotangent
+
+        ok = True
+        for causal in (True, False):
+            f_out = jax.jit(
+                lambda q, k, v, c=causal: flash(q, k, v, c))(q, k, v)
+            d_out = jax.jit(
+                lambda q, k, v, c=causal: dense_attention(q, k, v, c))(
+                    q, k, v)
+            fwd_ok = np.allclose(np.asarray(f_out), np.asarray(d_out),
+                                 rtol=2e-2, atol=2e-2)
+            gf = jax.jit(jax.grad(
+                lambda q, k, v, c=causal: jnp.sum(flash(q, k, v, c) * w),
+                argnums=(0, 1, 2)))(q, k, v)
+            gd = jax.jit(jax.grad(
+                lambda q, k, v, c=causal: jnp.sum(
+                    dense_attention(q, k, v, c) * w),
+                argnums=(0, 1, 2)))(q, k, v)
+            bwd_ok = all(np.allclose(np.asarray(a), np.asarray(bb),
+                                     rtol=3e-2, atol=3e-2)
+                         for a, bb in zip(gf, gd))
+            if not (fwd_ok and bwd_ok):
+                print(f"bench: flash numerics MISMATCH causal={causal} "
+                      f"fwd_ok={fwd_ok} bwd_ok={bwd_ok}",
+                      file=sys.stderr, flush=True)
+            ok = ok and fwd_ok and bwd_ok
+        result["flash_numerics_ok"] = bool(ok)
+        return bool(ok)
+    except Exception as e:  # pragma: no cover - best-effort enrichment
+        print(f"bench: flash numerics check errored ({e!r})",
+              file=sys.stderr, flush=True)
+        result["flash_numerics_ok"] = False
+        return False
+
+
+def _fill_decode(result) -> None:
+    """VERDICT r3 #4: measure serving decode — KV-cache autoregressive
+    generation (``models/generate.py``) on the flagship LM at batch 8.
+    Records ``decode_tokens_per_sec`` (greedy, O(T)/token scan) and the
+    measured speedup over re-forward decode (argmax over a full causal
+    forward per emitted token — the O(T^2) baseline a framework without
+    KV caching pays), plus greedy token agreement between the two as an
+    on-chip correctness signal.  Best-effort."""
+    try:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax import lax
+
+        from autodist_tpu.models.generate import make_generator
+        from autodist_tpu.models.transformer_lm import transformer_lm
+
+        batch, p_len, n_new = 8, 32, 128
+        total = p_len + n_new
+        spec = transformer_lm(num_layers=12, num_heads=12, head_dim=64,
+                              d_ff=3072, max_len=total, seq_len=total,
+                              dtype=jnp.bfloat16)
+        params = spec.init(jax.random.PRNGKey(0))
+        rng = np.random.RandomState(0)
+        prompt = jnp.asarray(rng.randint(
+            0, spec.config["vocab_size"], (batch, p_len)), jnp.int32)
+
+        gen = make_generator(spec)
+        tok_kv = gen(params, prompt, n_new)       # compile
+        tok_kv.block_until_ready()
+        t0 = time.perf_counter()
+        reps = 3
+        for _ in range(reps):
+            tok_kv = gen(params, prompt, n_new)
+        int(np.asarray(tok_kv[0, -1]))            # host fetch = hard sync
+        dt_kv = (time.perf_counter() - t0) / reps
+        result["decode_tokens_per_sec"] = round(batch * n_new / dt_kv, 1)
+        result["decode_batch"] = batch
+        result["decode_new_tokens"] = n_new
+        print(json.dumps(result), flush=True)
+
+        # Re-forward baseline: fixed [B, total] buffer, one compiled
+        # program (pos is a traced scalar), full causal forward per token.
+        @jax.jit
+        def refwd_one(params, buf, pos):
+            logits = spec.apply_fn(params, buf)          # [B, total, V]
+            prev = lax.dynamic_index_in_dim(logits, pos - 1, 1,
+                                            keepdims=False)
+            nxt = jnp.argmax(prev, axis=-1).astype(buf.dtype)
+            return lax.dynamic_update_index_in_dim(buf, nxt, pos, 1)
+
+        def refwd_decode():
+            buf = jnp.concatenate(
+                [prompt, jnp.zeros((batch, n_new), prompt.dtype)], axis=1)
+            for pos in range(p_len, total):
+                buf = refwd_one(params, buf, jnp.int32(pos))
+            return buf
+
+        tok_rf = refwd_decode()                   # compile
+        tok_rf.block_until_ready()
+        t0 = time.perf_counter()
+        tok_rf = refwd_decode()
+        int(np.asarray(tok_rf[0, -1]))
+        dt_rf = time.perf_counter() - t0
+        result["decode_kv_speedup_vs_reforward"] = round(dt_rf / dt_kv, 2)
+        # Greedy agreement (argmax ties under different reduction orders
+        # can diverge a few positions in; report, don't assert).
+        agree = float(np.mean(np.asarray(tok_kv[:, p_len:])
+                              == np.asarray(tok_rf[:, p_len:])))
+        result["decode_greedy_agreement"] = round(agree, 4)
+    except Exception as e:  # pragma: no cover - best-effort enrichment
+        print(f"bench: decode metric unavailable ({e!r})",
+              file=sys.stderr, flush=True)
 
 
 def _fill_lm(result):
@@ -583,6 +743,84 @@ def _fill_lm1b(result) -> None:
               file=sys.stderr, flush=True)
 
 
+def _fill_auto_strategy(result) -> None:
+    """VERDICT r3 #5: AutoStrategy's END-TO-END claim measured on TPU —
+    for two contrasting workloads (embedding-heavy, dense MLP) the auto
+    choice's step time vs the best fixed builder's.  Records
+    ``auto_vs_best_pct`` = worst-case percentage overhead of auto over
+    the measured-best fixed builder (negative = auto was fastest).
+    Best-effort."""
+    try:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        import optax
+
+        from autodist_tpu.autodist import AutoDist, \
+            _reset_default_autodist_for_testing
+        from autodist_tpu.strategy import (AllReduce, AutoStrategy,
+                                           Parallax, PSLoadBalancing)
+
+        def measure(builder, params, loss_fn, batch, sparse_vars=()):
+            _reset_default_autodist_for_testing()
+            ad = AutoDist(strategy_builder=builder)
+            with ad.scope():
+                ad.capture(params=params, optimizer=optax.sgd(0.1),
+                           loss_fn=loss_fn, sparse_vars=sparse_vars)
+            sess = ad.create_distributed_session()
+            placed = sess.place_batch(batch)
+            dt = _measure_session(sess, placed, 3, 15)
+            del sess, ad
+            _reset_default_autodist_for_testing()
+            return dt / 15
+
+        rng = np.random.RandomState(0)
+        vocab, dim = 200_000, 64
+        emb_params = {
+            "emb": {"table": jnp.asarray(rng.randn(vocab, dim) * 0.01,
+                                         jnp.float32)},
+            "head": {"w": jnp.asarray(rng.randn(dim, 1) * 0.1,
+                                      jnp.float32)}}
+        emb_batch = {"ids": rng.randint(0, vocab, (4096,)).astype(np.int32),
+                     "y": rng.randn(4096).astype(np.float32)}
+
+        def emb_loss(p, b):
+            rows = jnp.take(p["emb"]["table"], b["ids"], axis=0)
+            return jnp.mean(((rows @ p["head"]["w"])[:, 0] - b["y"]) ** 2)
+
+        dense_params = {
+            "l1": {"w": jnp.asarray(rng.randn(1024, 1024) * 0.03,
+                                    jnp.float32)},
+            "l2": {"w": jnp.asarray(rng.randn(1024, 1024) * 0.03,
+                                    jnp.float32)},
+            "out": {"w": jnp.asarray(rng.randn(1024, 1) * 0.1,
+                                     jnp.float32)}}
+        dense_batch = {"x": rng.randn(512, 1024).astype(np.float32),
+                       "y": rng.randn(512).astype(np.float32)}
+
+        def dense_loss(p, b):
+            h = jnp.tanh(b["x"] @ p["l1"]["w"])
+            h = jnp.tanh(h @ p["l2"]["w"])
+            return jnp.mean(((h @ p["out"]["w"])[:, 0] - b["y"]) ** 2)
+
+        worst_pct = None
+        for name, params, loss_fn, batch, sparse, fixed in (
+                ("sparse", emb_params, emb_loss, emb_batch, ("emb/table",),
+                 (AllReduce(), Parallax(), PSLoadBalancing())),
+                ("dense", dense_params, dense_loss, dense_batch, (),
+                 (AllReduce(), PSLoadBalancing()))):
+            best = min(measure(b, params, loss_fn, batch, sparse)
+                       for b in fixed)
+            auto = measure(AutoStrategy(), params, loss_fn, batch, sparse)
+            pct = 100.0 * (auto / best - 1.0)
+            result[f"auto_vs_best_pct_{name}"] = round(pct, 1)
+            worst_pct = pct if worst_pct is None else max(worst_pct, pct)
+        result["auto_vs_best_pct"] = round(worst_pct, 1)
+    except Exception as e:  # pragma: no cover - best-effort enrichment
+        print(f"bench: auto-strategy metric unavailable ({e!r})",
+              file=sys.stderr, flush=True)
+
+
 def _fill_mfu(result, dev, on_tpu, dt, sess, batch) -> None:
     """MFU = model FLOPs/s ÷ chip peak, from analytic ResNet-50 FLOPs (the
     cheap, always-available estimate).  XLA's compiled cost analysis is
@@ -654,20 +892,34 @@ def _extract_json(text: str):
 def main() -> int:
     errors = []
 
-    # 1) Probe the TPU tunnel with retries/backoff.
+    # 1) Probe the TPU tunnel until it answers or the deadline expires.
+    #    A full-round outage must not zero the artifact on a short fuse:
+    #    the deadline defaults to hours (env-tunable, see PROBE_DEADLINE_S)
+    #    because a late success is cheap — the persistent compile cache
+    #    means a revived tunnel skips straight to measurement.
     tpu_alive = False
-    for backoff in PROBE_BACKOFFS_S:
-        if backoff:
-            print(f"bench: tunnel down, retrying probe in {backoff}s",
-                  file=sys.stderr, flush=True)
-            time.sleep(backoff)
+    probe_deadline = time.monotonic() + PROBE_DEADLINE_S
+    n_probes = 0
+    while True:
         rc, _ = _spawn(["--probe"], PROBE_TIMEOUT_S)
+        n_probes += 1
         if rc == 0:
             tpu_alive = True
             break
-        errors.append(f"probe rc={rc}")
         if rc == 2:  # backend up but routed to non-TPU: retries won't help
+            errors.append(f"probe rc=2 after {n_probes} attempts")
             break
+        remaining = probe_deadline - time.monotonic()
+        if remaining <= 0:
+            errors.append(
+                f"probe rc={rc}; tunnel down for the full "
+                f"{PROBE_DEADLINE_S:.0f}s deadline ({n_probes} probes)")
+            break
+        wait = min(PROBE_RETRY_INTERVAL_S, remaining)
+        print(f"bench: tunnel down (probe #{n_probes} rc={rc}), retrying "
+              f"in {wait:.0f}s ({remaining / 60:.0f} min left in probe "
+              f"deadline)", file=sys.stderr, flush=True)
+        time.sleep(wait)
 
     # 2) Measure: TPU when alive (one retry — first compile over the tunnel
     #    is the slow part), else CPU fallback.
@@ -678,6 +930,14 @@ def main() -> int:
         # (its optional post-measurement enrichment hung): use it.
         result = _extract_json(out)
         if result is not None and result.get("value") is not None:
+            if result.get("platform") != "tpu":
+                # Label WHY this is a CPU artifact: a dead tunnel
+                # (tpu_unavailable) reads very differently from a live
+                # TPU whose measurement children failed.
+                if tpu_alive:
+                    result["tpu_measurement_failed"] = True
+                else:
+                    result["tpu_unavailable"] = True
             print(json.dumps(result), flush=True)
             return 0
         errors.append(f"bench[{platform}] rc={rc}")
@@ -688,6 +948,7 @@ def main() -> int:
         "value": None,
         "unit": "images/sec",
         "vs_baseline": None,
+        "tpu_unavailable": not tpu_alive,
         "error": "; ".join(errors),
     }), flush=True)
     return 1

@@ -38,9 +38,15 @@ import jax  # noqa: E402
 # — the parity reference proving the process boundary changes nothing.
 SINGLE = os.environ.get("AUTODIST_TEST_SINGLE", "").lower() \
     not in ("", "0", "false")
+# Topology: AUTODIST_TEST_NODES=N processes sharing 4 global devices
+# (default 2 nodes x 2 devices; 4 -> 4 nodes x 1 device, so EVERY mesh
+# axis necessarily crosses OS-process boundaries).
+NODES = int(os.environ.get("AUTODIST_TEST_NODES", "2"))
+assert 4 % NODES == 0, NODES
+CHIPS = 4 // NODES
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 4 if SINGLE else 2)
+jax.config.update("jax_num_cpu_devices", 4 if SINGLE else CHIPS)
 
 import numpy as np  # noqa: E402
 
@@ -185,16 +191,32 @@ def main():
         mesh_axes = {k: int(v) for k, v in
                      (kv.split("=") for kv in
                       os.environ["AUTODIST_TEST_MESH"].split(","))}
+    # Optional hybrid (multi-slice-style) mesh: the ici/dcn split built
+    # AFTER rendezvous via the lazy-mesh hook — data is the DCN-outer
+    # axis, model the ICI-inner one (mesh.build_hybrid_mesh semantics).
+    hybrid = bool(os.environ.get("AUTODIST_TEST_HYBRID"))
+
     if SINGLE:
         # One node holding all 4 devices: the parity oracle topology.
         spec = ResourceSpec(resource_info={
             "nodes": [{"address": "127.0.0.1", "chips": 4, "chief": True}]})
     else:
-        # Two "nodes", both local: the chief fans the script out with
+        # N "nodes", all local: the chief fans the script out with
         # subprocess+env exactly as it would over SSH to a remote host.
+        # Distinct local addresses give each process its own node
+        # identity (every name here resolves to this machine; dedupe in
+        # case the hostname IS one of the literals).
+        import socket
+
+        pool = []
+        for a in ("127.0.0.1", "localhost", socket.gethostname(), "0.0.0.0"):
+            if a not in pool:
+                pool.append(a)
+        assert len(pool) >= NODES, pool
         spec = ResourceSpec(resource_info={
-            "nodes": [{"address": "127.0.0.1", "chips": 2, "chief": True},
-                      {"address": "localhost", "chips": 2}]})
+            "nodes": [{"address": pool[i], "chips": CHIPS,
+                       **({"chief": True} if i == 0 else {})}
+                      for i in range(NODES)]})
 
     # Params as numpy: no jax computation may run before
     # jax.distributed.initialize (see Cluster.start).
@@ -217,7 +239,14 @@ def main():
               f"{strategy.id}", flush=True)
         sys.exit(17)
 
-    sess = ad.create_distributed_session()
+    mesh_arg = None
+    if hybrid:
+        from autodist_tpu.mesh import build_hybrid_mesh
+
+        # Lazy: the global device list exists only after rendezvous.
+        mesh_arg = lambda: build_hybrid_mesh(  # noqa: E731
+            {"model": 2}, {"data": 2})
+    sess = ad.create_distributed_session(mesh=mesh_arg)
 
     import jax
 
@@ -236,7 +265,8 @@ def main():
     # feed-splitting Remapper.  The resulting loss must equal evaluating
     # the same global batch fed identically from every process.
     pidx, pcount = jax.process_index(), jax.process_count()
-    if sess.mesh.shape.get("data", 1) > 1 and pcount > 1:
+    data_size = sess.mesh.shape.get("data", 1)
+    if data_size > 1 and pcount > 1 and data_size % pcount == 0:
         nrows = next(iter(batch.values())).shape[0]
         rows = nrows // pcount
         local = {k: v[pidx * rows:(pidx + 1) * rows]
@@ -270,6 +300,27 @@ def main():
                        "save_step": save_step,
                        "restored_step": restored_step}
 
+    # Hybrid-mesh evidence: which PROCESS owns each device along each
+    # mesh axis — the driver asserts the DCN-outer (data) axis genuinely
+    # spans OS processes, i.e. its collectives cross the boundary.
+    axis_process_ids = None
+    if hybrid:
+        devs = sess.mesh.devices          # ndarray indexed by axis order
+        names = list(sess.mesh.axis_names)
+        di, mi = names.index("data"), names.index("model")
+        take = [0] * devs.ndim
+
+        def procs_along(axis):
+            idx = list(take)
+            out = []
+            for j in range(devs.shape[axis]):
+                idx[axis] = j
+                out.append(int(devs[tuple(idx)].process_index))
+            return out
+
+        axis_process_ids = {"data": procs_along(di),
+                            "model": procs_along(mi)}
+
     result = {
         "role": "worker" if ENV.AUTODIST_WORKER.val else "chief",
         "case": case_name,
@@ -284,10 +335,14 @@ def main():
         "final_w": final_w,
         "param_checksum": param_checksum,
         "checkpoint": ckpt_losses,
+        "axis_process_ids": axis_process_ids,
     }
     out = os.environ["AUTODIST_RESULT_FILE"]
     if ENV.AUTODIST_WORKER.val:
-        out += ".worker"
+        # process 1 keeps the historical ".worker" name; higher indices
+        # (>2-process topologies) get ".worker<idx>".
+        idx = jax.process_index()
+        out += ".worker" if idx == 1 else f".worker{idx}"
     with open(out, "w", encoding="utf-8") as f:
         json.dump(result, f)
     print(f"[{result['role']}] done: losses={losses}", flush=True)

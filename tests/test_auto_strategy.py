@@ -96,3 +96,71 @@ def test_threshold_moves_the_boundary():
     kinds2 = {n.var_name: n.synchronizer.kind for n in s2.node_config}
     assert kinds2["big/w"] == "AllReduce"  # below the huge threshold
     assert kinds2["emb/table"] == "PS"     # sparse stays PS regardless
+
+
+@pytest.mark.integration
+def test_auto_measured_within_tolerance_of_best_fixed():
+    """VERDICT r3 #5 — the AutoSync pitch as EVIDENCE, not heuristic
+    argument: on two contrasting workloads, AutoStrategy's measured
+    wall-clock step time (real session path, 8-device CPU mesh) lands
+    within tolerance of the best fixed builder's.
+
+    Tolerance is 1.25x: the ~10% target plus CPU-mesh host noise
+    (the min-over-repeats measurement still jitters ~10% between
+    whole-suite runs).  Integration-gated (--run-integration) because a
+    wall-clock assertion on a loaded shared host is inherently noisy —
+    the default suite stays deterministic; the companion bench section
+    (auto_vs_best_pct in BENCH_r04) records the same comparison on TPU
+    hardware where the timing floor is stable."""
+    from test_cost_model_calibration import _measure
+
+    from autodist_tpu.strategy import (AllReduce, Parallax, PartitionedAR,
+                                       PS, PSLoadBalancing)
+
+    rng = np.random.RandomState(0)
+
+    # Workload 1 — embedding-heavy (the regime where the choice MATTERS:
+    # densifying builders move the whole 200k x 32 table every step).
+    vocab, dim = 200_000, 32
+    emb_params = {
+        "emb": {"table": jnp.asarray(rng.randn(vocab, dim) * 0.01,
+                                     jnp.float32)},
+        "head": {"w": jnp.asarray(rng.randn(dim, 1) * 0.1, jnp.float32)},
+    }
+    emb_batch = {"ids": rng.randint(0, vocab, (256,)).astype(np.int32),
+                 "y": rng.randn(256).astype(np.float32)}
+
+    def emb_loss(p, b):
+        rows = jnp.take(p["emb"]["table"], b["ids"], axis=0)
+        return jnp.mean(((rows @ p["head"]["w"])[:, 0] - b["y"]) ** 2)
+
+    # Workload 2 — dense MLP (near-tie regime: every ring lowering moves
+    # the same bytes; auto must simply not pick something pathological).
+    dense_params = {
+        "l1": {"w": jnp.asarray(rng.randn(512, 512) * 0.05, jnp.float32)},
+        "l2": {"w": jnp.asarray(rng.randn(512, 512) * 0.05, jnp.float32)},
+        "out": {"w": jnp.asarray(rng.randn(512, 1) * 0.1, jnp.float32)},
+    }
+    dense_batch = {"x": rng.randn(128, 512).astype(np.float32),
+                   "y": rng.randn(128).astype(np.float32)}
+
+    def dense_loss(p, b):
+        h = jnp.tanh(b["x"] @ p["l1"]["w"])
+        h = jnp.tanh(h @ p["l2"]["w"])
+        return jnp.mean(((h @ p["out"]["w"])[:, 0] - b["y"]) ** 2)
+
+    cases = [
+        ("sparse", emb_params, emb_loss, emb_batch, ("emb/table",),
+         [AllReduce(), PartitionedAR(), Parallax(), PSLoadBalancing()]),
+        ("dense", dense_params, dense_loss, dense_batch, (),
+         [AllReduce(), PS(), PSLoadBalancing(), PartitionedAR()]),
+    ]
+    for name, params, loss_fn, batch, sparse, fixed in cases:
+        fixed_times = [_measure(b, params, loss_fn, batch,
+                                sparse_vars=sparse) for b in fixed]
+        auto_time = _measure(AutoStrategy(), params, loss_fn, batch,
+                             sparse_vars=sparse)
+        best = min(fixed_times)
+        assert auto_time <= 1.25 * best, (
+            name, auto_time, dict(zip([type(b).__name__ for b in fixed],
+                                      fixed_times)))

@@ -162,6 +162,23 @@ def test_function_decorator():
     assert float(train_step(batch)) == pytest.approx(ref_losses[1], rel=1e-5)
 
 
+def test_function_rejects_non_callable():
+    """``run = ad.function(); run(batch)`` is a misuse (ad.function()
+    returns the decorator): the batch dict must not be silently accepted
+    as a fetch selector."""
+    params, loss_fn, batch = _make_problem()
+    ad = AutoDist(strategy_builder=AllReduce())
+    with ad.scope():
+        ad.capture(params=params, optimizer=optax.sgd(0.1), loss_fn=loss_fn)
+    with pytest.raises(TypeError, match="callable"):
+        ad.function()(batch)
+    with pytest.raises(TypeError, match="callable"):
+        ad.function(batch)
+    # the documented plain-runner form still works
+    run = ad.function()(None)
+    assert "loss" in run(batch)
+
+
 def test_function_decorator_async_cadence():
     """ad.function(sync_every=N): auto-placement plus the async hot-loop
     cadence — only every N-th call syncs metrics to host numpy; the

@@ -89,6 +89,28 @@ def test_with_logits_validates_rng(lm):
                         temperature=0.7)
 
 
+def test_sampling_knob_ranges_validated(lm):
+    """top_k > vocab fails loudly at the API (not deep inside lax.top_k),
+    and num_beams > vocab would leak the -1e30 duplicate-suppressed
+    starter beams through the first top-k."""
+    spec, params = lm
+    gen = make_generator(spec)
+    prompt = np.zeros((1, 2), np.int32)
+    rng = jax.random.PRNGKey(0)
+    with pytest.raises(ValueError, match="top_k"):
+        gen(params, prompt, 4, rng=rng, temperature=0.7, top_k=98)
+    with pytest.raises(ValueError, match="top_k"):
+        gen(params, prompt, 4, rng=rng, temperature=0.7, top_k=-1)
+    with pytest.raises(ValueError, match="num_beams"):
+        gen.beam_search(params, prompt, 4, num_beams=98)
+    # the boundary values are legal — num_beams == vocab is exactly where
+    # a wrong guard would let a -1e30 starter beam survive the first
+    # top-k, so assert the winning logprob is finite and sane
+    gen(params, prompt, 1, rng=rng, temperature=0.7, top_k=97)
+    _, lp = gen.beam_search(params, prompt, 1, num_beams=97)
+    assert np.isfinite(float(lp[0])) and float(lp[0]) > -1e6
+
+
 def test_generate_from_session_sharded_params(lm):
     """Decode runs straight off a session's mesh-sharded parameters
     (vocab-sharded embed under Parallax on a model-axis mesh) and

@@ -224,6 +224,64 @@ def test_two_process_checkpoint_roundtrip(tmp_path):
         worker["checkpoint"]["after_save"]
 
 
+def test_four_process_hybrid_mesh(tmp_path):
+    """VERDICT r3 #6: >2 processes AND a hybrid (multi-slice-style) mesh,
+    live.  4 processes x 1 CPU device rendezvous into a
+    ``build_hybrid_mesh({"model": 2}, {"data": 2})`` topology — data is
+    the DCN-outer axis (spans the two emulated "slices"), model the
+    ICI-inner one.  With one device per process EVERY axis crosses OS
+    processes; the recorded per-axis process ids prove the outer-axis
+    collective genuinely crosses the boundary, and numeric parity against
+    the closed-form single-process solution AND the single-process oracle
+    on the same hybrid mesh proves it crosses correctly."""
+    env, result_file = _chief_env(tmp_path, "PartitionedPS",
+                                  AUTODIST_TEST_NODES="4",
+                                  AUTODIST_TEST_HYBRID="1")
+    proc = subprocess.run(
+        [sys.executable, "-u", SCRIPT], env=env, timeout=300,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    out = proc.stdout.decode()
+    assert proc.returncode == 0, \
+        f"chief failed (rc={proc.returncode}):\n{out[-4000:]}"
+    with open(result_file, encoding="utf-8") as f:
+        chief = json.load(f)
+    workers = []
+    for suffix in (".worker", ".worker2", ".worker3"):
+        with open(result_file + suffix, encoding="utf-8") as f:
+            workers.append(json.load(f))
+
+    assert chief["process_count"] == 4
+    assert chief["local_devices"] == 1 and chief["global_devices"] == 4
+    assert chief["mesh"] == {"data": 2, "model": 2}
+    assert sorted(w["process_index"] for w in workers) == [1, 2, 3]
+
+    # The DCN-outer data axis spans processes (and slices): walking the
+    # data axis at model=0 must visit >1 process — its psum/reduce
+    # crosses the OS-process (emulated-DCN) boundary.  The emulated
+    # slice layout is contiguous (procs {0,1} = slice 0, {2,3} = slice
+    # 1), so the data hop is exactly the cross-slice hop.
+    procs = chief["axis_process_ids"]
+    assert len(set(procs["data"])) > 1, procs
+    assert len(set(procs["model"])) > 1, procs       # 1 dev/process
+    assert procs["data"] == [0, 2], procs            # slice 0 -> slice 1
+
+    # SPMD lockstep across all four processes.
+    for w in workers:
+        np.testing.assert_allclose(chief["losses"], w["losses"], rtol=1e-6)
+        assert w["strategy_id"] == chief["strategy_id"]
+    # Numeric parity: closed-form single-device solution...
+    ref_losses, ref_w = _reference_losses()
+    np.testing.assert_allclose(chief["losses"], ref_losses, rtol=1e-4)
+    np.testing.assert_allclose(chief["final_w"], ref_w, rtol=1e-4)
+    # ...and the single-process oracle on the SAME hybrid mesh.
+    single = _run_single_oracle(tmp_path, "PartitionedPS",
+                                AUTODIST_TEST_HYBRID="1")
+    assert single["mesh"] == chief["mesh"]
+    np.testing.assert_allclose(chief["losses"], single["losses"], rtol=1e-5)
+    np.testing.assert_allclose(chief["param_checksum"],
+                               single["param_checksum"], rtol=1e-5)
+
+
 def test_worker_crash_aborts_chief(tmp_path):
     """Fail-fast failure propagation (reference coordinator.py:98-110): a
     worker dying mid-bootstrap must abort the chief instead of leaving it
