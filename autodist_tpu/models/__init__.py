@@ -8,6 +8,9 @@ long-context/multi-dim parallelism).
 from autodist_tpu.models.base import ModelSpec, cross_entropy_loss  # noqa: F401
 from autodist_tpu.models.bert import bert, bert_base, bert_large  # noqa: F401
 from autodist_tpu.models.generate import make_generator  # noqa: F401
+from autodist_tpu.models.speculative import (  # noqa: F401
+    make_speculative_generator,
+)
 from autodist_tpu.models.densenet import densenet121  # noqa: F401
 from autodist_tpu.models.inception import inception_v3  # noqa: F401
 from autodist_tpu.models.lm1b import lm1b  # noqa: F401

@@ -96,6 +96,8 @@ def make_generator(spec: ModelSpec):
         categorical sampling.
       top_k / top_p: optional sampling filters (top-k truncation /
         nucleus sampling); require ``temperature > 0``.
+      eos_id: optional stop token — rows that generate it pad the rest
+        of their slots with it (static-shape masking; see with_logits).
 
     The returned function also carries ``.with_logits`` (adds the
     per-position logits) and ``.beam_search`` (width-W beam decode
@@ -126,9 +128,9 @@ def make_generator(spec: ModelSpec):
 
     # max_new_tokens and the sampling knobs are static: they shape the
     # scan and select the sampling branch at trace time.
-    @functools.partial(jax.jit, static_argnums=(2, 4, 5, 6))
+    @functools.partial(jax.jit, static_argnums=(2, 4, 5, 6, 7))
     def generate(params, prompt, max_new_tokens, rng=None,
-                 temperature=0.0, top_k=0, top_p=0.0):
+                 temperature=0.0, top_k=0, top_p=0.0, eos_id=-1):
         b, p_len = prompt.shape
         total = p_len + max_new_tokens
         _check_len(total)
@@ -139,9 +141,10 @@ def make_generator(spec: ModelSpec):
         tokens0 = jnp.concatenate(
             [prompt, jnp.zeros((b, max_new_tokens), prompt.dtype)], axis=1)
         rng0 = rng if rng is not None else jax.random.PRNGKey(0)
+        done0 = jnp.zeros((b,), bool)
 
         def tick(carry, pos):
-            tokens, k_cache, v_cache, key = carry
+            tokens, k_cache, v_cache, key, done = carry
             tok = lax.dynamic_index_in_dim(tokens, pos, 1, keepdims=False)
             x = jnp.take(embed, tok, axis=0) + pos_embed[pos]
             logits, k_cache, v_cache = _token_step(
@@ -169,26 +172,43 @@ def make_generator(spec: ModelSpec):
             else:
                 nxt = jnp.argmax(logits, axis=-1)
             nxt = nxt.astype(tokens.dtype)
+            if eos_id >= 0:
+                # Stop-token semantics under static shapes: a finished
+                # row keeps emitting eos (masking, not early exit — the
+                # scan length is fixed, the XLA-idiomatic form).  Only
+                # GENERATED eos finishes a row; eos inside the prompt is
+                # data (e.g. a separator), not a stop.
+                nxt = jnp.where(done, jnp.asarray(eos_id, tokens.dtype),
+                                nxt)
             # Position pos predicts slot pos+1 (pos <= total-2, so the
             # write never overflows).  Teacher-force prompt positions:
             # keep the prompt token for slots still inside the prompt.
             cur = lax.dynamic_index_in_dim(tokens, pos + 1, 1,
                                            keepdims=False)
+            in_gen = pos + 1 >= p_len
             tokens = lax.dynamic_update_index_in_dim(
-                tokens, jnp.where(pos + 1 >= p_len, nxt, cur), pos + 1, 1)
-            return (tokens, k_cache, v_cache, key), logits
+                tokens, jnp.where(in_gen, nxt, cur), pos + 1, 1)
+            if eos_id >= 0:
+                done = done | (in_gen & (nxt == eos_id))
+            return (tokens, k_cache, v_cache, key, done), logits
 
-        (tokens, _, _, _), step_logits = lax.scan(
-            tick, (tokens0, k0, k0, rng0), jnp.arange(total - 1))
+        (tokens, _, _, _, _), step_logits = lax.scan(
+            tick, (tokens0, k0, k0, rng0, done0), jnp.arange(total - 1))
         return tokens, step_logits
 
     def with_logits(params, prompt, max_new_tokens: int,
                     rng: Optional[jax.Array] = None,
                     temperature: float = 0.0, top_k: int = 0,
-                    top_p: float = 0.0):
+                    top_p: float = 0.0, eos_id: Optional[int] = None):
         """Tokens plus the per-position logits ``[total-1, B, V]``
         (scoring/evaluation use).  ``top_k``/``top_p`` filter the
-        sampling distribution (only with ``temperature > 0``)."""
+        sampling distribution (only with ``temperature > 0``).
+
+        ``eos_id``: stop token — a row that GENERATES it keeps emitting
+        ``eos_id`` for its remaining slots (masking under static shapes,
+        not early exit; prompt-resident eos tokens are data and do not
+        stop).  The returned logits are still the model's per-position
+        logits for every slot."""
         if temperature > 0.0 and rng is None:
             raise ValueError("temperature sampling needs an rng key")
         if (top_k or top_p) and temperature <= 0:
@@ -197,15 +217,19 @@ def make_generator(spec: ModelSpec):
         if top_k and not 0 < top_k <= vocab:
             raise ValueError(
                 f"top_k must be in [1, vocab_size={vocab}], got {top_k}")
+        if eos_id is not None and not 0 <= eos_id < vocab:
+            raise ValueError(
+                f"eos_id must be in [0, vocab_size={vocab}), got {eos_id}")
         return generate(params, prompt, int(max_new_tokens), rng,
-                        float(temperature), int(top_k), float(top_p))
+                        float(temperature), int(top_k), float(top_p),
+                        -1 if eos_id is None else int(eos_id))
 
     def wrapped(params, prompt, max_new_tokens: int,
                 rng: Optional[jax.Array] = None,
                 temperature: float = 0.0, top_k: int = 0,
-                top_p: float = 0.0):
+                top_p: float = 0.0, eos_id: Optional[int] = None):
         tokens, _ = with_logits(params, prompt, max_new_tokens, rng,
-                                temperature, top_k, top_p)
+                                temperature, top_k, top_p, eos_id)
         return tokens
 
     # Beam search: beams ride the batch dim ([B·W] rows through the same
@@ -289,7 +313,11 @@ def make_generator(spec: ModelSpec):
     def beam_search(params, prompt, max_new_tokens: int,
                     num_beams: int = 4):
         """Beam-search decode; returns ``(tokens [B, P+N], logprob [B])``
-        — the total log-probability of the generated suffix."""
+        — the total log-probability of the generated suffix.  No
+        ``eos_id`` support here: finished-beam bookkeeping (freezing a
+        beam's score while others grow) is a different algorithm from
+        the masking trick greedy/sampled decode uses; use the greedy/
+        sampled path when stop tokens matter."""
         if num_beams < 1:
             raise ValueError(f"num_beams must be >= 1, got {num_beams}")
         vocab = params["embed"].shape[0]

@@ -1,0 +1,255 @@
+"""Speculative decoding for ``transformer_lm`` (draft-and-verify).
+
+Beyond the reference (training-only) and beyond plain KV-cache decode
+(``models/generate.py``): a small DRAFT model proposes ``gamma`` tokens
+with cheap sequential steps, then the TARGET model verifies all of them
+in ONE parallel cached forward — the classic latency lever for serving
+(Leviathan et al. 2023, "Fast Inference from Transformers via
+Speculative Decoding"), specialised here to greedy acceptance so the
+output is EXACTLY the target model's greedy decode, token for token.
+
+TPU-first shape discipline:
+
+* one ``lax.while_loop`` whose carries are fixed-shape buffers — tokens
+  ``[B, L]``, both models' KV caches, a per-row position vector ``[B]``
+  (rows accept different amounts per iteration, so progress is per-row);
+* the verify step feeds the target ``gamma + 1`` positions at once
+  through the SAME shared ``TransformerLayer`` block math as training
+  and single-token decode (``generate._token_step``), with a
+  block-causal mask against the cache — MXU-batched verification is
+  where the speedup comes from;
+* rejected proposals leave stale KV entries behind; every stale position
+  is overwritten by the next iteration's writes before any query can
+  attend it (writes land at ``n'-1 .. n'+gamma-1`` which covers the
+  stale range ``n'+.. .. n+gamma-1``), so no masking bookkeeping is
+  needed beyond the per-position causal mask.
+
+Greedy acceptance: accept the longest prefix of draft proposals that
+matches the target's argmax, then emit the target's argmax at the first
+mismatch ("bonus" token) — at least one target-correct token per
+iteration, so the loop terminates in at most ``max_new_tokens``
+iterations and the result equals target-greedy regardless of how bad
+the draft is.
+"""
+from __future__ import annotations
+
+import functools
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from autodist_tpu.models.base import ModelSpec
+from autodist_tpu.models.transformer import TransformerLayer
+
+
+def _unpack(params, num_layers):
+    layer_params = [params["decoder"][f"layers_{i}"]
+                    for i in range(num_layers)]
+    return (params["embed"], params["pos_embed"], layer_params,
+            params["decoder"]["ln_final"]["scale"])
+
+
+def _positions_step(layer_params, ln_final_scale, embed, x, k_cache,
+                    v_cache, pos, total_len):
+    """Process S consecutive positions per row in ONE pass against the
+    KV cache.  ``x``: [B, S, D] embedded inputs, row b's slots at
+    absolute positions ``pos[b] .. pos[b]+S-1`` (``pos``: [B] int32);
+    caches [Layers, B, T, H, Dh].  Returns (logits [B, S, V], caches).
+
+    The S=1 case is the single-token decode tick with a per-ROW position
+    (generate._token_step takes one scalar position for the whole
+    batch); larger S is the verify step.  The block math is the shared
+    ``TransformerLayer`` — only the cached block-causal attention is
+    specific to this path."""
+    b, s, _ = x.shape
+    heads, hd = k_cache.shape[-2], k_cache.shape[-1]
+    d_ff = layer_params[0]["mlp"]["wi"]["kernel"].shape[1]
+    rows = jnp.arange(b)[:, None]                       # [B, 1]
+    cols = pos[:, None] + jnp.arange(s)[None, :]        # [B, S] absolute
+    for i, lp in enumerate(layer_params):
+        cache_out = {}
+
+        def cached_attn(q, k, v, causal, _i=i, _out=cache_out):
+            # q/k/v: [B, S, H, K].  Write this block's K/V, then attend
+            # each query over cache entries <= its own absolute position
+            # (the S new slots are written first, so the block is
+            # causally visible to itself).
+            kc = k_cache.at[_i, rows, cols].set(k)
+            vc = v_cache.at[_i, rows, cols].set(v)
+            _out["k"], _out["v"] = kc, vc
+            depth = q.shape[-1]
+            logits = jnp.einsum("bshk,bthk->bsht", q, kc[_i]) \
+                / jnp.sqrt(jnp.asarray(depth, q.dtype))
+            mask = (jnp.arange(total_len)[None, None, :]
+                    <= cols[:, :, None])                # [B, S, T]
+            # logits: [B, S, H, T]; broadcast the mask over heads.
+            logits = jnp.where(mask[:, :, None, :], logits,
+                               jnp.finfo(logits.dtype).min)
+            probs = jax.nn.softmax(logits.astype(jnp.float32),
+                                   axis=-1).astype(q.dtype)
+            return jnp.einsum("bsht,bthk->bshk", probs, vc[_i])
+
+        x = TransformerLayer(heads, hd, d_ff, causal=True,
+                             attn_fn=cached_attn).apply({"params": lp}, x)
+        k_cache, v_cache = cache_out["k"], cache_out["v"]
+    x = nn.LayerNorm(use_bias=False).apply(
+        {"params": {"scale": ln_final_scale}}, x)
+    return jnp.einsum("bsd,vd->bsv", x, embed), k_cache, v_cache
+
+
+def make_speculative_generator(target_spec: ModelSpec,
+                               draft_spec: ModelSpec):
+    """Build ``spec_gen(target_params, draft_params, prompt,
+    max_new_tokens, gamma=4)`` → ``(tokens [B, P+N], stats)``.
+
+    ``stats`` is a dict of device scalars: ``iterations`` (verify
+    passes) and ``proposed`` / ``accepted`` draft-token counts over the
+    whole batch — ``accepted / proposed`` is the draft's acceptance
+    rate, the quantity that decides whether speculation pays off.
+
+    Requirements: both specs are transformer_lm-family and share the
+    vocabulary (the draft proposes token ids the target scores); the
+    buffer needs ``P + N + gamma`` positions of both models' max_len
+    (proposals may overshoot the requested length before being
+    trimmed)."""
+    for which, spec in (("target", target_spec), ("draft", draft_spec)):
+        if "num_layers" not in spec.config or "max_len" not in spec.config:
+            raise ValueError(
+                f"{which} spec must be transformer_lm-family, got "
+                f"{spec.name!r}")
+    t_cfg, d_cfg = target_spec.config, draft_spec.config
+    if t_cfg["vocab_size"] != d_cfg["vocab_size"]:
+        raise ValueError(
+            f"target/draft vocab mismatch: {t_cfg['vocab_size']} vs "
+            f"{d_cfg['vocab_size']}")
+
+    @functools.partial(jax.jit, static_argnums=(3, 4))
+    def spec_gen(target_params, draft_params, prompt, max_new_tokens,
+                 gamma=4):
+        if gamma < 1:
+            raise ValueError(f"gamma must be >= 1, got {gamma}")
+        b, p_len = prompt.shape
+        if p_len < 1:
+            raise ValueError("prompt must hold at least one token")
+        end = p_len + max_new_tokens
+        buf_len = end + gamma                 # proposals may overshoot
+        for which, cfg in (("target", t_cfg), ("draft", d_cfg)):
+            if buf_len > cfg["max_len"]:
+                raise ValueError(
+                    f"prompt + max_new_tokens + gamma = {buf_len} exceeds "
+                    f"the {which} model's max_len {cfg['max_len']} "
+                    f"(speculation needs gamma slack positions)")
+
+        t_embed, t_pos, t_layers, t_ln = _unpack(target_params,
+                                                 t_cfg["num_layers"])
+        d_embed, d_pos, d_layers, d_ln = _unpack(draft_params,
+                                                 d_cfg["num_layers"])
+        rows = jnp.arange(b)
+
+        def cache(cfg, params_embed):
+            heads, hd = cfg["num_heads"], cfg["head_dim"]
+            return jnp.zeros((cfg["num_layers"], b, buf_len, heads, hd),
+                             params_embed.dtype)
+
+        tokens0 = jnp.concatenate(
+            [prompt, jnp.zeros((b, buf_len - p_len), prompt.dtype)], axis=1)
+
+        # Prefill BOTH caches with one parallel pass over the prompt
+        # (positions 0..P-1); the logits are discarded — the loop's
+        # verify pass re-derives the first prediction from position P-1.
+        zeros = jnp.zeros((b,), jnp.int32)
+
+        def prefill(embed, pos_embed, layers, ln, kc, vc):
+            x = jnp.take(embed, prompt, axis=0) + pos_embed[None, :p_len]
+            _, kc, vc = _positions_step(layers, ln, embed, x, kc, vc,
+                                        zeros, buf_len)
+            return kc, vc
+
+        tk, tv = prefill(t_embed, t_pos, t_layers, t_ln,
+                         cache(t_cfg, t_embed), cache(t_cfg, t_embed))
+        dk, dv = prefill(d_embed, d_pos, d_layers, d_ln,
+                         cache(d_cfg, d_embed), cache(d_cfg, d_embed))
+
+        def body(carry):
+            tokens, n, tk, tv, dk, dv, iters, proposed, accepted = carry
+            active = n < end
+
+            # -- draft: gamma cheap sequential proposals ---------------
+            # Cache continuity: the draft only ever PROCESSES inputs up
+            # to position n+gamma-2 (the last proposal and the bonus
+            # token are emitted, never fed back within the iteration),
+            # so after a full acceptance the next context tail is absent
+            # from its cache.  The first step therefore processes a
+            # 2-position catch-up window ending at n-1 — always enough,
+            # since n advances by at most gamma+1 while the draft
+            # processed through n+gamma-2.
+            for i in range(gamma):
+                if i == 0:
+                    start = jnp.maximum(n - 2, 0)
+                    cols0 = start[:, None] + jnp.arange(2)
+                    toks0 = jnp.take_along_axis(tokens, cols0, axis=1)
+                    x = jnp.take(d_embed, toks0, axis=0) + d_pos[cols0]
+                    logits, dk, dv = _positions_step(
+                        d_layers, d_ln, d_embed, x, dk, dv, start,
+                        buf_len)
+                    # the query AT position n-1 predicts slot n; its
+                    # window index is n-1-start (0 when n==1 clamps).
+                    idx = (n - 1 - start)[:, None, None]
+                    logit_i = jnp.take_along_axis(
+                        logits, jnp.broadcast_to(
+                            idx, (logits.shape[0], 1, logits.shape[2])),
+                        axis=1)[:, 0]
+                else:
+                    pos_i = jnp.minimum(n - 1 + i, buf_len - 1)
+                    cur = tokens[rows, pos_i]
+                    x = (jnp.take(d_embed, cur, axis=0)
+                         + d_pos[pos_i])[:, None, :]
+                    logits, dk, dv = _positions_step(
+                        d_layers, d_ln, d_embed, x, dk, dv, pos_i,
+                        buf_len)
+                    logit_i = logits[:, 0]
+                prop = jnp.argmax(logit_i, axis=-1).astype(tokens.dtype)
+                slot = jnp.minimum(n + i, buf_len - 1)
+                tokens = tokens.at[rows, slot].set(
+                    jnp.where(active, prop, tokens[rows, slot]))
+
+            # -- target: verify gamma+1 positions in ONE pass ----------
+            v_pos = jnp.minimum(n - 1, buf_len - 1 - gamma)   # [B]
+            v_cols = v_pos[:, None] + jnp.arange(gamma + 1)   # [B, G+1]
+            v_tok = jnp.take_along_axis(tokens, v_cols, axis=1)
+            x = jnp.take(t_embed, v_tok, axis=0) + t_pos[v_cols]
+            logits, tk, tv = _positions_step(
+                t_layers, t_ln, t_embed, x, tk, tv, v_pos, buf_len)
+            preds = jnp.argmax(logits, axis=-1).astype(tokens.dtype)
+            # preds[:, i] is the target's token for slot n+i.
+
+            drafts = jnp.take_along_axis(
+                tokens, n[:, None] + jnp.arange(gamma), axis=1)
+            match = preds[:, :gamma] == drafts                # [B, G]
+            a = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1),
+                        axis=1)                               # [B] 0..G
+            bonus = jnp.take_along_axis(preds, a[:, None], axis=1)[:, 0]
+            slot = jnp.minimum(n + a, buf_len - 1)
+            tokens = tokens.at[rows, slot].set(
+                jnp.where(active, bonus, tokens[rows, slot]))
+            n = jnp.where(active, jnp.minimum(n + a + 1, end), n)
+
+            iters = iters + 1
+            proposed = proposed + jnp.sum(jnp.where(active, gamma, 0))
+            accepted = accepted + jnp.sum(jnp.where(active, a, 0))
+            return tokens, n, tk, tv, dk, dv, iters, proposed, accepted
+
+        def cond(carry):
+            return jnp.any(carry[1] < end)
+
+        n0 = jnp.full((b,), p_len, jnp.int32)
+        zero = jnp.zeros((), jnp.int32)
+        tokens, n, *_rest, iters, proposed, accepted = lax.while_loop(
+            cond, body, (tokens0, n0, tk, tv, dk, dv, zero, zero, zero))
+        stats = {"iterations": iters, "proposed": proposed,
+                 "accepted": accepted}
+        return tokens[:, :end], stats
+
+    return spec_gen

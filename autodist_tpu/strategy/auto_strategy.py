@@ -47,11 +47,23 @@ class AutoStrategy(StrategyBuilder):
       chunk_size: collective group width for the small-variable AllReduce
         tier (reference chunking semantics).
       compressor: optional gradient compressor for the AllReduce tier.
+      search: cost-model search instead of (only) the tier heuristic —
+        the AutoSync move the paper pitches: build every candidate fixed
+        builder's strategy PLUS the tier heuristic's, estimate each with
+        the rank-calibrated cost model
+        (``tests/test_cost_model_calibration.py``), and return the
+        cheapest.  The chosen candidate's name lands in ``last_choice``
+        and the log.  Ties resolve to the earliest candidate — the
+        heuristic tier goes first, so on near-tie dense workloads the
+        structure-aware assignment wins.
+      candidates: optional builder list for ``search=True`` (defaults to
+        the tier heuristic + every shipped fixed builder).
     """
 
     def __init__(self, partition_threshold: int = 1 << 20,
                  chunk_size: int = 128,
-                 compressor: str = "NoneCompressor"):
+                 compressor: str = "NoneCompressor",
+                 search: bool = False, candidates=None):
         if partition_threshold < 1:
             raise ValueError("partition_threshold must be >= 1")
         if chunk_size < 1:
@@ -59,9 +71,55 @@ class AutoStrategy(StrategyBuilder):
         self._threshold = partition_threshold
         self._chunk_size = chunk_size
         self._compressor = compressor
+        self._search = search
+        self._candidates = candidates
+        self.last_choice: str = ""
 
     def build(self, graph_item: GraphItem,
               resource_spec: ResourceSpec) -> Strategy:
+        if self._search:
+            return self._build_search(graph_item, resource_spec)
+        return self._build_tiers(graph_item, resource_spec)
+
+    def _build_search(self, graph_item: GraphItem,
+                      resource_spec: ResourceSpec) -> Strategy:
+        from autodist_tpu.strategy.cost_model import estimate_cost
+        from autodist_tpu.utils import logging
+
+        if self._candidates is not None:
+            candidates = list(self._candidates)
+            if not candidates:
+                raise ValueError(
+                    "AutoStrategy(search=True) needs at least one "
+                    "candidate builder")
+        else:
+            from autodist_tpu.strategy import (
+                AllReduce, Parallax, PartitionedAR, PartitionedPS, PS,
+                PSLoadBalancing, RandomAxisPartitionAR,
+                UnevenPartitionedPS)
+
+            heuristic = AutoStrategy(
+                partition_threshold=self._threshold,
+                chunk_size=self._chunk_size, compressor=self._compressor)
+            candidates = [heuristic, PSLoadBalancing(), PS(),
+                          PartitionedPS(), UnevenPartitionedPS(),
+                          AllReduce(chunk_size=self._chunk_size),
+                          PartitionedAR(), RandomAxisPartitionAR(),
+                          Parallax()]
+        best = None
+        for builder in candidates:
+            strategy = builder.build(graph_item, resource_spec)
+            cost = estimate_cost(strategy, graph_item, resource_spec)
+            if best is None or cost.time_s < best[2].time_s:
+                best = (type(builder).__name__, strategy, cost)
+        self.last_choice = best[0]
+        logging.info(
+            "AutoStrategy(search): picked %s (est %.3f ms sync) from %d "
+            "candidates", best[0], best[2].time_s * 1e3, len(candidates))
+        return best[1]
+
+    def _build_tiers(self, graph_item: GraphItem,
+                     resource_spec: ResourceSpec) -> Strategy:
         ps_devices = self.reduction_device_names(resource_spec)
         variables = graph_item.trainable_var_infos
 

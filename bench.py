@@ -337,8 +337,11 @@ def _fill_decode(result) -> None:
 
         batch, p_len, n_new = 8, 32, 128
         total = p_len + n_new
+        # max_len carries 8 slack positions for the speculative section
+        # below (its proposals can overshoot the requested length by
+        # gamma before trimming).
         spec = transformer_lm(num_layers=12, num_heads=12, head_dim=64,
-                              d_ff=3072, max_len=total, seq_len=total,
+                              d_ff=3072, max_len=total + 8, seq_len=total,
                               dtype=jnp.bfloat16)
         params = spec.init(jax.random.PRNGKey(0))
         rng = np.random.RandomState(0)
@@ -388,6 +391,37 @@ def _fill_decode(result) -> None:
         agree = float(np.mean(np.asarray(tok_kv[:, p_len:])
                               == np.asarray(tok_rf[:, p_len:])))
         result["decode_greedy_agreement"] = round(agree, 4)
+        print(json.dumps(result), flush=True)
+
+        # Speculative decoding (models/speculative.py), draft == target:
+        # every proposal is accepted, so this is the MECHANICAL upper
+        # bound of the draft-and-verify pipeline (gamma+1 tokens per
+        # batched verify pass) — labeled as such; real speedup depends
+        # on a trained draft's acceptance rate, which untrained bench
+        # weights cannot exhibit.
+        from autodist_tpu.models.speculative import \
+            make_speculative_generator
+
+        sg = make_speculative_generator(spec, spec)
+        gamma = 4
+        tok_sp, stats = sg(params, params, prompt, n_new, gamma)
+        tok_sp.block_until_ready()
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            tok_sp, stats = sg(params, params, prompt, n_new, gamma)
+        int(np.asarray(tok_sp[0, -1]))
+        dt_sp = (time.perf_counter() - t0) / reps
+        result["decode_speculative_tokens_per_sec"] = round(
+            batch * n_new / dt_sp, 1)
+        result["decode_speculative_note"] = \
+            f"draft=target upper bound, gamma={gamma}"
+        prop = int(np.asarray(stats["proposed"]))
+        result["decode_speculative_acceptance"] = round(
+            int(np.asarray(stats["accepted"])) / max(prop, 1), 4)
+        spec_agree = float(np.mean(np.asarray(tok_sp[:, p_len:])
+                                   == np.asarray(tok_kv[:, p_len:])))
+        result["decode_speculative_greedy_agreement"] = round(
+            spec_agree, 4)
     except Exception as e:  # pragma: no cover - best-effort enrichment
         print(f"bench: decode metric unavailable ({e!r})",
               file=sys.stderr, flush=True)
@@ -803,7 +837,7 @@ def _fill_auto_strategy(result) -> None:
             h = jnp.tanh(h @ p["l2"]["w"])
             return jnp.mean(((h @ p["out"]["w"])[:, 0] - b["y"]) ** 2)
 
-        worst_pct = None
+        worst_pct = worst_search_pct = None
         for name, params, loss_fn, batch, sparse, fixed in (
                 ("sparse", emb_params, emb_loss, emb_batch, ("emb/table",),
                  (AllReduce(), Parallax(), PSLoadBalancing())),
@@ -815,7 +849,18 @@ def _fill_auto_strategy(result) -> None:
             pct = 100.0 * (auto / best - 1.0)
             result[f"auto_vs_best_pct_{name}"] = round(pct, 1)
             worst_pct = pct if worst_pct is None else max(worst_pct, pct)
+            # Cost-model search mode: the searched candidate usually IS
+            # one of the fixed builders, so its program hits the
+            # compile cache.
+            searcher = AutoStrategy(search=True)
+            s_auto = measure(searcher, params, loss_fn, batch, sparse)
+            s_pct = 100.0 * (s_auto / best - 1.0)
+            result[f"auto_search_vs_best_pct_{name}"] = round(s_pct, 1)
+            result[f"auto_search_choice_{name}"] = searcher.last_choice
+            worst_search_pct = s_pct if worst_search_pct is None \
+                else max(worst_search_pct, s_pct)
         result["auto_vs_best_pct"] = round(worst_pct, 1)
+        result["auto_search_vs_best_pct"] = round(worst_search_pct, 1)
     except Exception as e:  # pragma: no cover - best-effort enrichment
         print(f"bench: auto-strategy metric unavailable ({e!r})",
               file=sys.stderr, flush=True)

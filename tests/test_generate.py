@@ -241,3 +241,39 @@ def test_score_rejects_single_token(lm):
     gen = make_generator(spec)
     with pytest.raises(ValueError, match="length >= 2"):
         gen.score(params, np.zeros((2, 1), np.int32))
+
+
+def test_eos_stop_token(lm):
+    """A row that generates eos_id keeps emitting it (static-shape
+    masking); tokens before the stop match the unstopped run; eos in the
+    PROMPT is data, not a stop; eos_id=None is unchanged behavior."""
+    spec, params = lm
+    gen = make_generator(spec)
+    rng = np.random.RandomState(3)
+    prompt = rng.randint(0, 97, (3, 6)).astype(np.int32)
+    free = np.asarray(gen(params, prompt, 8))          # no stopping
+    # Pick the token row 0 greedily emits at its SECOND generated slot as
+    # eos: the stopped run must match up to and including that slot, then
+    # pad with it.
+    eos = int(free[0, 7])
+    stopped = np.asarray(gen(params, prompt, 8, eos_id=eos))
+    np.testing.assert_array_equal(stopped[0, :8], free[0, :8])
+    assert (stopped[0, 8:] == eos).all(), (eos, stopped[0])
+    # Rows that never emit eos are untouched.
+    for b in range(1, 3):
+        if eos not in free[b, 6:]:
+            np.testing.assert_array_equal(stopped[b], free[b])
+    # eos inside the prompt does not stop generation.
+    p2 = prompt.copy()
+    p2[:, 2] = eos
+    out2 = np.asarray(gen(params, p2, 4, eos_id=eos))
+    assert (out2[:, :6] == p2).all()
+    free2 = np.asarray(gen(params, p2, 4))
+    # first generated slot identical (prompt eos ignored)
+    np.testing.assert_array_equal(out2[:, 6], free2[:, 6])
+    # eos_id=None identical to omitting it.
+    np.testing.assert_array_equal(np.asarray(gen(params, prompt, 4)),
+                                  np.asarray(gen(params, prompt, 4,
+                                                 eos_id=None)))
+    with pytest.raises(ValueError, match="eos_id"):
+        gen(params, prompt, 4, eos_id=97)

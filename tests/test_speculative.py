@@ -1,0 +1,104 @@
+"""Speculative decoding: exact greedy-equivalence with the target model.
+
+The whole point of greedy-acceptance speculation is that the DRAFT can
+be arbitrarily bad without changing the output — only the speed.  So
+the oracle for every configuration is ``generate.make_generator`` greedy
+decode of the TARGET model, asserted token-exact.
+"""
+import jax
+import numpy as np
+import pytest
+
+from autodist_tpu.models.generate import make_generator
+from autodist_tpu.models.speculative import make_speculative_generator
+from autodist_tpu.models.transformer import dense_attention
+from autodist_tpu.models.transformer_lm import transformer_lm
+
+VOCAB = 61
+
+
+def _lm(layers, heads=2, hd=8, seed=0, max_len=40):
+    spec = transformer_lm(vocab_size=VOCAB, num_layers=layers,
+                          num_heads=heads, head_dim=hd, d_ff=32,
+                          max_len=max_len, seq_len=16,
+                          attn_fn=dense_attention)
+    return spec, spec.init(jax.random.PRNGKey(seed))
+
+
+@pytest.fixture(scope="module")
+def target():
+    return _lm(3, seed=0)
+
+
+@pytest.fixture(scope="module")
+def draft():
+    # Different depth AND different init: a genuinely disagreeing draft.
+    return _lm(1, seed=9)
+
+
+@pytest.mark.parametrize("gamma", [1, 3, 5])
+def test_exact_greedy_equivalence_bad_draft(target, draft, gamma):
+    """An unrelated draft model: low acceptance, identical output."""
+    t_spec, t_params = target
+    d_spec, d_params = draft
+    rng = np.random.RandomState(0)
+    prompt = rng.randint(0, VOCAB, (3, 7)).astype(np.int32)
+    new = 9
+    oracle = np.asarray(make_generator(t_spec)(t_params, prompt, new))
+    sg = make_speculative_generator(t_spec, d_spec)
+    tokens, stats = sg(t_params, d_params, prompt, new, gamma)
+    np.testing.assert_array_equal(np.asarray(tokens), oracle)
+    assert int(stats["iterations"]) <= new    # >= 1 token per iteration
+    assert int(stats["proposed"]) >= int(stats["accepted"]) >= 0
+
+
+def test_perfect_draft_accepts_everything(target):
+    """draft == target: every proposal matches the target's argmax, so
+    each verify pass lands gamma+1 tokens and the loop runs
+    ~ceil(new/(gamma+1)) iterations — the mechanical upper bound."""
+    t_spec, t_params = target
+    rng = np.random.RandomState(1)
+    prompt = rng.randint(0, VOCAB, (2, 5)).astype(np.int32)
+    new, gamma = 12, 3
+    oracle = np.asarray(make_generator(t_spec)(t_params, prompt, new))
+    sg = make_speculative_generator(t_spec, t_spec)
+    tokens, stats = sg(t_params, t_params, prompt, new, gamma)
+    np.testing.assert_array_equal(np.asarray(tokens), oracle)
+    iters = int(stats["iterations"])
+    assert iters <= -(-new // (gamma + 1)) + 1, stats   # ceil + ragged tail
+    assert int(stats["accepted"]) == int(stats["proposed"]), stats
+
+
+def test_ragged_acceptance_rows_advance_independently(target, draft):
+    """Rows accept different counts per iteration (per-row position
+    vector): a batch mixing an easy row (prompt repeated tokens) and
+    hard rows must still match the oracle row-for-row."""
+    t_spec, t_params = target
+    d_spec, d_params = draft
+    rng = np.random.RandomState(2)
+    prompt = rng.randint(0, VOCAB, (4, 6)).astype(np.int32)
+    prompt[0, :] = 7                       # degenerate easy row
+    new = 8
+    oracle = np.asarray(make_generator(t_spec)(t_params, prompt, new))
+    sg = make_speculative_generator(t_spec, d_spec)
+    tokens, _ = sg(t_params, d_params, prompt, new, gamma=4)
+    np.testing.assert_array_equal(np.asarray(tokens), oracle)
+
+
+def test_validation_errors(target, draft):
+    t_spec, t_params = target
+    d_spec, d_params = draft
+    other = transformer_lm(vocab_size=VOCAB + 1, num_layers=1, num_heads=2,
+                           head_dim=8, d_ff=32, max_len=40, seq_len=16,
+                           attn_fn=dense_attention)
+    with pytest.raises(ValueError, match="vocab"):
+        make_speculative_generator(t_spec, other)
+    from autodist_tpu.models.ncf import ncf
+    with pytest.raises(ValueError, match="transformer_lm-family"):
+        make_speculative_generator(t_spec, ncf(num_users=4, num_items=4))
+    sg = make_speculative_generator(t_spec, d_spec)
+    prompt = np.zeros((1, 4), np.int32)
+    with pytest.raises(ValueError, match="max_len"):
+        sg(t_params, d_params, prompt, 40, 4)   # 4+40+4 > max_len 40
+    with pytest.raises(ValueError, match="gamma"):
+        sg(t_params, d_params, prompt, 4, 0)
