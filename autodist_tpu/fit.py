@@ -22,6 +22,7 @@ Design constraints (why this isn't a 5-line loop):
 from __future__ import annotations
 
 import itertools
+import signal as _signal
 import time
 from typing import Any, Callable, Dict, Iterable, Optional, Sequence, Union
 
@@ -85,6 +86,9 @@ class History:
                                          "epoch_loss": []}
         self.epochs_run = 0
         self.steps_run = 0
+        #: True when ``fit`` stopped early on a preemption signal (the
+        #: partial epoch is NOT counted in ``epochs_run``).
+        self.preempted = False
 
     def _sample(self, step: int, loss: float) -> None:
         self.history["loss"].append(loss)
@@ -121,7 +125,8 @@ def fit(session, data: DataArg, epochs: int = 1,
         checkpoint_dir: Optional[str] = None, checkpoint_every: int = 1,
         resume: bool = True, async_checkpoints: bool = False,
         initial_epoch: Optional[int] = None,
-        prefetch_depth: int = 2) -> History:
+        prefetch_depth: int = 2,
+        preemption_signals: Sequence = ()) -> History:
     """Train ``epochs`` × (``steps_per_epoch`` or len(data)) steps.
 
     ``epochs`` is the TOTAL target, Keras-style: resuming an interrupted
@@ -159,6 +164,16 @@ def fit(session, data: DataArg, epochs: int = 1,
         durable before returning.
       prefetch_depth: host→device transfers kept in flight ahead of
         compute (see ``DistributedSession.prefetch``).
+      preemption_signals: signal names (``"SIGTERM"``) or numbers to
+        treat as preemption notices — cloud TPU VMs deliver SIGTERM
+        shortly before eviction.  On receipt, ``fit`` finishes the
+        in-flight step, saves a checkpoint (when ``checkpoint_dir`` is
+        set — mid-epoch, so a later ``fit(..., resume=True)`` continues
+        from the preempted step), sets ``history.preempted``, and
+        returns.  Handlers are installed only for the duration of
+        ``fit`` and the previous handlers are restored on exit.  The
+        reference's closest facility is fail-fast process reaping
+        (coordinator.py:98-110) — graceful preemption is beyond-parity.
 
     Returns a :class:`History`.
     """
@@ -214,10 +229,62 @@ def fit(session, data: DataArg, epochs: int = 1,
                 "validation_steps")
         validation_data = session.place_batch(validation_data)
 
+    preempt = {"signum": None}
+    installed = []
+    if preemption_signals:
+        nums = []
+        for s in preemption_signals:   # validate ALL before installing ANY
+            if isinstance(s, str):
+                num = getattr(_signal, s, None)
+                if not isinstance(num, _signal.Signals):
+                    raise ValueError(f"unknown signal name {s!r}")
+            else:
+                num = _signal.Signals(s)
+            nums.append(num)
+
+        def _on_preempt(signum, frame):
+            # Runs in the main thread between bytecodes: ONLY set the
+            # flag — stream I/O (logging) from a handler can re-enter a
+            # buffered writer mid-write and raise, aborting fit before
+            # the checkpoint; the step boundary logs and checkpoints.
+            preempt["signum"] = signum
+
+        for num in nums:
+            installed.append((num, _signal.signal(num, _on_preempt)))
+
     hist = History()
     for cb in callbacks:
         cb.on_train_begin(session)
 
+    last_saved_step = None
+    try:
+        last_saved_step = _fit_epochs(
+            session, data, epochs, steps_per_epoch, validation_data,
+            validation_steps, callbacks, log_every, checkpoint_dir,
+            checkpoint_every, prefetch_depth, initial_epoch, saver, hist,
+            preempt)
+    finally:
+        for num, prev in installed:
+            _signal.signal(num, prev)
+
+    if (saver is not None and hist.steps_run
+            and last_saved_step != session.step_count):
+        # Never lose the tail epochs to the checkpoint_every stride.
+        saver.save(checkpoint_dir, step=session.step_count)
+    if saver is not None:
+        saver.wait()   # async saves must be durable before fit returns
+
+    for cb in callbacks:
+        cb.on_train_end(hist)
+    return hist
+
+
+def _fit_epochs(session, data, epochs, steps_per_epoch, validation_data,
+                validation_steps, callbacks, log_every, checkpoint_dir,
+                checkpoint_every, prefetch_depth, initial_epoch, saver,
+                hist, preempt):
+    """The epoch loop (split out so ``fit`` can wrap it in the
+    signal-handler install/restore).  Returns ``last_saved_step``."""
     last_saved_step = None
     for epoch in range(initial_epoch, epochs):
         for cb in callbacks:
@@ -246,6 +313,32 @@ def fit(session, data: DataArg, epochs: int = 1,
                     "fit: epoch %d step %d loss %.5f (%.1f steps/s)",
                     epoch, session.step_count, loss,
                     tp.get("steps_per_sec") or 0.0)
+            if preempt["signum"] is not None:
+                break
+        if preempt["signum"] is not None:
+            # Preemption notice (e.g. cloud SIGTERM before eviction):
+            # the in-flight step finished; checkpoint NOW — mid-epoch —
+            # so resume continues from this step, and stop.  The partial
+            # epoch stays out of epochs_run (resume re-derives its place
+            # from the step counter).
+            hist.preempted = True
+            loss = float(np.asarray(out["loss"])) if out is not None \
+                else None
+            if loss is not None and last_sampled_step != session.step_count:
+                hist._sample(session.step_count, loss)
+            if saver is not None and hist.steps_run:
+                saver.save(checkpoint_dir, step=session.step_count)
+                last_saved_step = session.step_count
+            for cb in callbacks:
+                cb.on_epoch_end(epoch, {
+                    "loss": loss, "epoch_steps": epoch_steps,
+                    "step": session.step_count, "preempted": True})
+            logging.warning(
+                "fit: preempted (signal %d) at step %d%s",
+                preempt["signum"], session.step_count,
+                " — checkpoint saved" if last_saved_step is not None
+                else "")
+            break
         if out is None:
             # on_epoch_end still fires so begin/end-paired callbacks stay
             # balanced; an iterator exhausted MID-training ends the run
@@ -296,13 +389,4 @@ def fit(session, data: DataArg, epochs: int = 1,
             saver.save(checkpoint_dir, step=session.step_count)
             last_saved_step = session.step_count
 
-    if (saver is not None and hist.steps_run
-            and last_saved_step != session.step_count):
-        # Never lose the tail epochs to the checkpoint_every stride.
-        saver.save(checkpoint_dir, step=session.step_count)
-    if saver is not None:
-        saver.wait()   # async saves must be durable before fit returns
-
-    for cb in callbacks:
-        cb.on_train_end(hist)
-    return hist
+    return last_saved_step

@@ -362,6 +362,27 @@ def _fill_decode(result) -> None:
         result["decode_new_tokens"] = n_new
         print(json.dumps(result), flush=True)
 
+        # Serving throughput at batch 64: decode is bandwidth-bound
+        # (every tick re-reads all weights), so batching amortizes the
+        # weight traffic — the number a serving deployment cares about.
+        try:
+            b64 = 64
+            prompt64 = jnp.asarray(rng.randint(
+                0, spec.config["vocab_size"], (b64, p_len)), jnp.int32)
+            tok64 = gen(params, prompt64, n_new)
+            tok64.block_until_ready()
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                tok64 = gen(params, prompt64, n_new)
+            int(np.asarray(tok64[0, -1]))
+            dt64 = (time.perf_counter() - t0) / reps
+            result["decode_tokens_per_sec_b64"] = round(
+                b64 * n_new / dt64, 1)
+            print(json.dumps(result), flush=True)
+        except Exception as e:
+            print(f"bench: b64 decode unavailable ({e!r})",
+                  file=sys.stderr, flush=True)
+
         # Re-forward baseline: fixed [B, total] buffer, one compiled
         # program (pos is a traced scalar), full causal forward per token.
         @jax.jit

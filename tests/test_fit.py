@@ -172,6 +172,60 @@ def test_fit_resume_trains_to_total_epochs(tmp_path):
     assert hist4.epochs_run == 1 and sess4.step_count == 25
 
 
+def test_fit_preemption_checkpoint_and_resume(tmp_path):
+    """A preemption signal (cloud SIGTERM-before-eviction) checkpoints at
+    the next step boundary and stops; resume continues from that step."""
+    import os
+    import signal
+
+    ckpt = str(tmp_path / "ckpt")
+    sess, batches = _make_session()
+    data = batches(6)
+
+    class Bomb(Callback):
+        fired_at = None
+
+        def on_step_end(self, step, metrics):
+            if step == 3 and self.fired_at is None:
+                self.fired_at = step
+                os.kill(os.getpid(), signal.SIGUSR1)
+
+    prev = signal.getsignal(signal.SIGUSR1)
+    bomb = Bomb()
+    hist = sess.fit(data, epochs=4, checkpoint_dir=ckpt,
+                    callbacks=[bomb], preemption_signals=("SIGUSR1",))
+    assert bomb.fired_at == 3
+    assert hist.preempted
+    assert hist.steps_run == 3          # stopped at the next boundary
+    assert hist.epochs_run == 0         # the partial epoch is not counted
+    assert signal.getsignal(signal.SIGUSR1) is prev   # handler restored
+
+    from autodist_tpu.checkpoint import Saver
+
+    assert Saver.latest_step(ckpt) == 3   # saved AT the preempted step
+
+    # Resume: restores step 3 and trains on (mid-epoch resume re-runs the
+    # partial epoch at epoch granularity, as documented).
+    _reset_default_autodist_for_testing()
+    sess2, _ = _make_session()
+    hist2 = sess2.fit(data, epochs=1, steps_per_epoch=6,
+                      checkpoint_dir=ckpt, resume=True)
+    assert not hist2.preempted
+    assert sess2.step_count == 9        # resumed at 3, ran epoch 0's 6
+
+
+def test_fit_preemption_rejects_unknown_signal():
+    import signal
+
+    sess, batches = _make_session()
+    prev = signal.getsignal(signal.SIGUSR2)
+    with pytest.raises(ValueError, match="unknown signal"):
+        sess.fit(batches(2), epochs=1,
+                 preemption_signals=("SIGUSR2", "SIGNOPE"))
+    # Nothing was installed before the bad name was rejected.
+    assert signal.getsignal(signal.SIGUSR2) is prev
+
+
 def test_fit_empty_epoch_warns_not_crashes():
     sess, _ = _make_session()
     ends = []
