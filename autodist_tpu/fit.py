@@ -21,6 +21,7 @@ Design constraints (why this isn't a 5-line loop):
 """
 from __future__ import annotations
 
+import contextlib
 import itertools
 import signal as _signal
 import time
@@ -96,6 +97,45 @@ class History:
 
 
 DataArg = Union[Iterable, Callable[[], Iterable], Dict[str, Any]]
+
+
+def _validate_signals(specs: Sequence) -> list:
+    """Signal names/numbers → deduped ``signal.Signals`` list (dupes
+    would corrupt the previous-handler restore: the second install
+    records OUR handler as 'previous')."""
+    nums: list = []
+    for s in specs:
+        if isinstance(s, str):
+            num = getattr(_signal, s, None)
+            if not isinstance(num, _signal.Signals):
+                raise ValueError(f"unknown signal name {s!r}")
+        else:
+            num = _signal.Signals(s)
+        if num not in nums:
+            nums.append(num)
+    return nums
+
+
+@contextlib.contextmanager
+def _preemption_handlers(nums, preempt):
+    """Install flag-setting handlers for ``nums``; ALWAYS restore the
+    previous handlers on exit (reverse order), even on mid-install
+    failure."""
+    def _on_preempt(signum, frame):
+        # Runs in the main thread between bytecodes: ONLY set the flag —
+        # stream I/O (logging) from a handler can re-enter a buffered
+        # writer mid-write and raise, aborting fit before the
+        # checkpoint; the step boundary logs and checkpoints.
+        preempt["signum"] = signum
+
+    installed = []
+    try:
+        for num in nums:
+            installed.append((num, _signal.signal(num, _on_preempt)))
+        yield
+    finally:
+        for num, prev in reversed(installed):
+            _signal.signal(num, prev)
 
 
 def _epoch_iter(data: DataArg, steps_per_epoch: Optional[int]):
@@ -177,6 +217,9 @@ def fit(session, data: DataArg, epochs: int = 1,
 
     Returns a :class:`History`.
     """
+    # Validate FIRST: a bad signal name must fail before any restore or
+    # user callback runs.
+    handler_nums = _validate_signals(preemption_signals)
     saver = None
     resumed_step = None
     if checkpoint_dir is not None:
@@ -230,42 +273,20 @@ def fit(session, data: DataArg, epochs: int = 1,
         validation_data = session.place_batch(validation_data)
 
     preempt = {"signum": None}
-    installed = []
-    if preemption_signals:
-        nums = []
-        for s in preemption_signals:   # validate ALL before installing ANY
-            if isinstance(s, str):
-                num = getattr(_signal, s, None)
-                if not isinstance(num, _signal.Signals):
-                    raise ValueError(f"unknown signal name {s!r}")
-            else:
-                num = _signal.Signals(s)
-            nums.append(num)
-
-        def _on_preempt(signum, frame):
-            # Runs in the main thread between bytecodes: ONLY set the
-            # flag — stream I/O (logging) from a handler can re-enter a
-            # buffered writer mid-write and raise, aborting fit before
-            # the checkpoint; the step boundary logs and checkpoints.
-            preempt["signum"] = signum
-
-        for num in nums:
-            installed.append((num, _signal.signal(num, _on_preempt)))
-
     hist = History()
     for cb in callbacks:
         cb.on_train_begin(session)
 
-    last_saved_step = None
-    try:
+    with _preemption_handlers(handler_nums, preempt):
         last_saved_step = _fit_epochs(
-            session, data, epochs, steps_per_epoch, validation_data,
-            validation_steps, callbacks, log_every, checkpoint_dir,
-            checkpoint_every, prefetch_depth, initial_epoch, saver, hist,
-            preempt)
-    finally:
-        for num, prev in installed:
-            _signal.signal(num, prev)
+            session=session, data=data, epochs=epochs,
+            steps_per_epoch=steps_per_epoch,
+            validation_data=validation_data,
+            validation_steps=validation_steps, callbacks=callbacks,
+            log_every=log_every, checkpoint_dir=checkpoint_dir,
+            checkpoint_every=checkpoint_every,
+            prefetch_depth=prefetch_depth, initial_epoch=initial_epoch,
+            saver=saver, hist=hist, preempt=preempt)
 
     if (saver is not None and hist.steps_run
             and last_saved_step != session.step_count):
@@ -279,12 +300,13 @@ def fit(session, data: DataArg, epochs: int = 1,
     return hist
 
 
-def _fit_epochs(session, data, epochs, steps_per_epoch, validation_data,
-                validation_steps, callbacks, log_every, checkpoint_dir,
-                checkpoint_every, prefetch_depth, initial_epoch, saver,
-                hist, preempt):
+def _fit_epochs(*, session, data, epochs, steps_per_epoch,
+                validation_data, validation_steps, callbacks, log_every,
+                checkpoint_dir, checkpoint_every, prefetch_depth,
+                initial_epoch, saver, hist, preempt):
     """The epoch loop (split out so ``fit`` can wrap it in the
-    signal-handler install/restore).  Returns ``last_saved_step``."""
+    signal-handler scope; keyword-only — no positional-order hazard).
+    Returns ``last_saved_step``."""
     last_saved_step = None
     for epoch in range(initial_epoch, epochs):
         for cb in callbacks:

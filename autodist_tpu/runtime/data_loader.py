@@ -38,12 +38,25 @@ class DataLoader:
       num_threads / prefetch_depth: native pipeline parallelism and queue
         depth.
       seed: epoch-0 shuffle seed; epoch k uses ``seed + k``.
+      shard: ``(index, count)`` — keep only this host's strided subset of
+        rows (``rows[index::count]``, trimmed to ``n // count`` rows so
+        every host sees the SAME number of rows and therefore the same
+        number of batches — unequal counts would deadlock lockstep
+        collectives; the ``n % count`` remainder rows are dropped).  The
+        multi-host input split: every host constructs the same loader
+        over the same (or identically ordered) data with its own
+        ``index``, shards are disjoint, and each host feeds its local
+        batches through ``session.place_local_batch`` (the mesh's data
+        axis concatenates them logically).  Shuffling then permutes the
+        host's OWN subset per epoch — no cross-host coordination is ever
+        needed.
     """
 
     def __init__(self, data: ArrayDict, batch_size: int,
                  shuffle: bool = True, drop_last: bool = True,
                  to_bf16: Sequence = (), num_threads: int = 4,
-                 prefetch_depth: int = 2, seed: int = 0):
+                 prefetch_depth: int = 2, seed: int = 0,
+                 shard: Optional[tuple] = None):
         if isinstance(data, dict):
             self._names: Optional[List[str]] = list(data.keys())
             arrays = [data[k] for k in self._names]
@@ -57,6 +70,20 @@ class DataLoader:
             if a.shape[0] != n0:
                 raise ValueError("all arrays must share dim 0 "
                                  f"({a.shape[0]} != {n0})")
+        if shard is not None:
+            index, count = shard
+            if not 0 <= index < count:
+                raise ValueError(
+                    f"shard=(index, count) needs 0 <= index < count, "
+                    f"got {shard}")
+            # Strided split: contiguous block splits would starve the
+            # tail hosts of later-file rows under sorted datasets; the
+            # stride interleaves whatever order the caller stored.
+            # Trim every shard to the SAME row count (drop the n % count
+            # remainder): unequal per-host batch counts would deadlock
+            # lockstep collectives when hosts drive `sess.run` per local
+            # batch.
+            arrays = [a[index::count][:n0 // count] for a in arrays]
         self._arrays = [np.ascontiguousarray(a) for a in arrays]
         self._batch_size = int(batch_size)
         self._shuffle = shuffle

@@ -226,6 +226,38 @@ def test_fit_preemption_rejects_unknown_signal():
     assert signal.getsignal(signal.SIGUSR2) is prev
 
 
+def test_fit_preemption_duplicate_signals_restore_cleanly():
+    """Duplicate entries (name + number of the same signal) must not
+    leave fit's handler installed after return."""
+    import signal
+
+    sess, batches = _make_session()
+    prev = signal.getsignal(signal.SIGUSR1)
+    hist = sess.fit(batches(2), epochs=1,
+                    preemption_signals=("SIGUSR1", signal.SIGUSR1,
+                                        int(signal.SIGUSR1)))
+    assert not hist.preempted
+    assert signal.getsignal(signal.SIGUSR1) is prev
+
+
+def test_fit_preemption_handler_restored_when_callback_raises():
+    """An exception anywhere inside the handler scope (here: a callback)
+    must still restore the previous handlers."""
+    import signal
+
+    sess, batches = _make_session()
+
+    class Boom(Callback):
+        def on_epoch_begin(self, epoch):
+            raise RuntimeError("user callback bug")
+
+    prev = signal.getsignal(signal.SIGUSR1)
+    with pytest.raises(RuntimeError, match="user callback bug"):
+        sess.fit(batches(2), epochs=1, callbacks=[Boom()],
+                 preemption_signals=("SIGUSR1",))
+    assert signal.getsignal(signal.SIGUSR1) is prev
+
+
 def test_fit_empty_epoch_warns_not_crashes():
     sess, _ = _make_session()
     ends = []

@@ -57,6 +57,53 @@ def test_loader_shuffled_is_permutation_and_seeded():
     assert not np.array_equal(allx, x)
 
 
+def test_shard_disjoint_and_covering():
+    """shard=(i, n) is the multi-host input split: disjoint strided
+    subsets whose union is every row, each shuffled locally."""
+    x, y = make_data(64, 5)
+    rows = []
+    for i in range(4):
+        loader = DataLoader({"x": x, "y": y}, batch_size=4, shuffle=True,
+                            seed=3, shard=(i, 4))
+        assert len(loader) == 4                     # 16 local rows / 4
+        got = np.concatenate(
+            [b[0] for b in collect_epoch(loader)])
+        np.testing.assert_array_equal(              # host i's subset only
+            np.sort(got, axis=0), np.sort(x[i::4], axis=0))
+        rows.append(got)
+    # union covers the dataset exactly once
+    np.testing.assert_array_equal(
+        np.sort(np.concatenate(rows), axis=0), np.sort(x, axis=0))
+
+
+def test_shard_equal_counts_when_indivisible():
+    """66 rows over 4 hosts: every host must see the SAME number of rows
+    (16 = 66//4) and batches — unequal per-host batch counts would
+    deadlock lockstep collectives; the 2 remainder rows are dropped."""
+    x, y = make_data(66, 3)
+    lens, rows = set(), []
+    for i in range(4):
+        loader = DataLoader({"x": x, "y": y}, batch_size=8, shuffle=False,
+                            drop_last=False, shard=(i, 4))
+        batches = collect_epoch(loader)
+        lens.add(len(batches))
+        rows.append(np.concatenate([b[0] for b in batches]))
+    assert lens == {2}                          # identical on every host
+    got = np.concatenate(rows)
+    assert got.shape[0] == 64                   # 2 remainder rows dropped
+    # still disjoint: every kept row appears exactly once in the union
+    uniq = np.unique(got, axis=0)
+    assert uniq.shape[0] == 64
+
+
+def test_shard_validation():
+    x, y = make_data(8, 2)
+    with pytest.raises(ValueError, match="shard"):
+        DataLoader({"x": x, "y": y}, batch_size=2, shard=(4, 4))
+    with pytest.raises(ValueError, match="shard"):
+        DataLoader({"x": x, "y": y}, batch_size=2, shard=(-1, 2))
+
+
 def test_epochs_reshuffle():
     x, y = make_data(40, 2)
     loader = DataLoader((x, y), batch_size=10, shuffle=True, seed=3)
