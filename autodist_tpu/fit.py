@@ -274,10 +274,12 @@ def fit(session, data: DataArg, epochs: int = 1,
 
     preempt = {"signum": None}
     hist = History()
-    for cb in callbacks:
-        cb.on_train_begin(session)
-
     with _preemption_handlers(handler_nums, preempt):
+        # on_train_begin runs INSIDE the handler scope: a SIGTERM during
+        # a slow user callback must still flag (and checkpoint at the
+        # first step boundary), not kill the process.
+        for cb in callbacks:
+            cb.on_train_begin(session)
         last_saved_step = _fit_epochs(
             session=session, data=data, epochs=epochs,
             steps_per_epoch=steps_per_epoch,

@@ -8,6 +8,10 @@ long-context/multi-dim parallelism).
 from autodist_tpu.models.base import ModelSpec, cross_entropy_loss  # noqa: F401
 from autodist_tpu.models.bert import bert, bert_base, bert_large  # noqa: F401
 from autodist_tpu.models.generate import make_generator  # noqa: F401
+from autodist_tpu.models.quantize import (  # noqa: F401
+    dequantize_lm_params,
+    quantize_lm_params,
+)
 from autodist_tpu.models.speculative import (  # noqa: F401
     make_speculative_generator,
 )

@@ -40,7 +40,17 @@ import jax.numpy as jnp
 from jax import lax
 
 from autodist_tpu.models.base import ModelSpec
+from autodist_tpu.models.quantize import (embed_lookup, head_logits,
+                                          is_quantized, quant_interceptor)
 from autodist_tpu.models.transformer import TransformerLayer
+from autodist_tpu.ops.quant import Quantized
+
+
+def _vocab_size(params) -> int:
+    """Vocab size for either a full-precision ([V, D] embed) or a
+    weight-only int8 tree (Quantized [D, V], models/quantize.py)."""
+    e = params["embed"]
+    return e.shape[1] if is_quantized(params) else e.shape[0]
 
 
 def _token_step(layer_params, ln_final_scale, embed, x, k_cache, v_cache,
@@ -59,6 +69,8 @@ def _token_step(layer_params, ln_final_scale, embed, x, k_cache, v_cache,
     standard under tracing (the arrays are traced values either way)."""
     heads, hd = k_cache.shape[-2], k_cache.shape[-1]
     d_ff = layer_params[0]["mlp"]["wi"]["kernel"].shape[1]
+    quantized = isinstance(layer_params[0]["mlp"]["wi"]["kernel"],
+                           Quantized)
     x = x[:, None, :]                                   # [B, 1, D]
     for i, lp in enumerate(layer_params):
         cache_out = {}
@@ -79,12 +91,19 @@ def _token_step(layer_params, ln_final_scale, embed, x, k_cache, v_cache,
                                    axis=-1).astype(q.dtype)
             return jnp.einsum("bht,tbhk->bhk", probs, vc[_i])[:, None]
 
-        x = TransformerLayer(heads, hd, d_ff, causal=True,
-                             attn_fn=cached_attn).apply({"params": lp}, x)
+        layer = TransformerLayer(heads, hd, d_ff, causal=True,
+                                 attn_fn=cached_attn)
+        if quantized:
+            # Same TransformerLayer math; only Dense/DenseGeneral are
+            # rerouted to the int8 kernel (models/quantize.py).
+            with nn.intercept_methods(quant_interceptor(lp)):
+                x = layer.apply({"params": lp}, x)
+        else:
+            x = layer.apply({"params": lp}, x)
         k_cache, v_cache = cache_out["k"], cache_out["v"]
     x = nn.LayerNorm(use_bias=False).apply(
         {"params": {"scale": ln_final_scale}}, x)
-    out_logits = jnp.einsum("bd,vd->bv", x[:, 0], embed)
+    out_logits = head_logits(embed, x[:, 0])
     return out_logits, k_cache, v_cache
 
 
@@ -106,6 +125,12 @@ def make_generator(spec: ModelSpec):
     The returned function also carries ``.with_logits`` (adds the
     per-position logits) and ``.beam_search`` (width-W beam decode
     returning ``(tokens, suffix_logprob)``).
+
+    ``params`` may be a full-precision tree OR a weight-only int8 tree
+    from :func:`autodist_tpu.models.quantize.quantize_lm_params` —
+    greedy/sampled/beam decode then run the Pallas int8 matmul kernel
+    with weights resident in HBM as int8 (half the per-tick weight
+    traffic that bounds decode); ``score`` needs full precision.
 
     Returns ``[B, P + max_new_tokens]`` tokens (prompt included).
     """
@@ -140,7 +165,7 @@ def make_generator(spec: ModelSpec):
         _check_len(total)
         embed, pos_embed, layer_params, ln_final = _unpack(params)
         heads, hd = cfg["num_heads"], cfg["head_dim"]
-        dtype = embed.dtype
+        dtype = pos_embed.dtype   # embed may be Quantized
         k0 = jnp.zeros((num_layers, total, b, heads, hd), dtype)
         tokens0 = jnp.concatenate(
             [prompt, jnp.zeros((b, max_new_tokens), prompt.dtype)], axis=1)
@@ -150,7 +175,7 @@ def make_generator(spec: ModelSpec):
         def tick(carry, pos):
             tokens, k_cache, v_cache, key, done = carry
             tok = lax.dynamic_index_in_dim(tokens, pos, 1, keepdims=False)
-            x = jnp.take(embed, tok, axis=0) + pos_embed[pos]
+            x = embed_lookup(embed, tok, pos_embed.dtype) + pos_embed[pos]
             logits, k_cache, v_cache = _token_step(
                 layer_params, ln_final, embed, x, k_cache, v_cache, pos,
                 total)
@@ -217,7 +242,7 @@ def make_generator(spec: ModelSpec):
             raise ValueError("temperature sampling needs an rng key")
         if (top_k or top_p) and temperature <= 0:
             raise ValueError("top_k/top_p filtering needs temperature > 0")
-        vocab = params["embed"].shape[0]
+        vocab = _vocab_size(params)
         if top_k and not 0 < top_k <= vocab:
             raise ValueError(
                 f"top_k must be in [1, vocab_size={vocab}], got {top_k}")
@@ -255,12 +280,13 @@ def make_generator(spec: ModelSpec):
         # Phase 1 — prefill at batch B (no beam fan-out yet: all beams
         # would be identical, so running W copies through the prompt
         # would be W× wasted FLOPs and cache copies).
-        kb = jnp.zeros((num_layers, total, b, heads, hd), embed.dtype)
+        kb = jnp.zeros((num_layers, total, b, heads, hd),
+                       pos_embed.dtype)
 
         def prefill(carry, pos):
             k_cache, v_cache = carry
             tok = lax.dynamic_index_in_dim(tokens_b, pos, 1, keepdims=False)
-            x = jnp.take(embed, tok, axis=0) + pos_embed[pos]
+            x = embed_lookup(embed, tok, pos_embed.dtype) + pos_embed[pos]
             _, k_cache, v_cache = _token_step(
                 layer_params, ln_final, embed, x, k_cache, v_cache, pos,
                 total)
@@ -283,7 +309,7 @@ def make_generator(spec: ModelSpec):
         def tick(carry, pos):
             tokens, k_cache, v_cache, logprobs = carry
             tok = lax.dynamic_index_in_dim(tokens, pos, 1, keepdims=False)
-            x = jnp.take(embed, tok, axis=0) + pos_embed[pos]
+            x = embed_lookup(embed, tok, pos_embed.dtype) + pos_embed[pos]
             logits, k_cache, v_cache = _token_step(
                 layer_params, ln_final, embed, x, k_cache, v_cache, pos,
                 total)
@@ -324,7 +350,7 @@ def make_generator(spec: ModelSpec):
         sampled path when stop tokens matter."""
         if num_beams < 1:
             raise ValueError(f"num_beams must be >= 1, got {num_beams}")
-        vocab = params["embed"].shape[0]
+        vocab = _vocab_size(params)
         if num_beams > vocab:
             # beyond V beams, the -1e30 duplicate-suppressed starter
             # beams would survive the first top-k as degenerate beams
@@ -343,6 +369,12 @@ def make_generator(spec: ModelSpec):
         if tokens.shape[1] < 2:
             raise ValueError("score needs sequences of length >= 2 "
                              "(nothing to predict for a single token)")
+        if is_quantized(params):
+            raise ValueError(
+                "score runs the full parallel forward (spec.apply_fn) "
+                "and needs full-precision params — decode-only int8 "
+                "trees (quantize_lm_params) are not scoreable; keep the "
+                "original params for scoring")
         logits = spec.apply_fn(params, tokens)[:, :-1]   # [B, T-1, V]
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         tok_lp = jnp.take_along_axis(

@@ -1,0 +1,167 @@
+"""Weight-only int8 decode for ``transformer_lm`` (serving memory/bandwidth).
+
+Decode is bandwidth-bound — every tick re-reads every weight matrix (the
+decode-tick anatomy in BASELINE.md) — so int8 weights halve both the HBM
+footprint and the per-tick traffic.  The pieces:
+
+* :func:`quantize_lm_params` — params → the same tree with every matmul
+  weight (attention/MLP kernels + the tied embedding) replaced by an
+  :class:`~autodist_tpu.ops.quant.Quantized` (int8 + per-output-channel
+  scale); LayerNorm scales and positional embeddings stay full precision
+  (tiny, and norms are precision-sensitive).
+* :func:`quant_interceptor` — a ``flax.linen.intercept_methods``
+  interceptor that reroutes ``nn.Dense`` / ``nn.DenseGeneral`` calls to
+  the Pallas int8 kernel (``ops/quant.py``) when the layer's kernel leaf
+  is ``Quantized``.  This is what keeps ONE definition of the block math:
+  ``generate.py`` applies the SAME training-side ``TransformerLayer``
+  module for quantized decode — only the linear-layer implementation is
+  swapped underneath it, the r3 no-drift principle extended to
+  quantization.
+* :func:`dequantize_lm_params` — the exact full-precision tree the
+  quantized program simulates (``q * scale``); the parity oracle for
+  tests, and the export-back-to-training escape hatch.
+
+Use: ``qparams = quantize_lm_params(params)`` then pass ``qparams`` to
+``make_generator(spec)``'s returned function in place of ``params``
+(greedy/sampled/beam; ``score`` needs full precision).  No reference
+counterpart (training-only framework).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from autodist_tpu.ops.quant import Quantized, int8_matmul, quantize_weight
+
+
+def _quantize_kernel(name: str, k) -> Quantized:
+    """Kernel → 2-D Quantized with the contraction dim first.
+
+    DenseGeneral kernels: q/k/v are ``[D, H, Dh]`` (axis=-1 → flatten the
+    trailing feature dims); ``out`` is ``[H, Dh, D]`` (axis=(-2,-1) →
+    flatten the leading contraction dims).  MLP kernels are already 2-D.
+    """
+    if name == "out":
+        return quantize_weight(k.reshape((-1, k.shape[-1])))
+    return quantize_weight(k.reshape((k.shape[0], -1)))
+
+
+def quantize_lm_params(params: Dict[str, Any]) -> Dict[str, Any]:
+    """``transformer_lm`` params → decode-ready weight-only int8 tree.
+
+    The tied embedding is stored ONCE as ``Quantized([D, V])`` with
+    per-vocab-row scales — right for both the head matmul (scales factor
+    out per output column) and the input lookup (per-row rescale of a
+    gathered int8 column).
+    """
+    out: Dict[str, Any] = {
+        "embed": quantize_weight(params["embed"].T),       # [D, V]
+        "pos_embed": params["pos_embed"],
+        "decoder": {},
+    }
+    for lname, layer in params["decoder"].items():
+        if lname == "ln_final":
+            out["decoder"][lname] = layer
+            continue
+        qlayer: Dict[str, Any] = {}
+        for mod, sub in layer.items():
+            if mod.startswith("ln"):
+                qlayer[mod] = sub
+                continue
+            qlayer[mod] = {
+                proj: {"kernel": _quantize_kernel(proj, p["kernel"])}
+                for proj, p in sub.items()
+            }
+        out["decoder"][lname] = qlayer
+    return out
+
+
+def dequantize_lm_params(qparams: Dict[str, Any], spec) -> Dict[str, Any]:
+    """The full-precision tree the quantized program computes with
+    (``q * scale``, original kernel shapes) — the parity oracle."""
+    cfg = spec.config
+    heads, hd = cfg["num_heads"], cfg["head_dim"]
+
+    def deq(w: Quantized):
+        return w.q.astype(jnp.float32) * w.scale
+
+    out: Dict[str, Any] = {
+        "embed": deq(qparams["embed"]).T,                  # [V, D]
+        "pos_embed": qparams["pos_embed"],
+        "decoder": {},
+    }
+    for lname, layer in qparams["decoder"].items():
+        if lname == "ln_final":
+            out["decoder"][lname] = layer
+            continue
+        dlayer: Dict[str, Any] = {}
+        for mod, sub in layer.items():
+            if mod.startswith("ln"):
+                dlayer[mod] = sub
+                continue
+            dlayer[mod] = {}
+            for proj, p in sub.items():
+                w = deq(p["kernel"])
+                if proj == "out":                          # [H*Dh, D]
+                    w = w.reshape((heads, hd, -1))
+                elif mod == "attn":                        # [D, H*Dh]
+                    w = w.reshape((w.shape[0], heads, hd))
+                dlayer[mod][proj] = {"kernel": w}
+        out["decoder"][lname] = dlayer
+    return out
+
+
+def is_quantized(params: Dict[str, Any]) -> bool:
+    return isinstance(params.get("embed"), Quantized)
+
+
+def embed_lookup(embed, tok, dtype):
+    """Rows of the (possibly quantized) tied embedding for tokens [B]."""
+    if isinstance(embed, Quantized):
+        cols = jnp.take(embed.q, tok, axis=1)              # [D, B]
+        sc = jnp.take(embed.scale, tok, axis=1)            # [1, B]
+        return (cols.astype(jnp.float32) * sc).T.astype(dtype)
+    return jnp.take(embed, tok, axis=0)
+
+
+def head_logits(embed, x):
+    """Tied-head logits [B, V] for hidden x [B, D]."""
+    if isinstance(embed, Quantized):                       # [D, V]
+        return int8_matmul(x, embed)
+    return jnp.einsum("bd,vd->bv", x, embed)
+
+
+def quant_interceptor(layer_tree):
+    """``nn.intercept_methods`` interceptor rerouting Dense/DenseGeneral
+    to the int8 kernel when ``layer_tree``'s matching kernel leaf is
+    ``Quantized``.  Anything it does not recognize falls through to the
+    module's own implementation."""
+    def interceptor(next_fun, args, kwargs, context):
+        mod = context.module
+        if (context.method_name != "__call__"
+                or not isinstance(mod, (nn.DenseGeneral, nn.Dense))
+                or getattr(mod, "use_bias", True)):
+            return next_fun(*args, **kwargs)
+        node = layer_tree
+        for name in mod.path:
+            if not isinstance(node, dict) or name not in node:
+                return next_fun(*args, **kwargs)
+            node = node[name]
+        w = node.get("kernel") if isinstance(node, dict) else None
+        if not isinstance(w, Quantized):
+            return next_fun(*args, **kwargs)
+        (x,) = args
+        if isinstance(mod, nn.DenseGeneral):
+            ax = mod.axis if isinstance(mod.axis, (tuple, list)) \
+                else (mod.axis,)
+            feats = mod.features if isinstance(mod.features, (tuple, list)) \
+                else (mod.features,)
+            # our models contract trailing axes only (axis=-1 or (-2,-1))
+            lead = x.shape[:-len(ax)]
+            y = int8_matmul(x.reshape(lead + (-1,)), w)
+            return y.reshape(lead + tuple(feats))
+        return int8_matmul(x, w)
+
+    return interceptor

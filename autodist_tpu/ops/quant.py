@@ -1,0 +1,130 @@
+"""Int8 weight-only quantization + Pallas matmul kernel (TPU serving).
+
+Autoregressive decode is bandwidth-bound: every tick re-reads every
+weight matrix from HBM while doing almost no FLOPs (see the decode-tick
+anatomy in BASELINE.md).  Weight-only int8 halves that traffic — the
+classic serving lever.  The kernel keeps weights **int8 in HBM** and
+dequantizes per-tile in VMEM; a naive ``x @ (q * scale)`` in XLA would
+materialize the dequantized f32/bf16 matrix in HBM once, after which
+every tick re-reads FULL-WIDTH weights and the quantization saves
+nothing.
+
+Scheme: symmetric per-output-channel.  For ``w [K, N]``:
+``scale[n] = max_k |w[k, n]| / 127``, ``q = round(w / scale)``.  Because
+the scale is per OUTPUT column it factors out of the contraction —
+``x @ (q * scale) == (x @ q) * scale`` — so the kernel runs one integer
+valued matmul per tile and scales the result columns, never
+materializing a dequantized weight block.
+
+No counterpart exists in the reference (training-only framework).
+Layout/padding conventions follow ``ops/flash_attention.py``; interpret
+mode (CPU tests) is selected automatically off-TPU.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_TILE = 128          # MXU lane quantum
+_DEFAULT_BLOCK_N = 512
+
+
+class Quantized(NamedTuple):
+    """Weight-only int8 tensor: ``q`` int8 ``[K, N]``, ``scale`` f32
+    ``[1, N]`` (per-output-channel symmetric)."""
+    q: jax.Array
+    scale: jax.Array
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def nbytes(self) -> int:
+        return self.q.size + self.scale.size * 4
+
+
+def quantize_weight(w: jax.Array) -> Quantized:
+    """Symmetric per-output-channel int8 quantization of a 2-D weight.
+
+    ``w``: [K, N] (contraction dim first — transpose embedding tables to
+    [D, V] so the per-channel scale lands on the vocab axis)."""
+    if w.ndim != 2:
+        raise ValueError(f"quantize_weight expects a 2-D matrix, got "
+                         f"shape {w.shape}")
+    w = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(w), axis=0, keepdims=True)       # [1, N]
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return Quantized(q=q, scale=scale)
+
+
+def _use_interpret() -> bool:
+    return jax.devices()[0].platform != "tpu"
+
+
+def _kernel(x_ref, q_ref, s_ref, o_ref):
+    """One N-block program: dequant-free int8 matmul + column scaling.
+
+    Refs: x [M, K]; q [K, bn] int8; s [1, bn] f32; o [M, bn].
+    ``q.astype(x.dtype)`` is exact (|q| <= 127 fits bf16's 8-bit
+    mantissa); the f32 accumulator keeps the integer dot exact too.
+    """
+    x = x_ref[...]
+    w = q_ref[...].astype(x.dtype)
+    acc = jnp.dot(x, w, preferred_element_type=jnp.float32)   # [M, bn]
+    o_ref[...] = (acc * s_ref[...]).astype(o_ref.dtype)
+
+
+def _pad_to(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+@functools.partial(jax.jit, static_argnames=("block_n", "interpret"))
+def _int8_matmul_2d(x, q, scale, block_n: int, interpret: bool):
+    m, k = x.shape
+    kq, n = q.shape
+    bn = min(block_n, _pad_to(n, _TILE))
+    mp = m if interpret else _pad_to(max(m, 8), 8)
+    kp = k if interpret else _pad_to(k, _TILE)
+    np_ = _pad_to(n, bn)
+    xp = jnp.pad(x, ((0, mp - m), (0, kp - k)))
+    qp = jnp.pad(q, ((0, kp - k), (0, np_ - n)))
+    sp = jnp.pad(scale, ((0, 0), (0, np_ - n)))
+    out = pl.pallas_call(
+        _kernel,
+        grid=(np_ // bn,),
+        in_specs=[
+            pl.BlockSpec((mp, kp), lambda j: (0, 0)),
+            pl.BlockSpec((kp, bn), lambda j: (0, j)),
+            pl.BlockSpec((1, bn), lambda j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((mp, bn), lambda j: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((mp, np_), x.dtype),
+        interpret=interpret,
+    )(xp, qp, sp)
+    return out[:m, :n]
+
+
+def int8_matmul(x: jax.Array, w: Quantized, *,
+                block_n: int = _DEFAULT_BLOCK_N,
+                interpret: Optional[bool] = None) -> jax.Array:
+    """``x @ dequant(w)`` with int8 weights resident in HBM.
+
+    ``x``: [..., K] (leading dims flattened for the kernel); returns
+    ``[..., N]`` in ``x.dtype``.
+    """
+    if interpret is None:
+        interpret = _use_interpret()
+    k = x.shape[-1]
+    if w.q.shape[0] != k:
+        raise ValueError(f"contraction mismatch: x[..., {k}] @ "
+                         f"q{tuple(w.q.shape)}")
+    lead = x.shape[:-1]
+    x2 = x.reshape((-1, k))
+    out = _int8_matmul_2d(x2, w.q, w.scale, int(block_n), bool(interpret))
+    return out.reshape(lead + (w.q.shape[1],))

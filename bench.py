@@ -383,6 +383,46 @@ def _fill_decode(result) -> None:
             print(f"bench: b64 decode unavailable ({e!r})",
                   file=sys.stderr, flush=True)
 
+        # Weight-only int8 decode (ops/quant.py Pallas kernel): decode
+        # re-reads every weight per tick, so int8-resident weights halve
+        # the bound traffic.  The on-chip correctness signal is greedy
+        # agreement vs the SAME dequantized weights through the normal
+        # decode (kernel-only difference — quantization itself changes
+        # the model, so comparing against bf16 weights would mostly
+        # measure int8 noise on random bench weights).
+        try:
+            from autodist_tpu.models.quantize import (
+                dequantize_lm_params, quantize_lm_params)
+
+            qp = quantize_lm_params(params)
+            tok_q = gen(qp, prompt, n_new)
+            tok_q.block_until_ready()
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                tok_q = gen(qp, prompt, n_new)
+            int(np.asarray(tok_q[0, -1]))
+            dt_q = (time.perf_counter() - t0) / reps
+            result["decode_int8_tokens_per_sec"] = round(
+                batch * n_new / dt_q, 1)
+            # Cast the dequantized tree to the bench model's dtypes:
+            # avals then match `params`, so gen's compile is reused, and
+            # both paths run bf16 activations.  The agreement therefore
+            # includes bf16 weight rounding (w cast before the dot here,
+            # column-scaled after the dot in the kernel) on top of the
+            # kernel arithmetic — a sanity signal, not an exactness
+            # claim (the exact f32 oracle is tests/test_quant.py).
+            dq = jax.tree_util.tree_map(
+                lambda a, b: a.astype(b.dtype),
+                dequantize_lm_params(qp, spec), params)
+            tok_dq = gen(dq, prompt, n_new)
+            result["decode_int8_oracle_agreement"] = round(float(np.mean(
+                np.asarray(tok_q[:, p_len:])
+                == np.asarray(tok_dq[:, p_len:]))), 4)
+            print(json.dumps(result), flush=True)
+        except Exception as e:
+            print(f"bench: int8 decode unavailable ({e!r})",
+                  file=sys.stderr, flush=True)
+
         # Re-forward baseline: fixed [B, total] buffer, one compiled
         # program (pos is a traced scalar), full causal forward per token.
         @jax.jit

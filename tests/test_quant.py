@@ -1,0 +1,150 @@
+"""Weight-only int8 quantization: kernel exactness, decode parity.
+
+The structural guarantee under test: the quantized decode path runs the
+SAME TransformerLayer block math (rerouted through the Pallas int8
+kernel by the flax interceptor), so its output must match the normal
+generator running on the DEQUANTIZED weights — the quantization error is
+a model change; the kernel itself adds none.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from autodist_tpu.models.generate import make_generator
+from autodist_tpu.models.quantize import (dequantize_lm_params,
+                                          is_quantized, quantize_lm_params)
+from autodist_tpu.models.transformer_lm import transformer_lm
+from autodist_tpu.ops.quant import Quantized, int8_matmul, quantize_weight
+
+
+def test_quantize_weight_roundtrip_error_bound():
+    rng = np.random.RandomState(0)
+    w = jnp.asarray(rng.randn(64, 40).astype(np.float32) * 3)
+    qw = quantize_weight(w)
+    assert qw.q.dtype == jnp.int8 and qw.scale.shape == (1, 40)
+    deq = qw.q.astype(jnp.float32) * qw.scale
+    # symmetric round-to-nearest: |err| <= scale/2 per element
+    err = jnp.abs(deq - w)
+    assert float(jnp.max(err - qw.scale / 2)) <= 1e-6
+
+
+def test_quantize_weight_zero_column_safe():
+    w = jnp.zeros((8, 3))
+    qw = quantize_weight(w)
+    assert float(jnp.abs(qw.q.astype(jnp.float32) * qw.scale).max()) == 0.0
+    np.testing.assert_array_equal(np.asarray(qw.scale), 1.0)
+
+
+def test_quantize_weight_rejects_non_2d():
+    with pytest.raises(ValueError, match="2-D"):
+        quantize_weight(jnp.zeros((2, 3, 4)))
+
+
+@pytest.mark.parametrize("m,k,n", [(5, 64, 40), (8, 128, 512),
+                                   (1, 96, 1000), (16, 256, 513)])
+def test_int8_matmul_matches_dequant_oracle(m, k, n):
+    """The kernel (incl. its padding paths) computes exactly
+    x @ (q * scale) up to f32 accumulation order."""
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(m, k).astype(np.float32))
+    qw = quantize_weight(jnp.asarray(rng.randn(k, n).astype(np.float32)))
+    ref = x @ (qw.q.astype(jnp.float32) * qw.scale)
+    out = int8_matmul(x, qw)
+    assert out.shape == (m, n) and out.dtype == x.dtype
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-4)
+
+
+def test_int8_matmul_leading_dims_and_mismatch():
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(2, 3, 32).astype(np.float32))
+    qw = quantize_weight(jnp.asarray(rng.randn(32, 16).astype(np.float32)))
+    out = int8_matmul(x, qw)
+    assert out.shape == (2, 3, 16)
+    with pytest.raises(ValueError, match="contraction mismatch"):
+        int8_matmul(jnp.zeros((2, 31)), qw)
+
+
+@pytest.fixture(scope="module")
+def lm():
+    spec = transformer_lm(vocab_size=96, num_layers=2, num_heads=2,
+                          head_dim=8, d_ff=32, max_len=32, seq_len=32)
+    params = spec.init(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    prompt = jnp.asarray(rng.randint(0, 96, (3, 6)), jnp.int32)
+    return spec, params, prompt
+
+
+def test_quantized_decode_matches_dequantized_oracle(lm):
+    """Token-for-token: quantized decode == normal decode on q*scale."""
+    spec, params, prompt = lm
+    qp = quantize_lm_params(params)
+    assert is_quantized(qp) and not is_quantized(params)
+    gen = make_generator(spec)
+    tok_q, logits_q = gen.with_logits(qp, prompt, 10)
+    dq = dequantize_lm_params(qp, spec)
+    tok_d, logits_d = gen.with_logits(dq, prompt, 10)
+    np.testing.assert_array_equal(np.asarray(tok_q), np.asarray(tok_d))
+    np.testing.assert_allclose(np.asarray(logits_q), np.asarray(logits_d),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_quantized_decode_tracks_full_precision(lm):
+    """Int8 perturbs but does not destroy the model: the quantized
+    logits correlate strongly with the full-precision ones.  (On a tiny
+    random-init model the absolute logits are near zero, so a relative
+    bound is meaningless — the kernel's own exactness is pinned by the
+    dequant-oracle test above; real-model quantization quality is a
+    property of int8 itself, not of this code.)  Uses a wider model
+    than the fixture (d=64, corr 0.94 measured vs 0.90 at d=16; on
+    random-init weights the logits are themselves noise, so the bar is
+    a deterministic-seed floor, not a quality claim)."""
+    spec = transformer_lm(vocab_size=96, num_layers=2, num_heads=4,
+                          head_dim=16, d_ff=128, max_len=32, seq_len=32)
+    params = spec.init(jax.random.PRNGKey(3))
+    prompt = jnp.asarray(
+        np.random.RandomState(3).randint(0, 96, (3, 6)), jnp.int32)
+    gen = make_generator(spec)
+    _, logits_f = gen.with_logits(params, prompt, 10)
+    _, logits_q = gen.with_logits(quantize_lm_params(params), prompt, 10)
+    a = np.asarray(logits_f, np.float64).ravel()
+    b = np.asarray(logits_q, np.float64).ravel()
+    corr = np.corrcoef(a, b)[0, 1]
+    assert corr > 0.9, corr
+
+
+def test_quantized_beam_and_sampling_run(lm):
+    spec, params, prompt = lm
+    qp = quantize_lm_params(params)
+    gen = make_generator(spec)
+    toks, lp = gen.beam_search(qp, prompt, 6, num_beams=3)
+    assert toks.shape == (3, 12) and np.isfinite(np.asarray(lp)).all()
+    sampled = gen(qp, prompt, 6, rng=jax.random.PRNGKey(1),
+                  temperature=0.8, top_k=20)
+    assert sampled.shape == (3, 12)
+
+
+def test_quantized_score_raises(lm):
+    spec, params, prompt = lm
+    gen = make_generator(spec)
+    with pytest.raises(ValueError, match="full-precision"):
+        gen.score(quantize_lm_params(params), jnp.zeros((2, 4), jnp.int32))
+
+
+def test_quantized_tree_is_half_the_bytes(lm):
+    spec, params, _ = lm
+    qp = quantize_lm_params(params)
+
+    def nbytes(t):
+        return sum(x.nbytes if isinstance(x, (Quantized,))
+                   else np.asarray(x).nbytes
+                   for x in jax.tree_util.tree_leaves(
+                       t, is_leaf=lambda y: isinstance(y, Quantized)))
+
+    # f32 weights -> int8 + f32 scales.  On this tiny model (d=16) the
+    # kept-full-precision pieces (pos_embed, LN scales) and the
+    # per-channel scales are a large fraction, so assert the honest
+    # bound: under half.  (At 12Lx768 the ratio is ~0.26.)
+    assert nbytes(qp) < 0.5 * nbytes(params)
