@@ -53,8 +53,35 @@ def _vocab_size(params) -> int:
     return e.shape[1] if is_quantized(params) else e.shape[0]
 
 
+def sample_next_token(logits, key, temperature=0.0, top_k=0, top_p=0.0):
+    """Greedy / temperature / top-k / nucleus selection over ``logits``
+    [B, V] → int32 [B].  The single definition of the sampling filters,
+    shared by :func:`make_generator` and the continuous-batching
+    :class:`autodist_tpu.serving.DecodeEngine`.  The knobs are static
+    (they select trace-time branches)."""
+    if not (temperature and temperature > 0.0):
+        return jnp.argmax(logits, axis=-1)
+    scaled = logits.astype(jnp.float32) / temperature
+    if top_k:
+        # keep only the top_k logits per row
+        kth = lax.top_k(scaled, top_k)[0][..., -1:]
+        scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+    if top_p and top_p > 0.0:
+        # nucleus: smallest prefix of the sorted distribution with
+        # cumulative probability >= top_p
+        sorted_lp = jnp.sort(scaled, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_lp, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # cutoff = last logit whose PRECEDING mass < top_p
+        keep = cum - probs < top_p
+        cutoff = jnp.min(jnp.where(keep, sorted_lp, jnp.inf),
+                         axis=-1, keepdims=True)
+        scaled = jnp.where(scaled < cutoff, -jnp.inf, scaled)
+    return jax.random.categorical(key, scaled, axis=-1)
+
+
 def _token_step(layer_params, ln_final_scale, embed, x, k_cache, v_cache,
-                pos, total_len):
+                pos, total_len, attn_mask=None):
     """One decode position through all layers.  ``x``: [B, D] embedded
     input; ``k_cache``/``v_cache``: [L, T, B, H, Dh] — time-major so
     ``.at[i, pos].set`` with a traced position lowers to a CONTIGUOUS
@@ -66,7 +93,13 @@ def _token_step(layer_params, ln_final_scale, embed, x, k_cache, v_cache,
     the attention itself is decode-specific (single query over the cache),
     injected through the module's ``attn_fn`` seam.  The updated caches
     are smuggled out of the functional ``apply`` through a closure cell —
-    standard under tracing (the arrays are traced values either way)."""
+    standard under tracing (the arrays are traced values either way).
+
+    ``attn_mask``: optional [B, total_len] bool of attendable cache
+    positions; default is the single-sequence causal set
+    ``arange(total_len) <= pos``.  The continuous-batching engine passes
+    per-slot windows (``start[b] <= arange <= pos``) so slots admitted
+    at different ticks share one uniform cache write index."""
     heads, hd = k_cache.shape[-2], k_cache.shape[-1]
     d_ff = layer_params[0]["mlp"]["wi"]["kernel"].shape[1]
     quantized = isinstance(layer_params[0]["mlp"]["wi"]["kernel"],
@@ -85,7 +118,10 @@ def _token_step(layer_params, ln_final_scale, embed, x, k_cache, v_cache,
             depth = q.shape[-1]
             logits = jnp.einsum("bhk,tbhk->bht", q[:, 0], kc[_i]) \
                 / jnp.sqrt(jnp.asarray(depth, q.dtype))
-            mask = jnp.arange(total_len)[None, None, :] <= pos
+            if attn_mask is None:
+                mask = jnp.arange(total_len)[None, None, :] <= pos
+            else:
+                mask = attn_mask[:, None, :]
             logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
             probs = jax.nn.softmax(logits.astype(jnp.float32),
                                    axis=-1).astype(q.dtype)
@@ -180,27 +216,8 @@ def make_generator(spec: ModelSpec):
                 layer_params, ln_final, embed, x, k_cache, v_cache, pos,
                 total)
             key, sub = jax.random.split(key)
-            if temperature and temperature > 0.0:
-                scaled = logits.astype(jnp.float32) / temperature
-                if top_k:
-                    # keep only the top_k logits per row
-                    kth = lax.top_k(scaled, top_k)[0][..., -1:]
-                    scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
-                if top_p and top_p > 0.0:
-                    # nucleus: smallest prefix of the sorted distribution
-                    # with cumulative probability >= top_p
-                    sorted_lp = jnp.sort(scaled, axis=-1)[..., ::-1]
-                    probs = jax.nn.softmax(sorted_lp, axis=-1)
-                    cum = jnp.cumsum(probs, axis=-1)
-                    # cutoff = last logit whose PRECEDING mass < top_p
-                    keep = cum - probs < top_p
-                    cutoff = jnp.min(jnp.where(keep, sorted_lp, jnp.inf),
-                                     axis=-1, keepdims=True)
-                    scaled = jnp.where(scaled < cutoff, -jnp.inf, scaled)
-                nxt = jax.random.categorical(sub, scaled, axis=-1)
-            else:
-                nxt = jnp.argmax(logits, axis=-1)
-            nxt = nxt.astype(tokens.dtype)
+            nxt = sample_next_token(logits, sub, temperature, top_k,
+                                    top_p).astype(tokens.dtype)
             if eos_id >= 0:
                 # Stop-token semantics under static shapes: a finished
                 # row keeps emitting eos (masking, not early exit — the

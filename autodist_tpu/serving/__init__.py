@@ -1,0 +1,10 @@
+"""Serving-side subsystem: continuous-batching decode engine.
+
+Beyond the reference (training-only — its serving story ends at
+``SavedModelBuilder`` export, reference ``autodist/checkpoint/
+saved_model_builder.py:24-64``): a slot-based continuous-batching
+engine over the KV-cache decode path of ``models/generate.py``.
+"""
+from autodist_tpu.serving.engine import DecodeEngine, EngineStats, Request
+
+__all__ = ["DecodeEngine", "EngineStats", "Request"]

@@ -1,0 +1,363 @@
+"""Continuous-batching decode engine (slot-based, TPU-first).
+
+The reference framework is training-only; its serving story ends at
+graph export (``autodist/checkpoint/saved_model_builder.py:24-64``).
+This engine is beyond-parity scope: the standard production decode
+loop — a fixed pool of ``slots`` sequences decoding in lockstep, where
+finished sequences are harvested and new requests admitted *without
+stopping the batch* — built on the same single-definition block math as
+training (``models/transformer.py``) via ``models/generate._token_step``.
+
+TPU-first design points:
+
+* **One compiled program, static shapes.**  The engine state is a fixed
+  ``[slots, window]`` token buffer and a time-major KV cache
+  ``[L, window, slots, H, Dh]``.  A chunk of ``chunk`` decode ticks is
+  one jitted ``lax.scan``; admission/harvest happen between chunks on
+  the host.  No recompiles at request boundaries.
+* **Uniform cache write index.**  Every tick writes every slot's K/V at
+  the same *engine tick* index, so the cache update stays the one
+  contiguous ``dynamic_update_slice`` that makes the decode tick fast
+  (the ~10× batch-major-vs-time-major lesson recorded in BASELINE.md).
+  Per-request sequence positions are recovered by offset: a slot
+  admitted at tick ``start`` attends cache positions
+  ``start <= pos <= tick`` and uses ``pos_embed[tick - start]``.  The
+  attended window of an active slot is always positions the *current*
+  occupant wrote, so slot reuse needs no cache zeroing.
+* **Token-exact.**  Greedy engine output equals ``make_generator``'s
+  for each request individually: the extra masked positions contribute
+  exactly-zero attention weight (``exp(min - max) == 0``), so the
+  numerics are identical, not approximately so (pinned in
+  ``tests/test_serving_engine.py``).
+
+Admission is first-fit at chunk boundaries; when the window is
+exhausted and no request fits, the engine waits for all in-flight slots
+to drain and resets the tick to 0 (the simple, honest alternative to
+ring-buffer compaction — a request's whole ``prompt + max_new`` span
+must fit inside ``window``).
+"""
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from autodist_tpu.models.base import ModelSpec
+from autodist_tpu.models.generate import (_token_step, _vocab_size,
+                                          embed_lookup, sample_next_token)
+
+
+@dataclass
+class Request:
+    """One decode request: ``prompt`` is a 1-D int array; the engine
+    appends up to ``max_new_tokens`` (fewer if ``eos_id`` fires)."""
+    prompt: np.ndarray
+    max_new_tokens: int
+    request_id: int = -1
+
+
+@dataclass
+class EngineStats:
+    """Aggregate engine counters (monotonic over the engine lifetime)."""
+    ticks: int = 0                # engine ticks executed
+    busy_slot_ticks: int = 0      # sum over ticks of unfinished slots
+    generated_tokens: int = 0     # tokens actually produced (post-prompt)
+    prompt_tokens: int = 0        # prompt tokens teacher-forced
+    completed: int = 0            # requests harvested
+    window_resets: int = 0
+    chunks: int = 0               # compiled-program dispatches
+
+    @property
+    def slot_utilization(self) -> float:
+        """Fraction of slot-ticks spent on an unfinished request."""
+        total = self.ticks * self._slots if self._slots else 0
+        return self.busy_slot_ticks / total if total else 0.0
+
+    _slots: int = field(default=0, repr=False)
+
+
+class DecodeEngine:
+    """Continuous-batching decode over a ``transformer_lm`` ModelSpec.
+
+    Usage::
+
+        eng = DecodeEngine(spec, params, slots=8, window=512)
+        rid = eng.submit(prompt_1d, max_new_tokens=64)
+        results = eng.run()          # {rid: np.ndarray tokens}
+
+    ``params`` may be full precision or a weight-only int8 tree from
+    :func:`autodist_tpu.models.quantize.quantize_lm_params` (the tick
+    math routes through the same Pallas int8 kernel as ``generate``).
+
+    Sampling knobs are engine-wide (they are trace-time constants of the
+    chunk program); ``temperature=0`` is greedy.
+    """
+
+    def __init__(self, spec: ModelSpec, params, *, slots: int = 8,
+                 window: int = 512, chunk: int = 16,
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 0.0, eos_id: Optional[int] = None,
+                 rng: Optional[jax.Array] = None):
+        cfg = spec.config
+        required = ("num_layers", "num_heads", "head_dim", "max_len")
+        if any(k not in cfg for k in required):
+            raise ValueError(
+                f"DecodeEngine needs a transformer_lm-family ModelSpec "
+                f"(config with {required}); got {spec.name!r}")
+        if window > cfg["max_len"]:
+            raise ValueError(
+                f"window={window} exceeds the model's max_len "
+                f"{cfg['max_len']} (pos_embed rows)")
+        if slots < 1 or window < 2 or chunk < 1:
+            raise ValueError("need slots >= 1, window >= 2, chunk >= 1")
+        if (top_k or top_p) and temperature <= 0:
+            raise ValueError("top_k/top_p filtering needs temperature > 0")
+        if temperature > 0 and rng is None:
+            # same contract as make_generator: a silent fixed key would
+            # make every engine instance sample the identical stream
+            raise ValueError("temperature sampling needs an rng key")
+        vocab = _vocab_size(params)
+        if top_k and not 0 < top_k <= vocab:
+            raise ValueError(
+                f"top_k must be in [1, vocab_size={vocab}], got {top_k}")
+        if eos_id is not None and not 0 <= eos_id < vocab:
+            raise ValueError(
+                f"eos_id must be in [0, vocab_size={vocab}), got {eos_id}")
+
+        self._spec = spec
+        self._params = params
+        self._cfg = cfg
+        self._slots = slots
+        self._window = window
+        self._chunk = chunk
+        self._temperature = float(temperature)
+        self._top_k = int(top_k)
+        self._top_p = float(top_p)
+        self._eos_id = -1 if eos_id is None else int(eos_id)
+        self._rng = rng if rng is not None else jax.random.PRNGKey(0)
+        self._vocab = vocab
+
+        # Host-side scheduler state.
+        self._queue: List[Request] = []
+        self._next_id = 0
+        self._results: Dict[int, np.ndarray] = {}
+        self._slot_req: List[Optional[Request]] = [None] * slots
+        self.stats = EngineStats(_slots=slots)
+
+        # Device/engine state.  tokens/start/p_end/end/done/active live
+        # on the host between chunks (tiny int arrays; admission edits
+        # them in numpy); the KV cache stays device-resident.
+        self._tokens = np.zeros((slots, window), np.int32)
+        self._start = np.zeros(slots, np.int32)
+        self._p_end = np.zeros(slots, np.int32)
+        self._end = np.zeros(slots, np.int32)
+        self._done = np.ones(slots, bool)
+        self._active = np.zeros(slots, bool)
+        self._tick = 0
+        heads, hd = cfg["num_heads"], cfg["head_dim"]
+        dtype = params["pos_embed"].dtype
+        # Two separate buffers: both are donated to the chunk program, and
+        # donating one array through two arguments is an aliasing error.
+        self._kc = jnp.zeros((cfg["num_layers"], window, slots, heads, hd),
+                             dtype)
+        self._vc = jnp.zeros((cfg["num_layers"], window, slots, heads, hd),
+                             dtype)
+
+        num_layers = cfg["num_layers"]
+
+        def _unpack(p):
+            layer_params = [p["decoder"][f"layers_{i}"]
+                            for i in range(num_layers)]
+            return (p["embed"], p["pos_embed"], layer_params,
+                    p["decoder"]["ln_final"]["scale"])
+
+        # The chunk program: n ticks of all slots in lockstep.  n is
+        # static (scan length); distinct n values near the window edge
+        # compile once each and come from the persistent cache after.
+        @functools.partial(jax.jit, static_argnums=(0,),
+                           donate_argnums=(3, 4))
+        def chunk_step(n, params, tokens, kc, vc, start, p_end, end,
+                       done, active, tick0, key):
+            embed, pos_embed, layer_params, ln_final = _unpack(params)
+            pos_idx = jnp.arange(window)[None, :]             # [1, W]
+
+            def one_tick(carry, i):
+                tokens, kc, vc, done, key = carry
+                t = tick0 + i
+                tok = lax.dynamic_index_in_dim(tokens, t, 1, keepdims=False)
+                rel = jnp.clip(t - start, 0, window - 1)      # [B]
+                x = embed_lookup(embed, tok, pos_embed.dtype) \
+                    + pos_embed[rel]
+                mask = (pos_idx >= start[:, None]) & (pos_idx <= t)
+                logits, kc, vc = _token_step(
+                    layer_params, ln_final, embed, x, kc, vc, t, window,
+                    attn_mask=mask)
+                key, sub = jax.random.split(key)
+                raw = sample_next_token(
+                    logits, sub, self._temperature, self._top_k,
+                    self._top_p).astype(tokens.dtype)
+                busy = jnp.sum((active & ~done).astype(jnp.int32))
+                # Teacher-force while inside the prompt; only live slots
+                # write; a finished slot's buffer is left as-is (harvest
+                # pads eos on the host).
+                cur = lax.dynamic_index_in_dim(tokens, t + 1, 1,
+                                               keepdims=False)
+                in_gen = t + 1 >= p_end                       # [B]
+                live = active & ~done
+                nxt = jnp.where(in_gen & live, raw, cur)
+                tokens = lax.dynamic_update_index_in_dim(
+                    tokens, nxt, t + 1, 1)
+                if self._eos_id >= 0:
+                    done = done | (in_gen & live & (raw == self._eos_id))
+                # The final token of slot b lands at buffer index
+                # end[b]-1, written by tick end[b]-2.
+                done = done | (t + 2 >= end)
+                return (tokens, kc, vc, done, key), busy
+
+            (tokens, kc, vc, done, key), busy = lax.scan(
+                one_tick, (tokens, kc, vc, done, key), jnp.arange(n))
+            return tokens, kc, vc, done, jnp.sum(busy)
+
+        self._chunk_step = chunk_step
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int) -> int:
+        """Queue a request; returns its id.  ``prompt`` is 1-D ints."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("prompt must have at least one token")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        span = prompt.size + int(max_new_tokens)
+        if span > self._window:
+            raise ValueError(
+                f"prompt + max_new_tokens = {span} exceeds the engine "
+                f"window {self._window}; raise window= (model max_len "
+                f"{self._cfg['max_len']}) or split the request")
+        if not np.all((prompt >= 0) & (prompt < self._vocab)):
+            raise ValueError("prompt tokens out of vocab range")
+        req = Request(prompt, int(max_new_tokens), self._next_id)
+        self._next_id += 1
+        self._queue.append(req)
+        return req.request_id
+
+    def run(self) -> Dict[int, np.ndarray]:
+        """Decode until the queue and all slots drain; returns and
+        clears ``{request_id: tokens}`` (prompt included, truncated
+        after a generated ``eos_id``)."""
+        while self._schedule():
+            self._run_chunk()
+        self._harvest()
+        out, self._results = self._results, {}
+        return out
+
+    def step(self) -> bool:
+        """One schedule+chunk iteration; False when fully drained.
+        (``run`` is the batch wrapper; ``step`` lets a caller interleave
+        submits with decoding — the continuous-batching loop proper.)"""
+        if not self._schedule():
+            self._harvest()
+            return False
+        self._run_chunk()
+        return True
+
+    def results(self) -> Dict[int, np.ndarray]:
+        """Completed results so far (and clears them)."""
+        self._harvest()
+        out, self._results = self._results, {}
+        return out
+
+    # ------------------------------------------------------------------
+    # scheduler internals
+    # ------------------------------------------------------------------
+    def _schedule(self) -> bool:
+        """Harvest finished slots, admit queued requests (first-fit),
+        reset the window when drained+stuck.  True if a chunk should
+        run."""
+        self._harvest()
+        self._admit()
+        if np.any(self._active & ~self._done):
+            return True
+        if self._queue:
+            # Nothing fits at this tick but work remains: drain is
+            # complete (no live slots), so rewind the window.  No cache
+            # zeroing needed — a slot only attends positions its current
+            # occupant wrote (see module docstring).
+            self._tick = 0
+            self.stats.window_resets += 1
+            self._admit()
+            return np.any(self._active & ~self._done)
+        return False
+
+    def _admit(self) -> None:
+        for b in range(self._slots):
+            if self._active[b] or not self._queue:
+                continue
+            # first-fit: take the first queued request whose whole span
+            # fits in the remaining window
+            pick = None
+            for qi, req in enumerate(self._queue):
+                if self._tick + req.prompt.size + req.max_new_tokens \
+                        <= self._window:
+                    pick = qi
+                    break
+            if pick is None:
+                break
+            req = self._queue.pop(pick)
+            p = req.prompt.size
+            t0 = self._tick
+            self._tokens[b, t0:t0 + p] = req.prompt
+            self._start[b] = t0
+            self._p_end[b] = t0 + p
+            self._end[b] = t0 + p + req.max_new_tokens
+            self._done[b] = False
+            self._active[b] = True
+            self._slot_req[b] = req
+            self.stats.prompt_tokens += p
+
+    def _harvest(self) -> None:
+        for b in range(self._slots):
+            if not (self._active[b] and self._done[b]):
+                continue
+            req = self._slot_req[b]
+            s, pe, e = self._start[b], self._p_end[b], self._end[b]
+            # Tokens written so far for this slot (done can fire before
+            # end when eos stops it early).
+            written = min(e, self._tick + 1)
+            seq = self._tokens[b, s:written].copy()
+            if self._eos_id >= 0:
+                gen = seq[pe - s:]
+                hits = np.nonzero(gen == self._eos_id)[0]
+                if hits.size:
+                    seq = seq[:pe - s + hits[0] + 1]
+            self.stats.generated_tokens += max(seq.size - (pe - s), 0)
+            self.stats.completed += 1
+            self._results[req.request_id] = seq
+            self._active[b] = False
+            self._slot_req[b] = None
+
+    def _run_chunk(self) -> None:
+        n = min(self._chunk, self._window - 1 - self._tick)
+        if n <= 0:  # pragma: no cover - _schedule resets before this
+            return
+        self._rng, sub = jax.random.split(self._rng)
+        tokens, self._kc, self._vc, done, busy = self._chunk_step(
+            n, self._params, jnp.asarray(self._tokens), self._kc,
+            self._vc, jnp.asarray(self._start), jnp.asarray(self._p_end),
+            jnp.asarray(self._end), jnp.asarray(self._done),
+            jnp.asarray(self._active), jnp.int32(self._tick), sub)
+        # np.array (copy): np.asarray of a device array is read-only,
+        # and _admit writes prompts into the host buffer in place.
+        self._tokens = np.array(tokens)
+        self._done = np.array(done)
+        self._tick += n
+        self.stats.ticks += n
+        self.stats.busy_slot_ticks += int(busy)
+        self.stats.chunks += 1
