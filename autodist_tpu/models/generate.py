@@ -42,7 +42,8 @@ from jax import lax
 from autodist_tpu.models.base import ModelSpec
 from autodist_tpu.models.quantize import (embed_lookup, head_logits,
                                           is_quantized, quant_interceptor)
-from autodist_tpu.models.transformer import TransformerLayer
+from autodist_tpu.models.transformer import (TransformerLayer,
+                                             dense_attention)
 from autodist_tpu.ops.quant import Quantized
 
 
@@ -51,6 +52,44 @@ def _vocab_size(params) -> int:
     weight-only int8 tree (Quantized [D, V], models/quantize.py)."""
     e = params["embed"]
     return e.shape[1] if is_quantized(params) else e.shape[0]
+
+
+def unpack_lm_params(params, num_layers: int):
+    """The ONE definition of the ``transformer_lm`` param-tree layout
+    used by decode: ``(embed, pos_embed, [layer_params], ln_final_scale)``.
+    Shared by :func:`make_generator` and the serving engine so a layout
+    change cannot silently diverge between them."""
+    layer_params = [params["decoder"][f"layers_{i}"]
+                    for i in range(num_layers)]
+    return (params["embed"], params["pos_embed"], layer_params,
+            params["decoder"]["ln_final"]["scale"])
+
+
+def check_sampling_args(vocab: int, temperature: float, top_k: int,
+                        top_p: float, eos_id, rng) -> None:
+    """Shared validation of the sampling knobs (generator + engine):
+    loud errors instead of opaque trace-time failures."""
+    if temperature > 0.0 and rng is None:
+        raise ValueError("temperature sampling needs an rng key")
+    if (top_k or top_p) and temperature <= 0:
+        raise ValueError("top_k/top_p filtering needs temperature > 0")
+    if top_k and not 0 < top_k <= vocab:
+        raise ValueError(
+            f"top_k must be in [1, vocab_size={vocab}], got {top_k}")
+    if eos_id is not None and not 0 <= eos_id < vocab:
+        raise ValueError(
+            f"eos_id must be in [0, vocab_size={vocab}), got {eos_id}")
+
+
+def require_lm_spec(spec: ModelSpec, who: str) -> None:
+    """Raise unless ``spec`` is a transformer_lm-family ModelSpec with
+    the decode-relevant config keys."""
+    required = ("num_layers", "num_heads", "head_dim", "max_len")
+    if any(k not in spec.config for k in required):
+        raise ValueError(
+            f"{who} needs a transformer_lm-family ModelSpec "
+            f"(config with {required}); got {spec.name!r} with "
+            f"{sorted(spec.config)}")
 
 
 def sample_next_token(logits, key, temperature=0.0, top_k=0, top_p=0.0):
@@ -143,6 +182,46 @@ def _token_step(layer_params, ln_final_scale, embed, x, k_cache, v_cache,
     return out_logits, k_cache, v_cache
 
 
+def _prefill_forward(layer_params, ln_final_scale, embed, pos_embed,
+                     tokens_1d, heads, head_dim):
+    """Parallel prompt prefill: ONE causal forward over ``tokens_1d``
+    [P] that also returns every layer's K/V — the MXU-friendly way to
+    charge a KV cache (one [P]-parallel matmul program instead of P
+    sequential decode ticks).  Returns ``(xs [P, D] final-normed
+    activations, ks [L, P, H, Dh], vs [L, P, H, Dh])``; the caller picks
+    which position's logits it needs (``head_logits(embed, xs[i])``).
+
+    Same single-definition block math as training/decode: the shared
+    ``TransformerLayer`` with a K/V-capturing dense causal attention in
+    its ``attn_fn`` seat.  Works on full-precision and weight-only int8
+    trees (the ``quant_interceptor`` reroute, as in ``_token_step``);
+    ``heads``/``head_dim`` come from the model config (the quantized
+    tree's flattened kernels don't carry them)."""
+    quantized = isinstance(layer_params[0]["mlp"]["wi"]["kernel"],
+                           Quantized)
+    d_ff = layer_params[0]["mlp"]["wi"]["kernel"].shape[1]
+    x = embed_lookup(embed, tokens_1d, pos_embed.dtype)[None]   # [1, P, D]
+    x = x + pos_embed[None, :tokens_1d.shape[0]]
+    ks, vs = [], []
+
+    def capture_attn(q, k, v, causal):
+        ks.append(k[0])                                   # [P, H, Dh]
+        vs.append(v[0])
+        return dense_attention(q, k, v, causal)
+
+    for lp in layer_params:
+        layer = TransformerLayer(heads, head_dim, d_ff, causal=True,
+                                 attn_fn=capture_attn)
+        if quantized:
+            with nn.intercept_methods(quant_interceptor(lp)):
+                x = layer.apply({"params": lp}, x)
+        else:
+            x = layer.apply({"params": lp}, x)
+    x = nn.LayerNorm(use_bias=False).apply(
+        {"params": {"scale": ln_final_scale}}, x)
+    return x[0], jnp.stack(ks), jnp.stack(vs)
+
+
 def make_generator(spec: ModelSpec):
     """Build ``generate(params, prompt, max_new_tokens, rng=None,
     temperature=0.0)`` for a ``transformer_lm`` ModelSpec.
@@ -170,13 +249,8 @@ def make_generator(spec: ModelSpec):
 
     Returns ``[B, P + max_new_tokens]`` tokens (prompt included).
     """
+    require_lm_spec(spec, "make_generator")
     cfg = spec.config
-    required = ("num_layers", "num_heads", "head_dim", "max_len")
-    if any(k not in cfg for k in required):
-        raise ValueError(
-            f"make_generator needs a transformer_lm-family ModelSpec "
-            f"(config with {required}); got {spec.name!r} with "
-            f"{sorted(cfg)}")
     num_layers = cfg["num_layers"]
 
     def _check_len(total):
@@ -186,10 +260,7 @@ def make_generator(spec: ModelSpec):
                 f"max_len {cfg['max_len']}")
 
     def _unpack(params):
-        layer_params = [params["decoder"][f"layers_{i}"]
-                        for i in range(num_layers)]
-        return (params["embed"], params["pos_embed"], layer_params,
-                params["decoder"]["ln_final"]["scale"])
+        return unpack_lm_params(params, num_layers)
 
     # max_new_tokens and the sampling knobs are static: they shape the
     # scan and select the sampling branch at trace time.
@@ -255,17 +326,8 @@ def make_generator(spec: ModelSpec):
         not early exit; prompt-resident eos tokens are data and do not
         stop).  The returned logits are still the model's per-position
         logits for every slot."""
-        if temperature > 0.0 and rng is None:
-            raise ValueError("temperature sampling needs an rng key")
-        if (top_k or top_p) and temperature <= 0:
-            raise ValueError("top_k/top_p filtering needs temperature > 0")
-        vocab = _vocab_size(params)
-        if top_k and not 0 < top_k <= vocab:
-            raise ValueError(
-                f"top_k must be in [1, vocab_size={vocab}], got {top_k}")
-        if eos_id is not None and not 0 <= eos_id < vocab:
-            raise ValueError(
-                f"eos_id must be in [0, vocab_size={vocab}), got {eos_id}")
+        check_sampling_args(_vocab_size(params), temperature, top_k,
+                            top_p, eos_id, rng)
         return generate(params, prompt, int(max_new_tokens), rng,
                         float(temperature), int(top_k), float(top_p),
                         -1 if eos_id is None else int(eos_id))

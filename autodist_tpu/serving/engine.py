@@ -29,6 +29,15 @@ TPU-first design points:
   exactly-zero attention weight (``exp(min - max) == 0``), so the
   numerics are identical, not approximately so (pinned in
   ``tests/test_serving_engine.py``).
+* **Parallel prefill.**  Once the window has room behind the tick, an
+  admitted prompt is charged into the cache with ONE [P]-parallel
+  causal forward (``models/generate._prefill_forward`` — MXU-shaped
+  matmuls) instead of P sequential decode ticks: the prompt's K/V land
+  at positions ``t0-P..t0-1`` and the slot joins the global tick
+  already generating.  Prefill logits equal the tick-by-tick logits up
+  to float reduction order (the documented allclose-level equivalence
+  of parallel vs cached attention), so greedy parity with ``generate``
+  holds on non-tied argmaxes — the deterministic case the tests pin.
 
 Admission is first-fit at chunk boundaries; when the window is
 exhausted and no request fits, the engine waits for all in-flight slots
@@ -48,8 +57,91 @@ import numpy as np
 from jax import lax
 
 from autodist_tpu.models.base import ModelSpec
-from autodist_tpu.models.generate import (_token_step, _vocab_size,
-                                          embed_lookup, sample_next_token)
+from autodist_tpu.models.generate import (_prefill_forward, _token_step,
+                                          _vocab_size, check_sampling_args,
+                                          embed_lookup, require_lm_spec,
+                                          sample_next_token,
+                                          unpack_lm_params)
+from autodist_tpu.models.quantize import head_logits
+
+
+# The two compiled programs live at module scope so the jit cache is
+# shared across DecodeEngine instances: a server that rebuilds its
+# engine (model reload, knob change) re-traces nothing that an earlier
+# instance already compiled.  All configuration enters either through
+# array shapes (cache layout carries L/window/slots/heads/head_dim) or
+# through the static ``knobs`` tuple (temperature, top_k, top_p, eos_id).
+
+@functools.partial(jax.jit, static_argnums=(0, 1), donate_argnums=(4, 5))
+def _chunk_program(n, knobs, params, tokens, kc, vc, start, p_end, end,
+                   done, active, tick0, key):
+    """``n`` decode ticks of all slots in lockstep (see DecodeEngine)."""
+    temperature, top_k, top_p, eos_id = knobs
+    num_layers, window = kc.shape[0], kc.shape[1]
+    embed, pos_embed, layer_params, ln_final = unpack_lm_params(
+        params, num_layers)
+    pos_idx = jnp.arange(window)[None, :]                 # [1, W]
+
+    def one_tick(carry, i):
+        tokens, kc, vc, done, key = carry
+        t = tick0 + i
+        tok = lax.dynamic_index_in_dim(tokens, t, 1, keepdims=False)
+        rel = jnp.clip(t - start, 0, window - 1)          # [B]
+        x = embed_lookup(embed, tok, pos_embed.dtype) + pos_embed[rel]
+        mask = (pos_idx >= start[:, None]) & (pos_idx <= t)
+        logits, kc, vc = _token_step(
+            layer_params, ln_final, embed, x, kc, vc, t, window,
+            attn_mask=mask)
+        key, sub = jax.random.split(key)
+        raw = sample_next_token(logits, sub, temperature, top_k,
+                                top_p).astype(tokens.dtype)
+        busy = jnp.sum((active & ~done).astype(jnp.int32))
+        # Teacher-force while inside the prompt; only live slots write;
+        # a finished slot's buffer is left as-is (harvest pads eos on
+        # the host).
+        cur = lax.dynamic_index_in_dim(tokens, t + 1, 1, keepdims=False)
+        in_gen = t + 1 >= p_end                           # [B]
+        live = active & ~done
+        nxt = jnp.where(in_gen & live, raw, cur)
+        tokens = lax.dynamic_update_index_in_dim(tokens, nxt, t + 1, 1)
+        if eos_id >= 0:
+            done = done | (in_gen & live & (raw == eos_id))
+        # The final token of slot b lands at buffer index end[b]-1,
+        # written by tick end[b]-2.
+        done = done | (t + 2 >= end)
+        return (tokens, kc, vc, done, key), busy
+
+    (tokens, kc, vc, done, key), busy = lax.scan(
+        one_tick, (tokens, kc, vc, done, key), jnp.arange(n))
+    return tokens, kc, vc, done, jnp.sum(busy)
+
+
+@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2, 3))
+def _prefill_program(knobs, params, kc, vc, prompt_pb, slot_b, t0, p_len,
+                     key):
+    """Parallel prefill: charge slot ``slot_b``'s K/V for a prompt with
+    ONE [Pb]-parallel causal forward (MXU-shaped) instead of P
+    sequential ticks, and sample the first generated token.  The prompt
+    lands at cache positions ``t0-P..t0-1`` — *behind* the admission
+    tick — so the slot joins the global tick already in generation
+    phase.  ``prompt_pb`` is the pow-2 padded bucket (one compile per
+    bucket size); pad positions' K/V land at >= t0 and are overwritten
+    by each tick's own cache write before any mask admits them."""
+    temperature, top_k, top_p, _ = knobs
+    num_layers, _, _, heads, head_dim = kc.shape
+    embed, pos_embed, layer_params, ln_final = unpack_lm_params(
+        params, num_layers)
+    xs, ks, vs = _prefill_forward(layer_params, ln_final, embed,
+                                  pos_embed, prompt_pb, heads, head_dim)
+    upd_k = ks[:, :, None].astype(kc.dtype)               # [L, Pb, 1, H, Dh]
+    upd_v = vs[:, :, None].astype(vc.dtype)
+    z = jnp.int32(0)
+    at = (z, jnp.int32(t0 - p_len), jnp.int32(slot_b), z, z)
+    kc = lax.dynamic_update_slice(kc, upd_k, at)
+    vc = lax.dynamic_update_slice(vc, upd_v, at)
+    logits = head_logits(embed, xs[p_len - 1][None])      # [1, V]
+    tok = sample_next_token(logits, key, temperature, top_k, top_p)[0]
+    return kc, vc, tok
 
 
 @dataclass
@@ -67,7 +159,9 @@ class EngineStats:
     ticks: int = 0                # engine ticks executed
     busy_slot_ticks: int = 0      # sum over ticks of unfinished slots
     generated_tokens: int = 0     # tokens actually produced (post-prompt)
-    prompt_tokens: int = 0        # prompt tokens teacher-forced
+    prompt_tokens: int = 0        # prompt tokens consumed (all admissions)
+    prefilled_tokens: int = 0     # of those, charged by parallel prefill
+    prefill_admissions: int = 0   # admissions that used parallel prefill
     completed: int = 0            # requests harvested
     window_resets: int = 0
     chunks: int = 0               # compiled-program dispatches
@@ -102,32 +196,19 @@ class DecodeEngine:
                  window: int = 512, chunk: int = 16,
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 0.0, eos_id: Optional[int] = None,
-                 rng: Optional[jax.Array] = None):
+                 rng: Optional[jax.Array] = None, prefill: bool = True):
+        require_lm_spec(spec, "DecodeEngine")
         cfg = spec.config
-        required = ("num_layers", "num_heads", "head_dim", "max_len")
-        if any(k not in cfg for k in required):
-            raise ValueError(
-                f"DecodeEngine needs a transformer_lm-family ModelSpec "
-                f"(config with {required}); got {spec.name!r}")
         if window > cfg["max_len"]:
             raise ValueError(
                 f"window={window} exceeds the model's max_len "
                 f"{cfg['max_len']} (pos_embed rows)")
         if slots < 1 or window < 2 or chunk < 1:
             raise ValueError("need slots >= 1, window >= 2, chunk >= 1")
-        if (top_k or top_p) and temperature <= 0:
-            raise ValueError("top_k/top_p filtering needs temperature > 0")
-        if temperature > 0 and rng is None:
-            # same contract as make_generator: a silent fixed key would
-            # make every engine instance sample the identical stream
-            raise ValueError("temperature sampling needs an rng key")
         vocab = _vocab_size(params)
-        if top_k and not 0 < top_k <= vocab:
-            raise ValueError(
-                f"top_k must be in [1, vocab_size={vocab}], got {top_k}")
-        if eos_id is not None and not 0 <= eos_id < vocab:
-            raise ValueError(
-                f"eos_id must be in [0, vocab_size={vocab}), got {eos_id}")
+        # Same contract as make_generator (shared validation): a silent
+        # fixed key would make every engine sample the identical stream.
+        check_sampling_args(vocab, temperature, top_k, top_p, eos_id, rng)
 
         self._spec = spec
         self._params = params
@@ -141,6 +222,7 @@ class DecodeEngine:
         self._eos_id = -1 if eos_id is None else int(eos_id)
         self._rng = rng if rng is not None else jax.random.PRNGKey(0)
         self._vocab = vocab
+        self._prefill = bool(prefill)
 
         # Host-side scheduler state.
         self._queue: List[Request] = []
@@ -168,62 +250,10 @@ class DecodeEngine:
         self._vc = jnp.zeros((cfg["num_layers"], window, slots, heads, hd),
                              dtype)
 
-        num_layers = cfg["num_layers"]
-
-        def _unpack(p):
-            layer_params = [p["decoder"][f"layers_{i}"]
-                            for i in range(num_layers)]
-            return (p["embed"], p["pos_embed"], layer_params,
-                    p["decoder"]["ln_final"]["scale"])
-
-        # The chunk program: n ticks of all slots in lockstep.  n is
-        # static (scan length); distinct n values near the window edge
-        # compile once each and come from the persistent cache after.
-        @functools.partial(jax.jit, static_argnums=(0,),
-                           donate_argnums=(3, 4))
-        def chunk_step(n, params, tokens, kc, vc, start, p_end, end,
-                       done, active, tick0, key):
-            embed, pos_embed, layer_params, ln_final = _unpack(params)
-            pos_idx = jnp.arange(window)[None, :]             # [1, W]
-
-            def one_tick(carry, i):
-                tokens, kc, vc, done, key = carry
-                t = tick0 + i
-                tok = lax.dynamic_index_in_dim(tokens, t, 1, keepdims=False)
-                rel = jnp.clip(t - start, 0, window - 1)      # [B]
-                x = embed_lookup(embed, tok, pos_embed.dtype) \
-                    + pos_embed[rel]
-                mask = (pos_idx >= start[:, None]) & (pos_idx <= t)
-                logits, kc, vc = _token_step(
-                    layer_params, ln_final, embed, x, kc, vc, t, window,
-                    attn_mask=mask)
-                key, sub = jax.random.split(key)
-                raw = sample_next_token(
-                    logits, sub, self._temperature, self._top_k,
-                    self._top_p).astype(tokens.dtype)
-                busy = jnp.sum((active & ~done).astype(jnp.int32))
-                # Teacher-force while inside the prompt; only live slots
-                # write; a finished slot's buffer is left as-is (harvest
-                # pads eos on the host).
-                cur = lax.dynamic_index_in_dim(tokens, t + 1, 1,
-                                               keepdims=False)
-                in_gen = t + 1 >= p_end                       # [B]
-                live = active & ~done
-                nxt = jnp.where(in_gen & live, raw, cur)
-                tokens = lax.dynamic_update_index_in_dim(
-                    tokens, nxt, t + 1, 1)
-                if self._eos_id >= 0:
-                    done = done | (in_gen & live & (raw == self._eos_id))
-                # The final token of slot b lands at buffer index
-                # end[b]-1, written by tick end[b]-2.
-                done = done | (t + 2 >= end)
-                return (tokens, kc, vc, done, key), busy
-
-            (tokens, kc, vc, done, key), busy = lax.scan(
-                one_tick, (tokens, kc, vc, done, key), jnp.arange(n))
-            return tokens, kc, vc, done, jnp.sum(busy)
-
-        self._chunk_step = chunk_step
+        # The static half of the compiled programs' signature (see the
+        # module-level _chunk_program/_prefill_program).
+        self._knobs = (self._temperature, self._top_k, self._top_p,
+                       self._eos_id)
 
     # ------------------------------------------------------------------
     # public API
@@ -280,32 +310,41 @@ class DecodeEngine:
     def _schedule(self) -> bool:
         """Harvest finished slots, admit queued requests (first-fit),
         reset the window when drained+stuck.  True if a chunk should
-        run."""
-        self._harvest()
-        self._admit()
-        if np.any(self._active & ~self._done):
-            return True
-        if self._queue:
-            # Nothing fits at this tick but work remains: drain is
-            # complete (no live slots), so rewind the window.  No cache
+        run.  Loops internally because a prefill admission can finish a
+        request outright (max_new_tokens=1, or eos as the first token):
+        such slots are harvested and refilled without running a chunk."""
+        while True:
+            self._harvest()
+            self._admit()
+            if np.any(self._active & ~self._done):
+                return True
+            if np.any(self._active & self._done):
+                continue          # finished-at-admission: free + refill
+            if not self._queue:
+                return False
+            # Work remains but nothing fits at this tick and no slot is
+            # live: rewind the window (drain is complete).  No cache
             # zeroing needed — a slot only attends positions its current
-            # occupant wrote (see module docstring).
+            # occupant wrote (see module docstring).  submit() bounds
+            # every span by the window, so at tick 0 a free slot always
+            # admits — each pass either returns or shrinks the queue.
             self._tick = 0
             self.stats.window_resets += 1
-            self._admit()
-            return np.any(self._active & ~self._done)
-        return False
 
     def _admit(self) -> None:
         for b in range(self._slots):
             if self._active[b] or not self._queue:
                 continue
-            # first-fit: take the first queued request whose whole span
-            # fits in the remaining window
+            # first-fit: take the first queued request that fits in the
+            # remaining window.  A prefill admission stores the prompt
+            # BEHIND the tick, so only its generation span needs room.
             pick = None
             for qi, req in enumerate(self._queue):
-                if self._tick + req.prompt.size + req.max_new_tokens \
-                        <= self._window:
+                if self._prefill and self._tick >= req.prompt.size:
+                    need = req.max_new_tokens
+                else:
+                    need = req.prompt.size + req.max_new_tokens
+                if self._tick + need <= self._window:
                     pick = qi
                     break
             if pick is None:
@@ -313,6 +352,11 @@ class DecodeEngine:
             req = self._queue.pop(pick)
             p = req.prompt.size
             t0 = self._tick
+            if self._prefill and t0 >= p:
+                self._admit_prefill(b, req)
+                continue
+            # Sequential (teacher-forced) admission: the window's opening
+            # ticks, where there is no room behind the tick for prefill.
             self._tokens[b, t0:t0 + p] = req.prompt
             self._start[b] = t0
             self._p_end[b] = t0 + p
@@ -321,6 +365,35 @@ class DecodeEngine:
             self._active[b] = True
             self._slot_req[b] = req
             self.stats.prompt_tokens += p
+
+    def _admit_prefill(self, b: int, req: Request) -> None:
+        """Admit with ONE parallel forward: prompt K/V written at cache
+        positions t0-P..t0-1 and the first generated token deposited at
+        the admission tick, so the slot starts in generation phase."""
+        p, t0 = req.prompt.size, self._tick
+        pb = 1 << (p - 1).bit_length()        # pow-2 compile bucket
+        if t0 - p + pb > self._window:
+            pb = p                            # window edge: exact size
+        padded = np.zeros(pb, np.int32)
+        padded[:p] = req.prompt
+        self._rng, sub = jax.random.split(self._rng)
+        self._kc, self._vc, tok = _prefill_program(
+            self._knobs, self._params, self._kc, self._vc,
+            jnp.asarray(padded), np.int32(b), np.int32(t0), np.int32(p),
+            sub)
+        tok = int(tok)
+        self._tokens[b, t0 - p:t0] = req.prompt
+        self._tokens[b, t0] = tok
+        self._start[b] = t0 - p
+        self._p_end[b] = t0
+        self._end[b] = t0 + req.max_new_tokens
+        self._done[b] = (req.max_new_tokens == 1
+                         or (self._eos_id >= 0 and tok == self._eos_id))
+        self._active[b] = True
+        self._slot_req[b] = req
+        self.stats.prompt_tokens += p
+        self.stats.prefilled_tokens += p
+        self.stats.prefill_admissions += 1
 
     def _harvest(self) -> None:
         for b in range(self._slots):
@@ -348,11 +421,12 @@ class DecodeEngine:
         if n <= 0:  # pragma: no cover - _schedule resets before this
             return
         self._rng, sub = jax.random.split(self._rng)
-        tokens, self._kc, self._vc, done, busy = self._chunk_step(
-            n, self._params, jnp.asarray(self._tokens), self._kc,
-            self._vc, jnp.asarray(self._start), jnp.asarray(self._p_end),
-            jnp.asarray(self._end), jnp.asarray(self._done),
-            jnp.asarray(self._active), jnp.int32(self._tick), sub)
+        tokens, self._kc, self._vc, done, busy = _chunk_program(
+            n, self._knobs, self._params, jnp.asarray(self._tokens),
+            self._kc, self._vc, jnp.asarray(self._start),
+            jnp.asarray(self._p_end), jnp.asarray(self._end),
+            jnp.asarray(self._done), jnp.asarray(self._active),
+            jnp.int32(self._tick), sub)
         # np.array (copy): np.asarray of a device array is read-only,
         # and _admit writes prompts into the host buffer in place.
         self._tokens = np.array(tokens)

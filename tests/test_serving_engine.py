@@ -33,14 +33,17 @@ def _oracle(spec, params, prompt, n, eos_id=None):
     return np.asarray(out)[0]
 
 
-def test_engine_matches_generate_exactly(lm):
+@pytest.mark.parametrize("prefill", [False, True])
+def test_engine_matches_generate_exactly(lm, prefill):
     """Varied prompt/output lengths across fewer slots than requests:
-    every harvested sequence equals the per-request oracle decode."""
+    every harvested sequence equals the per-request oracle decode —
+    with sequential admission and with parallel prefill."""
     spec, params = lm
     rng = np.random.RandomState(1)
     reqs = [(rng.randint(0, VOCAB, p).astype(np.int32), n)
             for p, n in [(3, 5), (1, 9), (6, 2), (4, 7), (2, 4), (5, 6)]]
-    eng = DecodeEngine(spec, params, slots=2, window=24, chunk=4)
+    eng = DecodeEngine(spec, params, slots=2, window=24, chunk=4,
+                       prefill=prefill)
     ids = [eng.submit(p, n) for p, n in reqs]
     results = eng.run()
     assert sorted(results) == sorted(ids)
@@ -54,6 +57,12 @@ def test_engine_matches_generate_exactly(lm):
     assert eng.stats.completed > 2
     assert 0 < eng.stats.slot_utilization <= 1.0
     assert eng.stats.generated_tokens == sum(n for _, n in reqs)
+    if prefill:
+        # later admissions happen mid-window, behind the tick
+        assert eng.stats.prefill_admissions > 0
+        assert eng.stats.prefilled_tokens > 0
+    else:
+        assert eng.stats.prefill_admissions == 0
 
 
 def test_engine_window_reset(lm):
@@ -135,6 +144,26 @@ def test_engine_sampling_smoke(lm):
     np.testing.assert_array_equal(seq[:3], prompt)
     assert np.all((seq >= 0) & (seq < VOCAB))
     del rid
+
+
+def test_engine_prefill_single_token_requests(lm):
+    """max_new_tokens=1 through the prefill path finishes a request AT
+    admission — the scheduler must keep draining the queue without
+    running idle chunks."""
+    spec, params = lm
+    rng = np.random.RandomState(7)
+    # a longer opener so later admissions happen at tick >= P
+    opener = rng.randint(0, VOCAB, 4).astype(np.int32)
+    reqs = [(opener, 6)] + [
+        (rng.randint(0, VOCAB, 3).astype(np.int32), 1) for _ in range(5)]
+    eng = DecodeEngine(spec, params, slots=2, window=32, chunk=4)
+    ids = [eng.submit(p, n) for p, n in reqs]
+    results = eng.run()
+    assert sorted(results) == sorted(ids)
+    for rid, (prompt, n) in zip(ids, reqs):
+        np.testing.assert_array_equal(results[rid],
+                                      _oracle(spec, params, prompt, n))
+    assert eng.stats.prefill_admissions >= 4
 
 
 def test_engine_quantized_params(lm):
