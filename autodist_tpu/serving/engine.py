@@ -316,10 +316,13 @@ class DecodeEngine:
         while True:
             self._harvest()
             self._admit()
+            # Finished-at-admission slots FIRST: free + refill them now,
+            # before ticking, even while other slots are live — otherwise
+            # a done slot would sit occupied through a whole chunk.
+            if np.any(self._active & self._done):
+                continue
             if np.any(self._active & ~self._done):
                 return True
-            if np.any(self._active & self._done):
-                continue          # finished-at-admission: free + refill
             if not self._queue:
                 return False
             # Work remains but nothing fits at this tick and no slot is

@@ -125,6 +125,14 @@ def test_generate_text_example():
     assert "continuation accuracy:" in out
 
 
+def test_serving_engine_example():
+    # The example asserts oracle-exactness of spot-checked results
+    # itself; the output lines are the smoke signal.
+    out = _run_example("examples/serving_engine.py")
+    assert "oracle-exact" in out
+    assert "slot_utilization=" in out
+
+
 @pytest.mark.integration
 def test_pipeline_1f1b_example_interleaved():
     out = _run_example("examples/pipeline_1f1b.py",
