@@ -41,14 +41,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from autodist_tpu.models.base import ModelSpec
+from autodist_tpu.models.generate import unpack_lm_params as _unpack
 from autodist_tpu.models.transformer import TransformerLayer
-
-
-def _unpack(params, num_layers):
-    layer_params = [params["decoder"][f"layers_{i}"]
-                    for i in range(num_layers)]
-    return (params["embed"], params["pos_embed"], layer_params,
-            params["decoder"]["ln_final"]["scale"])
 
 
 def _positions_step(layer_params, ln_final_scale, embed, x, k_cache,

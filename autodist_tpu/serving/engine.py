@@ -72,7 +72,8 @@ from autodist_tpu.models.quantize import head_logits
 # array shapes (cache layout carries L/window/slots/heads/head_dim) or
 # through the static ``knobs`` tuple (temperature, top_k, top_p, eos_id).
 
-@functools.partial(jax.jit, static_argnums=(0, 1), donate_argnums=(4, 5))
+@functools.partial(jax.jit, static_argnums=(0, 1),
+                   donate_argnums=(3, 4, 5))
 def _chunk_program(n, knobs, params, tokens, kc, vc, start, p_end, end,
                    done, active, tick0, key):
     """``n`` decode ticks of all slots in lockstep (see DecodeEngine)."""
@@ -116,17 +117,20 @@ def _chunk_program(n, knobs, params, tokens, kc, vc, start, p_end, end,
     return tokens, kc, vc, done, jnp.sum(busy)
 
 
-@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2, 3))
-def _prefill_program(knobs, params, kc, vc, prompt_pb, slot_b, t0, p_len,
-                     key):
+@functools.partial(jax.jit, static_argnums=(0,),
+                   donate_argnums=(2, 3, 4))
+def _prefill_program(knobs, params, tokens, kc, vc, prompt_pb, slot_b,
+                     t0, p_len, key):
     """Parallel prefill: charge slot ``slot_b``'s K/V for a prompt with
     ONE [Pb]-parallel causal forward (MXU-shaped) instead of P
     sequential ticks, and sample the first generated token.  The prompt
     lands at cache positions ``t0-P..t0-1`` — *behind* the admission
     tick — so the slot joins the global tick already in generation
-    phase.  ``prompt_pb`` is the pow-2 padded bucket (one compile per
-    bucket size); pad positions' K/V land at >= t0 and are overwritten
-    by each tick's own cache write before any mask admits them."""
+    phase; the token buffer row gets the prompt and the sampled token
+    in the same program (the buffer is device-resident).  ``prompt_pb``
+    is the pow-2 padded bucket (one compile per bucket size); pad
+    positions' K/V and pad token writes land at > t0 and are
+    overwritten by each tick's own write before any read sees them."""
     temperature, top_k, top_p, _ = knobs
     num_layers, _, _, heads, head_dim = kc.shape
     embed, pos_embed, layer_params, ln_final = unpack_lm_params(
@@ -141,7 +145,22 @@ def _prefill_program(knobs, params, kc, vc, prompt_pb, slot_b, t0, p_len,
     vc = lax.dynamic_update_slice(vc, upd_v, at)
     logits = head_logits(embed, xs[p_len - 1][None])      # [1, V]
     tok = sample_next_token(logits, key, temperature, top_k, top_p)[0]
-    return kc, vc, tok
+    tokens = lax.dynamic_update_slice(
+        tokens, prompt_pb[None].astype(tokens.dtype),
+        (jnp.int32(slot_b), jnp.int32(t0 - p_len)))
+    tokens = tokens.at[slot_b, t0].set(tok.astype(tokens.dtype))
+    return tokens, kc, vc, tok
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _write_prompt_program(tokens, prompt_pb, slot_b, t0):
+    """Sequential-admission prompt write into the device-resident token
+    buffer: row ``slot_b`` positions ``t0..t0+Pb-1`` (pow-2 bucket; the
+    pad tail lands on future tick-write positions of the same slot and
+    is overwritten before any read sees it)."""
+    return lax.dynamic_update_slice(
+        tokens, prompt_pb[None].astype(tokens.dtype),
+        (jnp.int32(slot_b), jnp.int32(t0)))
 
 
 @dataclass
@@ -231,10 +250,14 @@ class DecodeEngine:
         self._slot_req: List[Optional[Request]] = [None] * slots
         self.stats = EngineStats(_slots=slots)
 
-        # Device/engine state.  tokens/start/p_end/end/done/active live
-        # on the host between chunks (tiny int arrays; admission edits
-        # them in numpy); the KV cache stays device-resident.
-        self._tokens = np.zeros((slots, window), np.int32)
+        # Engine state.  The token buffer and KV cache are
+        # DEVICE-resident: the per-chunk host traffic is only the [B]
+        # `done` vector down and the tiny [B] metadata vectors up —
+        # harvest/partial pull single finished rows.  (Pulling the whole
+        # [B, W] buffer every chunk measurably dominated the loop when
+        # ticks are cheap.)  start/p_end/end/done/active live on the
+        # host (admission edits them in numpy).
+        self._tokens = jnp.zeros((slots, window), jnp.int32)
         self._start = np.zeros(slots, np.int32)
         self._p_end = np.zeros(slots, np.int32)
         self._end = np.zeros(slots, np.int32)
@@ -304,6 +327,37 @@ class DecodeEngine:
         out, self._results = self._results, {}
         return out
 
+    def partial(self, request_id: int) -> Optional[np.ndarray]:
+        """Streaming read: the tokens of an IN-FLIGHT request written so
+        far (prompt included, truncated after a generated eos), as of
+        the last chunk boundary.  None if the request is still queued or
+        already completed (use :meth:`results` for completed ones).
+        Finished slots are harvested first so a request never shows up
+        both here and in ``results``."""
+        self._harvest()
+        for b in range(self._slots):
+            req = self._slot_req[b]
+            if req is not None and req.request_id == request_id:
+                return self._slot_tokens(b)
+        return None
+
+    def _slot_tokens(self, b: int) -> np.ndarray:
+        """Tokens written so far for slot ``b`` (shared by partial reads
+        and harvest): buffer positions ``start..min(end, tick+1)``,
+        truncated after the first eos GENERATED (not prompt-resident).
+        Pulls ONE fixed-shape row of the device-resident buffer (one
+        compiled slice per slot index; variable bounds are applied in
+        numpy so streaming polls don't accrete jit-cache entries)."""
+        s, pe, e = self._start[b], self._p_end[b], self._end[b]
+        written = min(e, self._tick + 1)
+        seq = np.array(self._tokens[b])[s:written]
+        if self._eos_id >= 0:
+            gen = seq[pe - s:]
+            hits = np.nonzero(gen == self._eos_id)[0]
+            if hits.size:
+                seq = seq[:pe - s + hits[0] + 1]
+        return seq
+
     # ------------------------------------------------------------------
     # scheduler internals
     # ------------------------------------------------------------------
@@ -360,7 +414,9 @@ class DecodeEngine:
                 continue
             # Sequential (teacher-forced) admission: the window's opening
             # ticks, where there is no room behind the tick for prefill.
-            self._tokens[b, t0:t0 + p] = req.prompt
+            self._tokens = _write_prompt_program(
+                self._tokens, self._pad_bucket(req.prompt, t0),
+                np.int32(b), np.int32(t0))
             self._start[b] = t0
             self._p_end[b] = t0 + p
             self._end[b] = t0 + p + req.max_new_tokens
@@ -374,19 +430,12 @@ class DecodeEngine:
         positions t0-P..t0-1 and the first generated token deposited at
         the admission tick, so the slot starts in generation phase."""
         p, t0 = req.prompt.size, self._tick
-        pb = 1 << (p - 1).bit_length()        # pow-2 compile bucket
-        if t0 - p + pb > self._window:
-            pb = p                            # window edge: exact size
-        padded = np.zeros(pb, np.int32)
-        padded[:p] = req.prompt
         self._rng, sub = jax.random.split(self._rng)
-        self._kc, self._vc, tok = _prefill_program(
-            self._knobs, self._params, self._kc, self._vc,
-            jnp.asarray(padded), np.int32(b), np.int32(t0), np.int32(p),
-            sub)
+        self._tokens, self._kc, self._vc, tok = _prefill_program(
+            self._knobs, self._params, self._tokens, self._kc, self._vc,
+            self._pad_bucket(req.prompt, t0 - p), np.int32(b),
+            np.int32(t0), np.int32(p), sub)
         tok = int(tok)
-        self._tokens[b, t0 - p:t0] = req.prompt
-        self._tokens[b, t0] = tok
         self._start[b] = t0 - p
         self._p_end[b] = t0
         self._end[b] = t0 + req.max_new_tokens
@@ -398,21 +447,25 @@ class DecodeEngine:
         self.stats.prefilled_tokens += p
         self.stats.prefill_admissions += 1
 
+    def _pad_bucket(self, prompt: np.ndarray, origin: int) -> jax.Array:
+        """Zero-pad ``prompt`` to its pow-2 compile bucket, falling back
+        to the exact size when the bucket would overrun the window from
+        ``origin`` (dynamic_update_slice would clamp-shift the write)."""
+        p = prompt.size
+        pb = 1 << (p - 1).bit_length()
+        if origin + pb > self._window:
+            pb = p
+        padded = np.zeros(pb, np.int32)
+        padded[:p] = prompt
+        return jnp.asarray(padded)
+
     def _harvest(self) -> None:
         for b in range(self._slots):
             if not (self._active[b] and self._done[b]):
                 continue
             req = self._slot_req[b]
-            s, pe, e = self._start[b], self._p_end[b], self._end[b]
-            # Tokens written so far for this slot (done can fire before
-            # end when eos stops it early).
-            written = min(e, self._tick + 1)
-            seq = self._tokens[b, s:written].copy()
-            if self._eos_id >= 0:
-                gen = seq[pe - s:]
-                hits = np.nonzero(gen == self._eos_id)[0]
-                if hits.size:
-                    seq = seq[:pe - s + hits[0] + 1]
+            s, pe = self._start[b], self._p_end[b]
+            seq = self._slot_tokens(b)
             self.stats.generated_tokens += max(seq.size - (pe - s), 0)
             self.stats.completed += 1
             self._results[req.request_id] = seq
@@ -421,18 +474,28 @@ class DecodeEngine:
 
     def _run_chunk(self) -> None:
         n = min(self._chunk, self._window - 1 - self._tick)
+        if self._queue:
+            # Work is waiting: stop the chunk at the next KNOWN slot
+            # retirement (its end bound — tick end[b]-2 finishes slot b)
+            # so the freed slot refills immediately instead of idling to
+            # the boundary.  eos stops stay unpredictable; this clamps
+            # only on the exact bound.  Distinct n values each compile
+            # once (sizes <= chunk, warmed by any repeated workload).
+            live = self._active & ~self._done
+            if live.any():
+                nxt = int(self._end[live].min()) - 1 - self._tick
+                n = min(n, max(nxt, 1))
         if n <= 0:  # pragma: no cover - _schedule resets before this
             return
         self._rng, sub = jax.random.split(self._rng)
-        tokens, self._kc, self._vc, done, busy = _chunk_program(
-            n, self._knobs, self._params, jnp.asarray(self._tokens),
+        self._tokens, self._kc, self._vc, done, busy = _chunk_program(
+            n, self._knobs, self._params, self._tokens,
             self._kc, self._vc, jnp.asarray(self._start),
             jnp.asarray(self._p_end), jnp.asarray(self._end),
             jnp.asarray(self._done), jnp.asarray(self._active),
             jnp.int32(self._tick), sub)
-        # np.array (copy): np.asarray of a device array is read-only,
-        # and _admit writes prompts into the host buffer in place.
-        self._tokens = np.array(tokens)
+        # The only per-chunk host pull: the [B] done vector (the token
+        # buffer stays on device; harvest/partial pull single rows).
         self._done = np.array(done)
         self._tick += n
         self.stats.ticks += n

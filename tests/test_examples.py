@@ -134,6 +134,16 @@ def test_serving_engine_example():
 
 
 @pytest.mark.integration
+def test_speculative_draft_example():
+    # Trains a target (framework session) and a ~30x-smaller draft,
+    # then decodes speculatively; the example asserts acceptance > 0.5
+    # and token-exactness vs target greedy itself.
+    out = _run_example("examples/speculative_draft.py", timeout=900)
+    assert "acceptance rate:" in out
+    assert "token-exact" in out
+
+
+@pytest.mark.integration
 def test_pipeline_1f1b_example_interleaved():
     out = _run_example("examples/pipeline_1f1b.py",
                        ("--virtual-stages", "2", "--num-layers", "8",

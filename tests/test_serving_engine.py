@@ -129,6 +129,28 @@ def test_engine_interleaved_submit(lm):
     np.testing.assert_array_equal(results[r2], _oracle(spec, params, p2, 5))
 
 
+def test_engine_partial_streaming(lm):
+    """partial(): an in-flight request's tokens-so-far grow between
+    chunks and are a prefix of the final result."""
+    spec, params = lm
+    rng = np.random.RandomState(9)
+    prompt = rng.randint(0, VOCAB, 3).astype(np.int32)
+    eng = DecodeEngine(spec, params, slots=1, window=32, chunk=2)
+    rid = eng.submit(prompt, 8)
+    assert eng.partial(rid) is None          # still queued
+    snapshots = []
+    while eng.step():
+        part = eng.partial(rid)
+        if part is not None:
+            snapshots.append(part.copy())
+    final = eng.results()[rid]
+    assert eng.partial(rid) is None          # completed -> not partial
+    assert len(snapshots) >= 2
+    assert any(s.size < final.size for s in snapshots)
+    for s in snapshots:
+        np.testing.assert_array_equal(s, final[:s.size])
+
+
 def test_engine_sampling_smoke(lm):
     """Temperature path: shapes/ranges sane (the key schedule differs
     from generate's, so no token parity is claimed)."""
