@@ -327,6 +327,28 @@ class DecodeEngine:
         out, self._results = self._results, {}
         return out
 
+    def cancel(self, request_id: int) -> bool:
+        """Cancel a queued or in-flight request.  Returns True if it was
+        found and cancelled (its slot frees at the next boundary; any
+        tokens already generated are discarded), False if unknown or
+        already completed."""
+        for qi, req in enumerate(self._queue):
+            if req.request_id == request_id:
+                self._queue.pop(qi)
+                return True
+        for b in range(self._slots):
+            req = self._slot_req[b]
+            if req is not None and req.request_id == request_id:
+                # Freeing is host-side bookkeeping only: a freed slot
+                # stops writing (done), and its cache/buffer regions are
+                # overwritten by the next occupant per the module
+                # invariants.
+                self._active[b] = False
+                self._done[b] = True
+                self._slot_req[b] = None
+                return True
+        return False
+
     def partial(self, request_id: int) -> Optional[np.ndarray]:
         """Streaming read: the tokens of an IN-FLIGHT request written so
         far (prompt included, truncated after a generated eos), as of

@@ -151,6 +151,28 @@ def test_engine_partial_streaming(lm):
         np.testing.assert_array_equal(s, final[:s.size])
 
 
+def test_engine_cancel(lm):
+    """cancel(): queued requests vanish; an in-flight request frees its
+    slot for the next admission; completed/unknown ids return False."""
+    spec, params = lm
+    rng = np.random.RandomState(10)
+    p1 = rng.randint(0, VOCAB, 3).astype(np.int32)
+    p2 = rng.randint(0, VOCAB, 2).astype(np.int32)
+    p3 = rng.randint(0, VOCAB, 2).astype(np.int32)
+    eng = DecodeEngine(spec, params, slots=1, window=32, chunk=2)
+    r1 = eng.submit(p1, 10)
+    r2 = eng.submit(p2, 4)
+    r3 = eng.submit(p3, 3)
+    assert eng.cancel(r2)                    # still queued
+    assert eng.step()                        # r1 now in flight
+    assert eng.cancel(r1)                    # in flight -> freed
+    results = eng.run()
+    assert sorted(results) == [r3]           # only r3 completes
+    np.testing.assert_array_equal(results[r3], _oracle(spec, params, p3, 3))
+    assert not eng.cancel(r3)                # completed
+    assert not eng.cancel(99)                # unknown
+
+
 def test_engine_sampling_smoke(lm):
     """Temperature path: shapes/ranges sane (the key schedule differs
     from generate's, so no token parity is claimed)."""
