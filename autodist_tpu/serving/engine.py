@@ -209,13 +209,19 @@ class DecodeEngine:
 
     Sampling knobs are engine-wide (they are trace-time constants of the
     chunk program); ``temperature=0`` is greedy.
+
+    ``mesh``/``slot_axis``: multi-chip serving — shard the slot pool
+    over a mesh axis (the axis size must divide ``slots``).  Per-slot
+    decode has no cross-slot math, so each device decodes its own slots
+    with no collectives; composes with model-axis-sharded (TP) params.
     """
 
     def __init__(self, spec: ModelSpec, params, *, slots: int = 8,
                  window: int = 512, chunk: int = 16,
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 0.0, eos_id: Optional[int] = None,
-                 rng: Optional[jax.Array] = None, prefill: bool = True):
+                 rng: Optional[jax.Array] = None, prefill: bool = True,
+                 mesh=None, slot_axis: str = "data"):
         require_lm_spec(spec, "DecodeEngine")
         cfg = spec.config
         if window > cfg["max_len"]:
@@ -224,6 +230,16 @@ class DecodeEngine:
                 f"{cfg['max_len']} (pos_embed rows)")
         if slots < 1 or window < 2 or chunk < 1:
             raise ValueError("need slots >= 1, window >= 2, chunk >= 1")
+        if mesh is not None:
+            if slot_axis not in mesh.axis_names:
+                raise ValueError(
+                    f"slot_axis {slot_axis!r} not in mesh axes "
+                    f"{mesh.axis_names}")
+            n_shards = mesh.shape[slot_axis]
+            if slots % n_shards:
+                raise ValueError(
+                    f"slots={slots} must divide over the {slot_axis!r} "
+                    f"axis ({n_shards} shards)")
         vocab = _vocab_size(params)
         # Same contract as make_generator (shared validation): a silent
         # fixed key would make every engine sample the identical stream.
@@ -266,12 +282,35 @@ class DecodeEngine:
         self._tick = 0
         heads, hd = cfg["num_heads"], cfg["head_dim"]
         dtype = params["pos_embed"].dtype
-        # Two separate buffers: both are donated to the chunk program, and
-        # donating one array through two arguments is an aliasing error.
-        self._kc = jnp.zeros((cfg["num_layers"], window, slots, heads, hd),
-                             dtype)
-        self._vc = jnp.zeros((cfg["num_layers"], window, slots, heads, hd),
-                             dtype)
+        cache_shape = (cfg["num_layers"], window, slots, heads, hd)
+        if mesh is None:
+            # Two separate buffers: both are donated to the chunk
+            # program, and donating one array through two arguments is
+            # an aliasing error.
+            self._kc = jnp.zeros(cache_shape, dtype)
+            self._vc = jnp.zeros(cache_shape, dtype)
+        else:
+            # Multi-chip serving: shard the SLOT pool over a mesh axis.
+            # Per-slot decode has no cross-slot math, so GSPMD runs each
+            # shard's slots on its own devices with no collectives in
+            # the chunk program; donation keeps the shardings chunk to
+            # chunk.  (With model-axis-sharded params, TP composes: the
+            # per-tick einsums shard exactly as in training.)  Buffers
+            # are created DIRECTLY sharded — materializing the full
+            # cache on one device first would OOM exactly the multi-chip
+            # cache sizes this mode exists for.
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            def zeros(shape, dt, sh):
+                return jax.jit(lambda: jnp.zeros(shape, dt),
+                               out_shardings=sh)()
+
+            row = NamedSharding(mesh, P(slot_axis))
+            cache = NamedSharding(mesh, P(None, None, slot_axis))
+            self._tokens = zeros((slots, window), jnp.int32, row)
+            # two separate calls -> two distinct donatable buffers
+            self._kc = zeros(cache_shape, dtype, cache)
+            self._vc = zeros(cache_shape, dtype, cache)
 
         # The static half of the compiled programs' signature (see the
         # module-level _chunk_program/_prefill_program).
