@@ -152,6 +152,16 @@ def _prefill_program(knobs, params, tokens, kc, vc, prompt_pb, slot_b,
     return tokens, kc, vc, tok
 
 
+@functools.lru_cache(maxsize=None)
+def _sharded_zeros(shape, dtype, sharding):
+    """Cached jitted zero-init producing a buffer DIRECTLY in
+    ``sharding`` (never materialized on one device); cached so engine
+    rebuilds re-trace nothing, like the other module-scope programs.
+    Each call of the returned program yields a fresh donatable buffer."""
+    return jax.jit(lambda: jnp.zeros(shape, dtype),
+                   out_shardings=sharding)
+
+
 @functools.partial(jax.jit, donate_argnums=(0,))
 def _write_prompt_program(tokens, prompt_pb, slot_b, t0):
     """Sequential-admission prompt write into the device-resident token
@@ -273,7 +283,6 @@ class DecodeEngine:
         # [B, W] buffer every chunk measurably dominated the loop when
         # ticks are cheap.)  start/p_end/end/done/active live on the
         # host (admission edits them in numpy).
-        self._tokens = jnp.zeros((slots, window), jnp.int32)
         self._start = np.zeros(slots, np.int32)
         self._p_end = np.zeros(slots, np.int32)
         self._end = np.zeros(slots, np.int32)
@@ -284,9 +293,10 @@ class DecodeEngine:
         dtype = params["pos_embed"].dtype
         cache_shape = (cfg["num_layers"], window, slots, heads, hd)
         if mesh is None:
-            # Two separate buffers: both are donated to the chunk
+            # Separate buffers: kc/vc are both donated to the chunk
             # program, and donating one array through two arguments is
             # an aliasing error.
+            self._tokens = jnp.zeros((slots, window), jnp.int32)
             self._kc = jnp.zeros(cache_shape, dtype)
             self._vc = jnp.zeros(cache_shape, dtype)
         else:
@@ -301,16 +311,13 @@ class DecodeEngine:
             # cache sizes this mode exists for.
             from jax.sharding import NamedSharding, PartitionSpec as P
 
-            def zeros(shape, dt, sh):
-                return jax.jit(lambda: jnp.zeros(shape, dt),
-                               out_shardings=sh)()
-
             row = NamedSharding(mesh, P(slot_axis))
             cache = NamedSharding(mesh, P(None, None, slot_axis))
-            self._tokens = zeros((slots, window), jnp.int32, row)
+            self._tokens = _sharded_zeros(
+                (slots, window), jnp.int32, row)()
             # two separate calls -> two distinct donatable buffers
-            self._kc = zeros(cache_shape, dtype, cache)
-            self._vc = zeros(cache_shape, dtype, cache)
+            self._kc = _sharded_zeros(cache_shape, dtype, cache)()
+            self._vc = _sharded_zeros(cache_shape, dtype, cache)()
 
         # The static half of the compiled programs' signature (see the
         # module-level _chunk_program/_prefill_program).
