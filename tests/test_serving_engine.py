@@ -186,6 +186,37 @@ def test_engine_mesh_sharded_slots(lm):
                      slot_axis="model")
 
 
+def test_engine_tp_params_with_sharded_slots(lm):
+    """The composition the docstring promises: model-axis (TP) sharded
+    params AND a data-axis sharded slot pool on one 2-D mesh, token-
+    exact vs host-layout per-request decode."""
+    import optax
+
+    from autodist_tpu.autodist import (AutoDist,
+                                       _reset_default_autodist_for_testing)
+    from autodist_tpu.strategy import Parallax
+
+    spec, params = lm
+    _reset_default_autodist_for_testing()
+    ad = AutoDist(strategy_builder=Parallax(),
+                  mesh_axes={"model": 2, "data": 4})
+    with ad.scope():
+        ad.capture(params=params, optimizer=optax.sgd(0.01),
+                   loss_fn=spec.loss_fn, sparse_vars=spec.sparse_vars)
+    sess = ad.create_distributed_session()
+
+    rng = np.random.RandomState(13)
+    reqs = [(rng.randint(0, VOCAB, p).astype(np.int32), n)
+            for p, n in [(3, 5), (2, 7), (4, 3), (1, 6)]]
+    eng = DecodeEngine(spec, sess.sharded_params, slots=4, window=24,
+                       chunk=4, mesh=sess.mesh, slot_axis="data")
+    ids = [eng.submit(p, n) for p, n in reqs]
+    results = eng.run()
+    for rid, (prompt, n) in zip(ids, reqs):
+        np.testing.assert_array_equal(results[rid],
+                                      _oracle(spec, params, prompt, n))
+
+
 def test_engine_cancel(lm):
     """cancel(): queued requests vanish; an in-flight request frees its
     slot for the next admission; completed/unknown ids return False."""
