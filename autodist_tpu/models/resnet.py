@@ -13,6 +13,7 @@ from functools import partial
 from typing import Sequence, Tuple
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -47,13 +48,61 @@ class BottleneckBlock(nn.Module):
         return nn.relu(residual + y)
 
 
+def space_to_depth(x: jax.Array, block: int = 2) -> jax.Array:
+    """[B, H, W, C] → [B, H/b, W/b, b·b·C]; channel packing order is
+    (dy, dx, c).  The TPU stem transform: a 7×7/stride-2 conv on
+    3-channel input runs the 128-wide MXU at 3/128 occupancy on its
+    contraction dim; the SAME conv expressed over space-to-depth input
+    contracts 4·4·12 = 192 elements instead of 7·7·3 = 147 spread over
+    49 tiny steps (the MLPerf-era ResNet stem optimization)."""
+    b, h, w, c = x.shape
+    x = x.reshape(b, h // block, block, w // block, block, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, h // block, w // block, block * block * c)
+
+
+def convert_stem_params(params):
+    """Losslessly remap a ``stem='conv7'`` tree to ``stem='s2d'``: embed
+    the [7,7,C,64] kernel into the [4,4,4C,64] layout so the s2d model
+    computes the SAME function (pinned in tests/test_models.py).  The
+    derivation (XLA SAME for k=7/s=2 pads (2, 3)): output[i,j] =
+    Σ W7[u, v, c] · x[2i+u-2, 2j+v-2, c]; substituting the s2d
+    coordinates 2i+u-2 = 2(i+a)+dy gives u = 2a+dy+2 with a ∈ -1..2,
+    dy ∈ {0,1} — i.e. a 4×4 conv over s2d input with padding (1, 2)
+    and kernel entry (a+1, b+1, (dy,dx,c)) = W7[2a+dy+2, 2b+dx+2]
+    (zero where the index falls outside 0..6)."""
+    w7 = np.asarray(params["conv_init"]["kernel"])       # [7,7,C,64]
+    c_in, c_out = w7.shape[2], w7.shape[3]
+    w4 = np.zeros((4, 4, 4 * c_in, c_out), w7.dtype)
+    for a2 in range(4):
+        for b2 in range(4):
+            for dy in range(2):
+                for dx in range(2):
+                    r = 2 * a2 + dy
+                    s = 2 * b2 + dx
+                    if r < 7 and s < 7:
+                        ch = (dy * 2 + dx) * c_in
+                        w4[a2, b2, ch:ch + c_in] = w7[r, s]
+    out = dict(params)
+    out["conv_init"] = {"kernel": jnp.asarray(w4)}
+    return out
+
+
 class ResNet(nn.Module):
     stage_sizes: Sequence[int]
     num_classes: int
+    stem: str = "conv7"
 
     @nn.compact
     def __call__(self, x):
-        x = Conv(64, (7, 7), strides=(2, 2), name="conv_init")(x)
+        if self.stem == "s2d":
+            # Same function as the 7×7/s2 conv (see convert_stem_params)
+            # with the contraction shaped for the MXU.
+            x = space_to_depth(x, 2)
+            x = Conv(64, (4, 4), padding=((1, 2), (1, 2)),
+                     name="conv_init")(x)
+        else:
+            x = Conv(64, (7, 7), strides=(2, 2), name="conv_init")(x)
         x = nn.relu(_norm("norm_init")(x))
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
         for i, num_blocks in enumerate(self.stage_sizes):
@@ -92,11 +141,18 @@ def _image_spec(name: str, model: nn.Module, num_classes: int,
                                  image_size=image_size))
 
 
-def resnet50(num_classes: int = 1000, image_size: int = 224) -> ModelSpec:
-    return _image_spec("resnet50", ResNet([3, 4, 6, 3], num_classes),
+def resnet50(num_classes: int = 1000, image_size: int = 224,
+             stem: str = "conv7") -> ModelSpec:
+    """``stem='s2d'`` uses the space-to-depth stem (same function as
+    the 7×7 conv — see :func:`convert_stem_params` — shaped for the
+    MXU; image_size must be even)."""
+    return _image_spec("resnet50", ResNet([3, 4, 6, 3], num_classes,
+                                          stem=stem),
                        num_classes, image_size)
 
 
-def resnet101(num_classes: int = 1000, image_size: int = 224) -> ModelSpec:
-    return _image_spec("resnet101", ResNet([3, 4, 23, 3], num_classes),
+def resnet101(num_classes: int = 1000, image_size: int = 224,
+              stem: str = "conv7") -> ModelSpec:
+    return _image_spec("resnet101", ResNet([3, 4, 23, 3], num_classes,
+                                           stem=stem),
                        num_classes, image_size)

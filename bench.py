@@ -194,6 +194,9 @@ def run_child(platform: str) -> None:
         print(json.dumps(result), flush=True)
         del sess, ad  # free the ResNet session before the LM sections
         _reset_default_autodist_for_testing()
+        _fill_s2d_stem(result, batch_size, image_size)
+        print(json.dumps(result), flush=True)
+        _reset_default_autodist_for_testing()
         flash_ok = _check_flash_numerics(result)  # on-chip kernel check
         print(json.dumps(result), flush=True)
         if flash_ok:
@@ -487,6 +490,39 @@ def _fill_decode(result) -> None:
             spec_agree, 4)
     except Exception as e:  # pragma: no cover - best-effort enrichment
         print(f"bench: decode metric unavailable ({e!r})",
+              file=sys.stderr, flush=True)
+
+
+def _fill_s2d_stem(result, batch_size, image_size) -> None:
+    """A/B the space-to-depth ResNet stem (models/resnet.py
+    convert_stem_params — exactly the 7×7/s2 function, MXU-shaped):
+    same session path, same batch, records the s2d throughput and the
+    ratio over the main conv7 number measured above.  Best-effort."""
+    try:
+        import jax.numpy as jnp
+        import numpy as np
+        import optax
+
+        from autodist_tpu.models.resnet import resnet50
+        from autodist_tpu.strategy import AllReduce
+
+        spec = resnet50(num_classes=1000, image_size=image_size,
+                        stem="s2d")
+
+        def cast(batch):
+            return {"images": batch["images"].astype(np.float32).astype(
+                jnp.bfloat16), "labels": batch["labels"]}
+
+        s2d, _, _ = _session_throughput(
+            spec, AllReduce(), optax.sgd(0.1, momentum=0.9), batch_size,
+            MEASURE_STEPS, warmup=WARMUP_STEPS, bf16_params=True,
+            batch_cast=cast)
+        result["resnet50_s2d_images_per_sec"] = round(s2d, 2)
+        if result.get("value"):
+            result["resnet50_s2d_speedup"] = round(
+                s2d / result["value"], 3)
+    except Exception as e:
+        print(f"bench: s2d stem section unavailable ({e!r})",
               file=sys.stderr, flush=True)
 
 

@@ -124,3 +124,25 @@ def test_transformer_lm_partitioned_model_axis():
     # embedding sharded over the model axis
     emb = sess.sharded_params["embed"]
     assert "model" in str(emb.sharding.spec)
+
+
+def test_resnet_s2d_stem_equivalent():
+    """The space-to-depth stem computes EXACTLY the 7x7/s2 stem's
+    function: convert_stem_params remaps the conv7 kernel into the
+    [4,4,4C,64] layout and the two models' logits match."""
+    from autodist_tpu.models.resnet import convert_stem_params
+
+    spec7 = zoo.resnet50(num_classes=8, image_size=32)
+    spec_s2d = zoo.resnet50(num_classes=8, image_size=32, stem="s2d")
+    params7 = spec7.init(jax.random.PRNGKey(0))
+    params_s2d = convert_stem_params(params7)
+    # shape sanity: the remapped kernel matches the s2d init tree
+    init_s2d = spec_s2d.init(jax.random.PRNGKey(1))
+    assert params_s2d["conv_init"]["kernel"].shape == \
+        init_s2d["conv_init"]["kernel"].shape
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 32, 32, 3).astype(np.float32)
+    y7 = spec7.apply_fn(params7, x)
+    y4 = spec_s2d.apply_fn(params_s2d, x)
+    np.testing.assert_allclose(np.asarray(y4), np.asarray(y7),
+                               rtol=2e-4, atol=2e-5)
