@@ -183,13 +183,15 @@ def _token_step(layer_params, ln_final_scale, embed, x, k_cache, v_cache,
 
 
 def _prefill_forward(layer_params, ln_final_scale, embed, pos_embed,
-                     tokens_1d, heads, head_dim):
-    """Parallel prompt prefill: ONE causal forward over ``tokens_1d``
-    [P] that also returns every layer's K/V — the MXU-friendly way to
-    charge a KV cache (one [P]-parallel matmul program instead of P
-    sequential decode ticks).  Returns ``(xs [P, D] final-normed
-    activations, ks [L, P, H, Dh], vs [L, P, H, Dh])``; the caller picks
-    which position's logits it needs (``head_logits(embed, xs[i])``).
+                     tokens_2d, heads, head_dim):
+    """Parallel prompt prefill: ONE causal forward over ``tokens_2d``
+    [K, P] (a batch of K prompts) that also returns every layer's K/V —
+    the MXU-friendly way to charge a KV cache (one [P]-parallel matmul
+    program instead of P sequential decode ticks, batched across
+    concurrent admissions).  Returns ``(xs [K, P, D] final-normed
+    activations, ks [L, K, P, H, Dh], vs [L, K, P, H, Dh])``; the
+    caller picks which positions' logits it needs
+    (``head_logits(embed, xs[i, p])``).
 
     Same single-definition block math as training/decode: the shared
     ``TransformerLayer`` with a K/V-capturing dense causal attention in
@@ -200,13 +202,13 @@ def _prefill_forward(layer_params, ln_final_scale, embed, pos_embed,
     quantized = isinstance(layer_params[0]["mlp"]["wi"]["kernel"],
                            Quantized)
     d_ff = layer_params[0]["mlp"]["wi"]["kernel"].shape[1]
-    x = embed_lookup(embed, tokens_1d, pos_embed.dtype)[None]   # [1, P, D]
-    x = x + pos_embed[None, :tokens_1d.shape[0]]
+    x = embed_lookup(embed, tokens_2d, pos_embed.dtype)      # [K, P, D]
+    x = x + pos_embed[None, :tokens_2d.shape[1]]
     ks, vs = [], []
 
     def capture_attn(q, k, v, causal):
-        ks.append(k[0])                                   # [P, H, Dh]
-        vs.append(v[0])
+        ks.append(k)                                  # [K, P, H, Dh]
+        vs.append(v)
         return dense_attention(q, k, v, causal)
 
     for lp in layer_params:
@@ -219,7 +221,7 @@ def _prefill_forward(layer_params, ln_final_scale, embed, pos_embed,
             x = layer.apply({"params": lp}, x)
     x = nn.LayerNorm(use_bias=False).apply(
         {"params": {"scale": ln_final_scale}}, x)
-    return x[0], jnp.stack(ks), jnp.stack(vs)
+    return x, jnp.stack(ks), jnp.stack(vs)
 
 
 def make_generator(spec: ModelSpec):

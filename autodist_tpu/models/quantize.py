@@ -118,11 +118,13 @@ def is_quantized(params: Dict[str, Any]) -> bool:
 
 
 def embed_lookup(embed, tok, dtype):
-    """Rows of the (possibly quantized) tied embedding for tokens [B]."""
+    """Rows of the (possibly quantized) tied embedding for tokens of
+    any shape ``[...]`` → embeddings ``[..., D]``."""
     if isinstance(embed, Quantized):
-        cols = jnp.take(embed.q, tok, axis=1)              # [D, B]
-        sc = jnp.take(embed.scale, tok, axis=1)            # [1, B]
-        return (cols.astype(jnp.float32) * sc).T.astype(dtype)
+        cols = jnp.take(embed.q, tok, axis=1)              # [D, ...]
+        sc = jnp.take(embed.scale, tok, axis=1)            # [1, ...]
+        out = cols.astype(jnp.float32) * sc
+        return jnp.moveaxis(out, 0, -1).astype(dtype)      # [..., D]
     return jnp.take(embed, tok, axis=0)
 
 

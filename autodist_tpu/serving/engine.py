@@ -119,37 +119,45 @@ def _chunk_program(n, knobs, params, tokens, kc, vc, start, p_end, end,
 
 @functools.partial(jax.jit, static_argnums=(0,),
                    donate_argnums=(2, 3, 4))
-def _prefill_program(knobs, params, tokens, kc, vc, prompt_pb, slot_b,
-                     t0, p_len, key):
-    """Parallel prefill: charge slot ``slot_b``'s K/V for a prompt with
-    ONE [Pb]-parallel causal forward (MXU-shaped) instead of P
-    sequential ticks, and sample the first generated token.  The prompt
-    lands at cache positions ``t0-P..t0-1`` — *behind* the admission
-    tick — so the slot joins the global tick already in generation
-    phase; the token buffer row gets the prompt and the sampled token
-    in the same program (the buffer is device-resident).  ``prompt_pb``
-    is the pow-2 padded bucket (one compile per bucket size); pad
-    positions' K/V and pad token writes land at > t0 and are
-    overwritten by each tick's own write before any read sees them."""
+def _prefill_program(knobs, params, tokens, kc, vc, prompts_kpb,
+                     slot_ids, t0, p_lens, key):
+    """Parallel prefill, batched over the boundary's admissions: ONE
+    [K, Pb]-parallel causal forward (MXU-shaped) charges K slots' K/V
+    instead of Σ P sequential ticks or K separate dispatches, and
+    samples each slot's first generated token.  Each prompt lands at
+    cache positions ``t0-P..t0-1`` — *behind* the shared admission tick
+    — so the slots join the global tick already in generation phase;
+    the token-buffer rows get the prompts and sampled tokens in the
+    same program (the buffer is device-resident).  ``prompts_kpb``
+    [K, Pb] is pow-2 padded in both dims' compile buckets; pad
+    positions' K/V and pad token writes land at >= t0 and are
+    overwritten by each tick's own write before any read sees them.
+    ``p_lens`` may differ per row (prompts right-padded to Pb)."""
     temperature, top_k, top_p, _ = knobs
     num_layers, _, _, heads, head_dim = kc.shape
     embed, pos_embed, layer_params, ln_final = unpack_lm_params(
         params, num_layers)
     xs, ks, vs = _prefill_forward(layer_params, ln_final, embed,
-                                  pos_embed, prompt_pb, heads, head_dim)
-    upd_k = ks[:, :, None].astype(kc.dtype)               # [L, Pb, 1, H, Dh]
-    upd_v = vs[:, :, None].astype(vc.dtype)
+                                  pos_embed, prompts_kpb, heads,
+                                  head_dim)
+    k_count = prompts_kpb.shape[0]
     z = jnp.int32(0)
-    at = (z, jnp.int32(t0 - p_len), jnp.int32(slot_b), z, z)
-    kc = lax.dynamic_update_slice(kc, upd_k, at)
-    vc = lax.dynamic_update_slice(vc, upd_v, at)
-    logits = head_logits(embed, xs[p_len - 1][None])      # [1, V]
-    tok = sample_next_token(logits, key, temperature, top_k, top_p)[0]
-    tokens = lax.dynamic_update_slice(
-        tokens, prompt_pb[None].astype(tokens.dtype),
-        (jnp.int32(slot_b), jnp.int32(t0 - p_len)))
-    tokens = tokens.at[slot_b, t0].set(tok.astype(tokens.dtype))
-    return tokens, kc, vc, tok
+    for i in range(k_count):                  # K is static (shape)
+        upd_k = ks[:, i][:, :, None].astype(kc.dtype)  # [L, Pb, 1, H, Dh]
+        upd_v = vs[:, i][:, :, None].astype(vc.dtype)
+        at = (z, jnp.int32(t0 - p_lens[i]), jnp.int32(slot_ids[i]), z, z)
+        kc = lax.dynamic_update_slice(kc, upd_k, at)
+        vc = lax.dynamic_update_slice(vc, upd_v, at)
+        tokens = lax.dynamic_update_slice(
+            tokens, prompts_kpb[i][None].astype(tokens.dtype),
+            (jnp.int32(slot_ids[i]), jnp.int32(t0 - p_lens[i])))
+    last = jnp.take_along_axis(
+        xs, (p_lens - 1)[:, None, None].astype(jnp.int32), axis=1
+    )[:, 0]                                               # [K, D]
+    logits = head_logits(embed, last)                     # [K, V]
+    toks = sample_next_token(logits, key, temperature, top_k, top_p)
+    tokens = tokens.at[slot_ids, t0].set(toks.astype(tokens.dtype))
+    return tokens, kc, vc, toks
 
 
 @functools.lru_cache(maxsize=None)
@@ -191,6 +199,7 @@ class EngineStats:
     prompt_tokens: int = 0        # prompt tokens consumed (all admissions)
     prefilled_tokens: int = 0     # of those, charged by parallel prefill
     prefill_admissions: int = 0   # admissions that used parallel prefill
+    prefill_dispatches: int = 0   # batched prefill programs dispatched
     completed: int = 0            # requests harvested
     window_resets: int = 0
     chunks: int = 0               # compiled-program dispatches
@@ -457,6 +466,7 @@ class DecodeEngine:
             self.stats.window_resets += 1
 
     def _admit(self) -> None:
+        prefills: List[tuple] = []        # deferred (slot, req) pairs
         for b in range(self._slots):
             if self._active[b] or not self._queue:
                 continue
@@ -478,7 +488,9 @@ class DecodeEngine:
             p = req.prompt.size
             t0 = self._tick
             if self._prefill and t0 >= p:
-                self._admit_prefill(b, req)
+                # Deferred: this boundary's prefill admissions run as
+                # ONE batched program (MXU-batched, one dispatch).
+                prefills.append((b, req))
                 continue
             # Sequential (teacher-forced) admission: the window's opening
             # ticks, where there is no room behind the tick for prefill.
@@ -492,28 +504,68 @@ class DecodeEngine:
             self._active[b] = True
             self._slot_req[b] = req
             self.stats.prompt_tokens += p
+        if prefills:
+            self._flush_prefills(prefills)
 
-    def _admit_prefill(self, b: int, req: Request) -> None:
-        """Admit with ONE parallel forward: prompt K/V written at cache
-        positions t0-P..t0-1 and the first generated token deposited at
-        the admission tick, so the slot starts in generation phase."""
-        p, t0 = req.prompt.size, self._tick
+    def _flush_prefills(self, group) -> None:
+        """Run the boundary's prefill admissions in as few dispatches
+        as possible.  Rows are grouped largest-bucket-first: each round
+        batches every row that fits the current pow-2 bucket Pb
+        (overrun guard: ``t0 - P + Pb <= window``, else
+        dynamic_update_slice would clamp-shift the write), then the
+        bucket is recomputed over what remains — so one long prompt
+        cannot force the small prompts out of a shared batch.  A row no
+        bucket fits runs alone at exact size (always fits: t0 <= W)."""
+        t0 = self._tick
+        remaining = sorted(group, key=lambda br: br[1].prompt.size,
+                           reverse=True)
+        while remaining:
+            pb = 1 << (remaining[0][1].prompt.size - 1).bit_length()
+            fit_idx = [i for i, (_, r) in enumerate(remaining)
+                       if t0 - r.prompt.size + pb <= self._window]
+            if fit_idx:
+                self._run_prefill([remaining[i] for i in fit_idx], pb)
+                keep = set(fit_idx)
+                remaining = [br for i, br in enumerate(remaining)
+                             if i not in keep]
+            else:
+                b, req = remaining.pop(0)
+                self._run_prefill([(b, req)], req.prompt.size)
+
+    def _run_prefill(self, group, pb: int) -> None:
+        """One batched prefill dispatch: prompt K/V written at cache
+        positions t0-P..t0-1 per row and each first generated token
+        deposited at the admission tick, so the slots start in
+        generation phase."""
+        t0, k = self._tick, len(group)
+        prompts = np.zeros((k, pb), np.int32)
+        slot_ids = np.zeros(k, np.int32)
+        p_lens = np.zeros(k, np.int32)
+        for i, (b, req) in enumerate(group):
+            prompts[i, :req.prompt.size] = req.prompt
+            slot_ids[i] = b
+            p_lens[i] = req.prompt.size
         self._rng, sub = jax.random.split(self._rng)
-        self._tokens, self._kc, self._vc, tok = _prefill_program(
+        self._tokens, self._kc, self._vc, toks = _prefill_program(
             self._knobs, self._params, self._tokens, self._kc, self._vc,
-            self._pad_bucket(req.prompt, t0 - p), np.int32(b),
-            np.int32(t0), np.int32(p), sub)
-        tok = int(tok)
-        self._start[b] = t0 - p
-        self._p_end[b] = t0
-        self._end[b] = t0 + req.max_new_tokens
-        self._done[b] = (req.max_new_tokens == 1
-                         or (self._eos_id >= 0 and tok == self._eos_id))
-        self._active[b] = True
-        self._slot_req[b] = req
-        self.stats.prompt_tokens += p
-        self.stats.prefilled_tokens += p
-        self.stats.prefill_admissions += 1
+            jnp.asarray(prompts), jnp.asarray(slot_ids), np.int32(t0),
+            jnp.asarray(p_lens), sub)
+        toks = np.array(toks)
+        for i, (b, req) in enumerate(group):
+            p = req.prompt.size
+            tok = int(toks[i])
+            self._start[b] = t0 - p
+            self._p_end[b] = t0
+            self._end[b] = t0 + req.max_new_tokens
+            self._done[b] = (req.max_new_tokens == 1
+                             or (self._eos_id >= 0
+                                 and tok == self._eos_id))
+            self._active[b] = True
+            self._slot_req[b] = req
+            self.stats.prompt_tokens += p
+            self.stats.prefilled_tokens += p
+            self.stats.prefill_admissions += 1
+        self.stats.prefill_dispatches += 1
 
     def _pad_bucket(self, prompt: np.ndarray, origin: int) -> jax.Array:
         """Zero-pad ``prompt`` to its pow-2 compile bucket, falling back

@@ -256,6 +256,28 @@ def test_engine_sampling_smoke(lm):
     del rid
 
 
+def test_engine_batched_prefill_single_dispatch(lm):
+    """Two slots retiring at the same boundary admit their replacements
+    through ONE batched prefill program (prefill_dispatches counts
+    dispatches; prefill_admissions counts requests)."""
+    spec, params = lm
+    rng = np.random.RandomState(14)
+    eng = DecodeEngine(spec, params, slots=2, window=32, chunk=16)
+    # wave 1: identical spans -> both slots retire at the same tick
+    wave1 = [(rng.randint(0, VOCAB, 3).astype(np.int32), 5)
+             for _ in range(2)]
+    # wave 2: admitted together at that boundary, behind the tick
+    wave2 = [(rng.randint(0, VOCAB, 2).astype(np.int32), 4)
+             for _ in range(2)]
+    ids = [eng.submit(p, n) for p, n in wave1 + wave2]
+    results = eng.run()
+    for rid, (prompt, n) in zip(ids, wave1 + wave2):
+        np.testing.assert_array_equal(results[rid],
+                                      _oracle(spec, params, prompt, n))
+    assert eng.stats.prefill_admissions == 2
+    assert eng.stats.prefill_dispatches == 1
+
+
 def test_engine_prefill_single_token_requests(lm):
     """max_new_tokens=1 through the prefill path finishes a request AT
     admission — the scheduler must keep draining the queue without
