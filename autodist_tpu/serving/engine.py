@@ -129,7 +129,9 @@ def _prefill_program(knobs, params, tokens, kc, vc, prompts_kpb,
     — so the slots join the global tick already in generation phase;
     the token-buffer rows get the prompts and sampled tokens in the
     same program (the buffer is device-resident).  ``prompts_kpb``
-    [K, Pb] is pow-2 padded in both dims' compile buckets; pad
+    [K, Pb]: Pb is the rows' shared pow-2 prompt bucket and K a pow-2
+    sub-batch size, both chosen by the scheduler (``_flush_prefills``)
+    so the set of compiled (K, Pb) programs stays small.  Pad
     positions' K/V and pad token writes land at >= t0 and are
     overwritten by each tick's own write before any read sees them.
     ``p_lens`` may differ per row (prompts right-padded to Pb)."""
@@ -332,12 +334,25 @@ class DecodeEngine:
         # module-level _chunk_program/_prefill_program).
         self._knobs = (self._temperature, self._top_k, self._top_p,
                        self._eos_id)
+        # Set when a device dispatch raises mid-flight: the state
+        # buffers were DONATED to the failed program and may be invalid,
+        # so the engine refuses further use instead of decoding garbage.
+        self._poisoned = False
 
     # ------------------------------------------------------------------
     # public API
     # ------------------------------------------------------------------
+    def _check_usable(self) -> None:
+        if self._poisoned:
+            raise RuntimeError(
+                "DecodeEngine is poisoned: a device dispatch failed "
+                "after its state buffers were donated (e.g. a dropped "
+                "TPU connection mid-chunk); in-flight requests are "
+                "lost — rebuild the engine and resubmit")
+
     def submit(self, prompt, max_new_tokens: int) -> int:
         """Queue a request; returns its id.  ``prompt`` is 1-D ints."""
+        self._check_usable()
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("prompt must have at least one token")
@@ -360,6 +375,7 @@ class DecodeEngine:
         """Decode until the queue and all slots drain; returns and
         clears ``{request_id: tokens}`` (prompt included, truncated
         after a generated ``eos_id``)."""
+        self._check_usable()
         while self._schedule():
             self._run_chunk()
         self._harvest()
@@ -370,6 +386,7 @@ class DecodeEngine:
         """One schedule+chunk iteration; False when fully drained.
         (``run`` is the batch wrapper; ``step`` lets a caller interleave
         submits with decoding — the continuous-batching loop proper.)"""
+        self._check_usable()
         if not self._schedule():
             self._harvest()
             return False
@@ -377,8 +394,11 @@ class DecodeEngine:
         return True
 
     def results(self) -> Dict[int, np.ndarray]:
-        """Completed results so far (and clears them)."""
-        self._harvest()
+        """Completed results so far (and clears them).  Usable on a
+        poisoned engine: already-harvested results live on the host and
+        survive a failed dispatch (only in-flight work is lost)."""
+        if not self._poisoned:
+            self._harvest()
         out, self._results = self._results, {}
         return out
 
@@ -411,6 +431,7 @@ class DecodeEngine:
         already completed (use :meth:`results` for completed ones).
         Finished slots are harvested first so a request never shows up
         both here and in ``results``."""
+        self._check_usable()   # a streaming read touches device buffers
         self._harvest()
         for b in range(self._slots):
             req = self._slot_req[b]
@@ -494,9 +515,13 @@ class DecodeEngine:
                 continue
             # Sequential (teacher-forced) admission: the window's opening
             # ticks, where there is no room behind the tick for prefill.
-            self._tokens = _write_prompt_program(
-                self._tokens, self._pad_bucket(req.prompt, t0),
-                np.int32(b), np.int32(t0))
+            try:
+                self._tokens = _write_prompt_program(
+                    self._tokens, self._pad_bucket(req.prompt, t0),
+                    np.int32(b), np.int32(t0))
+            except Exception:
+                self._poisoned = True   # tokens buffer was donated
+                raise
             self._start[b] = t0
             self._p_end[b] = t0 + p
             self._end[b] = t0 + p + req.max_new_tokens
@@ -508,29 +533,28 @@ class DecodeEngine:
             self._flush_prefills(prefills)
 
     def _flush_prefills(self, group) -> None:
-        """Run the boundary's prefill admissions in as few dispatches
-        as possible.  Rows are grouped largest-bucket-first: each round
-        batches every row that fits the current pow-2 bucket Pb
-        (overrun guard: ``t0 - P + Pb <= window``, else
-        dynamic_update_slice would clamp-shift the write), then the
-        bucket is recomputed over what remains — so one long prompt
-        cannot force the small prompts out of a shared batch.  A row no
-        bucket fits runs alone at exact size (always fits: t0 <= W)."""
+        """Run the boundary's prefill admissions in few, compile-bounded
+        dispatches.  Rows group by their OWN pow-2 prompt bucket (a
+        short prompt never pays a long prompt's padded O(Pb²) attention)
+        and each bucket dispatches in pow-2-sized sub-batches, so both
+        compile dimensions are bounded: ≤ (log2(window) buckets) ×
+        (log2(slots)+1 batch sizes) programs ever exist.  A row whose
+        bucket would overrun the window (``t0 - P + Pb > window``, where
+        dynamic_update_slice would clamp-shift the write) runs at exact
+        prompt size instead (always fits: t0 <= window)."""
         t0 = self._tick
-        remaining = sorted(group, key=lambda br: br[1].prompt.size,
-                           reverse=True)
-        while remaining:
-            pb = 1 << (remaining[0][1].prompt.size - 1).bit_length()
-            fit_idx = [i for i, (_, r) in enumerate(remaining)
-                       if t0 - r.prompt.size + pb <= self._window]
-            if fit_idx:
-                self._run_prefill([remaining[i] for i in fit_idx], pb)
-                keep = set(fit_idx)
-                remaining = [br for i, br in enumerate(remaining)
-                             if i not in keep]
-            else:
-                b, req = remaining.pop(0)
-                self._run_prefill([(b, req)], req.prompt.size)
+        buckets: Dict[int, List[tuple]] = {}
+        for b, req in group:
+            p = req.prompt.size
+            pb = 1 << (p - 1).bit_length()
+            if t0 - p + pb > self._window:
+                pb = p
+            buckets.setdefault(pb, []).append((b, req))
+        for pb, rows in sorted(buckets.items()):
+            while rows:
+                k = 1 << (len(rows).bit_length() - 1)  # pow2 <= len
+                self._run_prefill(rows[:k], pb)
+                rows = rows[k:]
 
     def _run_prefill(self, group, pb: int) -> None:
         """One batched prefill dispatch: prompt K/V written at cache
@@ -546,11 +570,15 @@ class DecodeEngine:
             slot_ids[i] = b
             p_lens[i] = req.prompt.size
         self._rng, sub = jax.random.split(self._rng)
-        self._tokens, self._kc, self._vc, toks = _prefill_program(
-            self._knobs, self._params, self._tokens, self._kc, self._vc,
-            jnp.asarray(prompts), jnp.asarray(slot_ids), np.int32(t0),
-            jnp.asarray(p_lens), sub)
-        toks = np.array(toks)
+        try:
+            self._tokens, self._kc, self._vc, toks = _prefill_program(
+                self._knobs, self._params, self._tokens, self._kc,
+                self._vc, jnp.asarray(prompts), jnp.asarray(slot_ids),
+                np.int32(t0), jnp.asarray(p_lens), sub)
+            toks = np.array(toks)
+        except Exception:
+            self._poisoned = True
+            raise
         for i, (b, req) in enumerate(group):
             p = req.prompt.size
             tok = int(toks[i])
@@ -599,24 +627,32 @@ class DecodeEngine:
             # retirement (its end bound — tick end[b]-2 finishes slot b)
             # so the freed slot refills immediately instead of idling to
             # the boundary.  eos stops stay unpredictable; this clamps
-            # only on the exact bound.  Distinct n values each compile
-            # once (sizes <= chunk, warmed by any repeated workload).
+            # only on the exact bound.  The clamp is quantized DOWN to a
+            # power of two: each distinct scan length is its own XLA
+            # compile, so exact clamping could cost `chunk` compiles on
+            # a cold cache — pow-2 sizes bound that at log2(chunk)+1
+            # (undershooting just lands an extra boundary, never idles).
             live = self._active & ~self._done
             if live.any():
                 nxt = int(self._end[live].min()) - 1 - self._tick
-                n = min(n, max(nxt, 1))
+                if 0 < nxt < n:
+                    n = 1 << (nxt.bit_length() - 1)
         if n <= 0:  # pragma: no cover - _schedule resets before this
             return
         self._rng, sub = jax.random.split(self._rng)
-        self._tokens, self._kc, self._vc, done, busy = _chunk_program(
-            n, self._knobs, self._params, self._tokens,
-            self._kc, self._vc, jnp.asarray(self._start),
-            jnp.asarray(self._p_end), jnp.asarray(self._end),
-            jnp.asarray(self._done), jnp.asarray(self._active),
-            jnp.int32(self._tick), sub)
-        # The only per-chunk host pull: the [B] done vector (the token
-        # buffer stays on device; harvest/partial pull single rows).
-        self._done = np.array(done)
+        try:
+            self._tokens, self._kc, self._vc, done, busy = _chunk_program(
+                n, self._knobs, self._params, self._tokens,
+                self._kc, self._vc, jnp.asarray(self._start),
+                jnp.asarray(self._p_end), jnp.asarray(self._end),
+                jnp.asarray(self._done), jnp.asarray(self._active),
+                jnp.int32(self._tick), sub)
+            # The only per-chunk host pull: the [B] done vector (the
+            # token buffer stays on device; harvest/partial pull rows).
+            self._done = np.array(done)
+        except Exception:
+            self._poisoned = True
+            raise
         self._tick += n
         self.stats.ticks += n
         self.stats.busy_slot_ticks += int(busy)

@@ -349,6 +349,31 @@ def test_engine_quantized_params(lm):
         np.testing.assert_array_equal(results[rid], want)
 
 
+def test_engine_poisoned_after_failed_dispatch(lm, monkeypatch):
+    """A device dispatch failing mid-flight (buffers already donated)
+    must poison the engine with a clear error, not decode garbage."""
+    import autodist_tpu.serving.engine as eng_mod
+
+    spec, params = lm
+    eng = DecodeEngine(spec, params, slots=1, window=16, chunk=2)
+    eng.submit(np.arange(2, dtype=np.int32), 4)
+
+    def boom(*a, **k):
+        raise RuntimeError("tunnel dropped")
+
+    monkeypatch.setattr(eng_mod, "_chunk_program", boom)
+    with pytest.raises(RuntimeError, match="tunnel dropped"):
+        eng.run()
+    monkeypatch.undo()
+    with pytest.raises(RuntimeError, match="poisoned"):
+        eng.step()
+    with pytest.raises(RuntimeError, match="poisoned"):
+        eng.submit(np.arange(2, dtype=np.int32), 2)
+    with pytest.raises(RuntimeError, match="poisoned"):
+        eng.partial(0)
+    assert eng.results() == {}   # host-side salvage still works
+
+
 def test_engine_validation(lm):
     spec, params = lm
     eng = DecodeEngine(spec, params, slots=1, window=8)
