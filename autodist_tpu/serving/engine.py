@@ -120,7 +120,7 @@ def _chunk_program(n, knobs, params, tokens, kc, vc, start, p_end, end,
 @functools.partial(jax.jit, static_argnums=(0,),
                    donate_argnums=(2, 3, 4))
 def _prefill_program(knobs, params, tokens, kc, vc, prompts_kpb,
-                     slot_ids, t0, p_lens, key):
+                     slot_ids, row_map, t0, p_lens, key):
     """Parallel prefill, batched over the boundary's admissions: ONE
     [K, Pb]-parallel causal forward (MXU-shaped) charges K slots' K/V
     instead of Σ P sequential ticks or K separate dispatches, and
@@ -134,7 +134,13 @@ def _prefill_program(knobs, params, tokens, kc, vc, prompts_kpb,
     so the set of compiled (K, Pb) programs stays small.  Pad
     positions' K/V and pad token writes land at >= t0 and are
     overwritten by each tick's own write before any read sees them.
-    ``p_lens`` may differ per row (prompts right-padded to Pb)."""
+    ``p_lens`` may differ per row (prompts right-padded to Pb).
+
+    ``row_map`` [S] maps each target SLOT entry to its unique prompt
+    row — identical prompts admitted together (system-prompt fan-out,
+    n samples per prompt) are computed ONCE and their K/V scattered to
+    every slot; under temperature sampling each slot still draws its
+    own independent first token from the shared logits row."""
     temperature, top_k, top_p, _ = knobs
     num_layers, _, _, heads, head_dim = kc.shape
     embed, pos_embed, layer_params, ln_final = unpack_lm_params(
@@ -142,24 +148,36 @@ def _prefill_program(knobs, params, tokens, kc, vc, prompts_kpb,
     xs, ks, vs = _prefill_forward(layer_params, ln_final, embed,
                                   pos_embed, prompts_kpb, heads,
                                   head_dim)
-    k_count = prompts_kpb.shape[0]
+    s_count = slot_ids.shape[0]
     z = jnp.int32(0)
-    for i in range(k_count):                  # K is static (shape)
-        upd_k = ks[:, i][:, :, None].astype(kc.dtype)  # [L, Pb, 1, H, Dh]
-        upd_v = vs[:, i][:, :, None].astype(vc.dtype)
-        at = (z, jnp.int32(t0 - p_lens[i]), jnp.int32(slot_ids[i]), z, z)
+    for j in range(s_count):                  # S is static (shape)
+        i = row_map[j]
+        row_k = lax.dynamic_index_in_dim(ks, i, 1)   # [L, 1, Pb, H, Dh]
+        upd_k = jnp.moveaxis(row_k, 1, 2).astype(kc.dtype)
+        row_v = lax.dynamic_index_in_dim(vs, i, 1)
+        upd_v = jnp.moveaxis(row_v, 1, 2).astype(vc.dtype)
+        p_j = p_lens[i]
+        at = (z, jnp.int32(t0 - p_j), jnp.int32(slot_ids[j]), z, z)
         kc = lax.dynamic_update_slice(kc, upd_k, at)
         vc = lax.dynamic_update_slice(vc, upd_v, at)
+        prow = lax.dynamic_index_in_dim(prompts_kpb, i, 0)  # [1, Pb]
         tokens = lax.dynamic_update_slice(
-            tokens, prompts_kpb[i][None].astype(tokens.dtype),
-            (jnp.int32(slot_ids[i]), jnp.int32(t0 - p_lens[i])))
+            tokens, prow.astype(tokens.dtype),
+            (jnp.int32(slot_ids[j]), jnp.int32(t0 - p_j)))
     last = jnp.take_along_axis(
         xs, (p_lens - 1)[:, None, None].astype(jnp.int32), axis=1
     )[:, 0]                                               # [K, D]
     logits = head_logits(embed, last)                     # [K, V]
-    toks = sample_next_token(logits, key, temperature, top_k, top_p)
+    logits_s = jnp.take(logits, row_map, axis=0)          # [S, V]
+    toks = sample_next_token(logits_s, key, temperature, top_k, top_p)
     tokens = tokens.at[slot_ids, t0].set(toks.astype(tokens.dtype))
-    return tokens, kc, vc, toks
+    # Report the values that LANDED in the buffer, not the raw draws:
+    # S is padded to a pow-2 bucket with duplicated entries, and when
+    # duplicate slot indices scatter different samples the winner is
+    # unspecified — reading back keeps the host's eos bookkeeping
+    # consistent with what the next tick will actually consume.
+    landed = tokens[slot_ids, t0]
+    return tokens, kc, vc, landed
 
 
 @functools.lru_cache(maxsize=None)
@@ -202,6 +220,7 @@ class EngineStats:
     prefilled_tokens: int = 0     # of those, charged by parallel prefill
     prefill_admissions: int = 0   # admissions that used parallel prefill
     prefill_dispatches: int = 0   # batched prefill programs dispatched
+    prefill_dedup_hits: int = 0   # slots served by a shared prompt row
     completed: int = 0            # requests harvested
     window_resets: int = 0
     chunks: int = 0               # compiled-program dispatches
@@ -536,52 +555,79 @@ class DecodeEngine:
         """Run the boundary's prefill admissions in few, compile-bounded
         dispatches.  Rows group by their OWN pow-2 prompt bucket (a
         short prompt never pays a long prompt's padded O(Pb²) attention)
-        and each bucket dispatches in pow-2-sized sub-batches, so both
-        compile dimensions are bounded: ≤ (log2(window) buckets) ×
-        (log2(slots)+1 batch sizes) programs ever exist.  A row whose
+        and each bucket dispatches in pow-2-sized sub-batches; the slot
+        fan-out S is pow-2 padded inside _run_prefill — so all three
+        compile dimensions (Pb, K, S) are bucketed and the compiled
+        program set stays logarithmic in window and slots.  A row whose
         bucket would overrun the window (``t0 - P + Pb > window``, where
         dynamic_update_slice would clamp-shift the write) runs at exact
         prompt size instead (always fits: t0 <= window)."""
         t0 = self._tick
-        buckets: Dict[int, List[tuple]] = {}
+        buckets: Dict[int, Dict[bytes, list]] = {}
         for b, req in group:
             p = req.prompt.size
             pb = 1 << (p - 1).bit_length()
             if t0 - p + pb > self._window:
                 pb = p
-            buckets.setdefault(pb, []).append((b, req))
-        for pb, rows in sorted(buckets.items()):
-            while rows:
-                k = 1 << (len(rows).bit_length() - 1)  # pow2 <= len
-                self._run_prefill(rows[:k], pb)
-                rows = rows[k:]
+            # dedup identical prompts within a bucket: computed once,
+            # K/V scattered to every requesting slot
+            buckets.setdefault(pb, {}).setdefault(
+                req.prompt.tobytes(), []).append((b, req))
+        for pb, uniq in sorted(buckets.items()):
+            entries = list(uniq.values())     # [[(b, req), ...], ...]
+            while entries:
+                k = 1 << (len(entries).bit_length() - 1)  # pow2 <= len
+                self._run_prefill(entries[:k], pb)
+                entries = entries[k:]
 
-    def _run_prefill(self, group, pb: int) -> None:
-        """One batched prefill dispatch: prompt K/V written at cache
-        positions t0-P..t0-1 per row and each first generated token
+    def _run_prefill(self, entries, pb: int) -> None:
+        """One batched prefill dispatch over K unique prompts serving S
+        slots (S >= K when prompts repeat): prompt K/V written at cache
+        positions t0-P..t0-1 per slot and each first generated token
         deposited at the admission tick, so the slots start in
         generation phase."""
-        t0, k = self._tick, len(group)
+        t0, k = self._tick, len(entries)
         prompts = np.zeros((k, pb), np.int32)
-        slot_ids = np.zeros(k, np.int32)
         p_lens = np.zeros(k, np.int32)
-        for i, (b, req) in enumerate(group):
-            prompts[i, :req.prompt.size] = req.prompt
-            slot_ids[i] = b
-            p_lens[i] = req.prompt.size
+        slot_ids, row_map, flat = [], [], []
+        for i, slot_reqs in enumerate(entries):
+            prompt = slot_reqs[0][1].prompt
+            prompts[i, :prompt.size] = prompt
+            p_lens[i] = prompt.size
+            for b, req in slot_reqs:
+                slot_ids.append(b)
+                row_map.append(i)
+                flat.append((b, req))
+        slot_ids = np.asarray(slot_ids, np.int32)
+        row_map = np.asarray(row_map, np.int32)
+        # Pad S to its pow-2 bucket by repeating the last entry (an
+        # idempotent duplicate write; the program reports landed buffer
+        # values so duplicate sampling stays consistent) — S is a
+        # compile dimension like K and Pb, and all three must be
+        # bucketed to keep the compiled program set small.
+        s_real = len(flat)
+        s_pad = 1 << (s_real - 1).bit_length()
+        if s_pad != s_real:
+            slot_ids = np.concatenate(
+                [slot_ids, np.full(s_pad - s_real, slot_ids[-1],
+                                   np.int32)])
+            row_map = np.concatenate(
+                [row_map, np.full(s_pad - s_real, row_map[-1],
+                                  np.int32)])
         self._rng, sub = jax.random.split(self._rng)
         try:
             self._tokens, self._kc, self._vc, toks = _prefill_program(
                 self._knobs, self._params, self._tokens, self._kc,
                 self._vc, jnp.asarray(prompts), jnp.asarray(slot_ids),
-                np.int32(t0), jnp.asarray(p_lens), sub)
+                jnp.asarray(row_map), np.int32(t0), jnp.asarray(p_lens),
+                sub)
             toks = np.array(toks)
         except Exception:
             self._poisoned = True
             raise
-        for i, (b, req) in enumerate(group):
+        for j, (b, req) in enumerate(flat):
             p = req.prompt.size
-            tok = int(toks[i])
+            tok = int(toks[j])
             self._start[b] = t0 - p
             self._p_end[b] = t0
             self._end[b] = t0 + req.max_new_tokens
@@ -593,6 +639,7 @@ class DecodeEngine:
             self.stats.prompt_tokens += p
             self.stats.prefilled_tokens += p
             self.stats.prefill_admissions += 1
+        self.stats.prefill_dedup_hits += len(flat) - k
         self.stats.prefill_dispatches += 1
 
     def _pad_bucket(self, prompt: np.ndarray, origin: int) -> jax.Array:

@@ -278,6 +278,46 @@ def test_engine_batched_prefill_single_dispatch(lm):
     assert eng.stats.prefill_dispatches == 1
 
 
+def test_engine_prefill_dedup_shared_prompt(lm):
+    """Identical prompts admitted at one boundary (the n-samples-per-
+    prompt / system-prompt fan-out case) compute their prefill ONCE:
+    dedup hits recorded, greedy results still oracle-exact, and under
+    temperature the slots draw independent samples."""
+    spec, params = lm
+    rng = np.random.RandomState(15)
+    shared = rng.randint(0, VOCAB, 3).astype(np.int32)
+    eng = DecodeEngine(spec, params, slots=2, window=32, chunk=16)
+    # wave 1 occupies both slots to push the tick past the prompt size
+    w1 = [(rng.randint(0, VOCAB, 3).astype(np.int32), 5)
+          for _ in range(2)]
+    ids1 = [eng.submit(p, n) for p, n in w1]
+    # wave 2: the SAME prompt twice -> one prefill row, two slots
+    ids2 = [eng.submit(shared, 4) for _ in range(2)]
+    results = eng.run()
+    for rid, (p, n) in zip(ids1, w1):
+        np.testing.assert_array_equal(results[rid],
+                                      _oracle(spec, params, p, n))
+    want = _oracle(spec, params, shared, 4)
+    for rid in ids2:
+        np.testing.assert_array_equal(results[rid], want)
+    assert eng.stats.prefill_dedup_hits == 1
+    assert eng.stats.prefill_dispatches == 1
+
+    # temperature: shared prefill row, but per-slot independent draws
+    eng2 = DecodeEngine(spec, params, slots=2, window=32, chunk=16,
+                        temperature=1.0, rng=jax.random.PRNGKey(3))
+    w1b = [(rng.randint(0, VOCAB, 3).astype(np.int32), 5)
+           for _ in range(2)]
+    for p, n in w1b:
+        eng2.submit(p, n)
+    ids2b = [eng2.submit(shared, 8) for _ in range(2)]
+    res2 = eng2.run()
+    a, bseq = res2[ids2b[0]], res2[ids2b[1]]
+    assert eng2.stats.prefill_dedup_hits >= 1
+    # overwhelmingly likely to differ somewhere over 8 sampled tokens
+    assert not np.array_equal(a, bseq)
+
+
 def test_engine_prefill_single_token_requests(lm):
     """max_new_tokens=1 through the prefill path finishes a request AT
     admission — the scheduler must keep draining the queue without
