@@ -206,6 +206,10 @@ def _prefill_forward(layer_params, ln_final_scale, embed, pos_embed,
     x = x + pos_embed[None, :tokens_2d.shape[1]]
     ks, vs = [], []
 
+    # Dense attention deliberately: the flash kernel's own measured
+    # crossover vs dense is near T~2048 (ops/flash_attention.py block
+    # notes), far above engine prompt buckets, and dense keeps prefill
+    # numerics closest to the tick-by-tick decode path.
     def capture_attn(q, k, v, causal):
         ks.append(k)                                  # [K, P, H, Dh]
         vs.append(v)

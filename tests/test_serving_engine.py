@@ -370,6 +370,30 @@ def test_engine_with_session_sharded_params(lm):
                                       _oracle(spec, params, prompt, n))
 
 
+def test_engine_long_prompt_prefill(lm):
+    """A long (130-token) prompt stays oracle-exact through prefill;
+    its pow-2 bucket overruns the window so it also exercises the
+    exact-size fallback."""
+    spec_long = transformer_lm(vocab_size=VOCAB, num_layers=2,
+                               num_heads=2, head_dim=8, d_ff=32,
+                               max_len=200, seq_len=16,
+                               attn_fn=dense_attention)
+    params = spec_long.init(jax.random.PRNGKey(4))
+    rng = np.random.RandomState(16)
+    short = rng.randint(0, VOCAB, 2).astype(np.int32)
+    long_p = rng.randint(0, VOCAB, 130).astype(np.int32)
+    eng = DecodeEngine(spec_long, params, slots=1, window=192, chunk=32)
+    r1 = eng.submit(short, 140)          # drives the tick past 130
+    r2 = eng.submit(long_p, 6)           # prefill-admitted, P=130
+    results = eng.run()
+    np.testing.assert_array_equal(
+        results[r1], _oracle(spec_long, params, short, 140))
+    np.testing.assert_array_equal(
+        results[r2], _oracle(spec_long, params, long_p, 6))
+    assert eng.stats.prefill_admissions == 1
+    assert eng.stats.prefilled_tokens == 130
+
+
 def test_engine_quantized_params(lm):
     """Weight-only int8 tree through the engine: matches the int8
     generate() oracle exactly (the tick math routes through the same
