@@ -306,13 +306,33 @@ class DecodeEngine:
         self._slot_req: List[Optional[Request]] = [None] * slots
         self.stats = EngineStats(_slots=slots)
 
-        # Engine state.  The token buffer and KV cache are
-        # DEVICE-resident: the per-chunk host traffic is only the [B]
-        # `done` vector down and the tiny [B] metadata vectors up —
-        # harvest/partial pull single finished rows.  (Pulling the whole
-        # [B, W] buffer every chunk measurably dominated the loop when
-        # ticks are cheap.)  start/p_end/end/done/active live on the
-        # host (admission edits them in numpy).
+        self._mesh = mesh
+        self._slot_axis = slot_axis
+        self._alloc_state()
+
+        # The static half of the compiled programs' signature (see the
+        # module-level _chunk_program/_prefill_program).
+        self._knobs = (self._temperature, self._top_k, self._top_p,
+                       self._eos_id)
+        # Set when a device dispatch raises mid-flight: the state
+        # buffers were DONATED to the failed program and may be invalid,
+        # so the engine refuses further use instead of decoding garbage.
+        self._poisoned = False
+
+    def _alloc_state(self) -> None:
+        """(Re)allocate the engine state.  The token buffer and KV
+        cache are DEVICE-resident: the per-chunk host traffic is only
+        the [B] `done` vector down and the tiny [B] metadata vectors up
+        — harvest/partial pull single finished rows.  (Pulling the
+        whole [B, W] buffer every chunk measurably dominated the loop
+        when ticks are cheap.)  start/p_end/end/done/active live on the
+        host (admission edits them in numpy)."""
+        slots, window, cfg = self._slots, self._window, self._cfg
+        # Drop any previous buffers BEFORE allocating: on a healthy
+        # reset() the old cache is still live, and holding both would
+        # transiently double device memory — an OOM at exactly the
+        # cache sizes the sharded path exists to serve.
+        self._tokens = self._kc = self._vc = None
         self._start = np.zeros(slots, np.int32)
         self._p_end = np.zeros(slots, np.int32)
         self._end = np.zeros(slots, np.int32)
@@ -320,9 +340,9 @@ class DecodeEngine:
         self._active = np.zeros(slots, bool)
         self._tick = 0
         heads, hd = cfg["num_heads"], cfg["head_dim"]
-        dtype = params["pos_embed"].dtype
+        dtype = self._params["pos_embed"].dtype
         cache_shape = (cfg["num_layers"], window, slots, heads, hd)
-        if mesh is None:
+        if self._mesh is None:
             # Separate buffers: kc/vc are both donated to the chunk
             # program, and donating one array through two arguments is
             # an aliasing error.
@@ -341,26 +361,31 @@ class DecodeEngine:
             # cache sizes this mode exists for.
             from jax.sharding import NamedSharding, PartitionSpec as P
 
-            row = NamedSharding(mesh, P(slot_axis))
-            cache = NamedSharding(mesh, P(None, None, slot_axis))
+            row = NamedSharding(self._mesh, P(self._slot_axis))
+            cache = NamedSharding(self._mesh,
+                                  P(None, None, self._slot_axis))
             self._tokens = _sharded_zeros(
                 (slots, window), jnp.int32, row)()
             # two separate calls -> two distinct donatable buffers
             self._kc = _sharded_zeros(cache_shape, dtype, cache)()
             self._vc = _sharded_zeros(cache_shape, dtype, cache)()
 
-        # The static half of the compiled programs' signature (see the
-        # module-level _chunk_program/_prefill_program).
-        self._knobs = (self._temperature, self._top_k, self._top_p,
-                       self._eos_id)
-        # Set when a device dispatch raises mid-flight: the state
-        # buffers were DONATED to the failed program and may be invalid,
-        # so the engine refuses further use instead of decoding garbage.
-        self._poisoned = False
-
     # ------------------------------------------------------------------
     # public API
     # ------------------------------------------------------------------
+    def reset(self) -> None:
+        """Drop ALL engine state — queued requests, in-flight slots,
+        unfetched results — and reallocate the device buffers.  This
+        also revives a poisoned engine (the compiled programs live in
+        the module-scope jit cache, so recovery from a failed dispatch
+        costs an allocation, not a recompile).  Call ``results()``
+        first if completed-but-unfetched outputs matter."""
+        self._queue.clear()
+        self._results.clear()
+        self._slot_req = [None] * self._slots
+        self._alloc_state()
+        self._poisoned = False
+
     def _check_usable(self) -> None:
         if self._poisoned:
             raise RuntimeError(

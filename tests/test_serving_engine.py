@@ -437,6 +437,14 @@ def test_engine_poisoned_after_failed_dispatch(lm, monkeypatch):
         eng.partial(0)
     assert eng.results() == {}   # host-side salvage still works
 
+    # reset() revives the engine: fresh buffers, same compiled programs
+    eng.reset()
+    prompt = np.arange(3, dtype=np.int32)
+    rid = eng.submit(prompt, 4)
+    out = eng.run()
+    np.testing.assert_array_equal(out[rid],
+                                  _oracle(spec, params, prompt, 4))
+
 
 def test_engine_validation(lm):
     spec, params = lm
