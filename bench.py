@@ -563,11 +563,13 @@ def _fill_engine(result) -> None:
         prompts = [rng.randint(0, vocab, p_len).astype(np.int32)
                    for _ in range(n_reqs)]
 
-        def build_engine():
+        def build_engine(param_tree=params):
             # chunk=32: admission latency is irrelevant for a throughput
             # benchmark, and fewer boundaries = fewer host round-trips.
-            eng = DecodeEngine(spec, params, slots=slots, window=window,
-                               chunk=32)
+            # One definition for both the fp and int8 rows so they can
+            # never drift onto different engine configs.
+            eng = DecodeEngine(spec, param_tree, slots=slots,
+                               window=window, chunk=32)
             for p, n in zip(prompts, lens):
                 eng.submit(p, int(n))
             return eng
@@ -602,6 +604,24 @@ def _fill_engine(result) -> None:
         dt_static = time.perf_counter() - t0
         result["engine_vs_static_speedup"] = round(dt_static / dt_eng, 2)
         print(json.dumps(result), flush=True)
+
+        # The deployment config: continuous batching over weight-only
+        # int8 (decode is weight-bandwidth-bound; int8 halves it).
+        try:
+            from autodist_tpu.models.quantize import quantize_lm_params
+
+            qp = quantize_lm_params(params)
+            build_engine(qp).run()            # compile warm-up
+            eng_q = build_engine(qp)
+            t0 = time.perf_counter()
+            eng_q.run()
+            dt_q = time.perf_counter() - t0
+            result["engine_int8_tokens_per_sec"] = round(
+                gen_tokens / dt_q, 1)
+            print(json.dumps(result), flush=True)
+        except Exception as e:
+            print(f"bench: int8 engine row unavailable ({e!r})",
+                  file=sys.stderr, flush=True)
     except Exception as e:
         print(f"bench: engine section unavailable ({e!r})",
               file=sys.stderr, flush=True)
