@@ -119,11 +119,13 @@ def build_hybrid_mesh(ici_axes: Dict[str, int], dcn_axes: Dict[str, int],
     exactly this DCN/ICI distinction.
 
     On real multi-slice TPU hardware the per-slice topology is read from
-    device attributes (``mesh_utils.create_hybrid_device_mesh``).  Devices
-    without slice metadata (CPU test meshes, single-slice fleets) get an
-    emulated layout: the device list is split into ``prod(dcn_axes)`` equal
-    "slices" in order, preserving the same axis semantics — each combined
-    axis is (DCN-outer, ICI-inner)."""
+    device attributes (``mesh_utils.create_hybrid_device_mesh``); a TPU
+    fleet whose metadata says ONE physical slice fails loudly rather than
+    emulate a DCN split that would actually ride ICI.  Devices without
+    slice metadata (CPU test meshes) get an emulated layout: the device
+    list is split into ``prod(dcn_axes)`` equal "slices" in order,
+    preserving the same axis semantics — each combined axis is
+    (DCN-outer, ICI-inner)."""
     if devices is None:
         devices = jax.devices()
     devices = list(devices)
@@ -148,11 +150,21 @@ def build_hybrid_mesh(ici_axes: Dict[str, int], dcn_axes: Dict[str, int],
             tuple(ici_shape), tuple(dcn_shape), devices=devices)
         return Mesh(mesh_devices, tuple(names))
 
-    # No slice metadata, or every device reports the SAME slice while a
-    # multi-slice topology was requested (CPU test meshes — incl.
-    # multi-process gloo runtimes whose CPU devices all carry
-    # slice_index=0 — and single-slice fleets): emulated layout —
-    # contiguous equal slices, DCN-outer / ICI-inner.
+    if None not in slice_ids and devices[0].platform == "tpu":
+        # Real TPU metadata says ONE physical slice, yet the caller
+        # declared a multi-slice topology: fail loudly.  Emulating here
+        # would let a misdeclared fleet run with a fabricated DCN-outer
+        # split — axes the user believes cross DCN would all ride one
+        # slice's ICI, silently mispricing every collective.
+        raise ValueError(
+            f"dcn_axes={dict(zip(names, dcn_shape))} requests "
+            f"{num_slices} slices but all {len(devices)} TPU devices "
+            f"report slice_index={next(iter(slice_ids))}; this fleet is "
+            f"single-slice (use build_mesh, or fix the topology)")
+
+    # No slice metadata (CPU test meshes — incl. multi-process gloo
+    # runtimes whose CPU devices all carry no usable slice split):
+    # emulated layout — contiguous equal slices, DCN-outer / ICI-inner.
     if len(devices) != num_slices * math.prod(ici_shape):
         raise ValueError(
             f"hybrid mesh {dict(zip(names, dcn_shape))} x "

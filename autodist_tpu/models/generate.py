@@ -76,6 +76,9 @@ def check_sampling_args(vocab: int, temperature: float, top_k: int,
     if top_k and not 0 < top_k <= vocab:
         raise ValueError(
             f"top_k must be in [1, vocab_size={vocab}], got {top_k}")
+    if top_p and not 0.0 < top_p <= 1.0:
+        raise ValueError(
+            f"top_p is a probability mass in (0, 1], got {top_p}")
     if eos_id is not None and not 0 <= eos_id < vocab:
         raise ValueError(
             f"eos_id must be in [0, vocab_size={vocab}), got {eos_id}")

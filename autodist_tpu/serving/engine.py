@@ -591,9 +591,7 @@ class DecodeEngine:
         buckets: Dict[int, Dict[bytes, list]] = {}
         for b, req in group:
             p = req.prompt.size
-            pb = 1 << (p - 1).bit_length()
-            if t0 - p + pb > self._window:
-                pb = p
+            pb = self._prompt_bucket(p, t0 - p)
             # dedup identical prompts within a bucket: computed once,
             # K/V scattered to every requesting slot
             buckets.setdefault(pb, {}).setdefault(
@@ -667,15 +665,23 @@ class DecodeEngine:
         self.stats.prefill_dedup_hits += len(flat) - k
         self.stats.prefill_dispatches += 1
 
+    def _prompt_bucket(self, prompt_size: int, write_start: int) -> int:
+        """Pow-2 compile bucket for a prompt, falling back to the exact
+        size when the padded write from ``write_start`` would overrun
+        the window (dynamic_update_slice would clamp-shift the write).
+        The single definition of the bucketing rule — the batched
+        (_flush_prefills) and sequential (_pad_bucket) admission paths
+        must never desynchronize on it."""
+        pb = 1 << (prompt_size - 1).bit_length()
+        if write_start + pb > self._window:
+            pb = prompt_size
+        return pb
+
     def _pad_bucket(self, prompt: np.ndarray, origin: int) -> jax.Array:
-        """Zero-pad ``prompt`` to its pow-2 compile bucket, falling back
-        to the exact size when the bucket would overrun the window from
-        ``origin`` (dynamic_update_slice would clamp-shift the write)."""
+        """Zero-pad ``prompt`` to its pow-2 compile bucket (see
+        :meth:`_prompt_bucket`; ``origin`` is the write start)."""
         p = prompt.size
-        pb = 1 << (p - 1).bit_length()
-        if origin + pb > self._window:
-            pb = p
-        padded = np.zeros(pb, np.int32)
+        padded = np.zeros(self._prompt_bucket(p, origin), np.int32)
         padded[:p] = prompt
         return jnp.asarray(padded)
 

@@ -103,6 +103,12 @@ def test_sampling_knob_ranges_validated(lm):
         gen(params, prompt, 4, rng=rng, temperature=0.7, top_k=-1)
     with pytest.raises(ValueError, match="num_beams"):
         gen.beam_search(params, prompt, 4, num_beams=98)
+    # top_p is a probability mass: out-of-range values previously made
+    # the nucleus filter a silent no-op instead of erroring
+    with pytest.raises(ValueError, match="top_p"):
+        gen(params, prompt, 4, rng=rng, temperature=0.7, top_p=-0.9)
+    with pytest.raises(ValueError, match="top_p"):
+        gen(params, prompt, 4, rng=rng, temperature=0.7, top_p=9.0)
     # the boundary values are legal — num_beams == vocab is exactly where
     # a wrong guard would let a -1e30 starter beam survive the first
     # top-k, so assert the winning logprob is finite and sane

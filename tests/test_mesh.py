@@ -96,6 +96,20 @@ class TestHybridMesh:
         with pytest.raises(ValueError, match="needs"):
             mesh_lib.build_hybrid_mesh({"model": 4}, {"data": 4})
 
+    def test_single_slice_tpu_fleet_fails_loudly(self):
+        """Real TPU devices all reporting slice_index=0 with a declared
+        multi-slice topology must raise, not silently emulate a DCN
+        split that would actually ride one slice's ICI."""
+
+        class FakeTpu:
+            platform = "tpu"
+            slice_index = 0
+
+        devs = [FakeTpu() for _ in range(8)]
+        with pytest.raises(ValueError, match="single-slice"):
+            mesh_lib.build_hybrid_mesh({"model": 4}, {"data": 2},
+                                       devices=devs)
+
     def test_destination_coords_map_to_slices(self):
         """PS reduction destinations resolve to the owning slice's data
         coordinate on a hybrid mesh."""
