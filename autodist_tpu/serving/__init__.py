@@ -1,10 +1,14 @@
-"""Serving-side subsystem: continuous-batching decode engine.
+"""Serving-side subsystem: continuous-batching decode engine + HTTP front.
 
 Beyond the reference (training-only — its serving story ends at
 ``SavedModelBuilder`` export, reference ``autodist/checkpoint/
 saved_model_builder.py:24-64``): a slot-based continuous-batching
-engine over the KV-cache decode path of ``models/generate.py``.
+engine over the KV-cache decode path of ``models/generate.py``, and a
+stdlib HTTP server (completions + SSE streaming + cancel + stats) in
+front of it.
 """
 from autodist_tpu.serving.engine import DecodeEngine, EngineStats, Request
+from autodist_tpu.serving.server import EngineServer, serve
 
-__all__ = ["DecodeEngine", "EngineStats", "Request"]
+__all__ = ["DecodeEngine", "EngineStats", "Request", "EngineServer",
+           "serve"]

@@ -1,0 +1,465 @@
+"""HTTP serving front for :class:`~autodist_tpu.serving.engine.DecodeEngine`.
+
+The engine is a host-side continuous-batching scheduler; this module puts a
+network boundary in front of it so the framework's serving story runs end to
+end: model → engine → deployable server.  Stdlib only (``http.server`` +
+``threading``) — no web-framework dependency to gate on.
+
+The reference has no serving subsystem at all (its execution layer stops at
+``WrappedSession.run``, ``autodist/runner.py:78-132``); this is beyond-parity
+scope layered on the engine.
+
+Design: ONE driver thread owns the decode loop (``engine.step()`` under the
+server lock — the engine is not thread-safe), handler threads submit/cancel/
+stream under the same lock (released and handed over between chunks) and
+block on a per-request Event until their request id is harvested.  Sampling
+knobs are engine-wide trace-time constants (see
+``DecodeEngine``), so the per-request surface is ``prompt`` ×
+``max_new_tokens`` × ``stream``.
+
+Endpoints
+---------
+- ``POST /v1/completions`` — body ``{"prompt_tokens": [ints],
+  "max_new_tokens": N, "stream": false}``; with a tokenizer installed,
+  ``"prompt": "text"`` is accepted and ``"text"`` is returned.  Streaming
+  responses are Server-Sent Events, one ``data:`` JSON per new-token delta.
+- ``POST /v1/cancel`` — body ``{"id": N}``.
+- ``GET /v1/stats`` — engine counters + server counters.
+- ``GET /healthz``.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import asdict
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from autodist_tpu.serving.engine import DecodeEngine
+from autodist_tpu.utils import logging
+
+_MAX_BODY_BYTES = 8 << 20
+_CANCELLED = object()   # sentinel in the done-map for cancelled requests
+
+
+class EngineServer:
+    """Serve a :class:`DecodeEngine` over HTTP.
+
+    ``tokenizer`` (optional) is any object with ``encode(str) -> list[int]``
+    and ``decode(seq[int]) -> str``; installing one enables the ``"prompt"``
+    string form and ``"text"`` in responses.
+
+    ``request_timeout_s`` bounds how long a completion request may wait
+    end-to-end before the handler answers 504 and cancels the request
+    (freeing its slot).
+    """
+
+    def __init__(self, engine: DecodeEngine, *, host: str = "127.0.0.1",
+                 port: int = 0, tokenizer=None,
+                 request_timeout_s: float = 600.0):
+        self._engine = engine
+        self._tokenizer = tokenizer
+        self._timeout = float(request_timeout_s)
+
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)        # new submits
+        # Handlers wanting the lock bump this; the driver yields to them
+        # between iterations.  Python locks are NOT fair — the driver
+        # releasing and immediately re-acquiring would otherwise starve
+        # handler threads for the whole drain (a submit could not join a
+        # running batch).  The counter has its own tiny lock: '+=' is
+        # not atomic, and a lost update would drift the count
+        # permanently.  The driver READS it unlocked — a stale read is
+        # transient and harmless.
+        self._meta_lock = threading.Lock()   # waiter count + counters
+        self._handler_waiters = 0
+        self._outstanding: set = set()
+        self._done: Dict[int, Any] = {}          # rid -> tokens | _CANCELLED
+        # Completion signalling is per-request Events, NOT a shared
+        # condition: a condition waiter re-acquires the unfair lock on
+        # notify and can starve behind the driver; Event.wait holds no
+        # lock at all.
+        self._events: Dict[int, threading.Event] = {}
+        self._engine_error: Optional[BaseException] = None
+        self._stop = False
+        self.requests_served = 0
+        self.requests_failed = 0
+
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.owner = self
+        self._driver = threading.Thread(target=self._drive,
+                                        name="engine-server-driver",
+                                        daemon=True)
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, name="engine-server-http",
+            daemon=True)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "EngineServer":
+        self._driver.start()
+        self._http_thread.start()
+        logging.info("EngineServer listening on %s:%d", *self.address)
+        return self
+
+    def close(self) -> None:
+        """Stop serving.  In-flight handler threads are woken and answer
+        503; the engine object stays usable by the caller."""
+        with self._lock:
+            self._stop = True
+            self._work.notify_all()
+            for ev in self._events.values():
+                ev.set()
+            self._events.clear()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._driver.join(timeout=10)
+
+    def __enter__(self) -> "EngineServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    @property
+    def address(self):
+        return self._httpd.server_address[:2]
+
+    # -- driver loop -------------------------------------------------------
+
+    def _drive(self) -> None:
+        # The lock is RELEASED between iterations: a handler thread must
+        # be able to submit into (or stream from) the RUNNING batch —
+        # holding the lock across the whole busy loop would serialize
+        # the server into one batch per drain, defeating continuous
+        # batching across concurrent HTTP requests.
+        while True:
+            with self._lock:
+                if self._stop:
+                    return
+                if not self._outstanding:
+                    self._work.wait(timeout=0.25)
+                    continue
+                try:
+                    self._engine.step()
+                except Exception as e:   # poisoned engine, device loss
+                    self._engine_error = e
+                    logging.error("EngineServer: engine failed: %r", e)
+                for rid, toks in self._engine.results().items():
+                    if rid in self._outstanding:
+                        self._outstanding.discard(rid)
+                        self._done[rid] = toks
+                        ev = self._events.pop(rid, None)
+                        if ev is not None:
+                            ev.set()
+                if self._engine_error is not None:
+                    # In-flight work is lost (donated buffers); fail the
+                    # waiters loudly rather than hang them to timeout.
+                    self._outstanding.clear()
+                    for ev in self._events.values():
+                        ev.set()
+                    self._events.clear()
+                    return
+            if self._handler_waiters:
+                time.sleep(0.001)   # hand the lock to a waiting handler
+
+    # -- request plumbing (called from handler threads) --------------------
+
+    def _locked(self):
+        """Handler-side lock acquisition, counted so the driver loop
+        yields to it (see ``_handler_waiters``)."""
+        return _CountedLock(self)
+
+    def _submit(self, prompt: np.ndarray, max_new: int) -> int:
+        with self._locked():
+            if self._stop or self._engine_error is not None:
+                raise _Unavailable()
+            rid = self._engine.submit(prompt, max_new)
+            self._outstanding.add(rid)
+            self._events[rid] = threading.Event()
+            self._work.notify()
+            return rid
+
+    def _wait(self, rid: int, timeout_s: float) -> Any:
+        """Block until ``rid`` is harvested; returns its tokens.  Waits
+        on the request's own Event (no shared-lock contention)."""
+        with self._locked():
+            ev = self._events.get(rid)
+        if ev is not None and not ev.wait(timeout=timeout_s):
+            with self._locked():
+                # Re-check under the lock: the driver may have set the
+                # event between the timeout and here.
+                if rid not in self._done:
+                    # Nobody is waiting any more: cancel (frees the
+                    # slot instead of decoding unread tokens) and drop
+                    # the bookkeeping so a racing harvest is discarded,
+                    # not leaked.
+                    self._engine.cancel(rid)
+                    self._outstanding.discard(rid)
+                    self._events.pop(rid, None)
+                    raise _Timeout()
+        with self._locked():
+            if rid not in self._done:
+                raise _Unavailable()   # stop or engine failure
+            return self._done.pop(rid)
+
+    def _cancel(self, rid: int) -> bool:
+        with self._locked():
+            ok = self._engine.cancel(rid)
+            if ok and rid in self._outstanding:
+                self._outstanding.discard(rid)
+                self._done[rid] = _CANCELLED
+                ev = self._events.pop(rid, None)
+                if ev is not None:
+                    ev.set()
+            return ok
+
+    def _snapshot(self, rid: int):
+        """Streaming read: (tokens_so_far, done) for an in-flight rid."""
+        with self._locked():
+            if rid in self._done:
+                return self._done[rid], True
+            if self._engine_error is not None or self._stop:
+                raise _Unavailable()
+            part = self._engine.partial(rid)
+            return part, False
+
+    def _finish_stream(self, rid: int) -> Any:
+        with self._locked():
+            self._events.pop(rid, None)
+            return self._done.pop(rid, None)
+
+    def count_request(self, *, served: bool) -> None:
+        """Bump the served/failed counter (handler threads race here;
+        '+=' alone loses updates)."""
+        with self._meta_lock:
+            if served:
+                self.requests_served += 1
+            else:
+                self.requests_failed += 1
+
+    def stats(self) -> Dict[str, Any]:
+        with self._locked():
+            # Counters accumulate numpy scalars (+= np.int32); coerce so
+            # json.dumps never trips on a dtype.
+            st = {k: int(v) for k, v in asdict(self._engine.stats).items()
+                  if not k.startswith("_")}
+            st["slot_utilization"] = round(
+                self._engine.stats.slot_utilization, 4)
+            st["outstanding"] = len(self._outstanding)
+            st["requests_served"] = self.requests_served
+            st["requests_failed"] = self.requests_failed
+            st["engine_failed"] = self._engine_error is not None
+            return st
+
+    # -- body parsing ------------------------------------------------------
+
+    def parse_prompt(self, body: Dict[str, Any]) -> np.ndarray:
+        if "prompt_tokens" in body:
+            toks = body["prompt_tokens"]
+            if (not isinstance(toks, list) or not toks
+                    or not all(isinstance(t, int) for t in toks)):
+                raise ValueError(
+                    "prompt_tokens must be a non-empty list of ints")
+            return np.asarray(toks, np.int32)
+        if "prompt" in body:
+            if self._tokenizer is None:
+                raise ValueError(
+                    "server has no tokenizer: send prompt_tokens "
+                    "(a list of token ids) instead of prompt text")
+            return np.asarray(self._tokenizer.encode(body["prompt"]),
+                              np.int32)
+        raise ValueError("body needs prompt_tokens (or prompt, "
+                         "with a tokenizer installed)")
+
+    def render(self, rid: int, tokens: np.ndarray,
+               prompt_len: int) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "id": rid,
+            "tokens": [int(t) for t in tokens],
+            "new_tokens": [int(t) for t in tokens[prompt_len:]],
+        }
+        if self._tokenizer is not None:
+            out["text"] = self._tokenizer.decode(out["tokens"])
+        return out
+
+
+class _CountedLock:
+    """Context manager acquiring the server lock with the handler-waiter
+    count bumped, so the driver loop yields between iterations."""
+
+    def __init__(self, srv: "EngineServer"):
+        self._srv = srv
+
+    def __enter__(self):
+        with self._srv._meta_lock:
+            self._srv._handler_waiters += 1
+        try:
+            self._srv._lock.acquire()
+        finally:
+            with self._srv._meta_lock:
+                self._srv._handler_waiters -= 1
+
+    def __exit__(self, *exc):
+        self._srv._lock.release()
+
+
+class _Unavailable(Exception):
+    pass
+
+
+class _Timeout(Exception):
+    pass
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # Quiet the default per-request stderr lines; route to our logger.
+    def log_message(self, fmt, *args):   # noqa: N802 (stdlib name)
+        logging.debug("EngineServer http: " + fmt, *args)
+
+    def _json(self, code: int, payload: Dict[str, Any]) -> None:
+        data = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _body(self) -> Dict[str, Any]:
+        n = int(self.headers.get("Content-Length", 0))
+        if n > _MAX_BODY_BYTES:
+            raise ValueError(f"body too large ({n} bytes)")
+        body = json.loads(self.rfile.read(n) or b"{}")
+        if not isinstance(body, dict):
+            raise ValueError("body must be a JSON object")
+        return body
+
+    def do_GET(self) -> None:   # noqa: N802
+        srv: EngineServer = self.server.owner
+        if self.path == "/healthz":
+            self._json(200, {"ok": srv._engine_error is None
+                             and not srv._stop})
+        elif self.path == "/v1/stats":
+            self._json(200, srv.stats())
+        else:
+            self._json(404, {"error": f"unknown path {self.path}"})
+
+    def do_POST(self) -> None:   # noqa: N802
+        srv: EngineServer = self.server.owner
+        try:
+            body = self._body()
+        except (ValueError, json.JSONDecodeError) as e:
+            self._json(400, {"error": str(e)})
+            return
+        if self.path == "/v1/completions":
+            self._completions(srv, body)
+        elif self.path == "/v1/cancel":
+            rid = body.get("id")
+            if not isinstance(rid, int):
+                self._json(400, {"error": "cancel needs an integer id"})
+            else:
+                self._json(200, {"id": rid,
+                                 "cancelled": srv._cancel(rid)})
+        else:
+            self._json(404, {"error": f"unknown path {self.path}"})
+
+    def _completions(self, srv: EngineServer, body: Dict[str, Any]) -> None:
+        try:
+            prompt = srv.parse_prompt(body)
+            max_new = body.get("max_new_tokens", 16)
+            if not isinstance(max_new, int):
+                raise ValueError("max_new_tokens must be an int")
+            rid = srv._submit(prompt, max_new)
+        except _Unavailable:
+            self._json(503, {"error": "engine unavailable"})
+            return
+        except ValueError as e:   # engine/body validation, loud and typed
+            srv.count_request(served=False)
+            self._json(400, {"error": str(e)})
+            return
+        if body.get("stream"):
+            self._stream(srv, rid, prompt.size)
+            return
+        try:
+            tokens = srv._wait(rid, srv._timeout)
+        except _Timeout:
+            srv.count_request(served=False)
+            self._json(504, {"error": f"request {rid} timed out and was "
+                             f"cancelled", "id": rid})
+            return
+        except _Unavailable:
+            srv.count_request(served=False)
+            self._json(503, {"error": "engine unavailable", "id": rid})
+            return
+        if tokens is _CANCELLED:
+            self._json(409, {"error": f"request {rid} was cancelled",
+                             "id": rid})
+            return
+        srv.count_request(served=True)
+        self._json(200, srv.render(rid, tokens, prompt.size))
+
+    def _stream(self, srv: EngineServer, rid: int, prompt_len: int) -> None:
+        """SSE: one ``data:`` event per new-token delta, final event
+        carries the full result.  Deltas surface at chunk boundaries
+        (the engine's streaming granularity, ``DecodeEngine.partial``).
+        ``request_timeout_s`` applies here too: an expired stream is
+        cancelled (slot freed) with a final timeout event."""
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.end_headers()
+
+        def emit(payload: Dict[str, Any]) -> None:
+            self.wfile.write(b"data: " + json.dumps(payload).encode()
+                             + b"\n\n")
+            self.wfile.flush()
+
+        sent = prompt_len
+        deadline = time.monotonic() + srv._timeout
+        try:
+            while True:
+                try:
+                    snap, done = srv._snapshot(rid)
+                except _Unavailable:
+                    emit({"id": rid, "error": "engine unavailable"})
+                    return
+                if not done and time.monotonic() > deadline:
+                    srv._cancel(rid)
+                    srv._finish_stream(rid)
+                    srv.count_request(served=False)
+                    emit({"id": rid, "done": True, "timeout": True})
+                    return
+                if done:
+                    tokens = srv._finish_stream(rid)
+                    if tokens is _CANCELLED or tokens is None:
+                        emit({"id": rid, "done": True, "cancelled": True})
+                    else:
+                        srv.count_request(served=True)
+                        final = srv.render(rid, tokens, prompt_len)
+                        final["done"] = True
+                        emit(final)
+                    return
+                if snap is not None and snap.size > sent:
+                    emit({"id": rid, "done": False,
+                          "new_tokens": [int(t) for t in snap[sent:]]})
+                    sent = int(snap.size)
+                time.sleep(0.02)   # poll cadence between chunk boundaries
+        except (BrokenPipeError, ConnectionResetError):
+            # Client hung up mid-stream: free the slot instead of
+            # decoding tokens nobody will read.
+            srv._cancel(rid)
+            srv._finish_stream(rid)
+
+
+def serve(spec, params, *, host: str = "127.0.0.1", port: int = 8000,
+          tokenizer=None, **engine_kwargs) -> EngineServer:
+    """Build a :class:`DecodeEngine` over ``(spec, params)`` and start an
+    :class:`EngineServer` on it.  ``engine_kwargs`` pass through to the
+    engine (slots, window, chunk, sampling knobs, mesh, ...)."""
+    eng = DecodeEngine(spec, params, **engine_kwargs)
+    return EngineServer(eng, host=host, port=port,
+                        tokenizer=tokenizer).start()
