@@ -133,6 +133,14 @@ def test_serving_engine_example():
     assert "slot_utilization=" in out
 
 
+def test_serve_http_example():
+    # The example is its own HTTP client (concurrent completions + one
+    # SSE stream + stats) and asserts 200s internally.
+    out = _run_example("examples/serve_http.py")
+    assert "serve_http demo OK" in out
+    assert "stream:" in out
+
+
 @pytest.mark.integration
 def test_speculative_draft_example():
     # Trains a target (framework session) and a ~30x-smaller draft,
