@@ -1,10 +1,12 @@
-"""Native host runtime: buffer pool, prefetching data loader, bf16 cast.
+"""Native host runtime: buffer pool, prefetching data loader, bf16 cast,
+byte-level BPE tokenizer.
 
 The device-side runtime on TPU is XLA/PJRT (the analog of the TF C++ runtime
 the reference delegated to, SURVEY.md §2.9); this package is the *host*-side
 native layer — the piece that must overlap with device steps to keep the MXU
-fed.
+fed (and, for serving, keep per-request encode latency off the decode loop).
 """
 from autodist_tpu.runtime.data_loader import DataLoader  # noqa: F401
 from autodist_tpu.runtime.native import (fp32_to_bf16,  # noqa: F401
                                          native_available)
+from autodist_tpu.runtime.tokenizer import BPETokenizer  # noqa: F401

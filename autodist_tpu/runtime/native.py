@@ -26,13 +26,22 @@ _lib_lock = threading.Lock()
 _build_failed = False
 
 
+_SRC_NAMES = ("runtime.cpp", "tokenizer.cpp")
+
+
+def _src_mtime() -> float:
+    """Newest mtime across the sources compiled into the library."""
+    return max(os.path.getmtime(os.path.join(_NATIVE_DIR, n))
+               for n in _SRC_NAMES if os.path.exists(
+                   os.path.join(_NATIVE_DIR, n)))
+
+
 def _build_and_load() -> Optional[ctypes.CDLL]:
     lib_path = os.path.join(_NATIVE_DIR, _LIB_NAME)
-    src_path = os.path.join(_NATIVE_DIR, "runtime.cpp")
-    if not os.path.exists(src_path):
+    if not os.path.exists(os.path.join(_NATIVE_DIR, "runtime.cpp")):
         return None
     if (not os.path.exists(lib_path)
-            or os.path.getmtime(lib_path) < os.path.getmtime(src_path)):
+            or os.path.getmtime(lib_path) < _src_mtime()):
         # Serialize concurrent builds across processes (several workers can
         # land on one host): flock a sidecar, then re-check staleness — the
         # loser of the race finds a fresh .so and skips its own make.
@@ -43,8 +52,7 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
             with open(lock_path, "w") as lock_f:
                 fcntl.flock(lock_f, fcntl.LOCK_EX)
                 if (not os.path.exists(lib_path)
-                        or os.path.getmtime(lib_path)
-                        < os.path.getmtime(src_path)):
+                        or os.path.getmtime(lib_path) < _src_mtime()):
                     subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
                                    capture_output=True)
         except (subprocess.CalledProcessError, OSError) as e:
@@ -61,6 +69,20 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
         logging.warning("could not load %s: %s", lib_path, e)
         return None
 
+    try:
+        _bind_signatures(lib)
+    except AttributeError as e:
+        # A stale prebuilt .so missing newer symbols (copied artifact,
+        # mtime-preserving sync): honor the module contract — fall back
+        # to pure Python everywhere rather than raise from get_lib().
+        logging.warning("native runtime library is stale (%s); using "
+                        "pure-Python fallback — run `make -C native` to "
+                        "rebuild", e)
+        return None
+    return lib
+
+
+def _bind_signatures(lib: ctypes.CDLL) -> None:
     lib.ad_buffer_alloc.restype = ctypes.c_void_p
     lib.ad_buffer_alloc.argtypes = [ctypes.c_size_t, ctypes.c_size_t]
     lib.ad_buffer_free.argtypes = [ctypes.c_void_p]
@@ -81,7 +103,14 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
     lib.ad_loader_num_batches.restype = ctypes.c_size_t
     lib.ad_loader_num_batches.argtypes = [ctypes.c_void_p]
     lib.ad_loader_destroy.argtypes = [ctypes.c_void_p]
-    return lib
+    lib.ad_bpe_create.restype = ctypes.c_void_p
+    lib.ad_bpe_create.argtypes = [ctypes.POINTER(ctypes.c_int32),
+                                  ctypes.c_int32]
+    lib.ad_bpe_encode.restype = ctypes.c_int32
+    lib.ad_bpe_encode.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                  ctypes.c_int32,
+                                  ctypes.POINTER(ctypes.c_int32)]
+    lib.ad_bpe_destroy.argtypes = [ctypes.c_void_p]
 
 
 def get_lib() -> Optional[ctypes.CDLL]:

@@ -1,0 +1,178 @@
+"""Byte-level BPE tokenizer: native encode (``native/tokenizer.cpp``)
+with a bit-identical pure-Python fallback, a pure-Python trainer, and a
+JSON file format.
+
+The reference framework has no text pipeline (its examples feed
+pre-tokenized ids — e.g. lm1b's pre-built vocab files); this completes
+the TPU build's serving story: :class:`BPETokenizer` plugs directly into
+``EngineServer(tokenizer=...)`` so ``{"prompt": "text"}`` round-trips.
+
+Model: the 256 single bytes are the base vocabulary (ids 0..255 — every
+string is encodable, no unknown tokens), merges apply in rank order with
+repeated-best-merge semantics (global lowest rank, leftmost occurrence
+first).  No regex pretokenization — merges may cross word boundaries;
+for the model sizes this framework serves that trade-off favors the
+simpler, exactly-reproducible pipeline.
+"""
+from __future__ import annotations
+
+import ctypes
+import json
+import threading
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from autodist_tpu.runtime import native
+
+_BASE = 256
+
+
+class BPETokenizer:
+    """``merges`` is rank-ordered ``(left_id, right_id, new_id)``; new ids
+    must start at 256 (the byte base vocab is implicit)."""
+
+    def __init__(self, merges: Sequence[Tuple[int, int, int]]):
+        self.merges: List[Tuple[int, int, int]] = [
+            (int(a), int(b), int(c)) for a, b, c in merges]
+        # token id -> bytes (decode table)
+        self._bytes: List[bytes] = [bytes([i]) for i in range(_BASE)]
+        for left, right, out in self.merges:
+            if out != len(self._bytes):
+                raise ValueError(
+                    f"merge output ids must be dense from {_BASE}: "
+                    f"expected {len(self._bytes)}, got {out}")
+            if not (0 <= left < out and 0 <= right < out):
+                raise ValueError(f"merge ({left},{right})->{out} refers "
+                                 f"to an id not yet defined")
+            self._bytes.append(self._bytes[left] + self._bytes[right])
+        # (left, right) -> (rank, new_id); first rank wins on duplicates.
+        self._ranks: Dict[Tuple[int, int], Tuple[int, int]] = {}
+        for rank, (left, right, out) in enumerate(self.merges):
+            self._ranks.setdefault((left, right), (rank, out))
+        self._native: Optional[ctypes.c_void_p] = None
+        self._native_tried = False
+        # encode() is called from concurrent server handler threads;
+        # without this lock two first encodes could both ad_bpe_create
+        # and leak one native handle.
+        self._native_lock = threading.Lock()
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self._bytes)
+
+    # -- encode / decode ---------------------------------------------------
+
+    def _get_native(self):
+        with self._native_lock:
+            if not self._native_tried:
+                self._native_tried = True
+                lib = native.get_lib()
+                if lib is not None and self.merges:
+                    flat = np.asarray(self.merges, np.int32).reshape(-1)
+                    self._native = lib.ad_bpe_create(
+                        flat.ctypes.data_as(
+                            ctypes.POINTER(ctypes.c_int32)),
+                        np.int32(len(self.merges)))
+            return self._native
+
+    def encode(self, text: str) -> List[int]:
+        data = text.encode("utf-8")
+        if not data:
+            return []
+        handle = self._get_native()
+        if handle is not None:
+            lib = native.get_lib()
+            out = np.empty(len(data), np.int32)
+            n = lib.ad_bpe_encode(
+                handle, data, np.int32(len(data)),
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+            return out[:n].tolist()
+        return self._encode_py(data)
+
+    def _encode_py(self, data: bytes) -> List[int]:
+        """Pure-Python reference: must match the native loop exactly —
+        repeatedly merge the globally lowest-rank pair, leftmost
+        occurrence first."""
+        ids = list(data)
+        ranks = self._ranks
+        while True:
+            best_rank, best_pos = None, -1
+            for i in range(len(ids) - 1):
+                r = ranks.get((ids[i], ids[i + 1]))
+                if r is not None and (best_rank is None
+                                      or r[0] < best_rank[0]):
+                    best_rank, best_pos = r, i
+            if best_pos < 0:
+                break
+            ids[best_pos:best_pos + 2] = [best_rank[1]]
+        return ids
+
+    def decode(self, ids: Iterable[int]) -> str:
+        buf = b"".join(self._bytes[i] for i in ids)
+        return buf.decode("utf-8", errors="replace")
+
+    # -- persistence -------------------------------------------------------
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump({"format": "autodist-bpe-v1",
+                       "merges": self.merges}, f)
+
+    @classmethod
+    def load(cls, path: str) -> "BPETokenizer":
+        with open(path) as f:
+            obj = json.load(f)
+        if obj.get("format") != "autodist-bpe-v1":
+            raise ValueError(f"{path}: not an autodist-bpe-v1 file")
+        return cls(obj["merges"])
+
+    # -- training ----------------------------------------------------------
+
+    @classmethod
+    def train(cls, texts: Iterable[str], vocab_size: int) -> "BPETokenizer":
+        """Learn merges by iterated most-frequent-pair counting (the
+        classic BPE trainer) until ``vocab_size`` is reached or no pair
+        repeats.  Pure Python — training is offline/one-time; encode is
+        the hot path and is native."""
+        if vocab_size < _BASE:
+            raise ValueError(f"vocab_size must be >= {_BASE}")
+        corpus: List[List[int]] = [list(t.encode("utf-8")) for t in texts
+                                   if t]
+        merges: List[Tuple[int, int, int]] = []
+        next_id = _BASE
+        while next_id < vocab_size:
+            counts: Dict[Tuple[int, int], int] = {}
+            for seq in corpus:
+                for i in range(len(seq) - 1):
+                    pair = (seq[i], seq[i + 1])
+                    counts[pair] = counts.get(pair, 0) + 1
+            if not counts:
+                break
+            # Deterministic: max count, ties by smallest pair ids.
+            pair, cnt = min(counts.items(), key=lambda kv: (-kv[1], kv[0]))
+            if cnt < 2:
+                break
+            merges.append((pair[0], pair[1], next_id))
+            for seq in corpus:
+                i, out = 0, []
+                while i < len(seq):
+                    if (i + 1 < len(seq)
+                            and (seq[i], seq[i + 1]) == pair):
+                        out.append(next_id)
+                        i += 2
+                    else:
+                        out.append(seq[i])
+                        i += 1
+                seq[:] = out
+            next_id += 1
+        return cls(merges)
+
+    def __del__(self):  # pragma: no cover - interpreter teardown
+        try:
+            if self._native is not None:
+                lib = native.get_lib()
+                if lib is not None:
+                    lib.ad_bpe_destroy(self._native)
+        except Exception:
+            pass

@@ -72,6 +72,8 @@ def main():
         t.start()
     for t in threads:
         t.join()
+    assert len(outs) == len(threads), \
+        f"only {len(outs)}/{len(threads)} client threads completed"
     for i in sorted(outs):
         status, body = outs[i]
         assert status == 200, body

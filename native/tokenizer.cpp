@@ -1,0 +1,93 @@
+// Native byte-level BPE tokenizer for the serving path.
+//
+// The reference framework has no text pipeline at all (its examples feed
+// pre-tokenized ids); this rounds out the TPU build's serving story:
+// EngineServer's text mode needs an encode/decode pair, and encode is a
+// host-side hot loop (per-request, latency-sensitive) — exactly the kind
+// of work the native runtime layer exists for (cf. runtime.cpp's loader).
+//
+// Model: plain byte-level BPE, no regex pretokenization — every byte is a
+// base token (the Python side guarantees ids 0..255 are the single bytes),
+// then ranked pair merges apply in rank order.  Encode is the standard
+// repeated-best-merge loop over a doubly-linked symbol list:
+// O(n * merges_applied) with an O(1) pair-rank hash lookup.
+//
+// C ABI (ctypes-bound in autodist_tpu/runtime/tokenizer.py, pure-Python
+// fallback there must match bit-for-bit):
+//   ad_bpe_create(merges[n*3] as (left,right,new_id) in rank order)
+//   ad_bpe_encode(text bytes -> out_ids, returns count)
+//   ad_bpe_destroy
+#include <cstddef>
+#include <cstdint>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Bpe {
+  // (left_id << 32 | right_id) -> (rank << 32 | new_id)
+  std::unordered_map<uint64_t, uint64_t> ranks;
+};
+
+inline uint64_t pair_key(int32_t a, int32_t b) {
+  return (static_cast<uint64_t>(static_cast<uint32_t>(a)) << 32) |
+         static_cast<uint32_t>(b);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ad_bpe_create(const int32_t* merges, int32_t n_merges) {
+  Bpe* t = new Bpe();
+  t->ranks.reserve(static_cast<size_t>(n_merges) * 2);
+  for (int32_t r = 0; r < n_merges; ++r) {
+    const int32_t left = merges[3 * r], right = merges[3 * r + 1],
+                  out = merges[3 * r + 2];
+    // First (lowest) rank wins on duplicates, matching the fallback.
+    t->ranks.emplace(pair_key(left, right),
+                     (static_cast<uint64_t>(r) << 32) |
+                         static_cast<uint32_t>(out));
+  }
+  return t;
+}
+
+void ad_bpe_destroy(void* tok) { delete static_cast<Bpe*>(tok); }
+
+// Encode n bytes of text; out_ids must hold >= n entries (merges only
+// shrink the sequence).  Returns the id count.
+int32_t ad_bpe_encode(void* tok, const uint8_t* text, int32_t n,
+                      int32_t* out_ids) {
+  const Bpe* t = static_cast<const Bpe*>(tok);
+  if (n <= 0) return 0;
+  // Singly-linked list over a flat arena: next indices, -1 = end
+  // (merges always absorb the successor, so no prev links needed).
+  std::vector<int32_t> id(n), next(n);
+  for (int32_t i = 0; i < n; ++i) {
+    id[i] = text[i];  // base tokens ARE the bytes
+    next[i] = (i + 1 < n) ? i + 1 : -1;
+  }
+  const int32_t head = 0;
+  while (true) {
+    // Find the lowest-rank applicable pair.
+    uint64_t best = ~0ull;
+    int32_t best_pos = -1;
+    for (int32_t i = head; i != -1 && next[i] != -1; i = next[i]) {
+      auto it = t->ranks.find(pair_key(id[i], id[next[i]]));
+      if (it != t->ranks.end() && it->second < best) {
+        best = it->second;
+        best_pos = i;
+      }
+    }
+    if (best_pos == -1) break;
+    // Merge best_pos with its successor (leftmost occurrence merges
+    // first on rank ties along the scan — the fallback matches).
+    id[best_pos] = static_cast<int32_t>(best & 0xffffffffu);
+    next[best_pos] = next[next[best_pos]];
+  }
+  int32_t count = 0;
+  for (int32_t i = head; i != -1; i = next[i]) out_ids[count++] = id[i];
+  return count;
+}
+
+}  // extern "C"
