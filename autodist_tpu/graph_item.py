@@ -54,6 +54,14 @@ def _apply_remat(loss_fn: Optional[Callable], remat: Optional[str]
     return jax.checkpoint(loss_fn, policy=policy)
 
 
+def match_var_name(name: str, patterns: Tuple[str, ...]) -> bool:
+    """Public alias of the variable-pattern rule used by ``capture()``'s
+    sparse/untrainable/pipeline/expert arguments (exact, path-prefix, or
+    glob) — for callers building their own selections (e.g. LoRA
+    targets) that must read identically."""
+    return GraphItem._matches(name, patterns)
+
+
 def path_name(path: Tuple) -> str:
     """Human-readable, stable name for a pytree key path: parts joined by '/'.
 
@@ -143,8 +151,11 @@ class GraphItem:
         embedding structure — the analog of the reference detecting
         ``IndexedSlices`` gradients (graph_item.py:275-296).  Strategy
         builders treat these differently (e.g. Parallax, parallax_strategy.py:24-71).
-      untrainable_vars: names (or prefixes) excluded from synchronization,
-        e.g. batch-norm statistics.
+      untrainable_vars: names (or prefixes) FROZEN for the whole run:
+        excluded from synchronization, zero updates, and no optimizer
+        state (``frozen_aware_optimizer``) — batch-norm statistics, or
+        the base model under parameter-efficient finetuning
+        (``models/lora.py``).
       pipeline_vars: names (or prefixes) of variables whose LEADING axis is a
         pipeline-stage axis (stage-stacked parameters,
         ``autodist_tpu/parallel/pipeline.py``); the compiler shards it over
@@ -250,6 +261,29 @@ class GraphItem:
     def name_to_leaf(self) -> Dict[str, Any]:
         leaves = jax.tree_util.tree_flatten_with_path(self.params)[0]
         return {path_name(p): leaf for p, leaf in leaves}
+
+    def frozen_aware_optimizer(self, params: Any = None):
+        """``self.optimizer`` wrapped so untrainable variables get ZERO
+        updates and NO optimizer state (``optax.set_to_zero`` carries
+        none) — the memory contract parameter-efficient finetuning
+        (``models/lora.py``) relies on; XLA dead-code-eliminates the
+        frozen update math.  Identity when nothing is frozen.  ``params``
+        defaults to the captured tree; pass the PHYSICAL (padded) tree
+        when the step state is padded (same structure, so labels match
+        either way).  Reference analog: collection membership — variables
+        outside TRAINABLE_VARIABLES never reach the optimizer
+        (reference graph_item.py:111-214 trainable split)."""
+        frozen = {v.name for v in self.info.untrainable_variables}
+        if not frozen:
+            return self.optimizer
+        import optax
+
+        labels = jax.tree_util.tree_map_with_path(
+            lambda path, _: "frozen" if path_name(path) in frozen
+            else "train", self.params if params is None else params)
+        return optax.multi_transform(
+            {"train": self.optimizer, "frozen": optax.set_to_zero()},
+            labels)
 
     def prepare(self) -> "GraphItem":
         """Refresh the catalog (parity: graph_item.prepare(),

@@ -271,10 +271,14 @@ class GraphTransformer:
         # needs an ambient mesh at trace time, which jit tracing doesn't have.
         grad_sh = su.sharding_tree(mesh, grad_spec_tree)
 
+        # Freeze untrainable variables for real (zero updates, no
+        # optimizer state) — see GraphItem.frozen_aware_optimizer.
+        optimizer = gi.frozen_aware_optimizer(phys_params)
+
         # Optimizer-state layout: param-shaped blocks follow the per-variable
         # opt_spec (weight-update sharding for PS vars); scalars replicate.
         # Shapes are PHYSICAL (the state the step carries is padded).
-        opt_shape = jax.eval_shape(gi.optimizer.init, phys_params)
+        opt_shape = jax.eval_shape(optimizer.init, phys_params)
         opt_spec_tree = su.opt_spec_tree(opt_shape, phys_params, grad_spec_tree)
         opt_sh = su.sharding_tree(mesh, opt_spec_tree)
 
@@ -293,7 +297,6 @@ class GraphTransformer:
                 vg = user_grad
         else:
             vg = jax.value_and_grad(loss_fn, has_aux=gi.has_aux)
-        optimizer = gi.optimizer
         has_aux = gi.has_aux
         if gi.accum_steps > 1:
             vg = _accumulate_grads(vg, gi.accum_steps, has_aux)
@@ -380,7 +383,7 @@ class GraphTransformer:
         eval_fn = jax.jit(
             _make_eval_step(loss_fn, has_aux, extra_metrics_fn),
             in_shardings=(param_sh, None))
-        init_fn = jax.jit(gi.optimizer.init, out_shardings=opt_sh)
+        init_fn = jax.jit(optimizer.init, out_shardings=opt_sh)
         if stale is None:
             def init_sync_state(current_params=None):
                 return {}
@@ -408,7 +411,7 @@ class GraphTransformer:
             logical_grad_specs = self._logical_specs(self._opt_specs())
             logical_param_sh = su.sharding_tree(
                 mesh, su.spec_tree_for_params(params, logical_param_specs))
-            opt_shape_logical = jax.eval_shape(gi.optimizer.init, params)
+            opt_shape_logical = jax.eval_shape(optimizer.init, params)
             logical_opt_sh = su.sharding_tree(mesh, su.opt_spec_tree(
                 opt_shape_logical, params,
                 su.spec_tree_for_params(params, logical_grad_specs)))

@@ -84,7 +84,7 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy,
         # compressed all-reduce per step, N microbatches of activations.
         from autodist_tpu.kernel.graph_transformer import _accumulate_grads
         vg = _accumulate_grads(vg, gi.accum_steps, gi.has_aux)
-    optimizer = gi.optimizer
+    optimizer = gi.frozen_aware_optimizer()
     has_aux = gi.has_aux
 
     # Trace-time fusion table (reference chunk merge): vars in the same

@@ -18,6 +18,11 @@ from autodist_tpu.models.speculative import (  # noqa: F401
 from autodist_tpu.models.densenet import densenet121  # noqa: F401
 from autodist_tpu.models.inception import inception_v3  # noqa: F401
 from autodist_tpu.models.lm1b import lm1b  # noqa: F401
+from autodist_tpu.models.lora import (  # noqa: F401
+    lora_init,
+    lora_merge,
+    lora_setup,
+)
 from autodist_tpu.models.moe_lm import moe_transformer_lm  # noqa: F401
 from autodist_tpu.models.ncf import ncf  # noqa: F401
 from autodist_tpu.models.pipelined_lm import pipelined_transformer_lm  # noqa: F401

@@ -1,0 +1,164 @@
+"""LoRA: low-rank adaptation for parameter-efficient finetuning.
+
+Beyond the reference (training-only, full-parameter — it has no
+finetuning story): freeze the base model, train rank-``r`` adapter pairs
+``(A, B)`` on selected kernels (2-D by default; N-D DenseGeneral-style
+kernels via a fan-in split), where the effective weight is
+``W + (alpha / r) * A @ B`` with ``B`` zero-initialized (the adapted
+model starts EXACTLY at the base model).
+
+Composition with the framework is structural, not special-cased:
+
+* the captured tree is ``{"base": params, "lora": adapters}`` with
+  ``untrainable_vars=("base",)`` — the freeze machinery
+  (``GraphItem.frozen_aware_optimizer``) gives the base zero updates and
+  NO optimizer state, so optimizer memory scales with the adapters
+  (the point of LoRA), and the strategy layer syncs only adapter grads;
+* any strategy builder / mesh / remat / accum composes unchanged.
+
+Usage::
+
+    setup = lora_setup(params, spec.loss_fn, rank=8,
+                       rng=jax.random.PRNGKey(0))
+    with ad.scope():
+        ad.capture(**setup.capture_args, optimizer=optax.adamw(1e-3))
+    sess = ad.create_distributed_session()
+    ...train...
+    merged = setup.merge(sess.params)   # plain params tree for serving
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from autodist_tpu.graph_item import match_var_name, path_name
+
+
+def _match(name: str, patterns: Sequence[str]) -> bool:
+    # Same exact/prefix/glob semantics as capture()'s variable patterns,
+    # so LoRA targets read like untrainable_vars.
+    return match_var_name(name, tuple(patterns))
+
+
+def _resolve_split(name: str, shape, targets) -> Optional[int]:
+    """How many leading dims form the fan-in for this leaf, or None when
+    the leaf is not adapted.  ``targets`` entries are patterns or
+    ``(pattern, split)`` pairs — first match wins.  The split covers
+    DenseGeneral-style N-D kernels: a ``[d_model, heads, head_dim]``
+    projection splits at 1, its ``[heads, head_dim, d_model]`` output
+    projection at 2.  Default targets (None): every 2-D leaf."""
+    if targets is None:
+        return 1 if len(shape) == 2 else None
+    for entry in targets:
+        pattern, split = entry if isinstance(entry, tuple) else (entry, 1)
+        if _match(name, (pattern,)):
+            if len(shape) < 2:
+                raise ValueError(
+                    f"LoRA target {name} has shape {shape}; need >= 2 "
+                    f"dims to adapt")
+            if not 0 < split < len(shape):
+                raise ValueError(
+                    f"LoRA target {name}: split {split} out of range "
+                    f"for shape {shape} (use (pattern, split) with "
+                    f"0 < split < ndim)")
+            return split
+    return None
+
+
+def lora_init(rng: jax.Array, params: Any, *, rank: int = 8,
+              targets: Optional[Sequence] = None) -> Any:
+    """Build the adapter tree: for every matched leaf,
+    ``{"a": [fan_in, r] (scaled normal), "b": [r, fan_out] (zeros)}``;
+    non-target leaves are absent.  ``targets`` entries are name patterns
+    (exact/prefix/glob, like ``untrainable_vars``) or ``(pattern,
+    split)`` pairs for N-D kernels (see :func:`_resolve_split`); default
+    is all 2-D leaves.  Returned tree is a flat ``{var_name: {"a","b"}}``
+    dict keyed by the leaf's dotted path name (stable across pad/shard
+    transforms)."""
+    if rank < 1:
+        raise ValueError(f"rank must be >= 1, got {rank}")
+    import math
+
+    leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+    adapters: Dict[str, Any] = {}
+    for path, leaf in leaves:
+        name = path_name(path)
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        split = _resolve_split(name, shape, targets)
+        if split is None:
+            continue
+        fan_in = math.prod(shape[:split])
+        fan_out = math.prod(shape[split:])
+        rng, sub = jax.random.split(rng)
+        adapters[name.replace("/", ".")] = {
+            # He-style fan-in scaling on A; B zero => delta starts at 0.
+            "a": (jax.random.normal(sub, (fan_in, rank), jnp.float32)
+                  / jnp.sqrt(fan_in)),
+            "b": jnp.zeros((rank, fan_out), jnp.float32),
+        }
+    if not adapters:
+        raise ValueError("no leaves matched the LoRA targets")
+    return adapters
+
+
+def lora_merge(params: Any, adapters: Any, *, alpha: float,
+               rank: int) -> Any:
+    """``W + (alpha / rank) * A @ B`` on adapted leaves (cast back to the
+    leaf dtype); identity elsewhere.  Jit-safe: called inside the loss."""
+    scale = alpha / rank
+
+    def merge_leaf(path, leaf):
+        ad = adapters.get(path_name(path).replace("/", "."))
+        if ad is None:
+            return leaf
+        delta = ((ad["a"] @ ad["b"]) * scale).reshape(leaf.shape)
+        return (leaf.astype(jnp.float32) + delta).astype(leaf.dtype)
+
+    return jax.tree_util.tree_map_with_path(merge_leaf, params)
+
+
+@dataclass
+class LoRASetup:
+    """Bundle returned by :func:`lora_setup`: pass ``capture_args`` to
+    ``AutoDist.capture`` (add your optimizer), train, then ``merge`` the
+    session's params into a plain tree for serving/export."""
+    capture_args: Dict[str, Any]
+    alpha: float
+    rank: int
+
+    def merge(self, captured_params: Any) -> Any:
+        return lora_merge(captured_params["base"],
+                          captured_params["lora"],
+                          alpha=self.alpha, rank=self.rank)
+
+    @property
+    def num_adapter_params(self) -> int:
+        return sum(int(x.size) for x in jax.tree_util.tree_leaves(
+            self.capture_args["params"]["lora"]))
+
+
+def lora_setup(params: Any, loss_fn: Callable, *, rng: jax.Array,
+               rank: int = 8, alpha: Optional[float] = None,
+               targets: Optional[Sequence] = None,
+               has_aux: bool = False) -> LoRASetup:
+    """Everything ``capture()`` needs for LoRA finetuning of ``params``
+    under ``loss_fn(params, batch)``: the ``{"base", "lora"}`` tree,
+    a merged-view loss, and ``untrainable_vars=("base",)``."""
+    alpha = float(alpha) if alpha is not None else float(2 * rank)
+    adapters = lora_init(rng, params, rank=rank, targets=targets)
+
+    def merged_loss(p, batch):
+        merged = lora_merge(p["base"], p["lora"], alpha=alpha, rank=rank)
+        return loss_fn(merged, batch)
+
+    return LoRASetup(
+        capture_args={
+            "params": {"base": params, "lora": adapters},
+            "loss_fn": merged_loss,
+            "untrainable_vars": ("base",),
+            "has_aux": has_aux,
+        },
+        alpha=alpha, rank=rank)

@@ -109,6 +109,13 @@ class BPETokenizer:
         return ids
 
     def decode(self, ids: Iterable[int]) -> str:
+        ids = list(ids)
+        bad = [i for i in ids if not 0 <= i < len(self._bytes)]
+        if bad:
+            raise ValueError(
+                f"token ids {bad[:5]} out of range for vocab_size "
+                f"{len(self._bytes)} — is the model's vocab larger than "
+                f"the tokenizer's?")
         buf = b"".join(self._bytes[i] for i in ids)
         return buf.decode("utf-8", errors="replace")
 

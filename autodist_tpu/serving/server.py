@@ -62,6 +62,13 @@ class EngineServer:
                  request_timeout_s: float = 600.0):
         self._engine = engine
         self._tokenizer = tokenizer
+        tok_vocab = getattr(tokenizer, "vocab_size", None)
+        if tok_vocab is not None and tok_vocab < engine._vocab:
+            # Fail at construction, not mid-response: the model can
+            # sample ids the tokenizer cannot decode.
+            raise ValueError(
+                f"tokenizer vocab_size {tok_vocab} < model vocab "
+                f"{engine._vocab}: generated ids would not decode")
         self._timeout = float(request_timeout_s)
 
         self._lock = threading.Lock()

@@ -84,6 +84,26 @@ def test_validation():
         BPETokenizer.load(f.name)
 
 
+def test_decode_range_and_server_vocab_guard(tok):
+    """Out-of-range ids fail loudly in decode, and a server whose model
+    vocab exceeds the tokenizer's refuses to construct."""
+    import jax
+
+    from autodist_tpu.models.transformer import dense_attention
+    from autodist_tpu.models.transformer_lm import transformer_lm
+    from autodist_tpu.serving import DecodeEngine, EngineServer
+
+    with pytest.raises(ValueError, match="out of range"):
+        tok.decode([0, tok.vocab_size])
+    spec = transformer_lm(vocab_size=tok.vocab_size + 7, num_layers=1,
+                          num_heads=2, head_dim=8, d_ff=32, max_len=32,
+                          seq_len=16, attn_fn=dense_attention)
+    params = spec.init(jax.random.PRNGKey(0))
+    eng = DecodeEngine(spec, params, slots=1, window=16)
+    with pytest.raises(ValueError, match="vocab"):
+        EngineServer(eng, port=0, tokenizer=tok)
+
+
 def test_server_text_mode_with_bpe(tok):
     """End-to-end: EngineServer(tokenizer=BPETokenizer) serves prompt
     text and returns decoded text."""
