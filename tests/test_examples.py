@@ -133,6 +133,13 @@ def test_serving_engine_example():
     assert "slot_utilization=" in out
 
 
+def test_lora_finetune_example():
+    # The example asserts adapter learning and zero base drift itself.
+    out = _run_example("examples/lora_finetune.py", ("--steps", "30"))
+    assert "lora_finetune demo OK" in out
+    assert "base drift: 0.0" in out
+
+
 def test_serve_http_example():
     # The example is its own HTTP client (concurrent completions + one
     # SSE stream + stats) and asserts 200s internally.
