@@ -15,35 +15,40 @@ TPU-first design points:
   ``[L, window, slots, H, Dh]``.  A chunk of ``chunk`` decode ticks is
   one jitted ``lax.scan``; admission/harvest happen between chunks on
   the host.  No recompiles at request boundaries.
-* **Uniform cache write index.**  Every tick writes every slot's K/V at
-  the same *engine tick* index, so the cache update stays the one
-  contiguous ``dynamic_update_slice`` that makes the decode tick fast
-  (the ~10× batch-major-vs-time-major lesson recorded in BASELINE.md).
-  Per-request sequence positions are recovered by offset: a slot
-  admitted at tick ``start`` attends cache positions
-  ``start <= pos <= tick`` and uses ``pos_embed[tick - start]``.  The
-  attended window of an active slot is always positions the *current*
-  occupant wrote, so slot reuse needs no cache zeroing.
+* **Uniform cache write index over a RING.**  Every tick writes every
+  slot's K/V at the same *ring* index ``tick % window``, so the cache
+  update stays the one contiguous ``dynamic_update_slice`` that makes
+  the decode tick fast (the ~10× batch-major-vs-time-major lesson
+  recorded in BASELINE.md) while the engine tick itself grows without
+  bound.  Per-request sequence positions are recovered by offset: a
+  slot admitted at tick ``start`` (an *absolute* tick, unbounded) uses
+  ``pos_embed[tick - start]`` and attends the ring positions
+  ``(pos - start) % window <= tick - start``.  Because ``submit``
+  bounds every request's span by ``window``, a slot's live region
+  never wraps onto itself, and the attended window of an active slot
+  is always positions the *current* occupant wrote — so slot reuse
+  needs no cache zeroing, and a free slot can admit at ANY tick: one
+  long request can never stall the pool (no drain, no window reset —
+  the round-4 head-of-line blocker).
 * **Token-exact.**  Greedy engine output equals ``make_generator``'s
   for each request individually: the extra masked positions contribute
   exactly-zero attention weight (``exp(min - max) == 0``), so the
   numerics are identical, not approximately so (pinned in
   ``tests/test_serving_engine.py``).
-* **Parallel prefill.**  Once the window has room behind the tick, an
-  admitted prompt is charged into the cache with ONE [P]-parallel
+* **Parallel prefill.**  Every admission (when ``prefill=True``, the
+  default) charges its prompt into the cache with ONE [P]-parallel
   causal forward (``models/generate._prefill_forward`` — MXU-shaped
   matmuls) instead of P sequential decode ticks: the prompt's K/V land
-  at positions ``t0-P..t0-1`` and the slot joins the global tick
+  at ring positions ``(t0-P..t0-1) % window`` — behind the admission
+  tick, wrapping when ``t0 < P`` — and the slot joins the global tick
   already generating.  Prefill logits equal the tick-by-tick logits up
   to float reduction order (the documented allclose-level equivalence
   of parallel vs cached attention), so greedy parity with ``generate``
   holds on non-tied argmaxes — the deterministic case the tests pin.
 
-Admission is first-fit at chunk boundaries; when the window is
-exhausted and no request fits, the engine waits for all in-flight slots
-to drain and resets the tick to 0 (the simple, honest alternative to
-ring-buffer compaction — a request's whole ``prompt + max_new`` span
-must fit inside ``window``).
+Admission is FIFO at chunk boundaries and always succeeds to a free
+slot (a request's whole ``prompt + max_new`` span must fit inside
+``window``, which is exactly the ring-safety invariant).
 """
 from __future__ import annotations
 
@@ -85,13 +90,17 @@ def _chunk_program(n, knobs, params, tokens, kc, vc, start, p_end, end,
 
     def one_tick(carry, i):
         tokens, kc, vc, done, key = carry
-        t = tick0 + i
-        tok = lax.dynamic_index_in_dim(tokens, t, 1, keepdims=False)
+        t = tick0 + i                                     # absolute tick
+        t_ring = jnp.mod(t, window)                       # ring write pos
+        tok = lax.dynamic_index_in_dim(tokens, t_ring, 1, keepdims=False)
         rel = jnp.clip(t - start, 0, window - 1)          # [B]
         x = embed_lookup(embed, tok, pos_embed.dtype) + pos_embed[rel]
-        mask = (pos_idx >= start[:, None]) & (pos_idx <= t)
+        # Ring mask: slot b attends ring positions its CURRENT occupant
+        # wrote — sequence offsets 0..t-start[b], laid out mod window.
+        mask = jnp.mod(pos_idx - start[:, None], window) \
+            <= (t - start)[:, None]
         logits, kc, vc = _token_step(
-            layer_params, ln_final, embed, x, kc, vc, t, window,
+            layer_params, ln_final, embed, x, kc, vc, t_ring, window,
             attn_mask=mask)
         key, sub = jax.random.split(key)
         raw = sample_next_token(logits, sub, temperature, top_k,
@@ -100,11 +109,12 @@ def _chunk_program(n, knobs, params, tokens, kc, vc, start, p_end, end,
         # Teacher-force while inside the prompt; only live slots write;
         # a finished slot's buffer is left as-is (harvest pads eos on
         # the host).
-        cur = lax.dynamic_index_in_dim(tokens, t + 1, 1, keepdims=False)
+        w_ring = jnp.mod(t + 1, window)
+        cur = lax.dynamic_index_in_dim(tokens, w_ring, 1, keepdims=False)
         in_gen = t + 1 >= p_end                           # [B]
         live = active & ~done
         nxt = jnp.where(in_gen & live, raw, cur)
-        tokens = lax.dynamic_update_index_in_dim(tokens, nxt, t + 1, 1)
+        tokens = lax.dynamic_update_index_in_dim(tokens, nxt, w_ring, 1)
         if eos_id >= 0:
             done = done | (in_gen & live & (raw == eos_id))
         # The final token of slot b lands at buffer index end[b]-1,
@@ -131,10 +141,14 @@ def _prefill_program(knobs, params, tokens, kc, vc, prompts_kpb,
     same program (the buffer is device-resident).  ``prompts_kpb``
     [K, Pb]: Pb is the rows' shared pow-2 prompt bucket and K a pow-2
     sub-batch size, both chosen by the scheduler (``_flush_prefills``)
-    so the set of compiled (K, Pb) programs stays small.  Pad
-    positions' K/V and pad token writes land at >= t0 and are
-    overwritten by each tick's own write before any read sees them.
-    ``p_lens`` may differ per row (prompts right-padded to Pb).
+    so the set of compiled (K, Pb) programs stays small.  Writes land
+    at RING positions ``(t0-P..t0-1) % window`` (``t0`` is absolute and
+    ``t0 - P`` may be negative — the mod wraps both); pad positions'
+    K/V and pad token writes land at ring positions >= t0 and are
+    overwritten by each tick's own write before any read sees them
+    (``Pb <= window``, enforced by ``_prompt_bucket``, keeps the pad
+    tail off the prompt itself).  ``p_lens`` may differ per row
+    (prompts right-padded to Pb).
 
     ``row_map`` [S] maps each target SLOT entry to its unique prompt
     row — identical prompts admitted together (system-prompt fan-out,
@@ -149,34 +163,34 @@ def _prefill_program(knobs, params, tokens, kc, vc, prompts_kpb,
                                   pos_embed, prompts_kpb, heads,
                                   head_dim)
     s_count = slot_ids.shape[0]
-    z = jnp.int32(0)
+    pb = prompts_kpb.shape[1]
+    window = kc.shape[1]
     for j in range(s_count):                  # S is static (shape)
         i = row_map[j]
         row_k = lax.dynamic_index_in_dim(ks, i, 1)   # [L, 1, Pb, H, Dh]
-        upd_k = jnp.moveaxis(row_k, 1, 2).astype(kc.dtype)
         row_v = lax.dynamic_index_in_dim(vs, i, 1)
-        upd_v = jnp.moveaxis(row_v, 1, 2).astype(vc.dtype)
         p_j = p_lens[i]
-        at = (z, jnp.int32(t0 - p_j), jnp.int32(slot_ids[j]), z, z)
-        kc = lax.dynamic_update_slice(kc, upd_k, at)
-        vc = lax.dynamic_update_slice(vc, upd_v, at)
+        # ring positions of the prompt's Pb (bucketed) cache columns
+        idx = jnp.mod(t0 - p_j + jnp.arange(pb), window)  # [Pb]
+        sb = slot_ids[j]
+        kc = kc.at[:, idx, sb].set(row_k[:, 0].astype(kc.dtype))
+        vc = vc.at[:, idx, sb].set(row_v[:, 0].astype(vc.dtype))
         prow = lax.dynamic_index_in_dim(prompts_kpb, i, 0)  # [1, Pb]
-        tokens = lax.dynamic_update_slice(
-            tokens, prow.astype(tokens.dtype),
-            (jnp.int32(slot_ids[j]), jnp.int32(t0 - p_j)))
+        tokens = tokens.at[sb, idx].set(prow[0].astype(tokens.dtype))
     last = jnp.take_along_axis(
         xs, (p_lens - 1)[:, None, None].astype(jnp.int32), axis=1
     )[:, 0]                                               # [K, D]
     logits = head_logits(embed, last)                     # [K, V]
     logits_s = jnp.take(logits, row_map, axis=0)          # [S, V]
     toks = sample_next_token(logits_s, key, temperature, top_k, top_p)
-    tokens = tokens.at[slot_ids, t0].set(toks.astype(tokens.dtype))
+    t0r = jnp.mod(t0, window)
+    tokens = tokens.at[slot_ids, t0r].set(toks.astype(tokens.dtype))
     # Report the values that LANDED in the buffer, not the raw draws:
     # S is padded to a pow-2 bucket with duplicated entries, and when
     # duplicate slot indices scatter different samples the winner is
     # unspecified — reading back keeps the host's eos bookkeeping
     # consistent with what the next tick will actually consume.
-    landed = tokens[slot_ids, t0]
+    landed = tokens[slot_ids, t0r]
     return tokens, kc, vc, landed
 
 
@@ -193,12 +207,13 @@ def _sharded_zeros(shape, dtype, sharding):
 @functools.partial(jax.jit, donate_argnums=(0,))
 def _write_prompt_program(tokens, prompt_pb, slot_b, t0):
     """Sequential-admission prompt write into the device-resident token
-    buffer: row ``slot_b`` positions ``t0..t0+Pb-1`` (pow-2 bucket; the
-    pad tail lands on future tick-write positions of the same slot and
-    is overwritten before any read sees it)."""
-    return lax.dynamic_update_slice(
-        tokens, prompt_pb[None].astype(tokens.dtype),
-        (jnp.int32(slot_b), jnp.int32(t0)))
+    buffer: row ``slot_b`` RING positions ``(t0..t0+Pb-1) % window``
+    (pow-2 bucket; the pad tail lands on future tick-write positions of
+    the same slot and is overwritten before any read sees it)."""
+    idx = jnp.mod(jnp.int32(t0) + jnp.arange(prompt_pb.shape[0]),
+                  tokens.shape[1])
+    return tokens.at[jnp.int32(slot_b), idx].set(
+        prompt_pb.astype(tokens.dtype))
 
 
 @dataclass
@@ -222,7 +237,6 @@ class EngineStats:
     prefill_dispatches: int = 0   # batched prefill programs dispatched
     prefill_dedup_hits: int = 0   # slots served by a shared prompt row
     completed: int = 0            # requests harvested
-    window_resets: int = 0
     chunks: int = 0               # compiled-program dispatches
 
     @property
@@ -485,14 +499,17 @@ class DecodeEngine:
 
     def _slot_tokens(self, b: int) -> np.ndarray:
         """Tokens written so far for slot ``b`` (shared by partial reads
-        and harvest): buffer positions ``start..min(end, tick+1)``,
-        truncated after the first eos GENERATED (not prompt-resident).
-        Pulls ONE fixed-shape row of the device-resident buffer (one
-        compiled slice per slot index; variable bounds are applied in
-        numpy so streaming polls don't accrete jit-cache entries)."""
-        s, pe, e = self._start[b], self._p_end[b], self._end[b]
+        and harvest): absolute positions ``start..min(end, tick+1)``
+        gathered from their ring images, truncated after the first eos
+        GENERATED (not prompt-resident).  Pulls ONE fixed-shape row of
+        the device-resident buffer (one compiled slice per slot index;
+        variable bounds are applied in numpy so streaming polls don't
+        accrete jit-cache entries)."""
+        s, pe, e = int(self._start[b]), int(self._p_end[b]), \
+            int(self._end[b])
         written = min(e, self._tick + 1)
-        seq = np.array(self._tokens[b])[s:written]
+        row = np.array(self._tokens[b])
+        seq = row[(s + np.arange(written - s)) % self._window]
         if self._eos_id >= 0:
             gen = seq[pe - s:]
             hits = np.nonzero(gen == self._eos_id)[0]
@@ -504,11 +521,13 @@ class DecodeEngine:
     # scheduler internals
     # ------------------------------------------------------------------
     def _schedule(self) -> bool:
-        """Harvest finished slots, admit queued requests (first-fit),
-        reset the window when drained+stuck.  True if a chunk should
-        run.  Loops internally because a prefill admission can finish a
-        request outright (max_new_tokens=1, or eos as the first token):
-        such slots are harvested and refilled without running a chunk."""
+        """Harvest finished slots, admit queued requests (FIFO — in ring
+        mode a free slot admits at ANY tick, so no fit check and no
+        window reset exist).  True if a chunk should run.  Loops
+        internally because a prefill admission can finish a request
+        outright (max_new_tokens=1, or eos as the first token): such
+        slots are harvested and refilled without running a chunk."""
+        self._rebase_tick()
         while True:
             self._harvest()
             self._admit()
@@ -519,49 +538,54 @@ class DecodeEngine:
                 continue
             if np.any(self._active & ~self._done):
                 return True
-            if not self._queue:
-                return False
-            # Work remains but nothing fits at this tick and no slot is
-            # live: rewind the window (drain is complete).  No cache
-            # zeroing needed — a slot only attends positions its current
-            # occupant wrote (see module docstring).  submit() bounds
-            # every span by the window, so at tick 0 a free slot always
-            # admits — each pass either returns or shrinks the queue.
+            # Pool fully idle (a free slot always admits, so an empty
+            # pool means an empty queue): rewind to 0 — free (no state
+            # moves; ring contents are occupant-masked).
             self._tick = 0
-            self.stats.window_resets += 1
+            return False
+
+    _REBASE_AT = 1 << 24   # well under int32, amortized to ~never
+
+    def _rebase_tick(self) -> None:
+        """Bound absolute-tick growth under SUSTAINED load (the idle
+        rewind never fires then): subtract a multiple of ``window`` from
+        the tick and every slot's start/p_end/end.  Ring positions are
+        ``x % window`` and masks/offsets are differences, so a shift
+        that is ≡ 0 (mod window) is invisible to the device programs —
+        pure host bookkeeping, O(slots), amortized to ~one shift per
+        16M ticks."""
+        if self._tick < self._REBASE_AT:
+            return
+        shift = (self._tick // self._window) * self._window
+        self._tick -= shift
+        self._start -= shift
+        self._p_end -= shift
+        self._end -= shift
 
     def _admit(self) -> None:
         prefills: List[tuple] = []        # deferred (slot, req) pairs
         for b in range(self._slots):
             if self._active[b] or not self._queue:
                 continue
-            # first-fit: take the first queued request that fits in the
-            # remaining window.  A prefill admission stores the prompt
-            # BEHIND the tick, so only its generation span needs room.
-            pick = None
-            for qi, req in enumerate(self._queue):
-                if self._prefill and self._tick >= req.prompt.size:
-                    need = req.max_new_tokens
-                else:
-                    need = req.prompt.size + req.max_new_tokens
-                if self._tick + need <= self._window:
-                    pick = qi
-                    break
-            if pick is None:
-                break
-            req = self._queue.pop(pick)
+            req = self._queue.pop(0)      # FIFO: head always fits
             p = req.prompt.size
             t0 = self._tick
-            if self._prefill and t0 >= p:
+            if self._prefill:
                 # Deferred: this boundary's prefill admissions run as
-                # ONE batched program (MXU-batched, one dispatch).
+                # ONE batched program (MXU-batched, one dispatch).  The
+                # prompt lands BEHIND the tick at ring positions
+                # (t0-P..t0-1) % window — valid even at t0 < P (the
+                # slot's start tick goes negative; all position
+                # arithmetic is by offset).
                 prefills.append((b, req))
                 continue
-            # Sequential (teacher-forced) admission: the window's opening
-            # ticks, where there is no room behind the tick for prefill.
+            # Sequential (teacher-forced) admission — the prefill=False
+            # mode only (ring admission prefills unconditionally): the
+            # prompt lands AHEAD of the tick and is consumed tick by
+            # tick.
             try:
                 self._tokens = _write_prompt_program(
-                    self._tokens, self._pad_bucket(req.prompt, t0),
+                    self._tokens, self._pad_bucket(req.prompt),
                     np.int32(b), np.int32(t0))
             except Exception:
                 self._poisoned = True   # tokens buffer was donated
@@ -583,15 +607,10 @@ class DecodeEngine:
         and each bucket dispatches in pow-2-sized sub-batches; the slot
         fan-out S is pow-2 padded inside _run_prefill — so all three
         compile dimensions (Pb, K, S) are bucketed and the compiled
-        program set stays logarithmic in window and slots.  A row whose
-        bucket would overrun the window (``t0 - P + Pb > window``, where
-        dynamic_update_slice would clamp-shift the write) runs at exact
-        prompt size instead (always fits: t0 <= window)."""
-        t0 = self._tick
+        program set stays logarithmic in window and slots."""
         buckets: Dict[int, Dict[bytes, list]] = {}
         for b, req in group:
-            p = req.prompt.size
-            pb = self._prompt_bucket(p, t0 - p)
+            pb = self._prompt_bucket(req.prompt.size)
             # dedup identical prompts within a bucket: computed once,
             # K/V scattered to every requesting slot
             buckets.setdefault(pb, {}).setdefault(
@@ -665,23 +684,23 @@ class DecodeEngine:
         self.stats.prefill_dedup_hits += len(flat) - k
         self.stats.prefill_dispatches += 1
 
-    def _prompt_bucket(self, prompt_size: int, write_start: int) -> int:
+    def _prompt_bucket(self, prompt_size: int) -> int:
         """Pow-2 compile bucket for a prompt, falling back to the exact
-        size when the padded write from ``write_start`` would overrun
-        the window (dynamic_update_slice would clamp-shift the write).
-        The single definition of the bucketing rule — the batched
-        (_flush_prefills) and sequential (_pad_bucket) admission paths
-        must never desynchronize on it."""
+        size when the bucket would exceed the window (``Pb <= window``
+        is the ring-safety bound: it keeps a bucket's pad tail off the
+        prompt it pads).  The single definition of the bucketing rule —
+        the batched (_flush_prefills) and sequential (_pad_bucket)
+        admission paths must never desynchronize on it."""
         pb = 1 << (prompt_size - 1).bit_length()
-        if write_start + pb > self._window:
+        if pb > self._window:
             pb = prompt_size
         return pb
 
-    def _pad_bucket(self, prompt: np.ndarray, origin: int) -> jax.Array:
+    def _pad_bucket(self, prompt: np.ndarray) -> jax.Array:
         """Zero-pad ``prompt`` to its pow-2 compile bucket (see
-        :meth:`_prompt_bucket`; ``origin`` is the write start)."""
+        :meth:`_prompt_bucket`)."""
         p = prompt.size
-        padded = np.zeros(self._prompt_bucket(p, origin), np.int32)
+        padded = np.zeros(self._prompt_bucket(p), np.int32)
         padded[:p] = prompt
         return jnp.asarray(padded)
 
@@ -699,7 +718,7 @@ class DecodeEngine:
             self._slot_req[b] = None
 
     def _run_chunk(self) -> None:
-        n = min(self._chunk, self._window - 1 - self._tick)
+        n = self._chunk       # ring: no window clamp (writes wrap)
         if self._queue:
             # Work is waiting: stop the chunk at the next KNOWN slot
             # retirement (its end bound — tick end[b]-2 finishes slot b)
@@ -715,8 +734,6 @@ class DecodeEngine:
                 nxt = int(self._end[live].min()) - 1 - self._tick
                 if 0 < nxt < n:
                     n = 1 << (nxt.bit_length() - 1)
-        if n <= 0:  # pragma: no cover - _schedule resets before this
-            return
         self._rng, sub = jax.random.split(self._rng)
         try:
             self._tokens, self._kc, self._vc, done, busy = _chunk_program(

@@ -268,8 +268,10 @@ class EngineServer:
     def parse_prompt(self, body: Dict[str, Any]) -> np.ndarray:
         if "prompt_tokens" in body:
             toks = body["prompt_tokens"]
+            # type(t) is int, not isinstance: bool subclasses int, and
+            # true/false must be a 400, not token ids 1/0.
             if (not isinstance(toks, list) or not toks
-                    or not all(isinstance(t, int) for t in toks)):
+                    or not all(type(t) is int for t in toks)):
                 raise ValueError(
                     "prompt_tokens must be a non-empty list of ints")
             return np.asarray(toks, np.int32)
@@ -378,7 +380,7 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             prompt = srv.parse_prompt(body)
             max_new = body.get("max_new_tokens", 16)
-            if not isinstance(max_new, int):
+            if type(max_new) is not int:   # bool is an int subclass
                 raise ValueError("max_new_tokens must be an int")
             rid = srv._submit(prompt, max_new)
         except _Unavailable:
@@ -403,6 +405,9 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(503, {"error": "engine unavailable", "id": rid})
             return
         if tokens is _CANCELLED:
+            # counted as failed so served+failed covers every handled
+            # completion request
+            srv.count_request(served=False)
             self._json(409, {"error": f"request {rid} was cancelled",
                              "id": rid})
             return
@@ -427,25 +432,38 @@ class _Handler(BaseHTTPRequestHandler):
 
         sent = prompt_len
         deadline = time.monotonic() + srv._timeout
+        # Exactly-once counting: each terminal path counts, and the
+        # OSError handler counts only if no terminal path did (a final
+        # emit that fails AFTER counting must not count again).
+        counted = False
+
+        def count(*, served: bool) -> None:
+            nonlocal counted
+            if not counted:
+                counted = True
+                srv.count_request(served=served)
+
         try:
             while True:
                 try:
                     snap, done = srv._snapshot(rid)
                 except _Unavailable:
+                    count(served=False)
                     emit({"id": rid, "error": "engine unavailable"})
                     return
                 if not done and time.monotonic() > deadline:
                     srv._cancel(rid)
                     srv._finish_stream(rid)
-                    srv.count_request(served=False)
+                    count(served=False)
                     emit({"id": rid, "done": True, "timeout": True})
                     return
                 if done:
                     tokens = srv._finish_stream(rid)
                     if tokens is _CANCELLED or tokens is None:
+                        count(served=False)
                         emit({"id": rid, "done": True, "cancelled": True})
                     else:
-                        srv.count_request(served=True)
+                        count(served=True)
                         final = srv.render(rid, tokens, prompt_len)
                         final["done"] = True
                         emit(final)
@@ -455,11 +473,13 @@ class _Handler(BaseHTTPRequestHandler):
                           "new_tokens": [int(t) for t in snap[sent:]]})
                     sent = int(snap.size)
                 time.sleep(0.02)   # poll cadence between chunk boundaries
-        except (BrokenPipeError, ConnectionResetError):
-            # Client hung up mid-stream: free the slot instead of
-            # decoding tokens nobody will read.
+        except OSError:
+            # Any socket write failure — hang-up, abort, timeout — frees
+            # the slot instead of decoding tokens nobody will read (and
+            # drains the harvested result so it can't leak in _done).
             srv._cancel(rid)
             srv._finish_stream(rid)
+            count(served=False)
 
 
 def serve(spec, params, *, host: str = "127.0.0.1", port: int = 8000,

@@ -56,8 +56,7 @@ def main():
           f"({s.generated_tokens / dt:.0f} tok/s aggregate)")
     print(f"ticks={s.ticks} chunks={s.chunks} "
           f"slot_utilization={s.slot_utilization:.2f} "
-          f"prefill_admissions={s.prefill_admissions} "
-          f"window_resets={s.window_resets}")
+          f"prefill_admissions={s.prefill_admissions}")
 
     # Spot-check three results against the per-request oracle decode.
     gen = make_generator(spec)
