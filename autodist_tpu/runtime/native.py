@@ -103,9 +103,13 @@ def _bind_signatures(lib: ctypes.CDLL) -> None:
     lib.ad_loader_num_batches.restype = ctypes.c_size_t
     lib.ad_loader_num_batches.argtypes = [ctypes.c_void_p]
     lib.ad_loader_destroy.argtypes = [ctypes.c_void_p]
-    lib.ad_bpe_create.restype = ctypes.c_void_p
-    lib.ad_bpe_create.argtypes = [ctypes.POINTER(ctypes.c_int32),
-                                  ctypes.c_int32]
+    # _v2: the pretokenize flag changed the arity; the rename makes a
+    # stale .so (which still exports the 2-arg ad_bpe_create) hit the
+    # AttributeError staleness guard above instead of silently ignoring
+    # the third argument.
+    lib.ad_bpe_create_v2.restype = ctypes.c_void_p
+    lib.ad_bpe_create_v2.argtypes = [ctypes.POINTER(ctypes.c_int32),
+                                     ctypes.c_int32, ctypes.c_int32]
     lib.ad_bpe_encode.restype = ctypes.c_int32
     lib.ad_bpe_encode.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
                                   ctypes.c_int32,

@@ -486,7 +486,13 @@ def serve(spec, params, *, host: str = "127.0.0.1", port: int = 8000,
           tokenizer=None, **engine_kwargs) -> EngineServer:
     """Build a :class:`DecodeEngine` over ``(spec, params)`` and start an
     :class:`EngineServer` on it.  ``engine_kwargs`` pass through to the
-    engine (slots, window, chunk, sampling knobs, mesh, ...)."""
+    engine (slots, window, chunk, sampling knobs, mesh, ...).  A
+    tokenizer with a registered ``<eos>`` special token supplies the
+    engine's ``eos_id`` automatically (explicit ``eos_id=`` wins)."""
+    if "eos_id" not in engine_kwargs:
+        eos = getattr(tokenizer, "eos_id", None)
+        if eos is not None:
+            engine_kwargs["eos_id"] = int(eos)
     eng = DecodeEngine(spec, params, **engine_kwargs)
     return EngineServer(eng, host=host, port=port,
                         tokenizer=tokenizer).start()

@@ -6,19 +6,39 @@
 // host-side hot loop (per-request, latency-sensitive) — exactly the kind
 // of work the native runtime layer exists for (cf. runtime.cpp's loader).
 //
-// Model: plain byte-level BPE, no regex pretokenization — every byte is a
-// base token (the Python side guarantees ids 0..255 are the single bytes),
-// then ranked pair merges apply in rank order.  Encode is the standard
-// repeated-best-merge loop over a doubly-linked symbol list:
-// O(n * merges_applied) with an O(1) pair-rank hash lookup.
+// Model: byte-level BPE with GPT-2-style pretokenization.  Every byte is
+// a base token (the Python side guarantees ids 0..255 are the single
+// bytes); ranked pair merges apply within pretoken segments only (merges
+// never cross word/space boundaries).  The pretokenizer is a hand-rolled
+// byte-class scanner equivalent in structure to GPT-2's pattern
+//   's|'t|'re|'ve|'m|'ll|'d| ?L+| ?N+| ?P+|\s+(?!\S)|\s+
+// under a byte-level class map: L = ASCII letters plus every byte >=
+// 0x80 (so UTF-8 continuation/lead bytes group as "letters" — the right
+// byte-level approximation without Unicode tables), N = ASCII digits,
+// \s = ASCII whitespace, P = everything else.  The same scanner exists
+// in pure Python (runtime/tokenizer.py) and the two must match
+// BIT-FOR-BIT; change them together.
 //
-// C ABI (ctypes-bound in autodist_tpu/runtime/tokenizer.py, pure-Python
-// fallback there must match bit-for-bit):
-//   ad_bpe_create(merges[n*3] as (left,right,new_id) in rank order)
+// Encode within a segment is heap-based best-merge: a priority queue of
+// candidate pairs ordered by (rank, position) with lazy invalidation
+// over a doubly-linked symbol arena — O(n log n), replacing the old
+// O(n * merges) full rescan (pathological on long uniform inputs).
+// Semantics are unchanged: repeatedly apply the globally lowest-rank
+// pair, leftmost occurrence first (heap pop order == global min by
+// (rank, pos); stale entries are detected by their recorded pair ids).
+//
+// C ABI (ctypes-bound in autodist_tpu/runtime/tokenizer.py):
+//   ad_bpe_create_v2(merges[n*3] as (left,right,new_id) in rank order,
+//                    n_merges, pretokenize)
 //   ad_bpe_encode(text bytes -> out_ids, returns count)
 //   ad_bpe_destroy
+// The _v2 suffix is load-bearing: the pretokenize flag changed the
+// create arity, and a RENAME makes a stale prebuilt .so fail the
+// binding (AttributeError -> pure-Python fallback) instead of silently
+// calling the old 2-arg function with the flag ignored.
 #include <cstddef>
 #include <cstdint>
+#include <queue>
 #include <unordered_map>
 #include <vector>
 
@@ -27,6 +47,7 @@ namespace {
 struct Bpe {
   // (left_id << 32 | right_id) -> (rank << 32 | new_id)
   std::unordered_map<uint64_t, uint64_t> ranks;
+  bool pretokenize = false;
 };
 
 inline uint64_t pair_key(int32_t a, int32_t b) {
@@ -34,12 +55,131 @@ inline uint64_t pair_key(int32_t a, int32_t b) {
          static_cast<uint32_t>(b);
 }
 
+// Byte classes for the pretokenizer (see module comment).
+enum Cls { kSpace, kLetter, kDigit, kPunct };
+
+inline Cls classify(uint8_t b) {
+  if (b == ' ' || b == '\t' || b == '\n' || b == '\r' || b == '\f' ||
+      b == '\v')
+    return kSpace;
+  if ((b >= 'a' && b <= 'z') || (b >= 'A' && b <= 'Z') || b >= 0x80)
+    return kLetter;
+  if (b >= '0' && b <= '9') return kDigit;
+  return kPunct;
+}
+
+// Length of a contraction ('s 't 'm 'd 're 've 'll) starting at text[i],
+// or 0.  Lowercase-only, like GPT-2's pattern.
+inline int32_t contraction_len(const uint8_t* text, int32_t n, int32_t i) {
+  if (text[i] != '\'' || i + 1 >= n) return 0;
+  const uint8_t c = text[i + 1];
+  if (c == 's' || c == 't' || c == 'm' || c == 'd') return 2;
+  if (i + 2 < n) {
+    const uint8_t d = text[i + 2];
+    if ((c == 'r' && d == 'e') || (c == 'v' && d == 'e') ||
+        (c == 'l' && d == 'l'))
+      return 3;
+  }
+  return 0;
+}
+
+// Emit [start, end) pretoken boundaries into segs as (start, end) pairs.
+// Mirrors runtime/tokenizer.py _pretokenize — keep in lockstep.
+void pretokenize(const uint8_t* text, int32_t n,
+                 std::vector<std::pair<int32_t, int32_t>>* segs) {
+  int32_t i = 0;
+  while (i < n) {
+    const int32_t cl = contraction_len(text, n, i);
+    if (cl) {
+      segs->emplace_back(i, i + cl);
+      i += cl;
+      continue;
+    }
+    if (classify(text[i]) == kSpace) {
+      int32_t j = i;
+      while (j < n && classify(text[j]) == kSpace) ++j;
+      if (j == n) {  // trailing whitespace run: one token
+        segs->emplace_back(i, j);
+        i = j;
+        continue;
+      }
+      if (j - i > 1) {  // \s+(?!\S): all but the last space
+        segs->emplace_back(i, j - 1);
+        i = j - 1;
+        continue;
+      }
+      if (text[i] != ' ') {  // the ' ?' prefix is a LITERAL space:
+        segs->emplace_back(i, j);  // lone \t or \n is its own \s+ token
+        i = j;
+        continue;
+      }
+      // single literal space before non-space: falls into ' ?class+'
+    }
+    // optional single leading space + maximal same-class run
+    int32_t start = i;
+    if (text[i] == ' ') ++i;  // the ' ?' space (literal 0x20 only)
+    const Cls cls = classify(text[i]);
+    ++i;
+    while (i < n && classify(text[i]) == cls) ++i;
+    segs->emplace_back(start, i);
+  }
+}
+
+// Heap-based BPE over one segment.  id/next/prev are arena arrays the
+// caller owns; [lo, hi) is the segment.  After return the linked list
+// starting at lo (following next, stopping at >= hi or -1) holds the
+// merged ids.
+void merge_segment(const Bpe* t, std::vector<int32_t>* id_v,
+                   std::vector<int32_t>* next_v, std::vector<int32_t>* prev_v,
+                   int32_t lo, int32_t hi) {
+  auto& id = *id_v;
+  auto& next = *next_v;
+  auto& prev = *prev_v;
+  struct Cand {
+    uint64_t key;  // rank << 32 | pos  (min-heap by rank then pos)
+    int32_t a, b;  // pair ids at push time (stale detection)
+  };
+  struct Cmp {
+    bool operator()(const Cand& x, const Cand& y) const {
+      return x.key > y.key;
+    }
+  };
+  std::priority_queue<Cand, std::vector<Cand>, Cmp> heap;
+  auto push = [&](int32_t i) {
+    const int32_t j = next[i];
+    if (j < 0 || j >= hi) return;
+    auto it = t->ranks.find(pair_key(id[i], id[j]));
+    if (it == t->ranks.end()) return;
+    const uint64_t rank = it->second >> 32;
+    heap.push(Cand{(rank << 32) | static_cast<uint32_t>(i), id[i], id[j]});
+  };
+  for (int32_t i = lo; i < hi - 1; ++i) push(i);
+  while (!heap.empty()) {
+    const Cand c = heap.top();
+    heap.pop();
+    const int32_t i = static_cast<int32_t>(c.key & 0xffffffffu);
+    const int32_t j = next[i];
+    // Stale if i was absorbed, the pair changed, or j left the segment.
+    if (id[i] != c.a || j < 0 || j >= hi || id[j] != c.b) continue;
+    auto it = t->ranks.find(pair_key(c.a, c.b));
+    id[i] = static_cast<int32_t>(it->second & 0xffffffffu);
+    const int32_t k = next[j];
+    id[j] = -1;  // tombstone: any heap entry at j is now stale
+    next[i] = k;
+    if (k != -1) prev[k] = i;
+    if (prev[i] != -1 && prev[i] >= lo) push(prev[i]);
+    push(i);
+  }
+}
+
 }  // namespace
 
 extern "C" {
 
-void* ad_bpe_create(const int32_t* merges, int32_t n_merges) {
+void* ad_bpe_create_v2(const int32_t* merges, int32_t n_merges,
+                    int32_t pretokenize_flag) {
   Bpe* t = new Bpe();
+  t->pretokenize = pretokenize_flag != 0;
   t->ranks.reserve(static_cast<size_t>(n_merges) * 2);
   for (int32_t r = 0; r < n_merges; ++r) {
     const int32_t left = merges[3 * r], right = merges[3 * r + 1],
@@ -60,33 +200,27 @@ int32_t ad_bpe_encode(void* tok, const uint8_t* text, int32_t n,
                       int32_t* out_ids) {
   const Bpe* t = static_cast<const Bpe*>(tok);
   if (n <= 0) return 0;
-  // Singly-linked list over a flat arena: next indices, -1 = end
-  // (merges always absorb the successor, so no prev links needed).
-  std::vector<int32_t> id(n), next(n);
+  std::vector<int32_t> id(n), next(n), prev(n);
   for (int32_t i = 0; i < n; ++i) {
     id[i] = text[i];  // base tokens ARE the bytes
     next[i] = (i + 1 < n) ? i + 1 : -1;
+    prev[i] = i - 1;  // -1 at head
   }
-  const int32_t head = 0;
-  while (true) {
-    // Find the lowest-rank applicable pair.
-    uint64_t best = ~0ull;
-    int32_t best_pos = -1;
-    for (int32_t i = head; i != -1 && next[i] != -1; i = next[i]) {
-      auto it = t->ranks.find(pair_key(id[i], id[next[i]]));
-      if (it != t->ranks.end() && it->second < best) {
-        best = it->second;
-        best_pos = i;
-      }
-    }
-    if (best_pos == -1) break;
-    // Merge best_pos with its successor (leftmost occurrence merges
-    // first on rank ties along the scan — the fallback matches).
-    id[best_pos] = static_cast<int32_t>(best & 0xffffffffu);
-    next[best_pos] = next[next[best_pos]];
+  std::vector<std::pair<int32_t, int32_t>> segs;
+  if (t->pretokenize) {
+    pretokenize(text, n, &segs);
+  } else {
+    segs.emplace_back(0, n);
+  }
+  for (const auto& s : segs) {
+    // Sever the list at segment boundaries so merges cannot cross them.
+    if (s.second < n) next[s.second - 1] = -1;
+    merge_segment(t, &id, &next, &prev, s.first, s.second);
   }
   int32_t count = 0;
-  for (int32_t i = head; i != -1; i = next[i]) out_ids[count++] = id[i];
+  for (const auto& s : segs)
+    for (int32_t i = s.first; i != -1 && i < s.second; i = next[i])
+      out_ids[count++] = id[i];
   return count;
 }
 

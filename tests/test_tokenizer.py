@@ -45,18 +45,172 @@ def test_bytes_never_unknown(tok):
 
 def test_native_matches_fallback(tok):
     """The C++ encode and the pure-Python loop must agree token-for-token
-    (same repeated-best-merge semantics)."""
+    (same pretokenizer, same heap best-merge semantics)."""
     if not native.native_available():
         pytest.skip("native runtime unavailable")
     assert tok._get_native() is not None, "native tokenizer not built"
     rng = np.random.RandomState(0)
-    alphabet = "abcdefghij klmnopqrstuvwxyz  the quick"
-    for _ in range(50):
+    alphabet = "abcdefghij klmnopqrstuvwxyz  the quick's 'll 123!? \t\n é東"
+    for _ in range(80):
         s = "".join(alphabet[i] for i in
                     rng.randint(0, len(alphabet), rng.randint(0, 80)))
-        want = tok._encode_py(s.encode())
+        want = tok._encode_py(s.encode("utf-8"))
         got = tok.encode(s)
         assert got == want, f"native != fallback for {s!r}"
+
+
+def test_heap_encode_matches_naive_rescan(tok):
+    """The O(n log n) heap merge is semantically identical to the
+    brute-force 'rescan for the global lowest-rank pair, leftmost
+    first' reference on random inputs."""
+    def naive(ids):
+        ids = list(ids)
+        ranks = tok._ranks
+        while True:
+            best, pos = None, -1
+            for i in range(len(ids) - 1):
+                r = ranks.get((ids[i], ids[i + 1]))
+                if r is not None and (best is None or r[0] < best[0]):
+                    best, pos = r, i
+            if pos < 0:
+                return ids
+            ids[pos:pos + 2] = [best[1]]
+
+    rng = np.random.RandomState(3)
+    corpus_bytes = " ".join(CORPUS).encode()
+    for _ in range(40):
+        n = rng.randint(1, 60)
+        start = rng.randint(0, len(corpus_bytes) - n)
+        seg = list(corpus_bytes[start:start + n])
+        assert tok._merge_segment(list(seg)) == naive(seg)
+
+
+def test_pretokenize_boundaries():
+    """The scanner realizes the GPT-2 pattern structure: contractions,
+    space-prefixed class runs, digit/letter/punct splits, and the
+    \\s+(?!\\S) whitespace rule."""
+    from autodist_tpu.runtime.tokenizer import _pretokenize
+
+    def segs(s):
+        data = s.encode("utf-8")
+        return [data[a:b].decode("utf-8", errors="replace")
+                for a, b in _pretokenize(data)]
+
+    assert segs("don't stop") == ["don", "'t", " stop"]
+    assert segs("we'll they're I've") == \
+        ["we", "'ll", " they", "'re", " I", "'ve"]
+    assert segs("abc123 x!?") == ["abc", "123", " x", "!?"]
+    assert segs("a   b") == ["a", "  ", " b"]       # run keeps last space
+    assert segs("hi  ") == ["hi", "  "]             # trailing run intact
+    assert segs(" 's") == [" '", "s"]               # space blocks contraction
+    # the ' ?' prefix is a LITERAL space: \t and \n stand alone
+    assert segs("foo\nbar") == ["foo", "\n", "bar"]
+    assert segs("a\n\nb") == ["a", "\n", "\n", "b"]
+    assert segs("a\tb") == ["a", "\t", "b"]
+    assert segs("héllo 東京") == ["héllo", " 東京"]   # >=0x80 bytes are letters
+    # coverage over the whole byte range never crashes or drops bytes
+    everything = bytes(range(256))
+    spans = _pretokenize(everything)
+    assert spans[0][0] == 0 and spans[-1][1] == 256
+    assert all(a < b for a, b in spans)
+    assert [a for a, _ in spans[1:]] == [b for _, b in spans[:-1]]
+
+
+def test_merges_never_cross_pretoken_boundaries(tok):
+    """Encoding a concatenation equals concatenating the encodes when
+    the boundary is a pretoken boundary — the quality property that
+    motivates pretokenization."""
+    a, b = "the quick", " brown fox"
+    assert tok.encode(a + b) == tok.encode(a) + tok.encode(b)
+
+
+def test_v1_file_loads_without_pretokenization(tok, tmp_path):
+    """Old saved files (format v1) keep their original whole-string
+    merge behavior."""
+    import json as _json
+
+    p = str(tmp_path / "v1.json")
+    with open(p, "w") as f:
+        _json.dump({"format": "autodist-bpe-v1",
+                    "merges": tok.merges}, f)
+    old = BPETokenizer.load(p)
+    assert old.pretokenize is False
+    s = "the quick brown fox"
+    assert old.decode(old.encode(s)) == s
+
+
+def test_special_tokens(tok, tmp_path):
+    """Registration, atomic encode under with_special, plain-encode
+    immunity, decode rendering, and v2 persistence."""
+    t = BPETokenizer(tok.merges)
+    ids = t.add_special_tokens(["<eos>", "<pad>"])
+    assert t.eos_id == ids["<eos>"] and t.pad_id == ids["<pad>"]
+    assert t.vocab_size == ids["<pad>"] + 1
+    s = "hello<eos>world"
+    with_sp = t.encode(s, with_special=True)
+    assert t.eos_id in with_sp
+    assert with_sp == t.encode("hello") + [t.eos_id] + t.encode("world")
+    # plain encode treats the literal text as bytes, never the id
+    assert t.eos_id not in t.encode(s)
+    assert t.decode(with_sp) == s
+    p = str(tmp_path / "sp.json")
+    t.save(p)
+    t2 = BPETokenizer.load(p)
+    assert t2.special_tokens == t.special_tokens
+    assert t2.encode(s, with_special=True) == with_sp
+    with pytest.raises(ValueError, match="already registered"):
+        t2.add_special_tokens(["<eos>"])
+    with pytest.raises(ValueError, match="collides"):
+        BPETokenizer(tok.merges, special_tokens={"<x>": 0})
+
+
+def test_serve_wires_tokenizer_eos(tok):
+    """serve() picks up the tokenizer's <eos> as the engine eos_id."""
+    import jax
+
+    from autodist_tpu.models.transformer import dense_attention
+    from autodist_tpu.models.transformer_lm import transformer_lm
+    from autodist_tpu.serving.server import serve
+
+    t = BPETokenizer(tok.merges)
+    t.add_special_tokens(["<eos>"])
+    spec = transformer_lm(vocab_size=t.vocab_size, num_layers=1,
+                          num_heads=2, head_dim=8, d_ff=32, max_len=32,
+                          seq_len=16, attn_fn=dense_attention)
+    params = spec.init(jax.random.PRNGKey(0))
+    srv = serve(spec, params, port=0, tokenizer=t, slots=1, window=16)
+    try:
+        assert srv._engine._eos_id == t.eos_id
+    finally:
+        srv.close()
+
+
+def test_train_on_repo_corpus():
+    """Train on a real multi-hundred-KB corpus (this repo's docs +
+    README): round-trips exactly, compresses, and native matches the
+    Python path on real text."""
+    import glob
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = sorted(glob.glob(os.path.join(root, "*.md"))) + \
+        sorted(glob.glob(os.path.join(root, "docs", "*.md"))) + \
+        sorted(glob.glob(os.path.join(root, "examples", "*.py")))
+    texts = []
+    for p in paths:
+        with open(p, encoding="utf-8") as f:
+            texts.append(f.read())
+    assert sum(len(t) for t in texts) > 100_000, "corpus too small"
+    t = BPETokenizer.train(texts, vocab_size=256 + 512,
+                           special_tokens=["<eos>", "<pad>"])
+    assert len(t.merges) == 512
+    sample = texts[0][:20_000]
+    ids = t.encode(sample)
+    assert t.decode(ids) == sample
+    # real compression: well under one token per byte
+    assert len(ids) < 0.55 * len(sample.encode("utf-8"))
+    if native.native_available():
+        assert ids == t._encode_py(sample.encode("utf-8"))
 
 
 def test_save_load_roundtrip(tok, tmp_path):
