@@ -466,15 +466,12 @@ class GraphTransformer:
 
         gi = self.graph_item
         mesh = self.compiled.mesh
-        has_partitioned = any(p.param_spec != P()
-                              for p in self.compiled.var_plans.values())
         # extra metrics run OUTSIDE shard_map, on the updated params and the
         # GLOBAL batch — identical semantics to the GSPMD path (inside the
         # mapped step they would see only the local data shard and get
         # pmean-averaged, silently changing non-mean metrics).
-        step_fn, init_fn, init_sync, replicated = \
-            explicit_sync.make_explicit_step(gi, self.compiled,
-                                             has_partitioned)
+        step_fn, init_fn, init_sync, param_sh, opt_sh = \
+            explicit_sync.make_explicit_step(gi, self.compiled)
         if extra_metrics_fn is not None:
             inner_step = step_fn
 
@@ -488,7 +485,6 @@ class GraphTransformer:
             # Donation must live on the OUTER jit (the inner jit inlines
             # under tracing and its donate_argnums are ignored).
             step_fn = jax.jit(wrapped, donate_argnums=(0, 1, 2))
-        param_sh = jax.tree_util.tree_map(lambda _: replicated, gi.params)
         eval_fn = jax.jit(
             _make_eval_step(gi.loss_fn, gi.has_aux, extra_metrics_fn))
         logging.info(
@@ -496,7 +492,7 @@ class GraphTransformer:
             dict(mesh.shape), len(self.compiled.var_plans))
         return DistributedStep(
             step_fn=step_fn, init_fn=init_fn, init_sync_state=init_sync,
-            param_shardings=param_sh, opt_shardings=replicated,
+            param_shardings=param_sh, opt_shardings=opt_sh,
             mesh=mesh, compiled_strategy=self.compiled, eval_fn=eval_fn)
 
 

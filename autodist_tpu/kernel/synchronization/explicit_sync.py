@@ -7,19 +7,27 @@ through a compressor (``all_reduce_synchronizer.py:100-127``,
 ``compressor.py:85-96``).
 
 Semantics: the whole train step runs inside ``shard_map`` over the mesh.
-Parameters and optimizer state are replicated; the batch is sharded over
-``data``; each device computes local gradients (accumulated over
-``capture(accum_steps=N)`` microbatches of its local slice when asked —
-still ONE compressed collective per step), every variable's gradient is
-averaged over ``data`` through its compressor, and the (identical) update is
+The batch is sharded over ``data``; each device computes local gradients
+(accumulated over ``capture(accum_steps=N)`` microbatches of its local slice
+when asked — still ONE compressed collective per step), every variable's
+gradient is averaged over ``data`` through its compressor, and the update is
 applied on all devices.  Per-device compressor state (error-feedback
 residuals, PowerSGD factors) is carried as a *sync state* pytree with a
 leading per-shard axis, sharded over ``data`` so each device owns its slice.
 
-Restriction: compressors require replicated parameters — model-axis
-partitioned variables would make the user's loss function responsible for
-manual tensor-parallel math inside shard_map.  The transformer falls back to
-replication (with a warning) for such variables when a compressor is active.
+Partitioned variables COMPOSE with compression (the reference can express
+PartitionedAR + compressor — ``proto/synchronizers.proto:24-57``): a var
+sharded over a non-data mesh axis stays sharded outside the step; inside,
+it is all-gathered for the user's loss, its gradient is sliced back to the
+local shard, and the data-axis reduction of the SHARD runs through the
+compressor — per-shard compressed reduction, each partition reduced
+independently (the reference's per-shard synchronizer structure), with the
+parameter + optimizer-state memory of true partitioning.  Per-variable
+fallback to replication (with a warning) covers the cases where the
+composition is not defined: vars sharded over ``data`` itself (PS shards on
+a pure-DP mesh — the reduction axis and the shard axis coincide),
+pad-to-divisible vars, multi-axis shardings, and PowerSGD (its low-rank
+state is not grad-shaped, so the per-shard state layout does not apply).
 """
 from __future__ import annotations
 
@@ -61,20 +69,84 @@ def _compressors_for(gi: GraphItem, compiled: CompiledStrategy
     return out
 
 
-def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy,
-                       has_partitioned_vars: bool):
-    """Returns (step_fn, init_opt_fn, init_sync_state_fn, shardings...)
-    consumed by the GraphTransformer."""
+def _grad_shaped_state(comp: Compressor, shape: tuple, dtype) -> bool:
+    """True when ``comp``'s per-device state for a value of ``shape`` is
+    None or a single array of exactly that shape — the structural
+    requirement for the per-shard partitioned state layout (one leading
+    data axis + the var's own sharding applied to every leaf).  Probed
+    abstractly (eval_shape): no state is materialized."""
+    probe = jax.eval_shape(comp.init_state,
+                           jax.ShapeDtypeStruct(shape, dtype))
+    if probe is None:
+        return True
+    leaves = jax.tree_util.tree_leaves(probe)
+    return len(leaves) == 1 and tuple(leaves[0].shape) == tuple(shape)
+
+
+def _partition_support(gi: GraphItem, compiled: CompiledStrategy,
+                       comps: Dict[str, Compressor]) -> Dict[str, tuple]:
+    """Which partitioned vars keep their sharding on the explicit path:
+    ``{name: (axis_name, part_axis, n_shards)}``.  Unsupported cases
+    (see module docstring) are replicated per-variable with a warning."""
+    part: Dict[str, tuple] = {}
+    pad_names = set(compiled.pad_plans())
+    leaves = gi.name_to_leaf()
+    for name, plan in compiled.var_plans.items():
+        spec = plan.param_spec
+        if spec == P():
+            continue
+        sharded = [(i, e) for i, e in enumerate(spec) if e is not None]
+        axes = []
+        for _, e in sharded:
+            axes.extend([e] if isinstance(e, str) else list(e))
+        leaf = jnp.asarray(leaves[name])
+        why = None
+        if name in pad_names:
+            why = "pad-to-divisible sharding"
+        elif len(sharded) != 1 or len(axes) != 1:
+            why = f"multi-axis sharding {spec}"
+        elif MESH_AXIS_DATA in axes:
+            why = "sharded over the data (reduction) axis"
+        else:
+            part_axis, axis_name = sharded[0][0], axes[0]
+            n = compiled.mesh.shape[axis_name]
+            if leaf.shape[part_axis] % n:  # pragma: no cover - padded
+                why = f"dim {leaf.shape[part_axis]} not divisible by {n}"
+            else:
+                shard = list(leaf.shape)
+                shard[part_axis] //= n
+                if not _grad_shaped_state(comps[name], tuple(shard),
+                                          leaf.dtype):
+                    why = (f"{comps[name].name} state is not grad-shaped"
+                           f" (e.g. PowerSGD low-rank factors)")
+        if why is not None:
+            logging.warning(
+                "explicit sync path: replicating %s (%s); its "
+                "partitioning is dropped for this program", name, why)
+            continue
+        part[name] = (axis_name, part_axis, n)
+    return part
+
+
+def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
+    """Returns (step_fn, init_opt_fn, init_sync_state_fn, param_sh_tree,
+    opt_sh_tree) consumed by the GraphTransformer."""
     import optax
+
+    from autodist_tpu.kernel import sharding_utils as su
 
     mesh = compiled.mesh
     d = mesh.shape.get(MESH_AXIS_DATA, 1)
-    if has_partitioned_vars:
-        logging.warning(
-            "compressors force replicated parameters on the explicit sync "
-            "path; model-axis partitioning is ignored for this program")
-
     comps = _compressors_for(gi, compiled)
+    part = _partition_support(gi, compiled, comps)
+
+    # Effective per-var specs: the plan's spec for supported partitioned
+    # vars, replicated for everything else.
+    eff_specs = {name: (plan.param_spec if name in part else P())
+                 for name, plan in compiled.var_plans.items()}
+    param_spec_tree = su.spec_tree_for_params(gi.params, eff_specs)
+    param_sh_tree = su.sharding_tree(mesh, param_spec_tree)
+
     vg = jax.value_and_grad(gi.loss_fn, has_aux=gi.has_aux)
     if gi.accum_steps > 1:
         # Gradient accumulation composes with compression exactly where it
@@ -87,15 +159,25 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy,
     optimizer = gi.frozen_aware_optimizer()
     has_aux = gi.has_aux
 
+    # Optimizer-state layout: param-shaped blocks follow the effective
+    # param spec (shard-local moments for partitioned vars — the real
+    # memory win of keeping the partitioning); scalars replicate.
+    opt_shape = jax.eval_shape(optimizer.init, gi.params)
+    opt_spec_tree = su.opt_spec_tree(opt_shape, gi.params, param_spec_tree)
+    opt_sh_tree = su.sharding_tree(mesh, opt_spec_tree)
+
     # Trace-time fusion table (reference chunk merge): vars in the same
     # group are concatenated into ONE pmean.  Split by dtype — a fused
-    # vector must be homogeneous.
+    # vector must be homogeneous.  Partitioned vars own their per-shard
+    # collective and never fuse.
     fuse_member: Dict[str, tuple] = {}
     if d > 1:
         leaves = gi.name_to_leaf()
         for group, names in compiled.fusable_groups().items():
             by_dtype: Dict[str, list] = {}
             for n in names:
+                if n in part:
+                    continue
                 by_dtype.setdefault(str(jnp.asarray(leaves[n]).dtype),
                                     []).append(n)
             for dt, ns in by_dtype.items():
@@ -103,26 +185,74 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy,
                     for n in ns:
                         fuse_member[n] = (group, dt)
 
+    def _shard_shape(name: str, leaf) -> tuple:
+        shape = list(jnp.asarray(leaf).shape)
+        if name in part:
+            _, ax, n = part[name]
+            shape[ax] //= n
+        return tuple(shape)
+
     # -- sync state --------------------------------------------------------
+    # Which vars carry state and under which spec, probed abstractly ONCE
+    # (eval_shape — no full-model state is materialized just to test for
+    # None); consumed by both the shard_map specs and init_sync_state.
+    name_leaves = {n: jnp.asarray(v) for n, v in gi.name_to_leaf().items()}
+    sync_specs: Dict[str, P] = {}
+    for name, leaf in name_leaves.items():
+        probe = jax.eval_shape(
+            comps[name].init_state,
+            jax.ShapeDtypeStruct(_shard_shape(name, leaf), leaf.dtype))
+        if probe is None:
+            continue
+        sync_specs[name] = P(MESH_AXIS_DATA,
+                             *compiled.var_plans[name].param_spec) \
+            if name in part else P(MESH_AXIS_DATA)
+
     def init_sync_state(current_params=None):
         # Compressor residuals start at zero regardless of parameter values,
         # so current_params only matters for shape (identical to capture-time).
         state: Dict[str, Any] = {}
-        for name, leaf in gi.name_to_leaf().items():
-            per_dev = comps[name].init_state(jnp.asarray(leaf))
-            if per_dev is None:
-                continue
-            state[name] = jax.tree_util.tree_map(
-                lambda s: jnp.broadcast_to(s[None], (d,) + s.shape).copy(),
-                per_dev)
-        return jax.device_put(state, NamedSharding(mesh, P(MESH_AXIS_DATA)))
+        for name, spec in sync_specs.items():
+            leaf = name_leaves[name]
+            if name in part:
+                # Supported partitioned state is grad-shaped and all-zero
+                # (_grad_shaped_state gated it; every such compressor's
+                # init is zeros_like): build it directly in its target
+                # sharding, (d,) + FULL shape with the var's own axes
+                # shifted by 1 — each device owns its shard's residual.
+                shape = (d,) + leaf.shape
+                state[name] = jax.jit(
+                    lambda shape=shape, dt=leaf.dtype: jnp.zeros(shape, dt),
+                    out_shardings=NamedSharding(mesh, spec))()
+            else:
+                per_dev = comps[name].init_state(leaf)
+                stacked = jax.tree_util.tree_map(
+                    lambda s: jnp.broadcast_to(s[None],
+                                               (d,) + s.shape).copy(),
+                    per_dev)
+                state[name] = jax.device_put(
+                    stacked, NamedSharding(mesh, spec))
+        return state
 
     # -- the local (per-shard) step ---------------------------------------
     def local_step(params, opt_state, sync_state, batch):
+        # Reconstruct full tensors for the user's loss: sharded vars are
+        # all-gathered over their partition axis (what GSPMD inserts for
+        # a fully-consumed sharded param; here it is explicit).
+        flat_p, ptree = jax.tree_util.tree_flatten_with_path(params)
+        full_leaves = []
+        for path, x in flat_p:
+            info = part.get(path_name(path))
+            if info is not None:
+                axis_name, ax, _ = info
+                x = lax.all_gather(x, axis_name, axis=ax, tiled=True)
+            full_leaves.append(x)
+        full_params = jax.tree_util.tree_unflatten(ptree, full_leaves)
+
         if has_aux:
-            (loss, aux), grads = vg(params, batch)
+            (loss, aux), grads = vg(full_params, batch)
         else:
-            loss, grads = vg(params, batch)
+            loss, grads = vg(full_params, batch)
             aux = None
 
         flat, treedef = jax.tree_util.tree_flatten_with_path(grads)
@@ -135,6 +265,16 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy,
             if key is not None:
                 fused_parts.setdefault(key, []).append((i, g))
                 continue
+            info = part.get(name)
+            if info is not None:
+                # Per-shard compressed reduction: slice this device's
+                # shard of the full gradient, then compress its data-axis
+                # mean.  Slicing commutes with the mean, so the result is
+                # exact; only the shard crosses the compressed wire.
+                axis_name, ax, n = info
+                size = g.shape[ax] // n
+                idx = lax.axis_index(axis_name)
+                g = lax.dynamic_slice_in_dim(g, idx * size, size, ax)
             st = sync_state.get(name)
             local_st = None if st is None else jax.tree_util.tree_map(
                 lambda x: jnp.squeeze(x, 0), st)
@@ -155,6 +295,11 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy,
         grads = jax.tree_util.tree_unflatten(
             treedef, synced) if synced else grads
 
+        # Shard-local update: grads, params, and opt state all carry the
+        # per-device shard shapes, so elementwise optimizers (SGD, Adam*)
+        # update each partition in place.  (An optimizer coupling across
+        # parameters — e.g. global-norm clipping — would need its own
+        # collectives here; use the GSPMD path for those.)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         metrics = {"loss": lax.pmean(loss, MESH_AXIS_DATA)}
@@ -173,11 +318,11 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy,
     # collective escapes the compressor entirely.
     mapped = jax.shard_map(
         local_step, mesh=mesh,
-        in_specs=(P(), P(), P(MESH_AXIS_DATA), P(MESH_AXIS_DATA)),
-        out_specs=(P(), P(), P(MESH_AXIS_DATA), P()),
+        in_specs=(param_spec_tree, opt_spec_tree, dict(sync_specs),
+                  P(MESH_AXIS_DATA)),
+        out_specs=(param_spec_tree, opt_spec_tree, dict(sync_specs), P()),
         check_vma=False)
     step_fn = jax.jit(mapped, donate_argnums=(0, 1, 2))
 
-    replicated = NamedSharding(mesh, P())
-    init_opt_fn = jax.jit(optimizer.init, out_shardings=replicated)
-    return step_fn, init_opt_fn, init_sync_state, replicated
+    init_opt_fn = jax.jit(optimizer.init, out_shardings=opt_sh_tree)
+    return step_fn, init_opt_fn, init_sync_state, param_sh_tree, opt_sh_tree

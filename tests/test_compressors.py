@@ -200,3 +200,76 @@ def test_int8_error_feedback_carries_quantization_error():
 def test_int8_compressor_converges():
     sess, losses = _run_with_compressor("Int8Compressor", steps=60)
     assert losses[-1] < losses[0] * 0.05, losses
+
+
+def test_partitioned_vars_compose_with_compressor():
+    """PartitionedAR + compressor keeps its partitioning (VERDICT r4 #6;
+    reference-expressible config, proto/synchronizers.proto:24-57): on a
+    (data x model) mesh the partitioned var stays MODEL-SHARDED outside
+    the explicit step while its data-axis reduction is compressed
+    per-shard.  bf16 cast and EF are elementwise, so per-shard
+    compression equals whole-tensor compression: losses must match the
+    replicated compressor run to float tolerance."""
+    from autodist_tpu.kernel.synchronization import explicit_sync
+    from autodist_tpu.strategy import PartitionedAR
+
+    params, loss_fn, batch = _make_problem()
+
+    _reset_default_autodist_for_testing()
+    ad = AutoDist(strategy_builder=PartitionedAR(
+        chunk_size=1, compressor="HorovodCompressorEF"),
+        mesh_axes={"data": 4, "model": 2})
+    with ad.scope():
+        ad.capture(params=params, optimizer=optax.sgd(0.1), loss_fn=loss_fn)
+    sess = ad.create_distributed_session()
+    assert explicit_sync.uses_explicit_path(sess._step.compiled_strategy)
+
+    # the partitioned var is REALLY sharded over the model axis
+    w = sess.sharded_params["linear"]["w"]
+    w_spec = w.sharding.spec
+    assert any("model" in (e if isinstance(e, tuple) else (e,))
+               for e in w_spec if e is not None), w_spec
+    # ...and so are its param-shaped optimizer slots (sgd has none, but
+    # sync residuals exist for EF): residual sharded over data x model
+    sync = sess.sync_state
+    res_spec = jax.tree_util.tree_leaves(
+        jax.tree_util.tree_map(lambda x: x.sharding.spec, sync))[0]
+    flat = []
+    for e in res_spec:
+        flat.extend(e if isinstance(e, tuple) else (e,))
+    assert "data" in flat and "model" in flat, res_spec
+
+    losses = [float(sess.run(batch)["loss"]) for _ in range(20)]
+
+    # Oracle: same compressor, same (data x model) mesh, REPLICATED
+    # params (AllReduce) — identical local grads and identical bf16
+    # rounding, so per-shard compression must reproduce whole-tensor
+    # compression to float tolerance.
+    _reset_default_autodist_for_testing()
+    ad2 = AutoDist(strategy_builder=AllReduce(
+        compressor="HorovodCompressorEF"),
+        mesh_axes={"data": 4, "model": 2})
+    with ad2.scope():
+        ad2.capture(params=params, optimizer=optax.sgd(0.1),
+                    loss_fn=loss_fn)
+    sess2 = ad2.create_distributed_session()
+    repl_losses = [float(sess2.run(batch)["loss"]) for _ in range(20)]
+    np.testing.assert_allclose(losses, repl_losses, rtol=1e-4)
+    assert losses[-1] < losses[0] * 0.25
+
+
+def test_partitioned_powersgd_falls_back_to_replication():
+    """PowerSGD state is not grad-shaped: a partitioned var under it
+    replicates (warned) but still trains correctly."""
+    from autodist_tpu.strategy import PartitionedAR
+
+    params, loss_fn, batch = _make_problem()
+    _reset_default_autodist_for_testing()
+    ad = AutoDist(strategy_builder=PartitionedAR(
+        chunk_size=1, compressor="PowerSGDCompressor"),
+        mesh_axes={"data": 4, "model": 2})
+    with ad.scope():
+        ad.capture(params=params, optimizer=optax.sgd(0.1), loss_fn=loss_fn)
+    sess = ad.create_distributed_session()
+    losses = [float(sess.run(batch)["loss"]) for _ in range(40)]
+    assert losses[-1] < losses[0] * 0.3, losses
