@@ -80,7 +80,8 @@ def _grad_shaped_state(comp: Compressor, shape: tuple, dtype) -> bool:
     if probe is None:
         return True
     leaves = jax.tree_util.tree_leaves(probe)
-    return len(leaves) == 1 and tuple(leaves[0].shape) == tuple(shape)
+    return (len(leaves) == 1 and tuple(leaves[0].shape) == tuple(shape)
+            and leaves[0].dtype == dtype)
 
 
 def _partition_support(gi: GraphItem, compiled: CompiledStrategy,
@@ -176,8 +177,10 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
         for group, names in compiled.fusable_groups().items():
             by_dtype: Dict[str, list] = {}
             for n in names:
-                if n in part:
-                    continue
+                # fusable_groups() already excludes partitioned and
+                # compressed vars (strategy/compiler.py); a partitioned
+                # var in a fused group would double-own its collective.
+                assert n not in part, n
                 by_dtype.setdefault(str(jnp.asarray(leaves[n]).dtype),
                                     []).append(n)
             for dt, ns in by_dtype.items():
@@ -215,15 +218,27 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
         for name, spec in sync_specs.items():
             leaf = name_leaves[name]
             if name in part:
-                # Supported partitioned state is grad-shaped and all-zero
-                # (_grad_shaped_state gated it; every such compressor's
-                # init is zeros_like): build it directly in its target
-                # sharding, (d,) + FULL shape with the var's own axes
-                # shifted by 1 — each device owns its shard's residual.
-                shape = (d,) + leaf.shape
+                # Partitioned state is built THROUGH the compressor's own
+                # init_state on a shard-shaped zero input (the gate and
+                # the construction cannot diverge), tiled to (d,) + FULL
+                # shape directly in its target sharding — each device
+                # owns its shard's state.
+                _, ax, n = part[name]
+                shard = _shard_shape(name, leaf)
+
+                def _build(comp=comps[name], shard=shard, dt=leaf.dtype,
+                           ax=ax, n=n):
+                    def expand(s):
+                        reps = [n if i == ax else 1
+                                for i in range(s.ndim)]
+                        tiled = jnp.tile(s, reps)
+                        return jnp.broadcast_to(tiled[None],
+                                                (d,) + tiled.shape)
+                    return jax.tree_util.tree_map(
+                        expand, comp.init_state(jnp.zeros(shard, dt)))
+
                 state[name] = jax.jit(
-                    lambda shape=shape, dt=leaf.dtype: jnp.zeros(shape, dt),
-                    out_shardings=NamedSharding(mesh, spec))()
+                    _build, out_shardings=NamedSharding(mesh, spec))()
             else:
                 per_dev = comps[name].init_state(leaf)
                 stacked = jax.tree_util.tree_map(

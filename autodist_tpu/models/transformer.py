@@ -122,11 +122,28 @@ class TransformerStack(nn.Module):
     d_ff: int
     causal: bool = False
     attn_fn: Callable = staticmethod(dense_attention)
+    # Per-layer rematerialization: "none" keeps all activations; "full"
+    # recomputes the whole layer in the backward pass (max memory saving,
+    # +1 forward of FLOPs); "dots" saves matmul outputs and recomputes
+    # the cheap elementwise tail (the usual MFU sweet spot: batch can
+    # grow into the freed HBM while the recompute rides the idle MXU).
+    remat: str = "none"
 
     @nn.compact
     def __call__(self, x):
+        if self.remat not in ("none", "full", "dots"):
+            raise ValueError(f"remat={self.remat!r}: expected 'none', "
+                             f"'full', or 'dots'")
+        layer_cls = TransformerLayer
+        if self.remat != "none":
+            policy = {
+                "full": None,
+                "dots": jax.checkpoint_policies.checkpoint_dots,
+            }[self.remat]
+            layer_cls = nn.remat(TransformerLayer, policy=policy,
+                                 prevent_cse=False)
         for i in range(self.num_layers):
-            x = TransformerLayer(self.num_heads, self.head_dim, self.d_ff,
-                                 self.causal, attn_fn=self.attn_fn,
-                                 name=f"layers_{i}")(x)
+            x = layer_cls(self.num_heads, self.head_dim, self.d_ff,
+                          self.causal, attn_fn=self.attn_fn,
+                          name=f"layers_{i}")(x)
         return nn.LayerNorm(name="ln_final", use_bias=False)(x)

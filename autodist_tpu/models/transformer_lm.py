@@ -31,6 +31,7 @@ class TransformerLM(nn.Module):
     max_len: int
     attn_fn: Callable = staticmethod(dense_attention)
     dtype: jnp.dtype = jnp.float32
+    remat: str = "none"
 
     def setup(self):
         d_model = self.num_heads * self.head_dim
@@ -40,7 +41,7 @@ class TransformerLM(nn.Module):
                                     (self.max_len, d_model), self.dtype)
         self.decoder = TransformerStack(
             self.num_layers, self.num_heads, self.head_dim, self.d_ff,
-            causal=True, attn_fn=self.attn_fn)
+            causal=True, attn_fn=self.attn_fn, remat=self.remat)
 
     def features(self, tokens):
         """Pre-logits activations ``[B, T, D]`` — paired with the tied
@@ -61,20 +62,25 @@ def transformer_lm(vocab_size: int = 32128, num_layers: int = 12,
                    d_ff: int = 3072, max_len: int = 1024,
                    attn_fn: Optional[Callable] = None,
                    dtype=jnp.float32, seq_len: Optional[int] = None,
-                   xent_chunk: Optional[int] = None) -> ModelSpec:
+                   xent_chunk: Optional[int] = None,
+                   remat: str = "none") -> ModelSpec:
     """GPT-2-small-ish defaults; shrink for tests.
 
     ``attn_fn=None`` → backend default: the Pallas flash kernel on TPU,
     dense softmax elsewhere (``models/transformer.py:default_attention``).
     ``xent_chunk`` → train with the chunked-vocab cross entropy
     (``ops/chunked_xent.py``): the ``[B, T, vocab]`` logits never
-    materialize — worth ~2 GB of peak HBM at batch 16 × seq 2048."""
+    materialize — worth ~2 GB of peak HBM at batch 16 × seq 2048.
+    ``remat`` → per-layer rematerialization ("none" | "dots" | "full",
+    see ``TransformerStack.remat``): trade recompute FLOPs for
+    activation HBM, usually to grow the batch into the freed memory."""
     from autodist_tpu.models.transformer import default_attention
 
     attn_fn = attn_fn or default_attention()
     seq_len = seq_len or max_len
     model = TransformerLM(vocab_size, num_layers, num_heads, head_dim, d_ff,
-                          max_len, attn_fn=attn_fn, dtype=dtype)
+                          max_len, attn_fn=attn_fn, dtype=dtype,
+                          remat=remat)
 
     def init(rng):
         tokens = jnp.zeros((2, seq_len), jnp.int32)

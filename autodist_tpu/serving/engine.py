@@ -322,6 +322,25 @@ class DecodeEngine:
 
         self._mesh = mesh
         self._slot_axis = slot_axis
+        # Multi-PROCESS serving (slot pool sharded across machines): the
+        # host scheduler runs identically in every process (same inputs,
+        # same numpy bookkeeping → SPMD lockstep dispatches), but host
+        # pulls of device state must go through a replicating identity
+        # program — a non-addressable shard (another process's slots)
+        # cannot be np.array'd directly.  Single-process engines keep the
+        # direct (collective-free) pulls.
+        self._replicate = None
+        self._pull_row = None
+        if mesh is not None and jax.process_count() > 1:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            rep = NamedSharding(mesh, P())
+            self._replicate = jax.jit(
+                lambda x: x, out_shardings=rep)
+            self._pull_row = jax.jit(
+                lambda t, b: lax.dynamic_index_in_dim(
+                    t, b, 0, keepdims=False),
+                out_shardings=rep)
         self._alloc_state()
 
         # The static half of the compiled programs' signature (see the
@@ -508,7 +527,10 @@ class DecodeEngine:
         s, pe, e = int(self._start[b]), int(self._p_end[b]), \
             int(self._end[b])
         written = min(e, self._tick + 1)
-        row = np.array(self._tokens[b])
+        if self._pull_row is not None:   # cross-process slot row
+            row = np.array(self._pull_row(self._tokens, jnp.int32(b)))
+        else:
+            row = np.array(self._tokens[b])
         seq = row[(s + np.arange(written - s)) % self._window]
         if self._eos_id >= 0:
             gen = seq[pe - s:]
@@ -663,6 +685,8 @@ class DecodeEngine:
                 self._vc, jnp.asarray(prompts), jnp.asarray(slot_ids),
                 jnp.asarray(row_map), np.int32(t0), jnp.asarray(p_lens),
                 sub)
+            if self._replicate is not None:
+                toks = self._replicate(toks)
             toks = np.array(toks)
         except Exception:
             self._poisoned = True
@@ -744,6 +768,8 @@ class DecodeEngine:
                 jnp.int32(self._tick), sub)
             # The only per-chunk host pull: the [B] done vector (the
             # token buffer stays on device; harvest/partial pull rows).
+            if self._replicate is not None:
+                done, busy = self._replicate(done), self._replicate(busy)
             self._done = np.array(done)
         except Exception:
             self._poisoned = True
