@@ -330,6 +330,7 @@ class DecodeEngine:
         # cannot be np.array'd directly.  Single-process engines keep the
         # direct (collective-free) pulls.
         self._replicate = None
+        self._replicate2 = None
         self._pull_row = None
         if mesh is not None and jax.process_count() > 1:
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -337,6 +338,10 @@ class DecodeEngine:
             rep = NamedSharding(mesh, P())
             self._replicate = jax.jit(
                 lambda x: x, out_shardings=rep)
+            # done+busy replicate in ONE program: the per-chunk hot path
+            # pays one collective launch, not two.
+            self._replicate2 = jax.jit(
+                lambda a, b: (a, b), out_shardings=(rep, rep))
             self._pull_row = jax.jit(
                 lambda t, b: lax.dynamic_index_in_dim(
                     t, b, 0, keepdims=False),
@@ -768,8 +773,8 @@ class DecodeEngine:
                 jnp.int32(self._tick), sub)
             # The only per-chunk host pull: the [B] done vector (the
             # token buffer stays on device; harvest/partial pull rows).
-            if self._replicate is not None:
-                done, busy = self._replicate(done), self._replicate(busy)
+            if self._replicate2 is not None:
+                done, busy = self._replicate2(done, busy)
             self._done = np.array(done)
         except Exception:
             self._poisoned = True

@@ -61,6 +61,20 @@ class EngineServer:
                  port: int = 0, tokenizer=None,
                  request_timeout_s: float = 600.0):
         self._engine = engine
+        if getattr(engine, "_replicate", None) is not None:
+            # A multi-PROCESS engine requires every process to drive the
+            # scheduler in SPMD lockstep (identical submissions -> its
+            # host pulls are cross-process collectives).  HTTP requests
+            # land on ONE process, so serving it here would hang the
+            # other processes in the first collective — fail at
+            # construction instead.  Multi-process serving is driven by
+            # a lockstep harness (tests/integration/dist_train.py).
+            raise ValueError(
+                "EngineServer cannot drive a multi-process DecodeEngine: "
+                "HTTP requests arrive on one process while the engine's "
+                "host pulls are cross-process collectives requiring SPMD "
+                "lockstep; run the server on a single-process mesh, or "
+                "drive the multi-process engine from a lockstep script")
         self._tokenizer = tokenizer
         tok_vocab = getattr(tokenizer, "vocab_size", None)
         if tok_vocab is not None and tok_vocab < engine._vocab:

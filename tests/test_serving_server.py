@@ -203,7 +203,10 @@ def test_late_submit_joins_running_batch(lm):
     import time as _time
 
     spec, params = lm
-    eng = DecodeEngine(spec, params, slots=2, window=24, chunk=2)
+    # Wide margin for loaded CI hosts: the long request holds ~24
+    # throttled chunks (>1 s) after the short one lands, while the short
+    # one needs ~2 — ordering survives coarse thread scheduling.
+    eng = DecodeEngine(spec, params, slots=2, window=48, chunk=2)
     orig_step = eng.step
     eng.step = lambda: (_time.sleep(0.05), orig_step())[1]
     done_order = []
@@ -215,7 +218,7 @@ def test_late_submit_joins_running_batch(lm):
         done_order.append(tag)
 
     with EngineServer(eng, port=0, request_timeout_s=120) as srv:
-        t_long = threading.Thread(target=issue, args=("long", 20))
+        t_long = threading.Thread(target=issue, args=("long", 46))
         t_long.start()
         _time.sleep(0.4)    # several throttled chunks into the long decode
         t_short = threading.Thread(target=issue, args=("short", 2))
