@@ -70,6 +70,18 @@ from autodist_tpu.models.generate import (_prefill_forward, _token_step,
 from autodist_tpu.models.quantize import head_logits
 
 
+def _sample_per_slot(logits, key, temp, top_k, top_p):
+    """Per-slot temperature over one logits batch [B, V]: rows with
+    ``temp[b] == 0`` take the argmax, others sample from
+    ``logits / temp[b]`` through the engine-wide static top-k/top-p
+    filters (``sample_next_token`` at temperature 1.0 on the pre-scaled
+    logits — the single definition of the filters)."""
+    greedy = jnp.argmax(logits, axis=-1)
+    scaled = logits.astype(jnp.float32) / jnp.maximum(temp, 1e-6)[:, None]
+    sampled = sample_next_token(scaled, key, 1.0, top_k, top_p)
+    return jnp.where(temp > 0.0, sampled, greedy)
+
+
 # The two compiled programs live at module scope so the jit cache is
 # shared across DecodeEngine instances: a server that rebuilds its
 # engine (model reload, knob change) re-traces nothing that an earlier
@@ -80,9 +92,14 @@ from autodist_tpu.models.quantize import head_logits
 @functools.partial(jax.jit, static_argnums=(0, 1),
                    donate_argnums=(3, 4, 5))
 def _chunk_program(n, knobs, params, tokens, kc, vc, start, p_end, end,
-                   done, active, tick0, key):
-    """``n`` decode ticks of all slots in lockstep (see DecodeEngine)."""
-    temperature, top_k, top_p, eos_id = knobs
+                   done, active, temp, eos, tick0, key):
+    """``n`` decode ticks of all slots in lockstep (see DecodeEngine).
+
+    ``temp`` [B] f32 and ``eos`` [B] i32 are TRACED per-slot sampling
+    knobs (temperature 0 = greedy; eos -1 = none): per-REQUEST values
+    ride through without recompiles.  ``knobs`` = (top_k, top_p) stay
+    static — they select trace-time filter branches."""
+    top_k, top_p = knobs
     num_layers, window = kc.shape[0], kc.shape[1]
     embed, pos_embed, layer_params, ln_final = unpack_lm_params(
         params, num_layers)
@@ -103,8 +120,8 @@ def _chunk_program(n, knobs, params, tokens, kc, vc, start, p_end, end,
             layer_params, ln_final, embed, x, kc, vc, t_ring, window,
             attn_mask=mask)
         key, sub = jax.random.split(key)
-        raw = sample_next_token(logits, sub, temperature, top_k,
-                                top_p).astype(tokens.dtype)
+        raw = _sample_per_slot(logits, sub, temp, top_k,
+                               top_p).astype(tokens.dtype)
         busy = jnp.sum((active & ~done).astype(jnp.int32))
         # Teacher-force while inside the prompt; only live slots write;
         # a finished slot's buffer is left as-is (harvest pads eos on
@@ -115,8 +132,8 @@ def _chunk_program(n, knobs, params, tokens, kc, vc, start, p_end, end,
         live = active & ~done
         nxt = jnp.where(in_gen & live, raw, cur)
         tokens = lax.dynamic_update_index_in_dim(tokens, nxt, w_ring, 1)
-        if eos_id >= 0:
-            done = done | (in_gen & live & (raw == eos_id))
+        # per-slot eos (-1 = none, never matches a generated id >= 0)
+        done = done | (in_gen & live & (raw == eos))
         # The final token of slot b lands at buffer index end[b]-1,
         # written by tick end[b]-2.
         done = done | (t + 2 >= end)
@@ -130,7 +147,7 @@ def _chunk_program(n, knobs, params, tokens, kc, vc, start, p_end, end,
 @functools.partial(jax.jit, static_argnums=(0,),
                    donate_argnums=(2, 3, 4))
 def _prefill_program(knobs, params, tokens, kc, vc, prompts_kpb,
-                     slot_ids, row_map, t0, p_lens, key):
+                     slot_ids, row_map, t0, p_lens, temp, key):
     """Parallel prefill, batched over the boundary's admissions: ONE
     [K, Pb]-parallel causal forward (MXU-shaped) charges K slots' K/V
     instead of Σ P sequential ticks or K separate dispatches, and
@@ -138,7 +155,9 @@ def _prefill_program(knobs, params, tokens, kc, vc, prompts_kpb,
     cache positions ``t0-P..t0-1`` — *behind* the shared admission tick
     — so the slots join the global tick already in generation phase;
     the token-buffer rows get the prompts and sampled tokens in the
-    same program (the buffer is device-resident).  ``prompts_kpb``
+    same program (the buffer is device-resident).  ``temp`` [slots] is
+    the traced per-SLOT temperature vector (indexed by ``slot_ids`` for
+    each admitted row's first sampled token).  ``prompts_kpb``
     [K, Pb]: Pb is the rows' shared pow-2 prompt bucket and K a pow-2
     sub-batch size, both chosen by the scheduler (``_flush_prefills``)
     so the set of compiled (K, Pb) programs stays small.  Writes land
@@ -155,7 +174,7 @@ def _prefill_program(knobs, params, tokens, kc, vc, prompts_kpb,
     n samples per prompt) are computed ONCE and their K/V scattered to
     every slot; under temperature sampling each slot still draws its
     own independent first token from the shared logits row."""
-    temperature, top_k, top_p, _ = knobs
+    top_k, top_p = knobs
     num_layers, _, _, heads, head_dim = kc.shape
     embed, pos_embed, layer_params, ln_final = unpack_lm_params(
         params, num_layers)
@@ -182,7 +201,8 @@ def _prefill_program(knobs, params, tokens, kc, vc, prompts_kpb,
     )[:, 0]                                               # [K, D]
     logits = head_logits(embed, last)                     # [K, V]
     logits_s = jnp.take(logits, row_map, axis=0)          # [S, V]
-    toks = sample_next_token(logits_s, key, temperature, top_k, top_p)
+    temp_s = jnp.take(temp, slot_ids)                     # [S]
+    toks = _sample_per_slot(logits_s, key, temp_s, top_k, top_p)
     t0r = jnp.mod(t0, window)
     tokens = tokens.at[slot_ids, t0r].set(toks.astype(tokens.dtype))
     # Report the values that LANDED in the buffer, not the raw draws:
@@ -219,10 +239,14 @@ def _write_prompt_program(tokens, prompt_pb, slot_b, t0):
 @dataclass
 class Request:
     """One decode request: ``prompt`` is a 1-D int array; the engine
-    appends up to ``max_new_tokens`` (fewer if ``eos_id`` fires)."""
+    appends up to ``max_new_tokens`` (fewer if ``eos_id`` fires).
+    ``temperature``/``eos_id`` override the engine defaults per request
+    (traced per-slot values — no recompiles)."""
     prompt: np.ndarray
     max_new_tokens: int
     request_id: int = -1
+    temperature: float = 0.0
+    eos_id: int = -1
 
 
 @dataclass
@@ -261,8 +285,12 @@ class DecodeEngine:
     :func:`autodist_tpu.models.quantize.quantize_lm_params` (the tick
     math routes through the same Pallas int8 kernel as ``generate``).
 
-    Sampling knobs are engine-wide (they are trace-time constants of the
-    chunk program); ``temperature=0`` is greedy.
+    Sampling: ``temperature`` and ``eos_id`` here are DEFAULTS that each
+    ``submit(..., temperature=, eos_id=)`` may override per request —
+    they ride the compiled programs as traced per-slot vectors, so mixed
+    greedy/sampled batches share one program with no recompiles.
+    ``top_k``/``top_p`` stay engine-wide trace-time constants (filter
+    branches).  ``temperature=0`` is greedy.
 
     ``mesh``/``slot_axis``: multi-chip serving — shard the slot pool
     over a mesh axis (the axis size must divide ``slots``).  Per-slot
@@ -349,9 +377,10 @@ class DecodeEngine:
         self._alloc_state()
 
         # The static half of the compiled programs' signature (see the
-        # module-level _chunk_program/_prefill_program).
-        self._knobs = (self._temperature, self._top_k, self._top_p,
-                       self._eos_id)
+        # module-level _chunk_program/_prefill_program); temperature and
+        # eos ride as traced per-slot vectors.
+        self._knobs = (self._top_k, self._top_p)
+        self._rng_explicit = rng is not None
         # Set when a device dispatch raises mid-flight: the state
         # buffers were DONATED to the failed program and may be invalid,
         # so the engine refuses further use instead of decoding garbage.
@@ -376,6 +405,9 @@ class DecodeEngine:
         self._end = np.zeros(slots, np.int32)
         self._done = np.ones(slots, bool)
         self._active = np.zeros(slots, bool)
+        # per-slot sampling knobs (set at admission from the request)
+        self._temp = np.full(slots, self._temperature, np.float32)
+        self._eos = np.full(slots, self._eos_id, np.int32)
         self._tick = 0
         heads, hd = cfg["num_heads"], cfg["head_dim"]
         dtype = self._params["pos_embed"].dtype
@@ -432,8 +464,13 @@ class DecodeEngine:
                 "TPU connection mid-chunk); in-flight requests are "
                 "lost — rebuild the engine and resubmit")
 
-    def submit(self, prompt, max_new_tokens: int) -> int:
-        """Queue a request; returns its id.  ``prompt`` is 1-D ints."""
+    def submit(self, prompt, max_new_tokens: int, *,
+               temperature: Optional[float] = None,
+               eos_id: Optional[int] = None) -> int:
+        """Queue a request; returns its id.  ``prompt`` is 1-D ints.
+        ``temperature``/``eos_id`` override the engine defaults for THIS
+        request only (per-slot traced values — no recompiles); the
+        top-k/top-p filters stay engine-wide."""
         self._check_usable()
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
@@ -448,7 +485,37 @@ class DecodeEngine:
                 f"{self._cfg['max_len']}) or split the request")
         if not np.all((prompt >= 0) & (prompt < self._vocab)):
             raise ValueError("prompt tokens out of vocab range")
-        req = Request(prompt, int(max_new_tokens), self._next_id)
+        if temperature is None:
+            temperature = self._temperature
+        else:
+            temperature = float(temperature)
+            if not np.isfinite(temperature) or temperature < 0.0:
+                raise ValueError(f"temperature must be a finite number "
+                                 f">= 0, got {temperature}")
+            if temperature > 0.0 and float(np.float32(temperature)) == 0.0:
+                # would underflow to exact 0 in the f32 per-slot vector
+                # and silently decode greedy while "sampled" was asked
+                raise ValueError(f"temperature {temperature} underflows "
+                                 f"float32; use 0 for greedy or >= ~1e-38")
+            if (temperature > 0.0 and self._temperature <= 0.0
+                    and not self._rng_explicit):
+                raise ValueError(
+                    "per-request temperature sampling on a greedy-built "
+                    "engine needs an explicit rng= at engine "
+                    "construction (a silent fixed key would sample the "
+                    "identical stream every run)")
+        if eos_id is None:
+            eos_id = self._eos_id
+        else:
+            eos_id = int(eos_id)
+            # -1 explicitly DISABLES eos for this request (the program's
+            # own 'none' sentinel) — the way to ask for an unterminated
+            # fixed-length generation on an eos-defaulted engine.
+            if eos_id != -1 and not 0 <= eos_id < self._vocab:
+                raise ValueError(f"eos_id must be -1 (none) or in [0, "
+                                 f"vocab_size={self._vocab}), got {eos_id}")
+        req = Request(prompt, int(max_new_tokens), self._next_id,
+                      temperature=temperature, eos_id=eos_id)
         self._next_id += 1
         self._queue.append(req)
         return req.request_id
@@ -537,9 +604,10 @@ class DecodeEngine:
         else:
             row = np.array(self._tokens[b])
         seq = row[(s + np.arange(written - s)) % self._window]
-        if self._eos_id >= 0:
+        eos = int(self._eos[b])        # the slot's own (per-request) eos
+        if eos >= 0:
             gen = seq[pe - s:]
-            hits = np.nonzero(gen == self._eos_id)[0]
+            hits = np.nonzero(gen == eos)[0]
             if hits.size:
                 seq = seq[:pe - s + hits[0] + 1]
         return seq
@@ -622,6 +690,8 @@ class DecodeEngine:
             self._end[b] = t0 + p + req.max_new_tokens
             self._done[b] = False
             self._active[b] = True
+            self._temp[b] = req.temperature
+            self._eos[b] = req.eos_id
             self._slot_req[b] = req
             self.stats.prompt_tokens += p
         if prefills:
@@ -667,6 +737,10 @@ class DecodeEngine:
                 slot_ids.append(b)
                 row_map.append(i)
                 flat.append((b, req))
+                # per-slot knobs must land BEFORE the dispatch: the
+                # program samples each slot's first token through them
+                self._temp[b] = req.temperature
+                self._eos[b] = req.eos_id
         slot_ids = np.asarray(slot_ids, np.int32)
         row_map = np.asarray(row_map, np.int32)
         # Pad S to its pow-2 bucket by repeating the last entry (an
@@ -689,7 +763,7 @@ class DecodeEngine:
                 self._knobs, self._params, self._tokens, self._kc,
                 self._vc, jnp.asarray(prompts), jnp.asarray(slot_ids),
                 jnp.asarray(row_map), np.int32(t0), jnp.asarray(p_lens),
-                sub)
+                jnp.asarray(self._temp), sub)
             if self._replicate is not None:
                 toks = self._replicate(toks)
             toks = np.array(toks)
@@ -703,8 +777,8 @@ class DecodeEngine:
             self._p_end[b] = t0
             self._end[b] = t0 + req.max_new_tokens
             self._done[b] = (req.max_new_tokens == 1
-                             or (self._eos_id >= 0
-                                 and tok == self._eos_id))
+                             or (req.eos_id >= 0
+                                 and tok == req.eos_id))
             self._active[b] = True
             self._slot_req[b] = req
             self.stats.prompt_tokens += p
@@ -770,6 +844,7 @@ class DecodeEngine:
                 self._kc, self._vc, jnp.asarray(self._start),
                 jnp.asarray(self._p_end), jnp.asarray(self._end),
                 jnp.asarray(self._done), jnp.asarray(self._active),
+                jnp.asarray(self._temp), jnp.asarray(self._eos),
                 jnp.int32(self._tick), sub)
             # The only per-chunk host pull: the [B] done vector (the
             # token buffer stays on device; harvest/partial pull rows).

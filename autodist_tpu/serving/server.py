@@ -195,11 +195,14 @@ class EngineServer:
         yields to it (see ``_handler_waiters``)."""
         return _CountedLock(self)
 
-    def _submit(self, prompt: np.ndarray, max_new: int) -> int:
+    def _submit(self, prompt: np.ndarray, max_new: int,
+                temperature=None, eos_id=None) -> int:
         with self._locked():
             if self._stop or self._engine_error is not None:
                 raise _Unavailable()
-            rid = self._engine.submit(prompt, max_new)
+            rid = self._engine.submit(prompt, max_new,
+                                      temperature=temperature,
+                                      eos_id=eos_id)
             self._outstanding.add(rid)
             self._events[rid] = threading.Event()
             self._work.notify()
@@ -396,7 +399,15 @@ class _Handler(BaseHTTPRequestHandler):
             max_new = body.get("max_new_tokens", 16)
             if type(max_new) is not int:   # bool is an int subclass
                 raise ValueError("max_new_tokens must be an int")
-            rid = srv._submit(prompt, max_new)
+            temperature = body.get("temperature")
+            if temperature is not None and \
+                    type(temperature) not in (int, float):
+                raise ValueError("temperature must be a number")
+            eos_id = body.get("eos_id")
+            if eos_id is not None and type(eos_id) is not int:
+                raise ValueError("eos_id must be an int")
+            rid = srv._submit(prompt, max_new, temperature=temperature,
+                              eos_id=eos_id)
         except _Unavailable:
             self._json(503, {"error": "engine unavailable"})
             return

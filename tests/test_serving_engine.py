@@ -532,3 +532,79 @@ def test_engine_validation(lm):
         DecodeEngine(spec, params, window=8, top_k=5)
     with pytest.raises(ValueError, match="max_len"):
         DecodeEngine(spec, params, window=4096)
+
+
+def test_engine_per_request_sampling_knobs(lm):
+    """temperature/eos_id are PER-REQUEST (traced per-slot vectors, one
+    compiled program): a greedy request stays oracle-exact while a
+    sampled request decodes in the adjacent slot, and a per-request eos
+    stops only its own slot."""
+    spec, params = lm
+    rng = np.random.RandomState(21)
+    p1 = rng.randint(0, VOCAB, 3).astype(np.int32)
+    p2 = rng.randint(0, VOCAB, 4).astype(np.int32)
+
+    eng = DecodeEngine(spec, params, slots=2, window=24, chunk=4,
+                       rng=jax.random.PRNGKey(7))
+    r_greedy = eng.submit(p1, 8)                      # default temp 0
+    r_sampled = eng.submit(p2, 8, temperature=1.0)    # per-request
+    results = eng.run()
+    np.testing.assert_array_equal(results[r_greedy],
+                                  _oracle(spec, params, p1, 8))
+    sampled = results[r_sampled]
+    assert sampled.size == p2.size + 8
+    assert np.all((sampled >= 0) & (sampled < VOCAB))
+
+    # per-request eos: pick the greedy continuation's 3rd token as eos
+    # for ONE of two otherwise-identical greedy requests.
+    free = _oracle(spec, params, p1, 8)
+    eos = int(free[p1.size + 2])
+    if eos in (int(free[p1.size]), int(free[p1.size + 1])):
+        pytest.skip("greedy repeats; eos choice ambiguous")
+    eng2 = DecodeEngine(spec, params, slots=2, window=24, chunk=4)
+    r_stop = eng2.submit(p1, 8, eos_id=eos)
+    r_full = eng2.submit(p1, 8)
+    out = eng2.run()
+    np.testing.assert_array_equal(out[r_stop], free[:p1.size + 3])
+    assert out[r_stop][-1] == eos
+    np.testing.assert_array_equal(out[r_full], free)  # untouched slot
+
+
+def test_engine_per_request_temperature_needs_rng(lm):
+    """A greedy-built engine without an explicit rng refuses a sampled
+    request loudly (a silent fixed key would sample identical streams)."""
+    spec, params = lm
+    eng = DecodeEngine(spec, params, slots=1, window=16)
+    with pytest.raises(ValueError, match="rng"):
+        eng.submit(np.arange(2, dtype=np.int32), 4, temperature=0.7)
+    with pytest.raises(ValueError, match="temperature"):
+        eng.submit(np.arange(2, dtype=np.int32), 4, temperature=-1.0)
+    with pytest.raises(ValueError, match="eos_id"):
+        eng.submit(np.arange(2, dtype=np.int32), 4, eos_id=VOCAB + 3)
+
+
+def test_engine_per_request_validation_edges(lm):
+    """NaN/inf/f32-underflow temperatures are rejected; eos_id=-1
+    explicitly disables an engine-default eos for one request."""
+    spec, params = lm
+    rng = np.random.RandomState(31)
+    # find a prompt whose greedy continuation has a usable (non-tied,
+    # non-initial) eos candidate
+    for _ in range(20):
+        prompt = rng.randint(0, VOCAB, 3).astype(np.int32)
+        free = _oracle(spec, params, prompt, 6)
+        eos = int(free[prompt.size + 1])
+        if eos not in (int(free[prompt.size]), *prompt.tolist()):
+            break
+    else:  # pragma: no cover - wildly unlikely
+        pytest.skip("no unambiguous eos candidate found")
+    eng = DecodeEngine(spec, params, slots=2, window=24, chunk=3,
+                       eos_id=eos, rng=jax.random.PRNGKey(1))
+    for bad in (float("nan"), float("inf"), 1e-300):
+        with pytest.raises(ValueError):
+            eng.submit(np.arange(2, dtype=np.int32), 4, temperature=bad)
+    r_default = eng.submit(prompt, 6)
+    r_noeos = eng.submit(prompt, 6, eos_id=-1)
+    out = eng.run()
+    assert out[r_default][-1] == eos and out[r_default].size < 9
+    np.testing.assert_array_equal(out[r_noeos], free)   # ran to length
