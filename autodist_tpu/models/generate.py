@@ -123,7 +123,8 @@ def sample_next_token(logits, key, temperature=0.0, top_k=0, top_p=0.0):
 
 
 def _token_step(layer_params, ln_final_scale, embed, x, k_cache, v_cache,
-                pos, total_len, attn_mask=None):
+                pos, total_len, attn_mask=None, prefix_kv=None,
+                prefix_mask=None):
     """One decode position through all layers.  ``x``: [B, D] embedded
     input; ``k_cache``/``v_cache``: [L, T, B, H, Dh] — time-major so
     ``.at[i, pos].set`` with a traced position lowers to a CONTIGUOUS
@@ -141,7 +142,12 @@ def _token_step(layer_params, ln_final_scale, embed, x, k_cache, v_cache,
     positions; default is the single-sequence causal set
     ``arange(total_len) <= pos``.  The continuous-batching engine passes
     per-slot windows (``start[b] <= arange <= pos``) so slots admitted
-    at different ticks share one uniform cache write index."""
+    at different ticks share one uniform cache write index.
+
+    ``prefix_kv``: optional ``(kp, vp)`` each [L, Pp, H, Dh] — a SHARED
+    cached prefix (system prompt) held once and attended by every row
+    whose ``prefix_mask`` [B, Pp] says so, logically preceding the
+    per-row cache window (prefix-cache serving)."""
     heads, hd = k_cache.shape[-2], k_cache.shape[-1]
     d_ff = layer_params[0]["mlp"]["wi"]["kernel"].shape[1]
     quantized = isinstance(layer_params[0]["mlp"]["wi"]["kernel"],
@@ -165,8 +171,23 @@ def _token_step(layer_params, ln_final_scale, embed, x, k_cache, v_cache,
             else:
                 mask = attn_mask[:, None, :]
             logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
+            if prefix_kv is not None:
+                kp, vp = prefix_kv
+                pl = jnp.einsum("bhk,phk->bhp", q[:, 0],
+                                kp[_i].astype(q.dtype)) \
+                    / jnp.sqrt(jnp.asarray(depth, q.dtype))
+                pl = jnp.where(prefix_mask[:, None, :], pl,
+                               jnp.finfo(logits.dtype).min)
+                logits = jnp.concatenate([pl, logits], axis=-1)
             probs = jax.nn.softmax(logits.astype(jnp.float32),
                                    axis=-1).astype(q.dtype)
+            if prefix_kv is not None:
+                pp = prefix_kv[0].shape[1]
+                out = jnp.einsum("bhp,phk->bhk", probs[..., :pp],
+                                 prefix_kv[1][_i].astype(q.dtype))
+                out = out + jnp.einsum("bht,tbhk->bhk",
+                                       probs[..., pp:], vc[_i])
+                return out[:, None]
             return jnp.einsum("bht,tbhk->bhk", probs, vc[_i])[:, None]
 
         layer = TransformerLayer(heads, hd, d_ff, causal=True,
@@ -186,7 +207,8 @@ def _token_step(layer_params, ln_final_scale, embed, x, k_cache, v_cache,
 
 
 def _prefill_forward(layer_params, ln_final_scale, embed, pos_embed,
-                     tokens_2d, heads, head_dim):
+                     tokens_2d, heads, head_dim, prefix_kv=None,
+                     plen: int = 0):
     """Parallel prompt prefill: ONE causal forward over ``tokens_2d``
     [K, P] (a batch of K prompts) that also returns every layer's K/V —
     the MXU-friendly way to charge a KV cache (one [P]-parallel matmul
@@ -201,12 +223,26 @@ def _prefill_forward(layer_params, ln_final_scale, embed, pos_embed,
     its ``attn_fn`` seat.  Works on full-precision and weight-only int8
     trees (the ``quant_interceptor`` reroute, as in ``_token_step``);
     ``heads``/``head_dim`` come from the model config (the quantized
-    tree's flattened kernels don't carry them)."""
+    tree's flattened kernels don't carry them).
+
+    ``prefix_kv``/``plen`` (optional, as in :func:`_token_step`): a
+    SHARED cached prefix ``(kp, vp)`` each [L, Ppb, H, Dh] that every
+    query row attends in addition to its causal self-window, with
+    positions offset by the static ``plen`` (pad bucket rows beyond
+    ``plen`` masked; position ids clipped — bucket pad rows past
+    ``max_len`` gather a clamped embedding whose K/V are overwritten
+    before any read, per the engine's ring invariant)."""
     quantized = isinstance(layer_params[0]["mlp"]["wi"]["kernel"],
                            Quantized)
     d_ff = layer_params[0]["mlp"]["wi"]["kernel"].shape[1]
+    p = tokens_2d.shape[1]
     x = embed_lookup(embed, tokens_2d, pos_embed.dtype)      # [K, P, D]
-    x = x + pos_embed[None, :tokens_2d.shape[1]]
+    if plen:
+        pos_ids = jnp.clip(plen + jnp.arange(p), 0,
+                           pos_embed.shape[0] - 1)
+        x = x + pos_embed[pos_ids][None]
+    else:
+        x = x + pos_embed[None, :p]
     ks, vs = [], []
 
     # Dense attention deliberately: the flash kernel's own measured
@@ -214,9 +250,30 @@ def _prefill_forward(layer_params, ln_final_scale, embed, pos_embed,
     # notes), far above engine prompt buckets, and dense keeps prefill
     # numerics closest to the tick-by-tick decode path.
     def capture_attn(q, k, v, causal):
+        i = len(ks)                                   # layer index
         ks.append(k)                                  # [K, P, H, Dh]
         vs.append(v)
-        return dense_attention(q, k, v, causal)
+        if prefix_kv is None:
+            return dense_attention(q, k, v, causal)
+        # prefix-aware dense: each row attends [prefix | causal self]
+        kp, vp = prefix_kv
+        depth = q.shape[-1]
+        scale = jnp.sqrt(depth).astype(q.dtype)
+        sl = jnp.einsum("bqhd,bkhd->bhqk", q, k) / scale
+        t_q, t_k = sl.shape[-2], sl.shape[-1]
+        causal_m = jnp.tril(jnp.ones((t_q, t_k), bool))
+        sl = jnp.where(causal_m, sl, jnp.finfo(sl.dtype).min)
+        ppb = kp.shape[1]
+        pl = jnp.einsum("bqhd,phd->bhqp", q,
+                        kp[i].astype(q.dtype)) / scale
+        pmask = (jnp.arange(ppb) < plen)[None, None, None, :]
+        pl = jnp.where(pmask, pl, jnp.finfo(sl.dtype).min)
+        probs = jax.nn.softmax(
+            jnp.concatenate([pl, sl], axis=-1).astype(jnp.float32),
+            axis=-1).astype(q.dtype)
+        out = jnp.einsum("bhqp,phd->bqhd", probs[..., :ppb],
+                         vp[i].astype(q.dtype))
+        return out + jnp.einsum("bhqk,bkhd->bqhd", probs[..., ppb:], v)
 
     for lp in layer_params:
         layer = TransformerLayer(heads, head_dim, d_ff, causal=True,

@@ -92,25 +92,39 @@ def _sample_per_slot(logits, key, temp, top_k, top_p):
 @functools.partial(jax.jit, static_argnums=(0, 1),
                    donate_argnums=(3, 4, 5))
 def _chunk_program(n, knobs, params, tokens, kc, vc, start, p_end, end,
-                   done, active, temp, eos, tick0, key):
+                   done, active, temp, eos, use_prefix, kp, vp,
+                   tick0, key):
     """``n`` decode ticks of all slots in lockstep (see DecodeEngine).
 
     ``temp`` [B] f32 and ``eos`` [B] i32 are TRACED per-slot sampling
     knobs (temperature 0 = greedy; eos -1 = none): per-REQUEST values
-    ride through without recompiles.  ``knobs`` = (top_k, top_p) stay
-    static — they select trace-time filter branches."""
-    top_k, top_p = knobs
+    ride through without recompiles.  ``knobs`` = (top_k, top_p, plen)
+    stay static — filter branches and the registered prefix length.
+
+    ``kp``/``vp`` [L, Ppb, H, Dh] hold the SHARED cached prefix (one
+    copy, every opted-in slot attends it — ``use_prefix`` [B]); with no
+    prefix registered they are [L, 1, H, Dh] zeros, plen=0, and the
+    prefix math vanishes at trace time."""
+    top_k, top_p, plen = knobs
     num_layers, window = kc.shape[0], kc.shape[1]
     embed, pos_embed, layer_params, ln_final = unpack_lm_params(
         params, num_layers)
     pos_idx = jnp.arange(window)[None, :]                 # [1, W]
+    if plen:
+        pmask = use_prefix[:, None] \
+            & (jnp.arange(kp.shape[1]) < plen)[None, :]   # [B, Ppb]
+        pos_off = jnp.where(use_prefix, plen, 0)          # [B]
+        prefix_kv = (kp, vp)
+    else:
+        pmask, pos_off, prefix_kv = None, 0, None
 
     def one_tick(carry, i):
         tokens, kc, vc, done, key = carry
         t = tick0 + i                                     # absolute tick
         t_ring = jnp.mod(t, window)                       # ring write pos
         tok = lax.dynamic_index_in_dim(tokens, t_ring, 1, keepdims=False)
-        rel = jnp.clip(t - start, 0, window - 1)          # [B]
+        # sequence position: prefix length offsets opted-in slots
+        rel = jnp.clip(t - start, 0, window - 1) + pos_off  # [B]
         x = embed_lookup(embed, tok, pos_embed.dtype) + pos_embed[rel]
         # Ring mask: slot b attends ring positions its CURRENT occupant
         # wrote — sequence offsets 0..t-start[b], laid out mod window.
@@ -118,7 +132,7 @@ def _chunk_program(n, knobs, params, tokens, kc, vc, start, p_end, end,
             <= (t - start)[:, None]
         logits, kc, vc = _token_step(
             layer_params, ln_final, embed, x, kc, vc, t_ring, window,
-            attn_mask=mask)
+            attn_mask=mask, prefix_kv=prefix_kv, prefix_mask=pmask)
         key, sub = jax.random.split(key)
         raw = _sample_per_slot(logits, sub, temp, top_k,
                                top_p).astype(tokens.dtype)
@@ -144,10 +158,11 @@ def _chunk_program(n, knobs, params, tokens, kc, vc, start, p_end, end,
     return tokens, kc, vc, done, jnp.sum(busy)
 
 
-@functools.partial(jax.jit, static_argnums=(0,),
-                   donate_argnums=(2, 3, 4))
-def _prefill_program(knobs, params, tokens, kc, vc, prompts_kpb,
-                     slot_ids, row_map, t0, p_lens, temp, key):
+@functools.partial(jax.jit, static_argnums=(0, 1),
+                   donate_argnums=(3, 4, 5))
+def _prefill_program(knobs, with_prefix, params, tokens, kc, vc,
+                     prompts_kpb, slot_ids, row_map, t0, p_lens, temp,
+                     kp, vp, key):
     """Parallel prefill, batched over the boundary's admissions: ONE
     [K, Pb]-parallel causal forward (MXU-shaped) charges K slots' K/V
     instead of Σ P sequential ticks or K separate dispatches, and
@@ -173,14 +188,22 @@ def _prefill_program(knobs, params, tokens, kc, vc, prompts_kpb,
     row — identical prompts admitted together (system-prompt fan-out,
     n samples per prompt) are computed ONCE and their K/V scattered to
     every slot; under temperature sampling each slot still draws its
-    own independent first token from the shared logits row."""
-    top_k, top_p = knobs
+    own independent first token from the shared logits row.
+
+    ``with_prefix`` (static): this dispatch's rows all attend the
+    shared cached prefix ``kp``/``vp`` (the scheduler groups admissions
+    by prefix use) — their forward runs through ``_prefill_forward``'s
+    prefix seam with positions offset by the static ``plen`` in
+    ``knobs``."""
+    top_k, top_p, plen = knobs
     num_layers, _, _, heads, head_dim = kc.shape
     embed, pos_embed, layer_params, ln_final = unpack_lm_params(
         params, num_layers)
-    xs, ks, vs = _prefill_forward(layer_params, ln_final, embed,
-                                  pos_embed, prompts_kpb, heads,
-                                  head_dim)
+    xs, ks, vs = _prefill_forward(
+        layer_params, ln_final, embed, pos_embed, prompts_kpb, heads,
+        head_dim,
+        prefix_kv=(kp, vp) if with_prefix else None,
+        plen=plen if with_prefix else 0)
     s_count = slot_ids.shape[0]
     pb = prompts_kpb.shape[1]
     window = kc.shape[1]
@@ -212,6 +235,19 @@ def _prefill_program(knobs, params, tokens, kc, vc, prompts_kpb,
     # consistent with what the next tick will actually consume.
     landed = tokens[slot_ids, t0r]
     return tokens, kc, vc, landed
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4))
+def _prefix_kv_program(params, tokens_1p, num_layers, heads, head_dim):
+    """One-time K/V computation for a registered shared prefix: one
+    causal forward over the (bucketed) prefix tokens, returning
+    ``(kp, vp)`` each [L, Ppb, H, Dh].  Pad positions' K/V are garbage
+    but masked by the static plen everywhere they could be read."""
+    embed, pos_embed, layer_params, ln_final = unpack_lm_params(
+        params, num_layers)
+    _, ks, vs = _prefill_forward(layer_params, ln_final, embed,
+                                 pos_embed, tokens_1p, heads, head_dim)
+    return ks[:, 0], vs[:, 0]
 
 
 @functools.lru_cache(maxsize=None)
@@ -247,6 +283,7 @@ class Request:
     request_id: int = -1
     temperature: float = 0.0
     eos_id: int = -1
+    use_prefix: bool = False
 
 
 @dataclass
@@ -378,9 +415,18 @@ class DecodeEngine:
 
         # The static half of the compiled programs' signature (see the
         # module-level _chunk_program/_prefill_program); temperature and
-        # eos ride as traced per-slot vectors.
-        self._knobs = (self._top_k, self._top_p)
+        # eos ride as traced per-slot vectors; the third knob is the
+        # registered prefix length (0 = none).
+        self._knobs = (self._top_k, self._top_p, 0)
         self._rng_explicit = rng is not None
+        # Shared prefix cache (set_prefix): K/V held ONCE, attended by
+        # opted-in slots.  The dummies keep ONE program signature when
+        # no prefix is registered (plen=0 erases the math at trace time).
+        heads, hd = cfg["num_heads"], cfg["head_dim"]
+        pdtype = self._params["pos_embed"].dtype
+        self._kp = jnp.zeros((cfg["num_layers"], 1, heads, hd), pdtype)
+        self._vp = self._kp
+        self._prefix_tokens: Optional[np.ndarray] = None
         # Set when a device dispatch raises mid-flight: the state
         # buffers were DONATED to the failed program and may be invalid,
         # so the engine refuses further use instead of decoding garbage.
@@ -408,6 +454,7 @@ class DecodeEngine:
         # per-slot sampling knobs (set at admission from the request)
         self._temp = np.full(slots, self._temperature, np.float32)
         self._eos = np.full(slots, self._eos_id, np.int32)
+        self._use_prefix = np.zeros(slots, bool)
         self._tick = 0
         heads, hd = cfg["num_heads"], cfg["head_dim"]
         dtype = self._params["pos_embed"].dtype
@@ -464,13 +511,73 @@ class DecodeEngine:
                 "TPU connection mid-chunk); in-flight requests are "
                 "lost — rebuild the engine and resubmit")
 
+    def set_prefix(self, tokens) -> int:
+        """Register a SHARED cached prefix (system prompt): its K/V are
+        computed once and held as one ``[L, Pp, H, Dh]`` copy that every
+        ``submit(..., use_prefix=True)`` request attends in addition to
+        its own ring window — no per-slot storage, no per-admission
+        recompute.  Returns the prefix length.  Replaces any previous
+        prefix; requires an idle engine (the prefix length is a static
+        compile dimension of the in-flight programs)."""
+        self._check_usable()
+        if np.any(self._active) or self._queue:
+            raise RuntimeError(
+                "set_prefix requires an idle engine (drain or reset "
+                "first): in-flight slots reference the current prefix")
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        if tokens.size < 1:
+            raise ValueError("prefix must have at least one token")
+        if not np.all((tokens >= 0) & (tokens < self._vocab)):
+            raise ValueError("prefix tokens out of vocab range")
+        if tokens.size + 2 > self._cfg["max_len"]:
+            raise ValueError(
+                f"prefix length {tokens.size} leaves no room under the "
+                f"model's max_len {self._cfg['max_len']}")
+        plen = int(tokens.size)
+        ppb = 1 << (plen - 1).bit_length()      # pow-2 compile bucket
+        if ppb > self._cfg["max_len"]:
+            ppb = plen     # exact-size fallback (same rule as
+            #                _prompt_bucket): the bucket's pos_embed
+            #                rows must exist
+        padded = np.zeros(ppb, np.int32)
+        padded[:plen] = tokens
+        cfg = self._cfg
+        kp, vp = _prefix_kv_program(
+            self._params, jnp.asarray(padded)[None],
+            cfg["num_layers"], cfg["num_heads"], cfg["head_dim"])
+        self._kp, self._vp = kp, vp
+        self._prefix_tokens = tokens
+        self._knobs = (self._top_k, self._top_p, plen)
+        return plen
+
+    def clear_prefix(self) -> None:
+        """Drop the registered prefix (idle engine required)."""
+        self._check_usable()
+        if np.any(self._active) or self._queue:
+            raise RuntimeError("clear_prefix requires an idle engine")
+        cfg = self._cfg
+        pdtype = self._params["pos_embed"].dtype
+        self._kp = jnp.zeros((cfg["num_layers"], 1, cfg["num_heads"],
+                              cfg["head_dim"]), pdtype)
+        self._vp = self._kp
+        self._prefix_tokens = None
+        self._knobs = (self._top_k, self._top_p, 0)
+
+    @property
+    def prefix_len(self) -> int:
+        return 0 if self._prefix_tokens is None \
+            else int(self._prefix_tokens.size)
+
     def submit(self, prompt, max_new_tokens: int, *,
                temperature: Optional[float] = None,
-               eos_id: Optional[int] = None) -> int:
+               eos_id: Optional[int] = None,
+               use_prefix: bool = False) -> int:
         """Queue a request; returns its id.  ``prompt`` is 1-D ints.
         ``temperature``/``eos_id`` override the engine defaults for THIS
         request only (per-slot traced values — no recompiles); the
-        top-k/top-p filters stay engine-wide."""
+        top-k/top-p filters stay engine-wide.  ``use_prefix=True``
+        prepends the engine's registered shared prefix (:meth:`set_prefix`)
+        as cached context — the result contains only prompt+generated."""
         self._check_usable()
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
@@ -483,6 +590,15 @@ class DecodeEngine:
                 f"prompt + max_new_tokens = {span} exceeds the engine "
                 f"window {self._window}; raise window= (model max_len "
                 f"{self._cfg['max_len']}) or split the request")
+        if use_prefix:
+            if self._prefix_tokens is None:
+                raise ValueError("use_prefix=True but no prefix is "
+                                 "registered (call set_prefix first)")
+            if self.prefix_len + span > self._cfg["max_len"]:
+                raise ValueError(
+                    f"prefix ({self.prefix_len}) + prompt + "
+                    f"max_new_tokens ({span}) exceeds the model's "
+                    f"max_len {self._cfg['max_len']} (pos_embed rows)")
         if not np.all((prompt >= 0) & (prompt < self._vocab)):
             raise ValueError("prompt tokens out of vocab range")
         if temperature is None:
@@ -515,7 +631,8 @@ class DecodeEngine:
                 raise ValueError(f"eos_id must be -1 (none) or in [0, "
                                  f"vocab_size={self._vocab}), got {eos_id}")
         req = Request(prompt, int(max_new_tokens), self._next_id,
-                      temperature=temperature, eos_id=eos_id)
+                      temperature=temperature, eos_id=eos_id,
+                      use_prefix=bool(use_prefix))
         self._next_id += 1
         self._queue.append(req)
         return req.request_id
@@ -692,6 +809,7 @@ class DecodeEngine:
             self._active[b] = True
             self._temp[b] = req.temperature
             self._eos[b] = req.eos_id
+            self._use_prefix[b] = req.use_prefix
             self._slot_req[b] = req
             self.stats.prompt_tokens += p
         if prefills:
@@ -705,26 +823,30 @@ class DecodeEngine:
         fan-out S is pow-2 padded inside _run_prefill — so all three
         compile dimensions (Pb, K, S) are bucketed and the compiled
         program set stays logarithmic in window and slots."""
-        buckets: Dict[int, Dict[bytes, list]] = {}
+        buckets: Dict[tuple, Dict[bytes, list]] = {}
         for b, req in group:
             pb = self._prompt_bucket(req.prompt.size)
             # dedup identical prompts within a bucket: computed once,
-            # K/V scattered to every requesting slot
-            buckets.setdefault(pb, {}).setdefault(
+            # K/V scattered to every requesting slot.  Prefix users
+            # dispatch separately (their forward attends the shared
+            # prefix and their positions are offset — a static program
+            # difference).
+            buckets.setdefault((pb, req.use_prefix), {}).setdefault(
                 req.prompt.tobytes(), []).append((b, req))
-        for pb, uniq in sorted(buckets.items()):
+        for (pb, with_prefix), uniq in sorted(buckets.items()):
             entries = list(uniq.values())     # [[(b, req), ...], ...]
             while entries:
                 k = 1 << (len(entries).bit_length() - 1)  # pow2 <= len
-                self._run_prefill(entries[:k], pb)
+                self._run_prefill(entries[:k], pb, with_prefix)
                 entries = entries[k:]
 
-    def _run_prefill(self, entries, pb: int) -> None:
+    def _run_prefill(self, entries, pb: int, with_prefix: bool) -> None:
         """One batched prefill dispatch over K unique prompts serving S
         slots (S >= K when prompts repeat): prompt K/V written at cache
         positions t0-P..t0-1 per slot and each first generated token
         deposited at the admission tick, so the slots start in
-        generation phase."""
+        generation phase.  ``with_prefix`` rows attend the shared
+        cached prefix during their forward."""
         t0, k = self._tick, len(entries)
         prompts = np.zeros((k, pb), np.int32)
         p_lens = np.zeros(k, np.int32)
@@ -741,6 +863,7 @@ class DecodeEngine:
                 # program samples each slot's first token through them
                 self._temp[b] = req.temperature
                 self._eos[b] = req.eos_id
+                self._use_prefix[b] = req.use_prefix
         slot_ids = np.asarray(slot_ids, np.int32)
         row_map = np.asarray(row_map, np.int32)
         # Pad S to its pow-2 bucket by repeating the last entry (an
@@ -760,10 +883,11 @@ class DecodeEngine:
         self._rng, sub = jax.random.split(self._rng)
         try:
             self._tokens, self._kc, self._vc, toks = _prefill_program(
-                self._knobs, self._params, self._tokens, self._kc,
-                self._vc, jnp.asarray(prompts), jnp.asarray(slot_ids),
-                jnp.asarray(row_map), np.int32(t0), jnp.asarray(p_lens),
-                jnp.asarray(self._temp), sub)
+                self._knobs, with_prefix, self._params, self._tokens,
+                self._kc, self._vc, jnp.asarray(prompts),
+                jnp.asarray(slot_ids), jnp.asarray(row_map),
+                np.int32(t0), jnp.asarray(p_lens),
+                jnp.asarray(self._temp), self._kp, self._vp, sub)
             if self._replicate is not None:
                 toks = self._replicate(toks)
             toks = np.array(toks)
@@ -845,6 +969,7 @@ class DecodeEngine:
                 jnp.asarray(self._p_end), jnp.asarray(self._end),
                 jnp.asarray(self._done), jnp.asarray(self._active),
                 jnp.asarray(self._temp), jnp.asarray(self._eos),
+                jnp.asarray(self._use_prefix), self._kp, self._vp,
                 jnp.int32(self._tick), sub)
             # The only per-chunk host pull: the [B] done vector (the
             # token buffer stays on device; harvest/partial pull rows).

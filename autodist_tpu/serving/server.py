@@ -196,13 +196,15 @@ class EngineServer:
         return _CountedLock(self)
 
     def _submit(self, prompt: np.ndarray, max_new: int,
-                temperature=None, eos_id=None) -> int:
+                temperature=None, eos_id=None,
+                use_prefix: bool = False) -> int:
         with self._locked():
             if self._stop or self._engine_error is not None:
                 raise _Unavailable()
             rid = self._engine.submit(prompt, max_new,
                                       temperature=temperature,
-                                      eos_id=eos_id)
+                                      eos_id=eos_id,
+                                      use_prefix=use_prefix)
             self._outstanding.add(rid)
             self._events[rid] = threading.Event()
             self._work.notify()
@@ -406,8 +408,11 @@ class _Handler(BaseHTTPRequestHandler):
             eos_id = body.get("eos_id")
             if eos_id is not None and type(eos_id) is not int:
                 raise ValueError("eos_id must be an int")
+            use_prefix = body.get("use_prefix", False)
+            if type(use_prefix) is not bool:
+                raise ValueError("use_prefix must be a bool")
             rid = srv._submit(prompt, max_new, temperature=temperature,
-                              eos_id=eos_id)
+                              eos_id=eos_id, use_prefix=use_prefix)
         except _Unavailable:
             self._json(503, {"error": "engine unavailable"})
             return
@@ -508,16 +513,29 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 def serve(spec, params, *, host: str = "127.0.0.1", port: int = 8000,
-          tokenizer=None, **engine_kwargs) -> EngineServer:
+          tokenizer=None, prefix_tokens=None, prefix_text=None,
+          **engine_kwargs) -> EngineServer:
     """Build a :class:`DecodeEngine` over ``(spec, params)`` and start an
     :class:`EngineServer` on it.  ``engine_kwargs`` pass through to the
     engine (slots, window, chunk, sampling knobs, mesh, ...).  A
     tokenizer with a registered ``<eos>`` special token supplies the
-    engine's ``eos_id`` automatically (explicit ``eos_id=`` wins)."""
+    engine's ``eos_id`` automatically (explicit ``eos_id=`` wins).
+    ``prefix_tokens`` (ids) or ``prefix_text`` (tokenizer required)
+    registers the shared cached system prompt; requests opt in with
+    ``"use_prefix": true``."""
     if "eos_id" not in engine_kwargs:
         eos = getattr(tokenizer, "eos_id", None)
         if eos is not None:
             engine_kwargs["eos_id"] = int(eos)
     eng = DecodeEngine(spec, params, **engine_kwargs)
+    if prefix_text is not None:
+        if tokenizer is None:
+            raise ValueError("prefix_text needs a tokenizer; pass "
+                             "prefix_tokens instead")
+        if prefix_tokens is not None:
+            raise ValueError("pass prefix_tokens OR prefix_text")
+        prefix_tokens = tokenizer.encode(prefix_text)
+    if prefix_tokens is not None:
+        eng.set_prefix(prefix_tokens)
     return EngineServer(eng, host=host, port=port,
                         tokenizer=tokenizer).start()

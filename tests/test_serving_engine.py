@@ -608,3 +608,97 @@ def test_engine_per_request_validation_edges(lm):
     out = eng.run()
     assert out[r_default][-1] == eos and out[r_default].size < 9
     np.testing.assert_array_equal(out[r_noeos], free)   # ran to length
+
+
+@pytest.mark.parametrize("prefill", [False, True])
+def test_engine_prefix_cache_token_exact(lm, prefill):
+    """A registered shared prefix (system prompt) is held ONCE and
+    attended as cached context: each request's output equals the full
+    generate over concat(prefix, prompt) with the prefix stripped —
+    through both admission paths, with a non-prefix request decoding in
+    the adjacent slot concurrently."""
+    spec, params = lm
+    rng = np.random.RandomState(17)
+    prefix = rng.randint(0, VOCAB, 5).astype(np.int32)
+    p1 = rng.randint(0, VOCAB, 3).astype(np.int32)
+    p2 = rng.randint(0, VOCAB, 4).astype(np.int32)
+
+    eng = DecodeEngine(spec, params, slots=2, window=24, chunk=4,
+                       prefill=prefill)
+    assert eng.set_prefix(prefix) == 5
+    assert eng.prefix_len == 5
+    r_pre = eng.submit(p1, 7, use_prefix=True)
+    r_plain = eng.submit(p2, 6)                   # no prefix, same batch
+    results = eng.run()
+
+    want_full = _oracle(spec, params, np.concatenate([prefix, p1]), 7)
+    np.testing.assert_array_equal(results[r_pre], want_full[prefix.size:],
+                                  err_msg="prefix-cached decode")
+    np.testing.assert_array_equal(results[r_plain],
+                                  _oracle(spec, params, p2, 6),
+                                  err_msg="non-prefix slot disturbed")
+    # prefix K/V were not recomputed per admission
+    assert eng.stats.prompt_tokens == p1.size + p2.size
+
+    # slot REUSE under the prefix: a second wave still exact
+    r3 = eng.submit(p2, 5, use_prefix=True)
+    out2 = eng.run()
+    want3 = _oracle(spec, params, np.concatenate([prefix, p2]), 5)
+    np.testing.assert_array_equal(out2[r3], want3[prefix.size:])
+
+    # clear_prefix restores plain behavior
+    eng.clear_prefix()
+    r4 = eng.submit(p1, 4)
+    np.testing.assert_array_equal(eng.run()[r4],
+                                  _oracle(spec, params, p1, 4))
+
+
+def test_engine_prefix_validation(lm):
+    spec, params = lm
+    eng = DecodeEngine(spec, params, slots=1, window=16, chunk=2)
+    with pytest.raises(ValueError, match="no prefix"):
+        eng.submit(np.arange(2, dtype=np.int32), 3, use_prefix=True)
+    eng.set_prefix(np.arange(4, dtype=np.int32))
+    # prefix + span must fit the model's pos_embed rows (max_len 48)
+    with pytest.raises(ValueError, match="max_len"):
+        eng.set_prefix(np.arange(47, dtype=np.int32))
+    # busy engine refuses a prefix swap
+    eng.submit(np.arange(2, dtype=np.int32), 6)
+    assert eng.step()
+    with pytest.raises(RuntimeError, match="idle"):
+        eng.set_prefix(np.arange(3, dtype=np.int32))
+    while eng.step():
+        pass
+    eng.results()
+    eng.set_prefix(np.arange(3, dtype=np.int32))   # idle again: fine
+
+
+def test_engine_prefix_bucket_edges(lm):
+    """The pow-2 buckets must not outrun pos_embed (max_len 48 here):
+    (a) a prompt whose bucket extends past max_len under a prefix —
+    position ids clip, pad-row K/V are overwritten before any read;
+    (b) a prefix whose own bucket exceeds max_len falls back to exact
+    size.  Both stay token-exact vs the concat oracle."""
+    spec, params = lm
+    rng = np.random.RandomState(23)
+
+    # (a) prefix 20 + prompt 17 (bucket 32: 20+32 > 48) + 1 new
+    prefix = rng.randint(0, VOCAB, 20).astype(np.int32)
+    prompt = rng.randint(0, VOCAB, 17).astype(np.int32)
+    eng = DecodeEngine(spec, params, slots=2, window=24, chunk=4)
+    eng.set_prefix(prefix)
+    rid = eng.submit(prompt, 1, use_prefix=True)
+    out = eng.run()
+    want = _oracle(spec, params, np.concatenate([prefix, prompt]), 1)
+    np.testing.assert_array_equal(out[rid], want[prefix.size:])
+
+    # (b) prefix 40: pow-2 bucket 64 > max_len 48 -> exact fallback
+    prefix_b = rng.randint(0, VOCAB, 40).astype(np.int32)
+    eng2 = DecodeEngine(spec, params, slots=1, window=8, chunk=2)
+    assert eng2.set_prefix(prefix_b) == 40
+    p_small = rng.randint(0, VOCAB, 2).astype(np.int32)
+    rid2 = eng2.submit(p_small, 3, use_prefix=True)
+    out2 = eng2.run()
+    want2 = _oracle(spec, params,
+                    np.concatenate([prefix_b, p_small]), 3)
+    np.testing.assert_array_equal(out2[rid2], want2[prefix_b.size:])
