@@ -87,7 +87,11 @@ def _sample_per_slot(logits, key, temp, top_k, top_p):
 # engine (model reload, knob change) re-traces nothing that an earlier
 # instance already compiled.  All configuration enters either through
 # array shapes (cache layout carries L/window/slots/heads/head_dim) or
-# through the static ``knobs`` tuple (temperature, top_k, top_p, eos_id).
+# through the static ``knobs`` tuple (top_k, top_p, prefix_len);
+# temperature and eos ride as TRACED per-slot vectors (per-request
+# values, no recompiles), and dispatches that don't touch the prefix
+# pass prefix_len=0 + dummy kp/vp so the plain programs' compile-cache
+# key is independent of any registered prefix.
 
 @functools.partial(jax.jit, static_argnums=(0, 1),
                    donate_argnums=(3, 4, 5))
@@ -297,6 +301,7 @@ class EngineStats:
     prefill_admissions: int = 0   # admissions that used parallel prefill
     prefill_dispatches: int = 0   # batched prefill programs dispatched
     prefill_dedup_hits: int = 0   # slots served by a shared prompt row
+    prefix_admissions: int = 0    # requests decoding against the prefix
     completed: int = 0            # requests harvested
     chunks: int = 0               # compiled-program dispatches
 
@@ -424,8 +429,8 @@ class DecodeEngine:
         # no prefix is registered (plen=0 erases the math at trace time).
         heads, hd = cfg["num_heads"], cfg["head_dim"]
         pdtype = self._params["pos_embed"].dtype
-        self._kp = jnp.zeros((cfg["num_layers"], 1, heads, hd), pdtype)
-        self._vp = self._kp
+        self._kp0 = jnp.zeros((cfg["num_layers"], 1, heads, hd), pdtype)
+        self._kp = self._vp = self._kp0
         self._prefix_tokens: Optional[np.ndarray] = None
         # Set when a device dispatch raises mid-flight: the state
         # buffers were DONATED to the failed program and may be invalid,
@@ -555,11 +560,7 @@ class DecodeEngine:
         self._check_usable()
         if np.any(self._active) or self._queue:
             raise RuntimeError("clear_prefix requires an idle engine")
-        cfg = self._cfg
-        pdtype = self._params["pos_embed"].dtype
-        self._kp = jnp.zeros((cfg["num_layers"], 1, cfg["num_heads"],
-                              cfg["head_dim"]), pdtype)
-        self._vp = self._kp
+        self._kp = self._vp = self._kp0
         self._prefix_tokens = None
         self._knobs = (self._top_k, self._top_p, 0)
 
@@ -812,6 +813,7 @@ class DecodeEngine:
             self._use_prefix[b] = req.use_prefix
             self._slot_req[b] = req
             self.stats.prompt_tokens += p
+            self.stats.prefix_admissions += int(req.use_prefix)
         if prefills:
             self._flush_prefills(prefills)
 
@@ -882,12 +884,19 @@ class DecodeEngine:
                                   np.int32)])
         self._rng, sub = jax.random.split(self._rng)
         try:
+            # Plain dispatches pass plen=0 + dummy kp/vp: their
+            # compile-cache key stays independent of any registered
+            # prefix (no recompiles when a prefix is set or swapped).
+            knobs = self._knobs if with_prefix \
+                else (self._top_k, self._top_p, 0)
+            kp, vp = (self._kp, self._vp) if with_prefix \
+                else (self._kp0, self._kp0)
             self._tokens, self._kc, self._vc, toks = _prefill_program(
-                self._knobs, with_prefix, self._params, self._tokens,
+                knobs, with_prefix, self._params, self._tokens,
                 self._kc, self._vc, jnp.asarray(prompts),
                 jnp.asarray(slot_ids), jnp.asarray(row_map),
                 np.int32(t0), jnp.asarray(p_lens),
-                jnp.asarray(self._temp), self._kp, self._vp, sub)
+                jnp.asarray(self._temp), kp, vp, sub)
             if self._replicate is not None:
                 toks = self._replicate(toks)
             toks = np.array(toks)
@@ -908,6 +917,7 @@ class DecodeEngine:
             self.stats.prompt_tokens += p
             self.stats.prefilled_tokens += p
             self.stats.prefill_admissions += 1
+            self.stats.prefix_admissions += int(req.use_prefix)
         self.stats.prefill_dedup_hits += len(flat) - k
         self.stats.prefill_dispatches += 1
 
@@ -963,13 +973,21 @@ class DecodeEngine:
                     n = 1 << (nxt.bit_length() - 1)
         self._rng, sub = jax.random.split(self._rng)
         try:
+            # When no ACTIVE slot uses the prefix, run the plain program
+            # (plen=0 + dummies): its compile-cache key is independent
+            # of the registered prefix, and both variants compile once.
+            any_prefix = bool(np.any(self._use_prefix & self._active))
+            knobs = self._knobs if any_prefix \
+                else (self._top_k, self._top_p, 0)
+            kp, vp = (self._kp, self._vp) if any_prefix \
+                else (self._kp0, self._kp0)
             self._tokens, self._kc, self._vc, done, busy = _chunk_program(
-                n, self._knobs, self._params, self._tokens,
+                n, knobs, self._params, self._tokens,
                 self._kc, self._vc, jnp.asarray(self._start),
                 jnp.asarray(self._p_end), jnp.asarray(self._end),
                 jnp.asarray(self._done), jnp.asarray(self._active),
                 jnp.asarray(self._temp), jnp.asarray(self._eos),
-                jnp.asarray(self._use_prefix), self._kp, self._vp,
+                jnp.asarray(self._use_prefix), kp, vp,
                 jnp.int32(self._tick), sub)
             # The only per-chunk host pull: the [B] done vector (the
             # token buffer stays on device; harvest/partial pull rows).

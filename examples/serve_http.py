@@ -38,12 +38,14 @@ def main():
     from autodist_tpu.serving import serve
 
     spec = transformer_lm(vocab_size=331, num_layers=2, num_heads=4,
-                          head_dim=16, d_ff=128, max_len=args.window,
-                          seq_len=32)
+                          head_dim=16, d_ff=128,
+                          max_len=args.window + 16, seq_len=32)
     params = spec.init(jax.random.PRNGKey(0))
+    system_prompt = list(range(40, 52))     # the shared cached prefix
     srv = serve(spec, params, port=args.port, slots=args.slots,
                 window=args.window, chunk=8,
-                temperature=0.8, top_p=0.95, rng=jax.random.PRNGKey(7))
+                temperature=0.8, top_p=0.95, rng=jax.random.PRNGKey(7),
+                prefix_tokens=system_prompt)
     host, port = srv.address
     print(f"serving on http://{host}:{port}  "
           f"(POST /v1/completions, GET /v1/stats)")
@@ -63,9 +65,14 @@ def main():
 
     def issue(i):
         prompt = rng.randint(0, 331, rng.randint(2, 8)).tolist()
-        outs[i] = post("/v1/completions",
-                       {"prompt_tokens": prompt,
-                        "max_new_tokens": int(rng.randint(4, 12))})
+        # every other request: per-request greedy override + the shared
+        # system-prompt prefix as cached context
+        body = {"prompt_tokens": prompt,
+                "max_new_tokens": int(rng.randint(4, 12))}
+        if i % 2:
+            body["temperature"] = 0.0
+            body["use_prefix"] = True
+        outs[i] = post("/v1/completions", body)
 
     threads = [threading.Thread(target=issue, args=(i,)) for i in range(6)]
     for t in threads:

@@ -682,14 +682,17 @@ def test_engine_prefix_bucket_edges(lm):
     spec, params = lm
     rng = np.random.RandomState(23)
 
-    # (a) prefix 20 + prompt 17 (bucket 32: 20+32 > 48) + 1 new
-    prefix = rng.randint(0, VOCAB, 20).astype(np.int32)
-    prompt = rng.randint(0, VOCAB, 17).astype(np.int32)
-    eng = DecodeEngine(spec, params, slots=2, window=24, chunk=4)
+    # (a) the clip path proper: prefix 35 + prompt 9 (bucket 16 fits
+    # window 16, so no exact-size fallback) -> pad bucket positions
+    # 44..50 overrun max_len 48 and CLIP; their K/V land at ring >= t0
+    # and are overwritten before any read.  Real rows stay exact.
+    prefix = rng.randint(0, VOCAB, 35).astype(np.int32)
+    prompt = rng.randint(0, VOCAB, 9).astype(np.int32)
+    eng = DecodeEngine(spec, params, slots=2, window=16, chunk=4)
     eng.set_prefix(prefix)
-    rid = eng.submit(prompt, 1, use_prefix=True)
+    rid = eng.submit(prompt, 3, use_prefix=True)
     out = eng.run()
-    want = _oracle(spec, params, np.concatenate([prefix, prompt]), 1)
+    want = _oracle(spec, params, np.concatenate([prefix, prompt]), 3)
     np.testing.assert_array_equal(out[rid], want[prefix.size:])
 
     # (b) prefix 40: pow-2 bucket 64 > max_len 48 -> exact fallback
