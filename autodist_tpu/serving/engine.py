@@ -884,13 +884,7 @@ class DecodeEngine:
                                   np.int32)])
         self._rng, sub = jax.random.split(self._rng)
         try:
-            # Plain dispatches pass plen=0 + dummy kp/vp: their
-            # compile-cache key stays independent of any registered
-            # prefix (no recompiles when a prefix is set or swapped).
-            knobs = self._knobs if with_prefix \
-                else (self._top_k, self._top_p, 0)
-            kp, vp = (self._kp, self._vp) if with_prefix \
-                else (self._kp0, self._kp0)
+            knobs, kp, vp = self._dispatch_args(with_prefix)
             self._tokens, self._kc, self._vc, toks = _prefill_program(
                 knobs, with_prefix, self._params, self._tokens,
                 self._kc, self._vc, jnp.asarray(prompts),
@@ -920,6 +914,16 @@ class DecodeEngine:
             self.stats.prefix_admissions += int(req.use_prefix)
         self.stats.prefill_dedup_hits += len(flat) - k
         self.stats.prefill_dispatches += 1
+
+    def _dispatch_args(self, with_prefix: bool):
+        """(knobs, kp, vp) for one compiled-program dispatch — the ONE
+        place encoding the compile-cache-key contract: prefix-touching
+        dispatches carry the registered plen + real K/V, all others the
+        plen=0 knobs + dummies so their cache key is independent of any
+        registered prefix."""
+        if with_prefix:
+            return self._knobs, self._kp, self._vp
+        return (self._top_k, self._top_p, 0), self._kp0, self._kp0
 
     def _prompt_bucket(self, prompt_size: int) -> int:
         """Pow-2 compile bucket for a prompt, falling back to the exact
@@ -974,13 +978,9 @@ class DecodeEngine:
         self._rng, sub = jax.random.split(self._rng)
         try:
             # When no ACTIVE slot uses the prefix, run the plain program
-            # (plen=0 + dummies): its compile-cache key is independent
-            # of the registered prefix, and both variants compile once.
-            any_prefix = bool(np.any(self._use_prefix & self._active))
-            knobs = self._knobs if any_prefix \
-                else (self._top_k, self._top_p, 0)
-            kp, vp = (self._kp, self._vp) if any_prefix \
-                else (self._kp0, self._kp0)
+            # (see _dispatch_args); both variants compile once.
+            knobs, kp, vp = self._dispatch_args(
+                bool(np.any(self._use_prefix & self._active)))
             self._tokens, self._kc, self._vc, done, busy = _chunk_program(
                 n, knobs, self._params, self._tokens,
                 self._kc, self._vc, jnp.asarray(self._start),

@@ -37,11 +37,13 @@ def main():
     from autodist_tpu.models import transformer_lm
     from autodist_tpu.serving import serve
 
+    system_prompt = list(range(40, 52))     # the shared cached prefix
+    # pos_embed must hold prefix + a full window of request positions
     spec = transformer_lm(vocab_size=331, num_layers=2, num_heads=4,
                           head_dim=16, d_ff=128,
-                          max_len=args.window + 16, seq_len=32)
+                          max_len=args.window + len(system_prompt) + 4,
+                          seq_len=32)
     params = spec.init(jax.random.PRNGKey(0))
-    system_prompt = list(range(40, 52))     # the shared cached prefix
     srv = serve(spec, params, port=args.port, slots=args.slots,
                 window=args.window, chunk=8,
                 temperature=0.8, top_p=0.95, rng=jax.random.PRNGKey(7),
