@@ -277,11 +277,30 @@ def main():
                   for _ in range(10)]
         ids_s = [eng.submit(p, n) for p, n in reqs_s]
         out_s = eng.run()
+        # Capture BEFORE the prefix run: stats are monotonic over the
+        # engine lifetime, and the concurrency assertion documents THIS
+        # 10-request run.
+        util_main = round(eng.stats.slot_utilization, 4)
+        # Prefix cache across the process boundary: the shared K/V
+        # (replicated) compose with the process-spanning slot shards.
+        prefix_s = rng_s.randint(0, 97, 7).astype(np.int32)
+        eng.set_prefix(prefix_s)
+        pre_reqs = [(rng_s.randint(0, 97, rng_s.randint(2, 5))
+                     .astype(np.int32), int(rng_s.randint(3, 7)))
+                    for _ in range(4)]
+        pre_ids = [eng.submit(p, n, use_prefix=True)
+                   for p, n in pre_reqs]
+        out_pre = eng.run()
         serving_results = {
             "prompts": [p.tolist() for p, _ in reqs_s],
             "max_new": [n for _, n in reqs_s],
             "tokens": [np.asarray(out_s[rid]).tolist() for rid in ids_s],
-            "slot_utilization": round(eng.stats.slot_utilization, 4),
+            "prefix": prefix_s.tolist(),
+            "prefix_prompts": [p.tolist() for p, _ in pre_reqs],
+            "prefix_max_new": [n for _, n in pre_reqs],
+            "prefix_tokens": [np.asarray(out_pre[rid]).tolist()
+                              for rid in pre_ids],
+            "slot_utilization": util_main,
         }
 
     losses = [float(sess.run(batch)["loss"]) for _ in range(STEPS)]

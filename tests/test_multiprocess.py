@@ -336,5 +336,15 @@ def test_two_process_serving_token_exact(tmp_path):
         want = np.asarray(
             gen(params, np.asarray(prompt, np.int32)[None], n))[0]
         np.testing.assert_array_equal(np.asarray(got), want)
+    # prefix cache across the process boundary: shared K/V + sharded
+    # slots, still token-exact vs the concat oracle
+    assert cs["prefix_tokens"] == ws["prefix_tokens"]
+    prefix = np.asarray(cs["prefix"], np.int32)
+    for prompt, n, got in zip(cs["prefix_prompts"], cs["prefix_max_new"],
+                              cs["prefix_tokens"]):
+        full = np.concatenate([prefix, np.asarray(prompt, np.int32)])
+        want = np.asarray(gen(params, full[None], n))[0]
+        np.testing.assert_array_equal(np.asarray(got),
+                                      want[prefix.size:])
     # the sharded pool actually ran concurrently
     assert cs["slot_utilization"] > 0.3
