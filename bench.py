@@ -518,7 +518,7 @@ def _fill_speculative_trained(result) -> None:
     speculative_draft.py pipeline, abbreviated), measured with-vs-
     without speculation at the same config.  Random bench weights can't
     exhibit acceptance, so both models train briefly on a learnable
-    synthetic stream (next token = f(last two)); the recorded speedup —
+    unigram stream (next = (3*prev + 7) % vocab); the recorded speedup —
     or honest lack of one — is the point.  Best-effort."""
     try:
         import jax
@@ -538,21 +538,24 @@ def _fill_speculative_trained(result) -> None:
         # full config): layer counts and train steps.
         t_layers = int(os.environ.get("AUTODIST_BENCH_SPEC_LAYERS", 6))
         t_steps = int(os.environ.get("AUTODIST_BENCH_SPEC_STEPS", 600))
-        # vocab 97: the two-token transition space (97^2 = 9409 pairs) is
-        # small enough that the rotating training batches COVER it — the
-        # models must learn the rule, not memorize sequences, or novel
-        # prompts at decode time get garbage continuations and acceptance
-        # collapses (the failure the first cut of this section had).
+        # Unigram stream: next = (3*prev + 7) % 97 — only 97 transitions
+        # (and 97 deterministic trajectories, so most eval prompts recur
+        # from training), which BOTH models learn as an exact transition
+        # lookup: the draft tracks the target and the measurement shows
+        # what speculation delivers WITH a competent draft.  A richer
+        # two-token rule measurably fails here — the models minimize
+        # teacher-forced loss by memorizing the rotating batches and
+        # autoregressive accuracy collapses to ~0.3 (measured), so the
+        # acceptance number reflects model quality, not the pipeline.
+        # Acceptance is reported so the regime stays transparent.
         vocab, seq = 97, 128
         rng = np.random.RandomState(1)
 
         def make_batch(n):
             toks = np.zeros((n, seq), np.int64)
             toks[:, 0] = rng.randint(0, vocab, n)
-            toks[:, 1] = rng.randint(0, vocab, n)
-            for t in range(2, seq):
-                toks[:, t] = (3 * toks[:, t - 1] + toks[:, t - 2] + 7) \
-                    % vocab
+            for t in range(1, seq):
+                toks[:, t] = (3 * toks[:, t - 1] + 7) % vocab
             return {"tokens": toks.astype(np.int32)}
 
         t_spec = transformer_lm(vocab_size=vocab, num_layers=t_layers,
