@@ -1,0 +1,41 @@
+"""autodist_tpu.analysis — static strategy/sharding analysis ("shardlint").
+
+A pre-flight pass pipeline over ``(Strategy | CompiledStrategy,
+GraphItem, mesh axes, resource spec)`` that rejects bad distribution
+plans in milliseconds with rule-tagged diagnostics, instead of minutes
+into an XLA compile.  Five passes ship: sharding legality, sync
+coverage, static per-device HBM footprint, collective-schedule
+consistency (pipeline/MoE deadlock lint), and precision lint.  See
+docs/analysis.md for every rule id and the severity semantics.
+
+Entry points:
+
+* :func:`analyze` — run the pipeline, get an :class:`AnalysisReport`.
+* :func:`preflight` / :func:`preflight_session` — the ``validate=``
+  hook bodies used by ``AutoDist.create_distributed_session`` and
+  ``fit``: raise :class:`StrategyValidationError` on ERROR diagnostics,
+  log WARNs once.
+* ``python -m autodist_tpu.analysis <model> <strategy>`` — the CLI:
+  prints a diagnostics table, exits nonzero on ERROR.
+"""
+from autodist_tpu.analysis.analyzer import (
+    AnalysisContext,
+    PASS_ORDER,
+    PlanLite,
+    analyze,
+    log_report,
+    preflight,
+    preflight_session,
+)
+from autodist_tpu.analysis.diagnostics import (
+    AnalysisReport,
+    Diagnostic,
+    Severity,
+    StrategyValidationError,
+)
+
+__all__ = [
+    "AnalysisContext", "AnalysisReport", "Diagnostic", "PASS_ORDER",
+    "PlanLite", "Severity", "StrategyValidationError", "analyze",
+    "log_report", "preflight", "preflight_session",
+]

@@ -1,0 +1,248 @@
+"""CLI: ``python -m autodist_tpu.analysis <model> <strategy>``.
+
+Analyze a strategy against a model's variable catalog WITHOUT building a
+mesh, tracing, or compiling anything — the whole point is a sub-second
+verdict on a plan that would otherwise cost minutes of XLA compile to
+reject.  Prints the diagnostics table and exits 1 when any ERROR rule
+fires (0 otherwise; 2 on usage errors).
+
+``model`` is a builtin demo catalog (``--list-models``) or a path to a
+GraphItem catalog JSON (``GraphItem.serialize()`` output).  ``strategy``
+is a builder class name from ``autodist_tpu.strategy`` (built against
+the virtual resource spec) or a path to a serialized Strategy JSON.
+
+Examples::
+
+    python -m autodist_tpu.analysis linear_regression PSLoadBalancing \
+        --mesh data=8
+    python -m autodist_tpu.analysis pipeline AllReduce --mesh pipe=4,data=2
+    python -m autodist_tpu.analysis my_catalog.json /tmp/strategy.json \
+        --mesh data=8 --budget-gb 16 --json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict
+
+
+def _demo_models() -> Dict[str, dict]:
+    """Builtin demo catalogs, mirroring the examples/ programs (shapes
+    chosen so every shipped builder lowers cleanly on an 8-chip mesh)."""
+    return {
+        # examples/linear_regression.py: two scalars
+        "linear_regression": {
+            "params": {"w": ((), "float32"), "b": ((), "float32")},
+        },
+        # a small dense net (examples/image_classifier.py scale)
+        "mlp": {
+            "params": {
+                "dense1": {"kernel": ((128, 64), "float32"),
+                           "bias": ((64,), "float32")},
+                "dense2": {"kernel": ((64, 8), "float32"),
+                           "bias": ((8,), "float32")},
+            },
+        },
+        # embedding LM slice (examples/lm1b): sparse vocab table
+        "embedding_lm": {
+            "params": {
+                "emb": {"table": ((800, 64), "float32")},
+                "proj": {"kernel": ((64, 64), "float32")},
+            },
+            "sparse_vars": ["emb/table"],
+        },
+        # examples/pipeline_1f1b.py: stage-stacked transformer blocks
+        "pipeline": {
+            "params": {
+                "stages": {"w1": ((4, 32, 32), "float32"),
+                           "w2": ((4, 32, 32), "float32")},
+                "head": {"kernel": ((32, 64), "float32")},
+            },
+            "pipeline_vars": ["stages"],
+        },
+        # examples/moe_pipeline.py: expert-stacked FFN
+        "moe": {
+            "params": {
+                "router": ((32, 4), "float32"),
+                "wi": ((4, 32, 64), "float32"),
+                "wo": ((4, 64, 32), "float32"),
+            },
+            "expert_vars": ["wi", "wo"],
+        },
+    }
+
+
+def _build_graph_item(model_arg: str):
+    import jax
+
+    from autodist_tpu.graph_item import GraphItem
+
+    def from_spec(spec: dict) -> GraphItem:
+        def leafify(node):
+            if isinstance(node, dict):
+                return {k: leafify(v) for k, v in node.items()}
+            shape, dtype = node
+            return jax.ShapeDtypeStruct(tuple(shape), dtype)
+
+        return GraphItem(
+            leafify(spec["params"]),
+            sparse_vars=spec.get("sparse_vars", ()),
+            untrainable_vars=spec.get("untrainable_vars", ()),
+            pipeline_vars=spec.get("pipeline_vars", ()),
+            expert_vars=spec.get("expert_vars", ()))
+
+    demos = _demo_models()
+    if model_arg in demos:
+        return from_spec(demos[model_arg])
+    if os.path.exists(model_arg):
+        with open(model_arg, "r", encoding="utf-8") as f:
+            d = json.load(f)
+        if "variables" in d:  # GraphItem.serialize() catalog
+            params = {v["name"]: jax.ShapeDtypeStruct(
+                tuple(v["shape"]), v["dtype"]) for v in d["variables"]}
+            return GraphItem(
+                params,
+                sparse_vars=[v["name"] for v in d["variables"]
+                             if v.get("sparse")],
+                untrainable_vars=[v["name"] for v in d["variables"]
+                                  if not v.get("trainable", True)],
+                pipeline_vars=[v["name"] for v in d["variables"]
+                               if v.get("pipeline")],
+                expert_vars=[v["name"] for v in d["variables"]
+                             if v.get("expert")])
+        return from_spec(d)  # {"params": {...}, "sparse_vars": [...]} form
+    raise SystemExit(
+        f"unknown model {model_arg!r}: not a builtin "
+        f"({', '.join(sorted(demos))}) and not a file")
+
+
+def _build_strategy(strategy_arg: str, graph_item, resource_spec):
+    import autodist_tpu.strategy as S
+
+    if os.path.exists(strategy_arg):
+        with open(strategy_arg, "r", encoding="utf-8") as f:
+            return S.Strategy.from_dict(json.load(f))
+    builder_cls = getattr(S, strategy_arg, None)
+    if builder_cls is None or not (isinstance(builder_cls, type)
+                                   and issubclass(builder_cls,
+                                                  S.StrategyBuilder)):
+        names = sorted(n for n in dir(S)
+                       if isinstance(getattr(S, n), type)
+                       and issubclass(getattr(S, n), S.StrategyBuilder)
+                       and getattr(S, n) is not S.StrategyBuilder)
+        raise SystemExit(
+            f"unknown strategy {strategy_arg!r}: not a builder "
+            f"({', '.join(names)}) and not a file")
+    return builder_cls().build(graph_item, resource_spec)
+
+
+def _parse_mesh(mesh_arg: str) -> Dict[str, int]:
+    axes: Dict[str, int] = {}
+    for part in mesh_arg.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise SystemExit(
+                f"bad --mesh entry {part!r}: use name=size, e.g. "
+                "data=8,model=2")
+        name, size = part.split("=", 1)
+        axes[name.strip()] = int(size)
+    if not axes:
+        raise SystemExit("--mesh parsed to no axes")
+    return axes
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m autodist_tpu.analysis",
+        description="Static strategy/sharding analyzer (shardlint): "
+                    "pre-flight legality, sync-coverage, HBM, collective "
+                    "and precision checks.  See docs/analysis.md.")
+    parser.add_argument("model", nargs="?",
+                        help="builtin demo model or catalog JSON path")
+    parser.add_argument("strategy", nargs="?",
+                        help="builder class name or Strategy JSON path")
+    parser.add_argument("--mesh", default=None,
+                        help="logical mesh axes, e.g. data=8 or "
+                             "pipe=4,data=2 (default: resource spec / "
+                             "local device count)")
+    parser.add_argument("--resource-spec", default=None,
+                        help="resource spec yaml (mesh hint + hbm_gb "
+                             "budget)")
+    parser.add_argument("--budget-gb", type=float, default=None,
+                        help="per-device HBM budget in GiB (overrides "
+                             "the spec)")
+    parser.add_argument("--passes", default=None,
+                        help="comma-separated subset of passes "
+                             "(default: all)")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the report as JSON")
+    parser.add_argument("--warn-as-error", action="store_true",
+                        help="exit nonzero on WARN findings too")
+    parser.add_argument("--list-models", action="store_true")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print each pass's rule documentation")
+    args = parser.parse_args(argv)
+
+    if args.list_models:
+        for name in sorted(_demo_models()):
+            print(name)
+        return 0
+    if args.list_rules:
+        from autodist_tpu.analysis import analyzer
+        analyzer._load_passes()
+        for name in analyzer.PASS_ORDER:
+            fn = analyzer.PASS_REGISTRY[name]
+            print(f"== pass: {name} ==")
+            print((sys.modules[fn.__module__].__doc__ or "").strip())
+            print()
+        return 0
+    if not args.model or not args.strategy:
+        parser.error("model and strategy are required "
+                     "(or use --list-models / --list-rules)")
+
+    from autodist_tpu.analysis import Severity, analyze
+    from autodist_tpu.resource_spec import ResourceSpec
+
+    axes = _parse_mesh(args.mesh) if args.mesh else None
+    resource_spec = None
+    if args.resource_spec:
+        resource_spec = ResourceSpec(args.resource_spec)
+    if axes is None and resource_spec is None:
+        import jax
+        axes = {"data": jax.device_count()}
+
+    # Builders need a resource spec; fabricate a single-node one sized to
+    # the mesh when none was given (pure analysis — nothing launches).
+    if resource_spec is None:
+        import math
+        chips = math.prod(axes.values())
+        resource_spec = ResourceSpec(resource_info={
+            "nodes": [{"address": "localhost", "chips": chips}],
+            "mesh": dict(axes)})
+
+    graph_item = _build_graph_item(args.model)
+    strategy = _build_strategy(args.strategy, graph_item, resource_spec)
+    budget = int(args.budget_gb * (1 << 30)) if args.budget_gb else None
+    passes = tuple(p.strip() for p in args.passes.split(",")) \
+        if args.passes else None
+    report = analyze(strategy, graph_item, mesh=axes,
+                     resource_spec=resource_spec, budget_bytes=budget,
+                     passes=passes)
+
+    if args.json:
+        print(json.dumps(report.to_dict(), indent=1))
+    else:
+        print(report.format_table())
+    if report.has_errors():
+        return 1
+    if args.warn_as_error and report.warnings:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,246 @@
+"""The analyzer pipeline: Strategy × GraphItem × mesh axes → diagnostics.
+
+The central idea (PAPER.md: distribution is a *compilation* problem) is
+that everything a Strategy will do to the program is decidable before any
+tracing: which mesh axis each tensor dim lands on, whether the dims
+divide, what optimizer/compressor state materializes per device, and
+which collectives each shard issues, are all functions of
+``(Strategy, VarInfo catalog, mesh axis sizes)``.  The analyzer computes
+exactly that projection — :class:`PlanLite`, a mesh-free mirror of the
+compiler's :class:`~autodist_tpu.strategy.compiler.VarPlan` lowering —
+and runs rule passes over it, so a bad plan is rejected in milliseconds
+with a rule-tagged diagnostic instead of minutes into an XLA compile
+(the Automap/ergonomics argument, arXiv:2112.02958).
+
+Inputs are deliberately loose: ``mesh`` may be a real
+``jax.sharding.Mesh``, a plain ``{axis: size}`` dict (no devices needed —
+how the auto-strategy search prunes candidates before any mesh exists),
+or omitted (derived from ``resource_spec``).  Passing a
+:class:`CompiledStrategy` analyzes the *actual* lowered plans instead of
+the projection, which also catches hand-built plan drift.
+"""
+from __future__ import annotations
+
+from collections.abc import Mapping
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from autodist_tpu.analysis.diagnostics import (
+    AnalysisReport,
+    Diagnostic,
+    Severity,
+    StrategyValidationError,
+    diag,
+)
+from autodist_tpu.graph_item import GraphItem, VarInfo
+from autodist_tpu.utils import logging
+
+#: pass name -> rule-id prefix, populated by register_pass below.
+PASS_REGISTRY: Dict[str, Any] = {}
+
+
+@dataclass
+class PlanLite:
+    """Mesh-free projection of one variable's lowered plan.
+
+    ``placement`` maps tensor dim → mesh axis name for the parameter
+    layout; ``opt_placement`` for same-shaped optimizer slots.  ``pad``
+    is ``(dim, padded_size)`` when pad-to-divisible sharding covers an
+    indivisible dim.  ``synthesized`` marks plans the compiler would
+    create by default (no strategy node)."""
+
+    var: VarInfo
+    sync_kind: Optional[str] = None          # "AllReduce" | "PS" | None
+    placement: Dict[int, str] = field(default_factory=dict)
+    opt_placement: Dict[int, str] = field(default_factory=dict)
+    pad: Optional[Tuple[int, int]] = None
+    compressor: str = "NoneCompressor"
+    fused: bool = False
+    group: int = 0
+    staleness: int = 0
+    grad_reduce_axes: Tuple[str, ...] = ()
+    synthesized: bool = False
+
+    def physical_shape(self) -> Tuple[int, ...]:
+        shape = list(self.var.shape)
+        if self.pad is not None:
+            shape[self.pad[0]] = self.pad[1]
+        return tuple(shape)
+
+    def _denominator(self, placement: Dict[int, str],
+                     axes: Mapping[str, int]) -> int:
+        denom = 1
+        for axis_name in placement.values():
+            denom *= max(int(axes.get(axis_name, 1)), 1)
+        return denom
+
+    def param_bytes_per_device(self, axes: Mapping[str, int]) -> float:
+        import numpy as np
+        size = float(np.prod(self.physical_shape() or (1,)))
+        item = np.dtype(self.var.dtype).itemsize
+        return size * item / self._denominator(self.placement, axes)
+
+    def opt_denominator(self, axes: Mapping[str, int]) -> int:
+        return self._denominator(self.opt_placement, axes)
+
+
+@dataclass
+class AnalysisContext:
+    """Everything a pass may consult.  ``plans`` is filled by the
+    legality pass (which owns the lowering) before later passes run."""
+
+    strategy: Any                            # Strategy (never None)
+    graph_item: GraphItem
+    axes: Dict[str, int]
+    compiled: Any = None                     # CompiledStrategy | None
+    resource_spec: Any = None
+    budget_bytes: Optional[int] = None
+    batch: Any = None                        # pytree of arrays/shapes | None
+    plans: Dict[str, PlanLite] = field(default_factory=dict)
+
+    @property
+    def data_axis_size(self) -> int:
+        from autodist_tpu.const import MESH_AXIS_DATA
+        return int(self.axes.get(MESH_AXIS_DATA, 1))
+
+
+def _resolve_axes(strategy_or_compiled, mesh, resource_spec
+                  ) -> Tuple[Any, Any, Dict[str, int]]:
+    """Normalize (strategy, compiled, axes) from the loose inputs."""
+    from autodist_tpu.strategy.compiler import CompiledStrategy
+
+    compiled = None
+    strategy = strategy_or_compiled
+    if isinstance(strategy_or_compiled, CompiledStrategy):
+        compiled = strategy_or_compiled
+        strategy = compiled.strategy
+        axes = {str(k): int(v) for k, v in dict(compiled.mesh.shape).items()}
+        return strategy, compiled, axes
+
+    if mesh is not None:
+        if isinstance(mesh, Mapping):
+            axes = {str(k): int(v) for k, v in mesh.items()}
+        else:  # a real jax.sharding.Mesh
+            axes = {str(k): int(v) for k, v in dict(mesh.shape).items()}
+    elif resource_spec is not None:
+        from autodist_tpu.const import MESH_AXIS_DATA
+        axes = dict(resource_spec.mesh_hint) or \
+            {MESH_AXIS_DATA: max(resource_spec.num_chips, 1)}
+    else:
+        from autodist_tpu.const import MESH_AXIS_DATA
+        axes = {MESH_AXIS_DATA: 1}
+    return strategy, compiled, axes
+
+
+def register_pass(name: str):
+    def deco(fn):
+        PASS_REGISTRY[name] = fn
+        return fn
+    return deco
+
+
+def _load_passes() -> None:
+    """Import the pass modules once (each registers itself)."""
+    if PASS_REGISTRY:
+        return
+    from autodist_tpu.analysis import (  # noqa: F401
+        collectives,
+        legality,
+        memory,
+        precision,
+        sync_coverage,
+    )
+
+
+#: canonical pass order: legality first (it builds ctx.plans), then the
+#: coverage/resource/schedule/precision rules over the projection.
+PASS_ORDER = ("legality", "sync", "memory", "collectives", "precision")
+
+
+def analyze(strategy_or_compiled, graph_item: GraphItem, *,
+            mesh=None, resource_spec=None, budget_bytes: Optional[int] = None,
+            batch=None, passes: Optional[Tuple[str, ...]] = None
+            ) -> AnalysisReport:
+    """Run the static pass pipeline and return an :class:`AnalysisReport`.
+
+    Args:
+      strategy_or_compiled: a :class:`Strategy` or a
+        :class:`CompiledStrategy` (the latter analyzes actual lowered
+        plans and enables the compiled-only consistency rules).
+      graph_item: the captured program (variable catalog; optimizer and
+        params improve the HBM estimate when present).
+      mesh: a ``jax.sharding.Mesh`` or plain ``{axis: size}`` dict;
+        ignored for CompiledStrategy input (its mesh wins).  Defaults to
+        ``resource_spec.mesh_hint`` or pure data parallelism over the
+        spec's chips.
+      resource_spec: optional cluster description — supplies the default
+        mesh axes and the per-chip HBM budget (``hbm_gb`` yaml key).
+      budget_bytes: explicit per-device HBM budget; overrides the spec.
+      batch: optional batch pytree (arrays or ShapeDtypeStructs) for the
+        activation-footprint estimate.
+      passes: subset of :data:`PASS_ORDER` to run (e.g. only
+        ``("legality", "sync")`` for the auto-strategy candidate pruner).
+    """
+    _load_passes()
+    strategy, compiled, axes = _resolve_axes(
+        strategy_or_compiled, mesh, resource_spec)
+    if budget_bytes is None and resource_spec is not None:
+        budget_bytes = getattr(resource_spec, "hbm_bytes_per_chip", None)
+    ctx = AnalysisContext(strategy=strategy, graph_item=graph_item,
+                          axes=axes, compiled=compiled,
+                          resource_spec=resource_spec,
+                          budget_bytes=budget_bytes, batch=batch)
+    report = AnalysisReport()
+    selected = PASS_ORDER if passes is None else tuple(passes)
+    for name in selected:
+        if name not in PASS_REGISTRY:
+            raise ValueError(f"unknown analysis pass {name!r}; "
+                             f"available: {sorted(PASS_REGISTRY)}")
+    # Legality always runs first when selected — it builds ctx.plans,
+    # which every later pass consumes; when the caller skips it we still
+    # build the projection (without emitting its diagnostics).
+    if "legality" not in selected:
+        PASS_REGISTRY["legality"](ctx)
+    for name in PASS_ORDER:
+        if name in selected:
+            report.extend(PASS_REGISTRY[name](ctx))
+    return report
+
+
+_warned_reports: set = set()
+
+
+def log_report(report: AnalysisReport, context: str = "") -> None:
+    """Log WARN/INFO diagnostics once per (context, rule, var)."""
+    for d in report.diagnostics:
+        if d.severity == Severity.ERROR:
+            continue
+        key = (context, d.rule, d.var_name, d.location)
+        if key in _warned_reports:
+            continue
+        _warned_reports.add(key)
+        if d.severity == Severity.WARN:
+            logging.warning("analysis: %s", d.format())
+        else:
+            logging.info("analysis: %s", d.format())
+
+
+def preflight(strategy_or_compiled, graph_item: GraphItem, *,
+              mesh=None, resource_spec=None, batch=None,
+              context: str = "preflight") -> AnalysisReport:
+    """The ``validate=`` hook body: analyze, log WARNs once, raise
+    :class:`StrategyValidationError` on any ERROR — all before tracing."""
+    report = analyze(strategy_or_compiled, graph_item, mesh=mesh,
+                     resource_spec=resource_spec, batch=batch)
+    log_report(report, context)
+    report.raise_for_errors()
+    return report
+
+
+def preflight_session(session, batch=None) -> AnalysisReport:
+    """Pre-flight an already-built DistributedSession (the ``fit(...,
+    validate=True)`` path): analyzes the session's compiled strategy
+    before any step dispatch."""
+    compiled = session._step.compiled_strategy
+    return preflight(compiled, session._gi, batch=batch,
+                     context=f"session:{compiled.strategy.id}")

@@ -1,0 +1,174 @@
+"""Collective-schedule consistency pass: a static deadlock lint for
+pipeline and MoE plans.
+
+SPMD programs cannot deadlock on strategy choices — every device runs
+the same program.  The hazard lives exactly where this framework leaves
+SPMD: the ``shard_map``-manual pipeline schedules (``parallel/pipeline``,
+``pipeline_1f1b``) and hand-laid per-stage parameter groups, where each
+stage's devices issue their own collective sequence.  If stage 0's
+variables all-reduce through a compressor while stage 1's do a plain
+psum, or one stage fuses its group into a single concat-and-pmean while
+another issues per-variable reductions, the stages disagree on the
+*number and order* of collectives — the classic SPMD hang.
+
+The pass reconstructs, per stage/expert group, the ordered collective
+sequence the plan implies (catalog order: one entry per synced variable
+— kind, compressor wire, fused-group id, reduce axes, staleness) and
+requires the sequences to be identical across groups.  Stage identity
+comes from two sources:
+
+* **stacked** parameters (``pipeline_vars``/``expert_vars``): one
+  variable spans all stages, so its collective is uniform by
+  construction — only the stack shapes are checked for agreement;
+* **named** per-stage parameter groups — a path component matching
+  ``stage<k>`` / ``expert<k>`` (e.g. ``stage0/attn/kernel``) — the
+  layout of hand-built non-stacked pipelines, where the lint has real
+  teeth.
+
+Rules (docs/analysis.md):
+
+* ``collectives/stage-collective-mismatch`` (ERROR) — per-stage groups
+  issue different ordered collective sequences (length or entry).
+* ``collectives/stage-stack-heterogeneous`` (WARN) — stacked pipeline
+  (or expert) variables disagree on the stage/expert stack size.
+* ``collectives/unused-parallel-axis`` (WARN) — the mesh carries a
+  pipe/expert axis of size > 1 but no variable uses it.
+* ``collectives/staleness-mixed`` (WARN) — some-but-not-all PS plans use
+  bounded staleness: stale and fresh gradients interleave on one update
+  schedule (legal, rarely intended).
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Tuple
+
+from autodist_tpu.analysis.analyzer import (
+    AnalysisContext,
+    PlanLite,
+    register_pass,
+)
+from autodist_tpu.analysis.diagnostics import Diagnostic, Severity, diag
+from autodist_tpu.const import MESH_AXIS_EXPERT, MESH_AXIS_PIPE
+
+_GROUP_RE = re.compile(r"(?:^|/)(stage|expert)[_-]?(\d+)(?=/|$)")
+
+
+def _collective_entry(plan: PlanLite) -> Tuple:
+    """One variable's contribution to the static collective schedule."""
+    return (plan.sync_kind, plan.compressor or "NoneCompressor",
+            bool(plan.fused), plan.group, tuple(plan.grad_reduce_axes),
+            int(plan.staleness), tuple(sorted(plan.placement.items())))
+
+
+def _named_groups(ctx: AnalysisContext
+                  ) -> Dict[str, Dict[int, List[Tuple[str, PlanLite]]]]:
+    """{kind: {index: [(name-with-index-erased, plan), ...]}} in catalog
+    order — the per-stage sequences to compare."""
+    groups: Dict[str, Dict[int, List[Tuple[str, PlanLite]]]] = {}
+    for var in ctx.graph_item.info.variables:  # catalog order = schedule order
+        plan = ctx.plans.get(var.name)
+        if plan is None or plan.sync_kind is None:
+            continue
+        m = _GROUP_RE.search(var.name)
+        if not m:
+            continue
+        kind, idx = m.group(1), int(m.group(2))
+        erased = var.name[:m.start()] + f"/{kind}<i>" + var.name[m.end():]
+        groups.setdefault(kind, {}).setdefault(idx, []).append(
+            (erased.lstrip("/"), plan))
+    return groups
+
+
+def _check_named_groups(ctx: AnalysisContext) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    for kind, by_idx in _named_groups(ctx).items():
+        if len(by_idx) < 2:
+            continue
+        sequences = {
+            idx: [(name, _collective_entry(plan)) for name, plan in entries]
+            for idx, entries in by_idx.items()}
+        base_idx = min(sequences)
+        base = sequences[base_idx]
+        for idx in sorted(sequences):
+            if idx == base_idx:
+                continue
+            seq = sequences[idx]
+            if len(seq) != len(base):
+                diags.append(diag(
+                    "collectives/stage-collective-mismatch", Severity.ERROR,
+                    f"{kind} {idx} issues {len(seq)} collective(s) but "
+                    f"{kind} {base_idx} issues {len(base)}: the manual "
+                    "schedule's shards would block on unmatched "
+                    "collectives",
+                    location=f"{kind}{idx}",
+                    fix=f"give every {kind} the same synced variables"))
+                continue
+            for (n_a, e_a), (n_b, e_b) in zip(base, seq):
+                if e_a != e_b:
+                    diags.append(diag(
+                        "collectives/stage-collective-mismatch",
+                        Severity.ERROR,
+                        f"{kind} {idx} syncs {n_b!r} as {e_b} but "
+                        f"{kind} {base_idx} syncs {n_a!r} as {e_a}: "
+                        "shards would issue different collective "
+                        "sequences (deadlock under manual scheduling)",
+                        location=f"{kind}{idx}",
+                        fix="use one synchronizer/compressor/grouping "
+                            f"config across all {kind}s"))
+                    break
+    return diags
+
+
+def _check_stacked(ctx: AnalysisContext) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    for flag, axis_name, dim_of in (
+            ("pipeline", MESH_AXIS_PIPE, lambda v: 0),
+            ("expert", MESH_AXIS_EXPERT,
+             lambda v: 1 if v.pipeline else 0)):
+        stacked = [p.var for p in ctx.plans.values()
+                   if getattr(p.var, flag) and p.var.shape]
+        sizes = {v.shape[dim_of(v)] for v in stacked
+                 if len(v.shape) > dim_of(v)}
+        if len(sizes) > 1:
+            diags.append(diag(
+                "collectives/stage-stack-heterogeneous", Severity.WARN,
+                f"{flag}-stacked variables disagree on the stack size "
+                f"({sorted(sizes)}): only interleaved virtual stages "
+                "legitimately multiply it — check the stacking",
+                location=axis_name,
+                fix=f"stack every {flag} variable to the same leading "
+                    "size (x virtual-stage factor)"))
+        size = int(ctx.axes.get(axis_name, 1))
+        if size > 1 and not stacked and axis_name not in {
+                a for p in ctx.plans.values()
+                for a in p.placement.values()}:
+            diags.append(diag(
+                "collectives/unused-parallel-axis", Severity.WARN,
+                f"mesh carries a {axis_name!r} axis of size {size} but no "
+                f"variable is {flag}-stacked or sharded over it: those "
+                "devices replicate all work",
+                location=axis_name,
+                fix=f"flag the stacked variables via {flag}_vars=, or "
+                    f"drop the {axis_name!r} axis"))
+    return diags
+
+
+def _check_staleness(ctx: AnalysisContext) -> List[Diagnostic]:
+    ps = [p for p in ctx.plans.values() if p.sync_kind == "PS"]
+    stale = [p for p in ps if p.staleness > 0]
+    if stale and len(stale) != len(ps):
+        return [diag(
+            "collectives/staleness-mixed", Severity.WARN,
+            f"{len(stale)} of {len(ps)} PS plans use bounded staleness: "
+            "stale and fresh gradients interleave on one update schedule",
+            var=stale[0].var.name,
+            fix="use one staleness bound for all PS variables")]
+    return []
+
+
+@register_pass("collectives")
+def run(ctx: AnalysisContext) -> List[Diagnostic]:
+    diags = _check_named_groups(ctx)
+    diags += _check_stacked(ctx)
+    diags += _check_staleness(ctx)
+    return diags

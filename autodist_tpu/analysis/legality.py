@@ -1,0 +1,428 @@
+"""Sharding-legality pass: does the plan lower onto the mesh at all?
+
+This pass owns the *projection*: it mirrors the compiler's lowering rules
+(``strategy/compiler.py``) symbolically over ``{axis: size}`` — no mesh,
+no devices — filling ``ctx.plans`` with :class:`PlanLite` records that
+the later passes (memory, collectives, precision) consume.  Given a
+:class:`CompiledStrategy` it instead audits the *actual* ``VarPlan``s,
+which also catches hand-built plan drift the compiler never saw.
+
+Rules (docs/analysis.md):
+
+* ``legality/invalid-partitioner`` (ERROR) — unparseable partitioner,
+  more than one active axis, axis beyond the variable's rank, or a
+  dim < 2: the compiler raises ``ValueError`` on these mid-build.
+* ``legality/indivisible-partition`` (ERROR) — a partitioned dim neither
+  divides its mesh axis nor is covered by pad-to-divisible sharding
+  (padding would at least double the variable, so the compiler silently
+  replicates — the plan that runs is NOT the plan that was asked for).
+* ``legality/padded-partition`` (INFO) — indivisible dim covered by the
+  pad-to-divisible path (pad rows zero-masked each step).
+* ``legality/unknown-mesh-axis`` (ERROR) — a spec names an axis the mesh
+  does not carry (hand-built plans only; the projection cannot emit it).
+* ``legality/duplicate-mesh-axis`` (ERROR) — one spec uses the same mesh
+  axis on two tensor dims.
+* ``legality/structural-axis-claimed`` (WARN) — a partitioner claims a
+  pipeline/expert structural axis; the compiler drops the claim.
+* ``legality/structural-indivisible`` (WARN) — a stage/expert stack dim
+  not divisible by its mesh axis; the compiler keeps it replicated.
+* ``legality/ar-partition-colocated`` (INFO) — an AllReduce partitioner
+  on a mesh without a model axis: shards stay colocated with replicas
+  (the reference layout), i.e. the partitioner is a no-op.
+* ``legality/batch-axis-mismatch`` (ERROR) — compiled batch axes missing
+  from the mesh, or a trainable plan whose gradient is NOT reduced over
+  the data axis while the batch is sharded over it (silent divergence).
+* ``legality/batch-indivisible`` (WARN) — a provided batch leaf whose
+  leading dim does not divide the data axis (the step will replicate it).
+* ``legality/mesh-hint-mismatch`` (WARN) — the strategy's
+  ``graph_config.mesh_axes`` hint names axes the mesh does not carry.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from autodist_tpu.analysis.analyzer import (
+    AnalysisContext,
+    PlanLite,
+    register_pass,
+)
+from autodist_tpu.analysis.diagnostics import Diagnostic, Severity, diag
+from autodist_tpu.const import (
+    MESH_AXIS_DATA,
+    MESH_AXIS_EXPERT,
+    MESH_AXIS_MODEL,
+    MESH_AXIS_PIPE,
+)
+from autodist_tpu.graph_item import VarInfo
+
+
+def _structural_axes(var: VarInfo) -> Tuple[int, ...]:
+    axes = []
+    if var.pipeline:
+        axes.append(0)
+    if var.expert:
+        axes.append(1 if var.pipeline else 0)
+    return tuple(axes)
+
+
+def _partition(var: VarInfo, axis: Optional[int], target: Optional[str],
+               mesh_axes: Dict[str, int], diags: List[Diagnostic]
+               ) -> Tuple[Dict[int, str], Optional[Tuple[int, int]]]:
+    """Mirror of ``StrategyCompiler._partition_spec`` over axis sizes."""
+    if axis is None or target is None:
+        return {}, None
+    size = int(mesh_axes.get(target, 1))
+    if size <= 1:
+        return {}, None
+    dim = var.shape[axis]
+    if dim % size:
+        padded = -(-dim // size) * size
+        if padded >= 2 * dim:
+            diags.append(diag(
+                "legality/indivisible-partition", Severity.ERROR,
+                f"dim {axis} (size {dim}) cannot shard over {target!r} "
+                f"(size {size}): padding to {padded} would at least double "
+                "the variable, so the compiler silently replicates it",
+                var=var.name, location=f"dim{axis}->{target}",
+                fix=f"use a dim divisible by {size}, shrink the {target!r} "
+                    "axis, or drop the partitioner"))
+            return {}, None
+        diags.append(diag(
+            "legality/padded-partition", Severity.INFO,
+            f"dim {axis} (size {dim}) pads to {padded} for even {target!r} "
+            "sharding (pad rows zero-masked each step)",
+            var=var.name, location=f"dim{axis}->{target}"))
+        return {axis: target}, (axis, padded)
+    return {axis: target}, None
+
+
+def _apply_structural(var: VarInfo, placement: Dict[int, str],
+                      mesh_axes: Dict[str, int],
+                      diags: List[Diagnostic]) -> None:
+    """Mirror of ``_apply_structural_specs``: pipe on dim 0, expert on
+    the next structural dim, when they divide."""
+    def one(dim: int, axis_name: str, label: str) -> None:
+        size = int(mesh_axes.get(axis_name, 1))
+        if size <= 1 or len(var.shape) <= dim:
+            return
+        if var.shape[dim] % size:
+            diags.append(diag(
+                "legality/structural-indivisible", Severity.WARN,
+                f"{label} dim {dim} (size {var.shape[dim]}) is not "
+                f"divisible by the {axis_name!r} axis (size {size}); the "
+                "compiler keeps it replicated",
+                var=var.name, location=f"dim{dim}->{axis_name}",
+                fix=f"make the {label} stack a multiple of {size}"))
+            return
+        placement[dim] = axis_name
+
+    if var.pipeline:
+        one(0, MESH_AXIS_PIPE, "pipeline")
+    if var.expert:
+        one(1 if var.pipeline else 0, MESH_AXIS_EXPERT, "expert")
+
+
+def _wus_opt(var: VarInfo, placement: Dict[int, str],
+             mesh_axes: Dict[str, int]) -> Dict[int, str]:
+    """Mirror of ``_wus_opt_spec``: shard the largest free dim over
+    ``data`` when it divides evenly."""
+    d = int(mesh_axes.get(MESH_AXIS_DATA, 1))
+    if d <= 1 or not var.shape:
+        return dict(placement)
+    if MESH_AXIS_DATA in placement.values():
+        return dict(placement)
+    best, best_dim = None, 0
+    for i, dim in enumerate(var.shape):
+        if i not in placement and dim % d == 0 and dim > best_dim:
+            best, best_dim = i, dim
+    if best is None:
+        return dict(placement)
+    out = dict(placement)
+    out[best] = MESH_AXIS_DATA
+    return out
+
+
+def _lower_from_strategy(ctx: AnalysisContext
+                         ) -> Tuple[Dict[str, PlanLite], List[Diagnostic]]:
+    from autodist_tpu.strategy.base import (
+        AllReduceSynchronizerConfig,
+        PSSynchronizerConfig,
+    )
+    from autodist_tpu.strategy.compiler import parse_partitioner
+
+    diags: List[Diagnostic] = []
+    axes = ctx.axes
+    gi = ctx.graph_item
+    known = {v.name: v for v in gi.info.variables}
+    model_axis = MESH_AXIS_MODEL \
+        if int(axes.get(MESH_AXIS_MODEL, 1)) > 1 else None
+    d = int(axes.get(MESH_AXIS_DATA, 1))
+    grad_axes = (MESH_AXIS_DATA,) if d > 1 else ()
+    plans: Dict[str, PlanLite] = {}
+
+    for node in ctx.strategy.node_config:
+        var = known.get(node.var_name)
+        if var is None or not var.trainable:
+            continue  # dead / frozen nodes: the sync pass reports them
+        try:
+            axis, num_shards = parse_partitioner(node.partitioner)
+        except ValueError as e:
+            diags.append(diag(
+                "legality/invalid-partitioner", Severity.ERROR, str(e),
+                var=var.name, location=node.partitioner,
+                fix="use one active axis, e.g. \"1,4,1\""))
+            axis, num_shards = None, 1
+        if axis is not None and axis in _structural_axes(var):
+            diags.append(diag(
+                "legality/structural-axis-claimed", Severity.WARN,
+                f"partitioner {node.partitioner!r} claims structural dim "
+                f"{axis} (owned by the pipe/expert stacking); the compiler "
+                "drops the claim",
+                var=var.name, location=f"dim{axis}",
+                fix="partition a non-structural dim"))
+            axis = None
+        if axis is not None and (len(var.shape) <= axis
+                                 or var.shape[axis] < 2):
+            diags.append(diag(
+                "legality/invalid-partitioner", Severity.ERROR,
+                f"partitioner {node.partitioner!r} is invalid for shape "
+                f"{var.shape}: the compiler raises on it",
+                var=var.name, location=node.partitioner,
+                fix="partition an existing dim of size >= 2"))
+            axis = None
+
+        sync = node.synchronizer
+        if isinstance(sync, AllReduceSynchronizerConfig):
+            placement: Dict[int, str] = {}
+            pad = None
+            if axis is not None:
+                if model_axis is None:
+                    diags.append(diag(
+                        "legality/ar-partition-colocated", Severity.INFO,
+                        f"AllReduce partitioner {node.partitioner!r} on a "
+                        "mesh with no model axis: shards stay colocated "
+                        "with replicas (the partitioner is a layout no-op)",
+                        var=var.name, location=node.partitioner))
+                else:
+                    placement, pad = _partition(var, axis, model_axis,
+                                                axes, diags)
+            _apply_structural(var, placement, axes, diags)
+            plans[var.name] = PlanLite(
+                var=var, sync_kind="AllReduce", placement=placement,
+                opt_placement=dict(placement), pad=pad,
+                compressor=sync.compressor or "NoneCompressor",
+                fused=bool(getattr(sync, "fused", False)), group=sync.group,
+                grad_reduce_axes=grad_axes)
+        elif isinstance(sync, PSSynchronizerConfig):
+            shard_axis = model_axis or (
+                MESH_AXIS_DATA if axis is not None else None)
+            placement, pad = _partition(var, axis, shard_axis, axes, diags)
+            if (var.sparse and axis is None and var.shape
+                    and not (var.pipeline or var.expert)):
+                placement, pad = _partition(
+                    var, 0, model_axis or MESH_AXIS_DATA, axes, diags)
+            if var.pipeline or var.expert:
+                _apply_structural(var, placement, axes, diags)
+                opt = _wus_opt(var, placement, axes)
+            else:
+                opt = dict(placement) if placement \
+                    else _wus_opt(var, placement, axes)
+            plans[var.name] = PlanLite(
+                var=var, sync_kind="PS", placement=placement,
+                opt_placement=opt, pad=pad, staleness=sync.staleness,
+                grad_reduce_axes=grad_axes)
+        # nodes with no/unknown synchronizer: the sync pass errors on them
+
+    for name, var in known.items():
+        if name in plans:
+            continue
+        if var.trainable:
+            placement = {}
+            _apply_structural(var, placement, axes, diags)
+            plans[name] = PlanLite(
+                var=var, sync_kind="AllReduce", placement=placement,
+                opt_placement=dict(placement), grad_reduce_axes=grad_axes,
+                synthesized=True)
+        else:
+            plans[name] = PlanLite(var=var, sync_kind=None)
+    return plans, diags
+
+
+def _spec_axes(spec) -> List[Tuple[int, str]]:
+    """PartitionSpec → [(dim, axis_name)] with tuple entries flattened."""
+    out: List[Tuple[int, str]] = []
+    for dim, entry in enumerate(tuple(spec)):
+        if entry is None:
+            continue
+        names = [entry] if isinstance(entry, str) else list(entry)
+        out.extend((dim, str(n)) for n in names)
+    return out
+
+
+def _audit_spec(ctx: AnalysisContext, var: VarInfo, spec, pad,
+                label: str, diags: List[Diagnostic]) -> Dict[int, str]:
+    """Validate one lowered spec; return its placement dict."""
+    pairs = _spec_axes(spec)
+    placement: Dict[int, str] = {}
+    seen: Dict[str, int] = {}
+    for dim, axis_name in pairs:
+        if axis_name in seen:
+            diags.append(diag(
+                "legality/duplicate-mesh-axis", Severity.ERROR,
+                f"{label} spec uses mesh axis {axis_name!r} on dims "
+                f"{seen[axis_name]} and {dim}",
+                var=var.name, location=axis_name,
+                fix="each mesh axis may shard at most one tensor dim"))
+            continue
+        seen[axis_name] = dim
+        if axis_name not in ctx.axes:
+            diags.append(diag(
+                "legality/unknown-mesh-axis", Severity.ERROR,
+                f"{label} spec names mesh axis {axis_name!r}; the mesh "
+                f"carries {sorted(ctx.axes)}",
+                var=var.name, location=axis_name,
+                fix="add the axis to the mesh or fix the spec"))
+            continue
+        size = int(ctx.axes[axis_name])
+        if dim >= len(var.shape):
+            diags.append(diag(
+                "legality/unknown-mesh-axis", Severity.ERROR,
+                f"{label} spec shards dim {dim} of a rank-"
+                f"{len(var.shape)} variable",
+                var=var.name, location=f"dim{dim}"))
+            continue
+        phys = pad[1] if (pad is not None and pad[0] == dim) \
+            else var.shape[dim]
+        if size > 1 and phys % size:
+            diags.append(diag(
+                "legality/indivisible-partition", Severity.ERROR,
+                f"{label} dim {dim} (size {phys}) is not divisible by "
+                f"mesh axis {axis_name!r} (size {size}) and no pad plan "
+                "covers it",
+                var=var.name, location=f"dim{dim}->{axis_name}",
+                fix="pad the dim, change the axis size, or replicate"))
+        placement[dim] = axis_name
+    return placement
+
+
+def _lower_from_compiled(ctx: AnalysisContext
+                         ) -> Tuple[Dict[str, PlanLite], List[Diagnostic]]:
+    diags: List[Diagnostic] = []
+    gi = ctx.graph_item
+    known = {v.name: v for v in gi.info.variables}
+    plans: Dict[str, PlanLite] = {}
+
+    for name, vp in ctx.compiled.var_plans.items():
+        var = known.get(name)
+        if var is None:
+            diags.append(diag(
+                "legality/unknown-mesh-axis", Severity.WARN,
+                "compiled plan names a variable absent from the program "
+                "catalog", var=name,
+                fix="rebuild the plan against the current GraphItem"))
+            continue
+        pad = (vp.pad_axis, vp.pad_dim) if vp.pad_axis is not None else None
+        if pad is not None:
+            diags.append(diag(
+                "legality/padded-partition", Severity.INFO,
+                f"dim {pad[0]} (size {var.shape[pad[0]]}) pads to "
+                f"{pad[1]} for even sharding (pad rows zero-masked)",
+                var=name, location=f"dim{pad[0]}"))
+        placement = _audit_spec(ctx, var, vp.param_spec, pad, "param", diags)
+        opt_placement = _audit_spec(ctx, var, vp.opt_spec, pad, "opt", diags)
+        if (vp.partition_axis is not None and vp.num_shards > 1
+                and vp.partition_axis not in placement):
+            diags.append(diag(
+                "legality/indivisible-partition", Severity.ERROR,
+                f"the strategy partitioned dim {vp.partition_axis} "
+                f"({vp.num_shards} shards) but the lowered plan replicates "
+                "it (indivisible dim, pad not worthwhile): the plan that "
+                "runs is not the plan that was asked for",
+                var=name, location=f"dim{vp.partition_axis}",
+                fix="fix the partitioner or accept replication explicitly"))
+        for ax in vp.grad_reduce_axes:
+            if ax not in ctx.axes:
+                diags.append(diag(
+                    "legality/unknown-mesh-axis", Severity.ERROR,
+                    f"grad_reduce_axes names unknown mesh axis {ax!r}",
+                    var=name, location=ax))
+        plans[name] = PlanLite(
+            var=var, sync_kind=vp.sync_kind, placement=placement,
+            opt_placement=opt_placement, pad=pad,
+            compressor=vp.compressor or "NoneCompressor",
+            fused=bool(vp.fused), group=vp.group, staleness=vp.staleness,
+            grad_reduce_axes=tuple(vp.grad_reduce_axes))
+
+    for name, var in known.items():
+        if name not in plans:
+            plans[name] = PlanLite(
+                var=var, sync_kind="AllReduce" if var.trainable else None,
+                synthesized=var.trainable)
+    return plans, diags
+
+
+def _check_batch_layout(ctx: AnalysisContext) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    d = ctx.data_axis_size
+    if ctx.compiled is not None:
+        for ax in ctx.compiled.batch_axes:
+            if ax not in ctx.axes:
+                diags.append(diag(
+                    "legality/batch-axis-mismatch", Severity.ERROR,
+                    f"batch_axes names mesh axis {ax!r}; the mesh carries "
+                    f"{sorted(ctx.axes)}", location=str(ax)))
+        if d > 1 and MESH_AXIS_DATA in ctx.compiled.batch_axes:
+            for name, plan in ctx.plans.items():
+                if (plan.sync_kind is not None and not plan.synthesized
+                        and MESH_AXIS_DATA not in plan.grad_reduce_axes):
+                    diags.append(diag(
+                        "legality/batch-axis-mismatch", Severity.ERROR,
+                        "batch is sharded over 'data' but this plan never "
+                        "reduces its gradient over 'data': replicas would "
+                        "silently diverge", var=name,
+                        fix="add 'data' to grad_reduce_axes"))
+        elif d > 1 and MESH_AXIS_DATA not in ctx.compiled.batch_axes:
+            diags.append(diag(
+                "legality/batch-axis-mismatch", Severity.WARN,
+                f"mesh has a data axis of size {d} but the batch is not "
+                "sharded over it: every chip computes the full batch",
+                fix="set batch_axes=('data',) or drop the data axis"))
+    if ctx.batch is not None and d > 1:
+        import jax
+        for leaf in jax.tree_util.tree_leaves(ctx.batch):
+            shape = tuple(getattr(leaf, "shape", ()) or ())
+            if shape and shape[0] % d:
+                diags.append(diag(
+                    "legality/batch-indivisible", Severity.WARN,
+                    f"batch leaf with leading dim {shape[0]} does not "
+                    f"divide the data axis (size {d}); it will be "
+                    "replicated on every chip",
+                    location=f"batch[{shape}]",
+                    fix=f"pad the global batch to a multiple of {d}"))
+                break  # one finding is enough; the step warns per leaf
+    return diags
+
+
+def _check_mesh_hint(ctx: AnalysisContext) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    hint = getattr(ctx.strategy.graph_config, "mesh_axes", None) or {}
+    for name, size in hint.items():
+        if name not in ctx.axes:
+            diags.append(diag(
+                "legality/mesh-hint-mismatch", Severity.WARN,
+                f"strategy mesh hint names axis {name!r} (size {size}) "
+                f"but the mesh carries {sorted(ctx.axes)}",
+                location=str(name)))
+    return diags
+
+
+@register_pass("legality")
+def run(ctx: AnalysisContext) -> List[Diagnostic]:
+    if ctx.compiled is not None:
+        plans, diags = _lower_from_compiled(ctx)
+    else:
+        plans, diags = _lower_from_strategy(ctx)
+    ctx.plans = plans
+    diags += _check_batch_layout(ctx)
+    diags += _check_mesh_hint(ctx)
+    return diags

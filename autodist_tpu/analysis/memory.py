@@ -1,0 +1,202 @@
+"""Static HBM-footprint pass: will this plan fit per-device memory?
+
+An OOM-by-construction plan (replicated optimizer state on a model that
+only fits sharded, a compressor whose error-feedback residuals double
+gradient memory, no remat on a long sequence) surfaces today as an XLA
+allocation error minutes into compilation.  Everything in that sum is
+statically known: parameter and optimizer-state bytes come from the
+catalog and ``jax.eval_shape`` over the captured optimizer (dtype-aware,
+so ``ops/opt_state_dtype.cast_opt_state`` bf16 moments are counted at 2
+bytes), per-device denominators from the plan placements, compressor
+state from each compressor's own ``init_state`` probed abstractly, and
+activations from the batch shapes with a remat-aware multiplier.
+
+Rules (docs/analysis.md):
+
+* ``memory/hbm-breakdown`` (INFO) — always emitted: the per-device sum
+  ``params + optimizer + gradients + sync-state + activations`` with
+  each term listed.
+* ``memory/hbm-over-budget`` (ERROR) — the sum exceeds the per-device
+  budget (``analyze(budget_bytes=...)``, or the resource spec's
+  ``hbm_gb`` yaml key).
+* ``memory/hbm-near-budget`` (WARN) — the sum exceeds 90% of the budget.
+
+The activation term is a deliberate coarse bound — ``multiplier ×
+per-device batch bytes``, with the multiplier shrunk under remat
+(``full`` 2×, ``dots``/``dots_no_batch`` 4×, none 8×) — and is skipped
+(with a note) when no batch shapes are provided.  The other terms are
+exact up to XLA temporaries.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from autodist_tpu.analysis.analyzer import (
+    AnalysisContext,
+    PlanLite,
+    register_pass,
+)
+from autodist_tpu.analysis.diagnostics import Diagnostic, Severity, diag
+
+#: activation-estimate multipliers over per-device batch bytes, by remat
+#: policy (None = no remat).  Coarse by design; documented in
+#: docs/analysis.md.
+ACTIVATION_MULTIPLIERS = {None: 8.0, "none": 8.0, "full": 2.0,
+                          "dots": 4.0, "dots_no_batch": 4.0}
+
+_MiB = float(1 << 20)
+
+
+def _mib(x: float) -> str:
+    return f"{x / _MiB:.1f} MiB"
+
+
+def _param_and_grad_bytes(ctx: AnalysisContext) -> Dict[str, float]:
+    params = grads = 0.0
+    for plan in ctx.plans.values():
+        b = plan.param_bytes_per_device(ctx.axes)
+        params += b
+        if plan.var.trainable:
+            grads += b
+    return {"params": params, "gradients": grads}
+
+
+def _opt_state_bytes(ctx: AnalysisContext) -> Optional[float]:
+    """Exact per-device optimizer-state bytes via ``eval_shape`` over the
+    captured optimizer (None when no optimizer was captured)."""
+    gi = ctx.graph_item
+    if gi.optimizer is None or gi.params is None:
+        return None
+    import jax
+    import numpy as np
+
+    from autodist_tpu.graph_item import path_name
+    from autodist_tpu.kernel import sharding_utils as su
+
+    try:
+        opt_shapes = jax.eval_shape(gi.frozen_aware_optimizer().init,
+                                    gi.params)
+    except Exception:  # pragma: no cover - exotic optimizers
+        return None
+    # params-shaped tree of variable names, projected onto the opt state:
+    # every param-shaped block (mu/nu/...) resolves each leaf to its var.
+    name_tree = jax.tree_util.tree_map_with_path(
+        lambda p, _: path_name(p), gi.params)
+    mapped = su.opt_spec_tree(opt_shapes, gi.params, name_tree, default="")
+    total = 0.0
+    for leaf, name in zip(jax.tree_util.tree_leaves(opt_shapes),
+                          jax.tree_util.tree_leaves(mapped)):
+        size = float(np.prod(tuple(leaf.shape) or (1,)))
+        bytes_ = size * np.dtype(leaf.dtype).itemsize
+        plan = ctx.plans.get(name) if name else None
+        if plan is not None:
+            logical = float(np.prod(plan.var.shape or (1,)))
+            phys = float(np.prod(plan.physical_shape() or (1,)))
+            ratio = phys / logical if logical else 1.0
+            bytes_ = bytes_ * ratio / plan.opt_denominator(ctx.axes)
+        total += bytes_
+    return total
+
+
+def _sync_state_bytes(ctx: AnalysisContext) -> float:
+    """Compressor (error-feedback / PowerSGD / int8 residual) state per
+    device, probed through each compressor's own ``init_state`` so the
+    estimate cannot drift from the implementation."""
+    import jax
+    import numpy as np
+
+    from autodist_tpu.const import MESH_AXIS_DATA
+    from autodist_tpu.kernel.synchronization.compressor import get_compressor
+
+    total = 0.0
+    for plan in ctx.plans.values():
+        if plan.sync_kind != "AllReduce" or \
+                (plan.compressor or "NoneCompressor") == "NoneCompressor":
+            continue
+        shape = list(plan.var.shape)
+        # Supported per-shard state layouts keep the shard shape; every
+        # fallback case replicates (explicit_sync module docstring).
+        if (len(plan.placement) == 1 and plan.pad is None):
+            (dim, axis_name), = plan.placement.items()
+            n = int(ctx.axes.get(axis_name, 1))
+            if axis_name != MESH_AXIS_DATA and n > 1 \
+                    and shape[dim] % n == 0:
+                shape[dim] //= n
+        try:
+            comp = get_compressor(plan.compressor)
+        except ValueError:
+            continue  # the precision pass reports unknown compressors
+        probe = jax.eval_shape(
+            comp.init_state,
+            jax.ShapeDtypeStruct(tuple(shape), plan.var.dtype))
+        for leaf in jax.tree_util.tree_leaves(probe):
+            total += float(np.prod(tuple(leaf.shape) or (1,))) \
+                * np.dtype(leaf.dtype).itemsize
+    return total
+
+
+def _activation_bytes(ctx: AnalysisContext) -> Optional[float]:
+    if ctx.batch is None:
+        return None
+    import jax
+    import numpy as np
+
+    batch_bytes = 0.0
+    for leaf in jax.tree_util.tree_leaves(ctx.batch):
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        dtype = getattr(leaf, "dtype", None) or np.asarray(leaf).dtype
+        batch_bytes += float(np.prod(shape or (1,))) * np.dtype(dtype).itemsize
+    d = max(ctx.data_axis_size, 1)
+    mult = ACTIVATION_MULTIPLIERS.get(
+        ctx.graph_item.remat, ACTIVATION_MULTIPLIERS[None])
+    return mult * batch_bytes / d
+
+
+@register_pass("memory")
+def run(ctx: AnalysisContext) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    terms = _param_and_grad_bytes(ctx)
+    opt = _opt_state_bytes(ctx)
+    sync = _sync_state_bytes(ctx)
+    act = _activation_bytes(ctx)
+
+    total = terms["params"] + terms["gradients"] + sync
+    parts = [f"params {_mib(terms['params'])}",
+             f"gradients {_mib(terms['gradients'])}"]
+    if opt is None:
+        parts.append("optimizer ? (no optimizer captured)")
+    else:
+        total += opt
+        parts.append(f"optimizer {_mib(opt)}")
+    parts.append(f"sync-state {_mib(sync)}")
+    if act is None:
+        parts.append("activations ? (pass batch= for the estimate)")
+    else:
+        total += act
+        remat = ctx.graph_item.remat or "none"
+        parts.append(f"activations ~{_mib(act)} (remat={remat})")
+
+    budget = ctx.budget_bytes
+    budget_note = f"; budget {_mib(budget)}" if budget else ""
+    diags.append(diag(
+        "memory/hbm-breakdown", Severity.INFO,
+        f"per-device HBM ≈ {_mib(total)} = " + " + ".join(parts)
+        + budget_note))
+
+    if budget:
+        if total > budget:
+            diags.append(diag(
+                "memory/hbm-over-budget", Severity.ERROR,
+                f"per-device footprint ≈ {_mib(total)} exceeds the "
+                f"{_mib(budget)} budget",
+                fix="shard more state (PS/weight-update sharding), cast "
+                    "optimizer moments to bf16 (cast_opt_state), enable "
+                    "remat, or shrink the per-device batch"))
+        elif total > 0.9 * budget:
+            diags.append(diag(
+                "memory/hbm-near-budget", Severity.WARN,
+                f"per-device footprint ≈ {_mib(total)} is within 10% of "
+                f"the {_mib(budget)} budget (XLA temporaries may tip it "
+                "over)",
+                fix="leave headroom: shard or remat before scaling up"))
+    return diags

@@ -1,0 +1,80 @@
+"""Sync-coverage pass: every trainable variable has exactly one live
+sync rule; nothing is dead, shadowed, or syncing frozen state.
+
+The compiler is forgiving here by design — it prunes dead nodes with a
+debug log, lets a later duplicate silently shadow an earlier one, and
+backfills untouched trainables with replicate+psum.  Pre-flight is where
+forgiveness becomes a bug: a strategy that *meant* to cover a variable
+and missed (renamed layer, typo'd pattern) trains that variable with the
+default plan and nobody notices.  Rules (docs/analysis.md):
+
+* ``sync/unsynced-trainable`` (ERROR) — a trainable variable with no
+  strategy node at all (the compiler would backfill replicate+psum).
+* ``sync/missing-synchronizer`` (ERROR) — a node without a synchronizer
+  (the compiler raises mid-build).
+* ``sync/shadowed-node`` (ERROR) — two nodes for one variable; the
+  compiler silently keeps the LAST.
+* ``sync/dead-node`` (WARN) — a node naming a variable the program does
+  not have (pruned silently).
+* ``sync/frozen-var-synced`` (WARN) — a node naming an untrainable
+  (frozen) variable: it gets zero updates and no optimizer state, so
+  synchronizing it is dead weight.
+"""
+from __future__ import annotations
+
+from typing import List
+
+from autodist_tpu.analysis.analyzer import AnalysisContext, register_pass
+from autodist_tpu.analysis.diagnostics import Diagnostic, Severity, diag
+
+
+@register_pass("sync")
+def run(ctx: AnalysisContext) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    gi = ctx.graph_item
+    known = {v.name: v for v in gi.info.variables}
+    seen: dict = {}
+
+    for node in ctx.strategy.node_config:
+        name = node.var_name
+        if name in seen:
+            diags.append(diag(
+                "sync/shadowed-node", Severity.ERROR,
+                "duplicate strategy node: the compiler silently keeps the "
+                "last one, shadowing the earlier config",
+                var=name, fix="keep exactly one node per variable"))
+            continue
+        seen[name] = node
+        var = known.get(name)
+        if var is None:
+            diags.append(diag(
+                "sync/dead-node", Severity.WARN,
+                "strategy node names a variable the program does not have "
+                "(the compiler prunes it silently)",
+                var=name, fix="remove the node or fix the variable name"))
+            continue
+        if not var.trainable:
+            diags.append(diag(
+                "sync/frozen-var-synced", Severity.WARN,
+                "strategy node targets a frozen (untrainable) variable: "
+                "it receives zero updates and no optimizer state, so the "
+                "sync rule is dead weight",
+                var=name, fix="drop the node or unfreeze the variable"))
+            continue
+        if node.synchronizer is None:
+            diags.append(diag(
+                "sync/missing-synchronizer", Severity.ERROR,
+                "strategy node has no synchronizer; the compiler raises "
+                "ValueError on it",
+                var=name, fix="set a PS or AllReduce synchronizer config"))
+
+    for name, var in known.items():
+        if var.trainable and name not in seen:
+            diags.append(diag(
+                "sync/unsynced-trainable", Severity.ERROR,
+                "trainable variable has no sync rule; the compiler would "
+                "backfill replicate+psum, which may not be what the "
+                "strategy intended",
+                var=name,
+                fix="add a node for it (or an explicit AllReduce default)"))
+    return diags

@@ -206,7 +206,9 @@ class AutoDist:
             atexit.register(self._coordinator.reap)
         self._cluster.start()
 
-    def create_distributed_session(self, mesh=None) -> DistributedSession:
+    def create_distributed_session(self, mesh=None,
+                                   validate: Optional[bool] = None
+                                   ) -> DistributedSession:
         """Full build pipeline: strategy → compile → transform → session
         (reference _create_distributed_session, autodist.py:167-185).
 
@@ -214,7 +216,15 @@ class AutoDist:
         multi-process runs the global device list only exists after the
         cluster rendezvous (``_setup`` → ``jax.distributed.initialize``),
         so a custom topology (e.g. ``build_hybrid_mesh``) must be built
-        lazily — the callable runs after rendezvous."""
+        lazily — the callable runs after rendezvous.
+
+        ``validate`` runs the static pre-flight analyzer
+        (:mod:`autodist_tpu.analysis`) on the compiled strategy BEFORE
+        any tracing: ERROR diagnostics raise
+        :class:`~autodist_tpu.analysis.StrategyValidationError`
+        immediately (a bad plan dies in milliseconds, not minutes into
+        an XLA compile), WARNs log once.  Defaults to the
+        ``AUTODIST_VALIDATE`` environment knob."""
         if self._session is not None:
             return self._session
         if self._strategy is None:
@@ -230,6 +240,14 @@ class AutoDist:
         compiled = StrategyCompiler(
             mesh, resource_spec=self._resource_spec).compile(
                 self._strategy, self._graph_item)
+        if validate is None:
+            validate = ENV.AUTODIST_VALIDATE.val
+        if validate:
+            from autodist_tpu.analysis import preflight
+
+            preflight(compiled, self._graph_item,
+                      resource_spec=self._resource_spec,
+                      context=f"build:{self._strategy.id}")
         dist_step = GraphTransformer(compiled, self._graph_item).transform(
             extra_metrics_fn=self._graph_item.metrics_fn)
         self._session = DistributedSession(self._graph_item, dist_step)

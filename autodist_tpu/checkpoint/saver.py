@@ -178,7 +178,11 @@ class Saver:
         # Restoring without a target replays the original device topology,
         # which breaks across machines; build a replicated-on-current-devices
         # target from the checkpoint's own shape/dtype metadata instead.
-        meta = ckptr.metadata(path).item_metadata.tree
+        # Modern orbax wraps the tree in .item_metadata; older versions
+        # return the metadata tree directly.
+        meta = ckptr.metadata(path)
+        meta = getattr(meta, "item_metadata", meta)
+        meta = getattr(meta, "tree", meta)
         dev = jax.local_devices()[0]
         sharding = jax.sharding.SingleDeviceSharding(dev)
         abstract = jax.tree_util.tree_map(

@@ -105,6 +105,11 @@ class ENV(enum.Enum):
     # size is passed through as that option's value — see
     # kernel/graph_transformer.py:_combiner_bytes
     AUTODIST_COMBINER_FLAG = ("AUTODIST_COMBINER_FLAG", _str)
+    # pre-flight static strategy analysis (autodist_tpu.analysis) before
+    # the session builds: ERROR diagnostics raise StrategyValidationError
+    # before any tracing, WARNs log once.  Also reachable per-call via
+    # create_distributed_session(validate=...) / fit(validate=...).
+    AUTODIST_VALIDATE = ("AUTODIST_VALIDATE", _bool)
     # Cloud-TPU pod slice: rendezvous via TPU metadata (TPUPodCluster)
     AUTODIST_TPU_POD = ("AUTODIST_TPU_POD", _bool)
     # jax.distributed coordinator (host:port)

@@ -166,7 +166,8 @@ def fit(session, data: DataArg, epochs: int = 1,
         resume: bool = True, async_checkpoints: bool = False,
         initial_epoch: Optional[int] = None,
         prefetch_depth: int = 2,
-        preemption_signals: Sequence = ()) -> History:
+        preemption_signals: Sequence = (),
+        validate: bool = False) -> History:
     """Train ``epochs`` × (``steps_per_epoch`` or len(data)) steps.
 
     ``epochs`` is the TOTAL target, Keras-style: resuming an interrupted
@@ -215,10 +216,23 @@ def fit(session, data: DataArg, epochs: int = 1,
         reference's closest facility is fail-fast process reaping
         (coordinator.py:98-110) — graceful preemption is beyond-parity.
 
+      validate: run the static pre-flight analyzer
+        (:mod:`autodist_tpu.analysis`) on the session's compiled
+        strategy before anything else — before the checkpoint restore,
+        callbacks, and the first (trace-triggering) step.  ERROR
+        diagnostics raise
+        :class:`~autodist_tpu.analysis.StrategyValidationError`; WARNs
+        log once.
+
     Returns a :class:`History`.
     """
-    # Validate FIRST: a bad signal name must fail before any restore or
-    # user callback runs.
+    # Pre-flight FIRST: an illegal plan must fail before any restore or
+    # user callback runs (and before the first step traces/compiles).
+    if validate:
+        from autodist_tpu.analysis import preflight_session
+
+        preflight_session(session)
+    # A bad signal name must likewise fail before any restore runs.
     handler_nums = _validate_signals(preemption_signals)
     saver = None
     resumed_step = None

@@ -21,6 +21,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from autodist_tpu.utils import compat
+
 
 class Compressor:
     """Base: compress → all-reduce → decompress, with optional state."""
@@ -153,7 +155,7 @@ class Int8Compressor(Compressor):
         return q, scale
 
     def reduce(self, grad, state, axis_name):
-        n = lax.axis_size(axis_name)
+        n = compat.axis_size(axis_name)
         corrected = (grad + state).astype(jnp.float32)
         flat = corrected.ravel()
         pad = (-flat.size) % n

@@ -31,7 +31,7 @@ state is not grad-shaped, so the per-shard state layout does not apply).
 """
 from __future__ import annotations
 
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -45,7 +45,7 @@ from autodist_tpu.kernel.synchronization.compressor import (
     get_compressor,
 )
 from autodist_tpu.strategy.compiler import CompiledStrategy
-from autodist_tpu.utils import logging
+from autodist_tpu.utils import compat, logging
 
 
 def uses_explicit_path(compiled: CompiledStrategy) -> bool:
@@ -84,6 +84,38 @@ def _grad_shaped_state(comp: Compressor, shape: tuple, dtype) -> bool:
             and leaves[0].dtype == dtype)
 
 
+def partition_drop_reason(spec_axes, shape, dtype, axis_sizes, padded,
+                          comp: Compressor) -> Optional[str]:
+    """Why the explicit path would drop a partitioned var's sharding, or
+    None when the partitioning is kept.
+
+    ``spec_axes`` is the flattened ``[(tensor_dim, mesh_axis_name), ...]``
+    of the param layout; ``axis_sizes`` maps axis name → size (a plain
+    dict — no mesh needed, so the static analyzer
+    (``autodist_tpu.analysis``) shares this exact rule and the lint can
+    never drift from the runtime fallback)."""
+    spec_axes = list(spec_axes)
+    if not spec_axes:
+        return None
+    if padded:
+        return "pad-to-divisible sharding"
+    if len(spec_axes) != 1:
+        return f"multi-axis sharding {spec_axes}"
+    part_axis, axis_name = spec_axes[0]
+    if axis_name == MESH_AXIS_DATA:
+        return "sharded over the data (reduction) axis"
+    n = int(axis_sizes.get(axis_name, 1))
+    if n > 1 and shape[part_axis] % n:  # pragma: no cover - padded
+        return f"dim {shape[part_axis]} not divisible by {n}"
+    shard = list(shape)
+    if n > 1:
+        shard[part_axis] //= n
+    if not _grad_shaped_state(comp, tuple(shard), dtype):
+        return (f"{comp.name} state is not grad-shaped"
+                f" (e.g. PowerSGD low-rank factors)")
+    return None
+
+
 def _partition_support(gi: GraphItem, compiled: CompiledStrategy,
                        comps: Dict[str, Compressor]) -> Dict[str, tuple]:
     """Which partitioned vars keep their sharding on the explicit path:
@@ -92,40 +124,28 @@ def _partition_support(gi: GraphItem, compiled: CompiledStrategy,
     part: Dict[str, tuple] = {}
     pad_names = set(compiled.pad_plans())
     leaves = gi.name_to_leaf()
+    axis_sizes = dict(compiled.mesh.shape)
     for name, plan in compiled.var_plans.items():
         spec = plan.param_spec
         if spec == P():
             continue
-        sharded = [(i, e) for i, e in enumerate(spec) if e is not None]
-        axes = []
-        for _, e in sharded:
-            axes.extend([e] if isinstance(e, str) else list(e))
+        spec_axes = []
+        for i, e in enumerate(spec):
+            if e is None:
+                continue
+            for a in ([e] if isinstance(e, str) else list(e)):
+                spec_axes.append((i, a))
         leaf = jnp.asarray(leaves[name])
-        why = None
-        if name in pad_names:
-            why = "pad-to-divisible sharding"
-        elif len(sharded) != 1 or len(axes) != 1:
-            why = f"multi-axis sharding {spec}"
-        elif MESH_AXIS_DATA in axes:
-            why = "sharded over the data (reduction) axis"
-        else:
-            part_axis, axis_name = sharded[0][0], axes[0]
-            n = compiled.mesh.shape[axis_name]
-            if leaf.shape[part_axis] % n:  # pragma: no cover - padded
-                why = f"dim {leaf.shape[part_axis]} not divisible by {n}"
-            else:
-                shard = list(leaf.shape)
-                shard[part_axis] //= n
-                if not _grad_shaped_state(comps[name], tuple(shard),
-                                          leaf.dtype):
-                    why = (f"{comps[name].name} state is not grad-shaped"
-                           f" (e.g. PowerSGD low-rank factors)")
+        why = partition_drop_reason(spec_axes, leaf.shape, leaf.dtype,
+                                    axis_sizes, name in pad_names,
+                                    comps[name])
         if why is not None:
             logging.warning(
                 "explicit sync path: replicating %s (%s); its "
                 "partitioning is dropped for this program", name, why)
             continue
-        part[name] = (axis_name, part_axis, n)
+        (part_axis, axis_name), = spec_axes
+        part[name] = (axis_name, part_axis, axis_sizes[axis_name])
     return part
 
 
@@ -331,7 +351,7 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
     # gradients would arrive pre-summed and the compressor pmean would then
     # scale them by the data-axis size (d x too large), while the real
     # collective escapes the compressor entirely.
-    mapped = jax.shard_map(
+    mapped = compat.shard_map(
         local_step, mesh=mesh,
         in_specs=(param_spec_tree, opt_spec_tree, dict(sync_specs),
                   P(MESH_AXIS_DATA)),

@@ -32,6 +32,7 @@ from jax.experimental import pallas as pl
 from jax.sharding import Mesh, PartitionSpec as P
 
 from autodist_tpu.const import MESH_AXIS_DATA, MESH_AXIS_MODEL
+from autodist_tpu.utils import compat
 
 _NEG_INF = -1e30  # finite -inf: keeps exp()/max() NaN-free (masked rows)
 _TILE = 128           # MXU lane quantum: pad unit and block alignment
@@ -399,7 +400,7 @@ def make_flash_attention(mesh: Optional[Mesh] = None, *,
         # metadata, and the kernel is trivially per-shard (no collectives).
         # jit: eager shard_map with partial axis_names trips JAX's internal
         # unmatch path; under jit (inlined when already tracing) it is sound.
-        return jax.jit(jax.shard_map(
+        return jax.jit(compat.shard_map(
             fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             axis_names=set(axes_key), check_vma=False))
 

@@ -25,6 +25,7 @@ Example yaml::
         python_venv: source /opt/venv/bin/activate
         shared_envs: {TPU_NAME: my-pod}
     network_bandwidth: 100   # Gbps, used by load-balancing strategies
+    hbm_gb: 16               # per-chip HBM budget (pre-flight analyzer)
     mesh:                    # optional
       data: 4
       model: 2
@@ -125,6 +126,10 @@ class ResourceSpec:
         self.network_bandwidth_gbps: float = 1.0
         self.ici_connected: bool = False
         self.mesh_hint: Dict[str, int] = {}
+        # Per-chip HBM budget in GiB (yaml `hbm_gb`): consumed by the
+        # static analyzer's pre-flight footprint check
+        # (autodist_tpu/analysis/memory.py).  None = no budget declared.
+        self.hbm_gb: Optional[float] = None
         # Remembered so the Coordinator can ship the spec file to workers
         # (the reference relied on shared paths; we copy explicitly).
         self.source_file: Optional[str] = (
@@ -186,6 +191,11 @@ class ResourceSpec:
         # defining difference from the reference's GPU clusters.  Yaml key:
         # `ici_connected: true`.
         self.ici_connected = bool(info.get("ici_connected", False))
+        if info.get("hbm_gb") is not None:
+            self.hbm_gb = float(info["hbm_gb"])
+            if self.hbm_gb <= 0:
+                raise ResourceSpecError(
+                    f"hbm_gb must be positive, got {self.hbm_gb}")
         self.mesh_hint = {str(k): int(v) for k, v in (info.get("mesh") or {}).items()}
         # Reference behavior: exactly-one-chief check, defaulting the single
         # node to chief (resource_spec.py:120-150).
@@ -244,6 +254,15 @@ class ResourceSpec:
     @property
     def num_chips(self) -> int:
         return sum(n.chips for n in self._nodes)
+
+    @property
+    def hbm_bytes_per_chip(self) -> Optional[int]:
+        """Declared per-chip HBM budget in bytes (None when the spec does
+        not carry one) — the default budget for the pre-flight analyzer's
+        static footprint check."""
+        if self.hbm_gb is None:
+            return None
+        return int(self.hbm_gb * (1 << 30))
 
     @property
     def tpu_devices(self) -> List[DeviceSpec]:

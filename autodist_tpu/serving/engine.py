@@ -70,14 +70,24 @@ from autodist_tpu.models.generate import (_prefill_forward, _token_step,
 from autodist_tpu.models.quantize import head_logits
 
 
+TEMPERATURE_FLOOR = 1e-6
+"""Smallest accepted nonzero per-request temperature.  Below it the
+scaled logits overflow f32 (|logit|/temp > f32 max) and the softmax
+NaNs, so ``submit`` rejects the range instead of silently clamping —
+``temperature=0`` is the supported way to ask for greedy."""
+
+
 def _sample_per_slot(logits, key, temp, top_k, top_p):
     """Per-slot temperature over one logits batch [B, V]: rows with
     ``temp[b] == 0`` take the argmax, others sample from
     ``logits / temp[b]`` through the engine-wide static top-k/top-p
     filters (``sample_next_token`` at temperature 1.0 on the pre-scaled
-    logits — the single definition of the filters)."""
+    logits — the single definition of the filters).  ``submit`` rejects
+    temperatures in (0, TEMPERATURE_FLOOR), so the floor below only
+    guards the greedy rows' dummy divide, never alters a request."""
     greedy = jnp.argmax(logits, axis=-1)
-    scaled = logits.astype(jnp.float32) / jnp.maximum(temp, 1e-6)[:, None]
+    scaled = logits.astype(jnp.float32) \
+        / jnp.maximum(temp, TEMPERATURE_FLOOR)[:, None]
     sampled = sample_next_token(scaled, key, 1.0, top_k, top_p)
     return jnp.where(temp > 0.0, sampled, greedy)
 
@@ -162,10 +172,10 @@ def _chunk_program(n, knobs, params, tokens, kc, vc, start, p_end, end,
     return tokens, kc, vc, done, jnp.sum(busy)
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1),
-                   donate_argnums=(3, 4, 5))
-def _prefill_program(knobs, with_prefix, params, tokens, kc, vc,
-                     prompts_kpb, slot_ids, row_map, t0, p_lens, temp,
+@functools.partial(jax.jit, static_argnums=(0, 1, 2),
+                   donate_argnums=(4, 5, 6))
+def _prefill_program(knobs, with_prefix, contiguous, params, tokens, kc,
+                     vc, prompts_kpb, slot_ids, row_map, t0, p_lens, temp,
                      kp, vp, key):
     """Parallel prefill, batched over the boundary's admissions: ONE
     [K, Pb]-parallel causal forward (MXU-shaped) charges K slots' K/V
@@ -198,7 +208,16 @@ def _prefill_program(knobs, with_prefix, params, tokens, kc, vc,
     shared cached prefix ``kp``/``vp`` (the scheduler groups admissions
     by prefix use) — their forward runs through ``_prefill_forward``'s
     prefix seam with positions offset by the static ``plen`` in
-    ``knobs``."""
+    ``knobs``.
+
+    ``contiguous`` (static): this dispatch's rows' ring ranges do NOT
+    wrap the window (``(t0 - p_j) % window + Pb <= window``, decided on
+    the host — ``_flush_prefills`` groups admissions by wrapness), so
+    each row's K/V charge is ONE ``dynamic_update_slice`` spanning all
+    layers — the contiguous cache write the module docstring's
+    batch-major lesson is about — instead of a per-column scatter.
+    Wrapped dispatches (only possible once the ring has cycled, i.e.
+    ``t0 % window < p_j``) take the mod-window scatter path."""
     top_k, top_p, plen = knobs
     num_layers, _, _, heads, head_dim = kc.shape
     embed, pos_embed, layer_params, ln_final = unpack_lm_params(
@@ -216,12 +235,27 @@ def _prefill_program(knobs, with_prefix, params, tokens, kc, vc,
         row_k = lax.dynamic_index_in_dim(ks, i, 1)   # [L, 1, Pb, H, Dh]
         row_v = lax.dynamic_index_in_dim(vs, i, 1)
         p_j = p_lens[i]
-        # ring positions of the prompt's Pb (bucketed) cache columns
-        idx = jnp.mod(t0 - p_j + jnp.arange(pb), window)  # [Pb]
         sb = slot_ids[j]
+        prow = lax.dynamic_index_in_dim(prompts_kpb, i, 0)  # [1, Pb]
+        if contiguous:
+            # Fast path: the whole Pb range is one contiguous window
+            # segment starting at (t0 - p_j) % window.
+            s0 = jnp.mod(t0 - p_j, window).astype(jnp.int32)
+            blk_k = jnp.swapaxes(row_k, 1, 2)     # [L, Pb, 1, H, Dh]
+            blk_v = jnp.swapaxes(row_v, 1, 2)
+            kc = lax.dynamic_update_slice(
+                kc, blk_k.astype(kc.dtype), (0, s0, sb, 0, 0))
+            vc = lax.dynamic_update_slice(
+                vc, blk_v.astype(vc.dtype), (0, s0, sb, 0, 0))
+            tokens = lax.dynamic_update_slice(
+                tokens, prow.astype(tokens.dtype), (sb, s0))
+            continue
+        # Wrapped range: per-column scatter over the mod-window indices
+        # (≤ 2 segments, but their lengths are traced — the scatter is
+        # the shape-stable form).
+        idx = jnp.mod(t0 - p_j + jnp.arange(pb), window)  # [Pb]
         kc = kc.at[:, idx, sb].set(row_k[:, 0].astype(kc.dtype))
         vc = vc.at[:, idx, sb].set(row_v[:, 0].astype(vc.dtype))
-        prow = lax.dynamic_index_in_dim(prompts_kpb, i, 0)  # [1, Pb]
         tokens = tokens.at[sb, idx].set(prow[0].astype(tokens.dtype))
     last = jnp.take_along_axis(
         xs, (p_lens - 1)[:, None, None].astype(jnp.int32), axis=1
@@ -613,7 +647,15 @@ class DecodeEngine:
                 # would underflow to exact 0 in the f32 per-slot vector
                 # and silently decode greedy while "sampled" was asked
                 raise ValueError(f"temperature {temperature} underflows "
-                                 f"float32; use 0 for greedy or >= ~1e-38")
+                                 f"float32; use 0 for greedy or >= 1e-6")
+            if 0.0 < temperature < TEMPERATURE_FLOOR:
+                # below the floor the scaled logits overflow f32 and the
+                # softmax NaNs; the sampler would otherwise clamp to the
+                # floor, silently diverging from the requested value
+                raise ValueError(
+                    f"temperature {temperature} is below the sampling "
+                    f"floor {TEMPERATURE_FLOOR}; use 0 for greedy or >= "
+                    f"{TEMPERATURE_FLOOR}")
             if (temperature > 0.0 and self._temperature <= 0.0
                     and not self._rng_explicit):
                 raise ValueError(
@@ -774,6 +816,14 @@ class DecodeEngine:
         self._start -= shift
         self._p_end -= shift
         self._end -= shift
+        # Inactive slots' bounds are dead state (never consumed until the
+        # next admission overwrites them) but would otherwise accumulate
+        # -shift per rebase — a silent int32 wrap after ~2^31 total ticks
+        # on a slot that never re-admits.  Zero them instead.
+        inactive = ~self._active
+        self._start[inactive] = 0
+        self._p_end[inactive] = 0
+        self._end[inactive] = 0
 
     def _admit(self) -> None:
         prefills: List[tuple] = []        # deferred (slot, req) pairs
@@ -832,23 +882,31 @@ class DecodeEngine:
             # K/V scattered to every requesting slot.  Prefix users
             # dispatch separately (their forward attends the shared
             # prefix and their positions are offset — a static program
-            # difference).
-            buckets.setdefault((pb, req.use_prefix), {}).setdefault(
+            # difference).  Wrapness is likewise static (it selects the
+            # contiguous-DUS vs mod-window-scatter cache write), decided
+            # here with the same arithmetic the program uses; identical
+            # prompts share a length, so dedup is unaffected.
+            s0 = (self._tick - req.prompt.size) % self._window
+            wrapped = s0 + pb > self._window
+            buckets.setdefault((pb, req.use_prefix, wrapped), {}).setdefault(
                 req.prompt.tobytes(), []).append((b, req))
-        for (pb, with_prefix), uniq in sorted(buckets.items()):
+        for (pb, with_prefix, wrapped), uniq in sorted(buckets.items()):
             entries = list(uniq.values())     # [[(b, req), ...], ...]
             while entries:
                 k = 1 << (len(entries).bit_length() - 1)  # pow2 <= len
-                self._run_prefill(entries[:k], pb, with_prefix)
+                self._run_prefill(entries[:k], pb, with_prefix, wrapped)
                 entries = entries[k:]
 
-    def _run_prefill(self, entries, pb: int, with_prefix: bool) -> None:
+    def _run_prefill(self, entries, pb: int, with_prefix: bool,
+                     wrapped: bool = False) -> None:
         """One batched prefill dispatch over K unique prompts serving S
         slots (S >= K when prompts repeat): prompt K/V written at cache
         positions t0-P..t0-1 per slot and each first generated token
         deposited at the admission tick, so the slots start in
         generation phase.  ``with_prefix`` rows attend the shared
-        cached prefix during their forward."""
+        cached prefix during their forward.  ``wrapped`` rows' ring
+        ranges cross the window boundary and take the scatter cache
+        write; all others take the contiguous fast path."""
         t0, k = self._tick, len(entries)
         prompts = np.zeros((k, pb), np.int32)
         p_lens = np.zeros(k, np.int32)
@@ -886,8 +944,8 @@ class DecodeEngine:
         try:
             knobs, kp, vp = self._dispatch_args(with_prefix)
             self._tokens, self._kc, self._vc, toks = _prefill_program(
-                knobs, with_prefix, self._params, self._tokens,
-                self._kc, self._vc, jnp.asarray(prompts),
+                knobs, with_prefix, not wrapped, self._params,
+                self._tokens, self._kc, self._vc, jnp.asarray(prompts),
                 jnp.asarray(slot_ids), jnp.asarray(row_map),
                 np.int32(t0), jnp.asarray(p_lens),
                 jnp.asarray(self._temp), kp, vp, sub)

@@ -83,6 +83,7 @@ class AutoStrategy(StrategyBuilder):
 
     def _build_search(self, graph_item: GraphItem,
                       resource_spec: ResourceSpec) -> Strategy:
+        from autodist_tpu.analysis import analyze
         from autodist_tpu.strategy.cost_model import estimate_cost
         from autodist_tpu.utils import logging
 
@@ -107,15 +108,41 @@ class AutoStrategy(StrategyBuilder):
                           PartitionedAR(), RandomAxisPartitionAR(),
                           Parallax()]
         best = None
+        pruned = 0
         for builder in candidates:
             strategy = builder.build(graph_item, resource_spec)
+            # Static pre-flight (legality + sync coverage) BEFORE paying
+            # for cost modeling: an illegal candidate (indivisible
+            # partition, uncovered trainable) is pruned here instead of
+            # winning on a cost estimate for a plan that cannot lower.
+            report = analyze(strategy, graph_item,
+                             resource_spec=resource_spec,
+                             passes=("legality", "sync"))
+            if report.has_errors():
+                pruned += 1
+                logging.info(
+                    "AutoStrategy(search): pruned illegal candidate %s "
+                    "(%s)", type(builder).__name__,
+                    report.errors[0].rule)
+                continue
             cost = estimate_cost(strategy, graph_item, resource_spec)
             if best is None or cost.time_s < best[2].time_s:
                 best = (type(builder).__name__, strategy, cost)
+        if best is None:
+            from autodist_tpu.analysis import StrategyValidationError
+
+            # Re-analyze the first candidate so the error carries its
+            # diagnostics (all candidates failed; any one illustrates).
+            report = analyze(
+                candidates[0].build(graph_item, resource_spec),
+                graph_item, resource_spec=resource_spec,
+                passes=("legality", "sync"))
+            raise StrategyValidationError(report)
         self.last_choice = best[0]
         logging.info(
             "AutoStrategy(search): picked %s (est %.3f ms sync) from %d "
-            "candidates", best[0], best[2].time_s * 1e3, len(candidates))
+            "candidates (%d pruned as illegal)", best[0],
+            best[2].time_s * 1e3, len(candidates), pruned)
         return best[1]
 
     def _build_tiers(self, graph_item: GraphItem,

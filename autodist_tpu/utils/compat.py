@@ -1,0 +1,125 @@
+"""JAX version-compatibility shims.
+
+The framework targets the current ``jax.shard_map`` / ``jax.set_mesh``
+API surface; older jaxlibs (0.4.x) spell these
+``jax.experimental.shard_map.shard_map`` (with ``check_rep``/``auto``
+instead of ``check_vma``/``axis_names``) and have no ``set_mesh``.  All
+internal call sites route through this module so the framework runs
+unmodified on both; each shim forwards verbatim when the modern API
+exists.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+
+def _native(name):
+    """The real jax attribute, ignoring any compat alias installed onto
+    the jax module (e.g. by tests/conftest.py) — prevents recursion."""
+    fn = getattr(jax, name, None)
+    if fn is not None and not getattr(fn, "_autodist_compat", False):
+        return fn
+    return None
+
+
+def has_native(name: str) -> bool:
+    """True when the REAL modern jax API exists (compat aliases a test
+    harness may have installed onto the jax module don't count)."""
+    return _native(name) is not None
+
+
+def require_native(name: str, feature: str) -> None:
+    """Raise cleanly when ``feature`` needs the modern API — for code
+    whose legacy-API fallback is known to hard-abort XLA (a crash is
+    strictly worse than a NotImplementedError)."""
+    if not has_native(name):
+        raise NotImplementedError(
+            f"{feature} requires the native jax.{name} API; this jax "
+            "version only has the legacy spelling, whose lowering is "
+            "known to miscompile this program")
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, axis_names=None,
+              check_vma=None, **kwargs):
+    """``jax.shard_map`` with graceful fallback to the 0.4.x
+    ``jax.experimental.shard_map`` spelling.
+
+    ``axis_names`` (the MANUAL axes; everything else stays auto) maps to
+    the legacy ``auto=`` complement; ``check_vma`` maps to the legacy
+    ``check_rep`` (both disable the replication/varying checker)."""
+    native = _native("shard_map")
+    if native is not None:
+        if axis_names is not None:
+            kwargs["axis_names"] = axis_names
+        if check_vma is not None:
+            kwargs["check_vma"] = check_vma
+        return native(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kwargs)
+
+    from jax.experimental.shard_map import shard_map as _legacy
+
+    if check_vma is not None:
+        kwargs["check_rep"] = check_vma
+    if axis_names is not None:
+        auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+        if auto:
+            kwargs["auto"] = auto
+    return _legacy(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   **kwargs)
+
+
+shard_map._autodist_compat = True
+
+
+def set_mesh(mesh):
+    """``jax.set_mesh`` context; on 0.4.x jaxlibs falls back to the
+    Mesh's own context manager (the legacy global-mesh mechanism the
+    sharding-in-types mesh replaced)."""
+    native = _native("set_mesh")
+    if native is not None:
+        return native(mesh)
+    if hasattr(jax.sharding, "use_mesh"):  # pragma: no cover - 0.5.x
+        return jax.sharding.use_mesh(mesh)
+    return _legacy_mesh_context(mesh)
+
+
+set_mesh._autodist_compat = True
+
+
+def axis_size(axis_name) -> int:
+    """``jax.lax.axis_size`` (static size of a bound mesh axis inside
+    shard_map); 0.4.x jaxlibs expose it as ``jax.core.axis_frame``."""
+    from jax import lax
+
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    from jax import core
+
+    return core.axis_frame(axis_name)  # 0.4.x returns the size directly
+
+
+axis_size._autodist_compat = True
+
+
+def pcast(x, axis_name, *, to="varying"):
+    """``jax.lax.pcast`` (vma cast).  Older jaxlibs either spell the
+    varying cast ``pvary`` or (0.4.x) have no varying-mesh-axis tracking
+    at all, where the cast is semantically an identity."""
+    from jax import lax
+
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, axis_name, to=to)
+    if to == "varying" and hasattr(lax, "pvary"):
+        return lax.pvary(x, axis_name)
+    return x
+
+
+pcast._autodist_compat = True
+
+
+@contextlib.contextmanager
+def _legacy_mesh_context(mesh):
+    with mesh:
+        yield mesh

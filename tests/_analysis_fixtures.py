@@ -1,0 +1,51 @@
+"""Shared helpers for the analyzer test files (one file per pass).
+
+Not a test module — imported by tests/test_analysis*.py.
+"""
+import jax.numpy as jnp
+import optax
+
+from autodist_tpu.graph_item import GraphItem
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.strategy.base import (
+    AllReduceSynchronizerConfig,
+    GraphConfig,
+    PSSynchronizerConfig,
+    Strategy,
+    VarConfig,
+)
+
+AXES8 = {"data": 8}
+
+
+def make_gi():
+    """Shapes chosen so every shipped builder lowers cleanly on 8 chips."""
+    params = {
+        "dense": {"kernel": jnp.zeros((16, 8)), "bias": jnp.zeros((16,))},
+        "emb": {"table": jnp.zeros((96, 16))},
+    }
+    return GraphItem(params, optimizer=optax.adam(1e-3),
+                     sparse_vars=["emb/table"])
+
+
+def make_spec8():
+    return ResourceSpec(resource_info={
+        "nodes": [{"address": "localhost", "chips": 8}]})
+
+
+def ar_node(name, **kw):
+    return VarConfig(name, synchronizer=AllReduceSynchronizerConfig(**kw))
+
+
+def ps_node(name, partitioner="", **kw):
+    return VarConfig(name, synchronizer=PSSynchronizerConfig(**kw),
+                     partitioner=partitioner)
+
+
+def full_cover(gi, but=(), extra=()):
+    """A strategy covering every trainable var with plain AllReduce,
+    minus ``but``, plus ``extra`` nodes."""
+    nodes = [ar_node(v.name) for v in gi.trainable_var_infos
+             if v.name not in but]
+    return Strategy(node_config=nodes + list(extra),
+                    graph_config=GraphConfig())

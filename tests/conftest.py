@@ -22,7 +22,22 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    # jax >= 0.5 spelling; older jaxlibs only honor the XLA_FLAGS form
+    # set above, so a missing option is not an error.
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass
+
+# Tests target the modern `jax.set_mesh` / `jax.shard_map` spellings; on
+# 0.4.x jaxlibs alias them to the framework's compat shims (the shims
+# detect and skip these aliases, so there is no recursion on any jax).
+from autodist_tpu.utils import compat as _compat  # noqa: E402
+
+if not hasattr(jax, "set_mesh"):
+    jax.set_mesh = _compat.set_mesh
+if not hasattr(jax, "shard_map"):
+    jax.shard_map = _compat.shard_map
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,324 @@
+"""Static strategy analyzer ("shardlint") golden-diagnostic tests:
+legality + sync-coverage rules, the shipped-builder cleanliness
+regression, and the pre-flight ``validate=`` hooks.
+
+Each rule gets a golden case: a legal plan analyzes clean, and each
+deliberately broken plan yields EXACTLY the expected ERROR with the
+right rule id — the analyzer's whole value is that its verdicts are
+precise enough to gate builds on.  The memory, collectives, and
+precision passes have their own files (test_analysis_memory.py,
+test_analysis_collectives.py, test_analysis_precision.py); the CLI is
+covered in test_analysis_cli.py.
+"""
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from autodist_tpu.analysis import (
+    StrategyValidationError,
+    analyze,
+    preflight,
+)
+from autodist_tpu.graph_item import GraphItem
+from autodist_tpu.mesh import build_mesh
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.strategy import (
+    AllReduce,
+    AutoStrategy,
+    Parallax,
+    PartitionedAR,
+    PartitionedPS,
+    PS,
+    PSLoadBalancing,
+    RandomAxisPartitionAR,
+    StrategyCompiler,
+    UnevenPartitionedPS,
+)
+from autodist_tpu.strategy.base import (
+    AllReduceSynchronizerConfig,
+    PSSynchronizerConfig,
+    Strategy,
+    VarConfig,
+)
+
+from _analysis_fixtures import (
+    AXES8,
+    ar_node,
+    full_cover,
+    make_gi,
+    make_spec8,
+    ps_node,
+)
+
+pytestmark = pytest.mark.analysis
+
+
+@pytest.fixture
+def gi():
+    return make_gi()
+
+
+@pytest.fixture
+def spec8():
+    return make_spec8()
+
+
+# -- legality ----------------------------------------------------------------
+
+def test_legal_plan_has_no_errors(gi):
+    report = analyze(full_cover(gi), gi, mesh=AXES8)
+    assert not report.has_errors()
+    assert not report.warnings
+
+
+def test_indivisible_partition_is_exactly_one_error():
+    gi2 = GraphItem({"w": jnp.zeros((3, 4))})
+    s = Strategy(node_config=[ps_node("w", partitioner="3,1")])
+    report = analyze(s, gi2, mesh=AXES8)
+    errors = report.errors
+    assert len(errors) == 1
+    assert errors[0].rule == "legality/indivisible-partition"
+    assert errors[0].var_name == "w"
+
+
+def test_padded_partition_is_info_not_error():
+    # dim 12 over 8 pads to 16 < 2*12: covered by pad_plans.
+    gi2 = GraphItem({"w": jnp.zeros((12, 4))})
+    s = Strategy(node_config=[ps_node("w", partitioner="12,1")])
+    report = analyze(s, gi2, mesh=AXES8)
+    assert not report.has_errors()
+    assert report.by_rule("legality/padded-partition")
+
+
+def test_invalid_partitioner_axis(gi):
+    s = full_cover(gi, but=["dense/bias"],
+                   extra=[ps_node("dense/bias", partitioner="1,1,4")])
+    report = analyze(s, gi, mesh=AXES8)
+    assert [d.rule for d in report.errors] == ["legality/invalid-partitioner"]
+
+
+def test_multi_active_axis_partitioner(gi):
+    s = full_cover(gi, but=["dense/kernel"],
+                   extra=[ps_node("dense/kernel", partitioner="2,2")])
+    report = analyze(s, gi, mesh=AXES8)
+    assert [d.rule for d in report.errors] == ["legality/invalid-partitioner"]
+
+
+def test_ar_partitioner_on_dp_mesh_is_info(gi):
+    s = full_cover(gi, but=["dense/kernel"],
+                   extra=[VarConfig(
+                       "dense/kernel",
+                       synchronizer=AllReduceSynchronizerConfig(),
+                       partitioner="16,1")])
+    report = analyze(s, gi, mesh=AXES8)
+    assert not report.has_errors()
+    assert report.by_rule("legality/ar-partition-colocated")
+
+
+def test_structural_axis_claim_warns():
+    gi = GraphItem({"stages": jnp.zeros((4, 8, 8))},
+                   pipeline_vars=["stages"])
+    s = Strategy(node_config=[ps_node("stages", partitioner="4,1,1")])
+    report = analyze(s, gi, mesh={"pipe": 4, "data": 2})
+    assert any(d.rule == "legality/structural-axis-claimed"
+               for d in report.warnings)
+
+
+def test_compiled_unknown_axis_and_duplicate_axis(gi, spec8):
+    from jax.sharding import PartitionSpec as P
+
+    mesh = build_mesh(AXES8)
+    compiled = StrategyCompiler(mesh).compile(
+        AllReduce().build(gi, spec8), gi)
+    compiled.var_plans["dense/kernel"].param_spec = P("model")   # unknown
+    compiled.var_plans["emb/table"].param_spec = P("data", "data")  # dup
+    report = analyze(compiled, gi)
+    rules = {d.rule for d in report.errors}
+    assert "legality/unknown-mesh-axis" in rules
+    assert "legality/duplicate-mesh-axis" in rules
+
+
+def test_batch_indivisible_warns(gi):
+    report = analyze(full_cover(gi), gi, mesh=AXES8,
+                     batch={"x": np.zeros((10, 4), np.float32)})
+    assert report.by_rule("legality/batch-indivisible")
+    assert not report.has_errors()
+
+
+def test_mesh_hint_mismatch_warns(gi):
+    s = full_cover(gi)
+    s.graph_config.mesh_axes = {"model": 2}
+    report = analyze(s, gi, mesh=AXES8)
+    assert any(d.rule == "legality/mesh-hint-mismatch"
+               for d in report.warnings)
+
+
+# -- sync coverage -----------------------------------------------------------
+
+def test_unsynced_trainable_is_exactly_one_error(gi):
+    report = analyze(full_cover(gi, but=["dense/bias"]), gi, mesh=AXES8)
+    errors = report.errors
+    assert len(errors) == 1
+    assert errors[0].rule == "sync/unsynced-trainable"
+    assert errors[0].var_name == "dense/bias"
+
+
+def test_shadowed_node_is_error(gi):
+    report = analyze(full_cover(gi, extra=[ar_node("dense/kernel")]),
+                     gi, mesh=AXES8)
+    assert [d.rule for d in report.errors] == ["sync/shadowed-node"]
+
+
+def test_dead_node_warns(gi):
+    report = analyze(full_cover(gi, extra=[ar_node("no/such/var")]),
+                     gi, mesh=AXES8)
+    assert not report.has_errors()
+    assert [d.rule for d in report.warnings] == ["sync/dead-node"]
+
+
+def test_frozen_var_synced_warns():
+    gi = GraphItem({"w": jnp.zeros((8,)), "frozen": jnp.zeros((8,))},
+                   untrainable_vars=["frozen"])
+    s = Strategy(node_config=[ar_node("w"), ar_node("frozen")])
+    report = analyze(s, gi, mesh=AXES8)
+    assert not report.has_errors()
+    assert [d.rule for d in report.warnings] == ["sync/frozen-var-synced"]
+
+
+def test_missing_synchronizer_is_error(gi):
+    report = analyze(
+        full_cover(gi, but=["dense/bias"],
+                   extra=[VarConfig("dense/bias")]), gi, mesh=AXES8)
+    assert [d.rule for d in report.errors] == ["sync/missing-synchronizer"]
+
+
+# -- builder regression ------------------------------------------------------
+
+ALL_BUILDERS = [AllReduce, AutoStrategy, Parallax, PartitionedAR,
+                PartitionedPS, PS, PSLoadBalancing, RandomAxisPartitionAR,
+                UnevenPartitionedPS]
+
+
+@pytest.mark.parametrize("builder_cls", ALL_BUILDERS,
+                         ids=[b.__name__ for b in ALL_BUILDERS])
+def test_every_builder_is_analyzer_clean(builder_cls, gi, spec8):
+    """Every shipped strategy builder produces a plan with no ERROR and
+    no WARN diagnostics on the virtual 8-device mesh — raw and
+    compiled."""
+    strategy = builder_cls().build(gi, spec8)
+    report = analyze(strategy, gi, mesh=AXES8, resource_spec=spec8)
+    assert not report.has_errors(), report.format_table()
+    assert not report.warnings, report.format_table()
+
+    mesh = build_mesh(AXES8)
+    compiled = StrategyCompiler(mesh, resource_spec=spec8).compile(
+        strategy, gi)
+    report2 = analyze(compiled, gi, resource_spec=spec8)
+    assert not report2.has_errors(), report2.format_table()
+    assert not report2.warnings, report2.format_table()
+
+
+# -- pre-flight hooks --------------------------------------------------------
+
+class _IllegalBuilder(PS):
+    """Deliberately illegal: a (3, 4) var partitioned 3-ways lowers to an
+    indivisible (and pad-unworthy) shard over the 8-wide data axis."""
+
+    def build(self, graph_item, resource_spec):
+        nodes = [ps_node("w", partitioner="3,1")]
+        if any(v.name == "b" for v in graph_item.trainable_var_infos):
+            nodes.append(ar_node("b"))
+        return Strategy(node_config=nodes)
+
+
+def test_preflight_raises_with_full_report(gi):
+    s = full_cover(gi, but=["dense/bias"])
+    with pytest.raises(StrategyValidationError) as exc:
+        preflight(s, gi, mesh=AXES8)
+    assert "sync/unsynced-trainable" in str(exc.value)
+    assert exc.value.report.has_errors()
+
+
+def test_create_distributed_session_validate_raises(monkeypatch, spec8):
+    """`validate=True` rejects an illegal plan BEFORE the step exists."""
+    monkeypatch.setenv("AUTODIST_IS_TESTING", "1")
+    from autodist_tpu.autodist import AutoDist
+
+    params = {"w": jnp.zeros((3, 4)), "b": jnp.zeros((4,))}
+    ad = AutoDist(strategy_builder=_IllegalBuilder(), resource_spec=spec8)
+    with ad.scope():
+        ad.capture(params=params, optimizer=optax.sgd(0.1),
+                   loss_fn=lambda p, b: jnp.sum(p["w"]) * 0.0)
+    with pytest.raises(StrategyValidationError) as exc:
+        ad.create_distributed_session(validate=True)
+    assert "legality/indivisible-partition" in str(exc.value)
+
+
+def test_fit_validate_raises_before_training(monkeypatch, spec8):
+    monkeypatch.setenv("AUTODIST_IS_TESTING", "1")
+    from autodist_tpu.autodist import AutoDist
+
+    params = {"w": jnp.zeros((3, 4)), "b": jnp.zeros((4,))}
+    ad = AutoDist(strategy_builder=_IllegalBuilder(), resource_spec=spec8)
+    with ad.scope():
+        ad.capture(params=params, optimizer=optax.sgd(0.1),
+                   loss_fn=lambda p, b: jnp.mean(p["w"]) * jnp.mean(b["x"]))
+    sess = ad.create_distributed_session()  # builds; lazy step untraced
+    batch = {"x": np.ones((8,), np.float32)}
+    with pytest.raises(StrategyValidationError):
+        sess.fit(batch, epochs=1, steps_per_epoch=1, validate=True)
+    # without validate the same session trains
+    hist = sess.fit(batch, epochs=1, steps_per_epoch=1)
+    assert hist.steps_run == 1
+
+
+def test_valid_session_passes_validate(spec8, monkeypatch):
+    monkeypatch.setenv("AUTODIST_IS_TESTING", "1")
+    from autodist_tpu.autodist import AutoDist
+
+    params = {"w": jnp.zeros((16, 8)), "b": jnp.zeros((8,))}
+    ad = AutoDist(strategy_builder=AllReduce(), resource_spec=spec8)
+    with ad.scope():
+        ad.capture(params=params, optimizer=optax.sgd(0.1),
+                   loss_fn=lambda p, b: jnp.mean(p["w"]) * jnp.mean(b["x"]))
+    sess = ad.create_distributed_session(validate=True)
+    assert sess is not None
+
+
+def test_validate_env_knob(monkeypatch, spec8):
+    """AUTODIST_VALIDATE=1 turns the pre-flight on without code change."""
+    monkeypatch.setenv("AUTODIST_IS_TESTING", "1")
+    monkeypatch.setenv("AUTODIST_VALIDATE", "1")
+    from autodist_tpu.autodist import AutoDist
+
+    params = {"w": jnp.zeros((3, 4)), "b": jnp.zeros((4,))}
+    ad = AutoDist(strategy_builder=_IllegalBuilder(), resource_spec=spec8)
+    with ad.scope():
+        ad.capture(params=params, optimizer=optax.sgd(0.1),
+                   loss_fn=lambda p, b: jnp.sum(p["w"]) * 0.0)
+    with pytest.raises(StrategyValidationError):
+        ad.create_distributed_session()
+
+
+# -- auto-strategy pruning ---------------------------------------------------
+
+def test_search_prunes_illegal_candidates(spec8):
+    params = {"w": jnp.zeros((3, 4)), "b": jnp.zeros((4,))}
+    gi = GraphItem(params, optimizer=optax.sgd(0.1))
+
+    auto = AutoStrategy(search=True,
+                        candidates=[_IllegalBuilder(), AllReduce()])
+    strategy = auto.build(gi, spec8)
+    assert auto.last_choice == "AllReduce"
+    report = analyze(strategy, gi, resource_spec=spec8)
+    assert not report.has_errors()
+
+
+def test_search_all_illegal_raises(spec8):
+    params = {"w": jnp.zeros((3, 4))}
+    gi = GraphItem(params, optimizer=optax.sgd(0.1))
+
+    auto = AutoStrategy(search=True, candidates=[_IllegalBuilder()])
+    with pytest.raises(StrategyValidationError):
+        auto.build(gi, spec8)

@@ -1,0 +1,104 @@
+"""Analyzer CLI smoke tests (tier-1, CPU-only, fast).
+
+The CLI contract the acceptance criteria pin: a deliberately illegal
+strategy (non-divisible partition on the 8-device virtual mesh) exits
+nonzero with a rule-tagged diagnostic in seconds, while the shipped
+example models × builders come out clean — including the
+``examples/linear_regression.py`` and pipeline-example shapes.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from autodist_tpu.graph_item import GraphItem
+from autodist_tpu.strategy.base import (
+    PSSynchronizerConfig,
+    Strategy,
+    VarConfig,
+)
+
+pytestmark = pytest.mark.analysis
+
+
+def _run_cli(*args, timeout=60):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "autodist_tpu.analysis", *args],
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def test_cli_rejects_illegal_strategy_fast(tmp_path):
+    """Nonzero exit + rule-tagged diagnostic for a non-divisible
+    partition on the 8-device virtual mesh, well under the 5 s budget."""
+    gi = GraphItem({"w": jax.ShapeDtypeStruct((3, 4), jnp.float32),
+                    "b": jax.ShapeDtypeStruct((4,), jnp.float32)})
+    strategy = Strategy(node_config=[
+        VarConfig("w", synchronizer=PSSynchronizerConfig(),
+                  partitioner="3,1"),
+        VarConfig("b", synchronizer=PSSynchronizerConfig())])
+    spath = tmp_path / "strategy.json"
+    spath.write_text(json.dumps(strategy.to_dict()))
+    cpath = tmp_path / "catalog.json"
+    cpath.write_text(gi.serialize())
+
+    t0 = time.monotonic()
+    r = _run_cli(str(cpath), str(spath), "--mesh", "data=8")
+    elapsed = time.monotonic() - t0
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "legality/indivisible-partition" in r.stdout
+    assert elapsed < 5.0, f"CLI verdict took {elapsed:.1f}s (budget 5s)"
+
+
+def test_cli_linear_regression_example_clean():
+    """The shapes of examples/linear_regression.py under its default
+    builder (PSLoadBalancing) analyze clean on the virtual 8-chip mesh."""
+    r = _run_cli("linear_regression", "PSLoadBalancing", "--mesh", "data=8")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "0 error(s)" in r.stdout
+
+
+def test_cli_pipeline_example_clean():
+    """The stage-stacked pipeline example shapes analyze clean on a
+    pipe=4 × data=2 mesh (the examples/pipeline_1f1b.py layout)."""
+    r = _run_cli("pipeline", "AllReduce", "--mesh", "pipe=4,data=2")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "0 error(s)" in r.stdout
+
+
+def test_cli_every_builder_on_every_demo_model():
+    """Shipped builders × builtin demo catalogs: all clean (one process,
+    importing the CLI in-proc to keep the matrix fast)."""
+    from autodist_tpu.analysis.__main__ import main
+
+    for model in ("linear_regression", "mlp", "embedding_lm", "moe"):
+        for builder in ("AllReduce", "PS", "PSLoadBalancing",
+                        "PartitionedPS", "Parallax", "AutoStrategy"):
+            rc = main([model, builder, "--mesh", "data=8"])
+            assert rc == 0, (model, builder)
+
+
+def test_cli_json_output_and_budget(tmp_path):
+    from autodist_tpu.analysis.__main__ import main
+    import io
+    from contextlib import redirect_stdout
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = main(["mlp", "AllReduce", "--mesh", "data=8", "--json",
+                   "--budget-gb", "0.000001"])
+    out = json.loads(buf.getvalue())
+    assert rc == 1
+    assert any(d["rule"] == "memory/hbm-over-budget"
+               for d in out["diagnostics"])
+
+
+def test_cli_list_rules_runs():
+    from autodist_tpu.analysis.__main__ import main
+    assert main(["--list-rules"]) == 0

@@ -1,0 +1,120 @@
+"""Analyzer collectives pass: the static deadlock lint goldens."""
+import jax.numpy as jnp
+import pytest
+
+from autodist_tpu.analysis import analyze
+from autodist_tpu.graph_item import GraphItem
+from autodist_tpu.strategy.base import (
+    PSSynchronizerConfig,
+    Strategy,
+    VarConfig,
+)
+
+from _analysis_fixtures import ar_node, full_cover, make_gi, ps_node
+
+pytestmark = pytest.mark.analysis
+
+
+def _stage_gi():
+    params = {
+        "stage0": {"w": jnp.zeros((8, 8)), "b": jnp.zeros((8,))},
+        "stage1": {"w": jnp.zeros((8, 8)), "b": jnp.zeros((8,))},
+    }
+    return GraphItem(params)
+
+
+def test_stage_collective_mismatch_is_exactly_one_error():
+    gi = _stage_gi()
+    s = Strategy(node_config=[
+        ar_node("stage0/w", compressor="HorovodCompressorEF"),
+        ar_node("stage0/b", compressor="HorovodCompressorEF"),
+        ar_node("stage1/w"),          # plain psum: sequence diverges
+        ar_node("stage1/b"),
+    ])
+    report = analyze(s, gi, mesh={"pipe": 2, "data": 4})
+    errors = [d for d in report.errors
+              if d.rule == "collectives/stage-collective-mismatch"]
+    assert len(errors) == 1
+    assert "stage" in errors[0].message
+
+
+def test_stage_sync_kind_mismatch_is_error():
+    gi = _stage_gi()
+    s = Strategy(node_config=[
+        ar_node("stage0/w"), ar_node("stage0/b"),
+        ar_node("stage1/w"),
+        VarConfig("stage1/b", synchronizer=PSSynchronizerConfig()),
+    ])
+    report = analyze(s, gi, mesh={"pipe": 2, "data": 4})
+    assert any(d.rule == "collectives/stage-collective-mismatch"
+               for d in report.errors)
+
+
+def test_uniform_stages_are_clean():
+    gi = _stage_gi()
+    s = Strategy(node_config=[
+        ar_node("stage0/w"), ar_node("stage0/b"),
+        ar_node("stage1/w"), ar_node("stage1/b")])
+    report = analyze(s, gi, mesh={"pipe": 2, "data": 4})
+    assert not report.has_errors()
+
+
+def test_expert_groups_lint_too():
+    """The per-index group lint covers expert<k> naming as well."""
+    gi = GraphItem({
+        "expert0": {"w": jnp.zeros((8, 8))},
+        "expert1": {"w": jnp.zeros((8, 8))},
+    })
+    s = Strategy(node_config=[
+        ar_node("expert0/w", compressor="Int8Compressor"),
+        ar_node("expert1/w")])
+    report = analyze(s, gi, mesh={"expert": 2, "data": 4})
+    assert any(d.rule == "collectives/stage-collective-mismatch"
+               and "expert" in d.location for d in report.errors)
+
+
+def test_stacked_pipeline_heterogeneous_stack_warns():
+    gi = GraphItem({"a": jnp.zeros((4, 8, 8)), "b": jnp.zeros((8, 8, 8))},
+                   pipeline_vars=["a", "b"])
+    s = Strategy(node_config=[ar_node("a"), ar_node("b")])
+    report = analyze(s, gi, mesh={"pipe": 4, "data": 2})
+    assert any(d.rule == "collectives/stage-stack-heterogeneous"
+               for d in report.warnings)
+
+
+def test_interleaved_virtual_stage_multiple_is_allowed_shapewise():
+    """A uniform S*V stack (all vars agree) does not warn."""
+    gi = GraphItem({"a": jnp.zeros((8, 8, 8)), "b": jnp.zeros((8, 8))},
+                   pipeline_vars=["a", "b"])
+    s = Strategy(node_config=[ar_node("a"), ar_node("b")])
+    report = analyze(s, gi, mesh={"pipe": 4, "data": 2})
+    assert not any(d.rule == "collectives/stage-stack-heterogeneous"
+                   for d in report.warnings)
+
+
+def test_unused_pipe_axis_warns():
+    gi = make_gi()
+    report = analyze(full_cover(gi), gi, mesh={"pipe": 4, "data": 2})
+    assert any(d.rule == "collectives/unused-parallel-axis"
+               for d in report.warnings)
+
+
+def test_pipe_axis_used_by_stacked_vars_is_quiet():
+    gi = GraphItem({"stages": jnp.zeros((4, 8, 8)),
+                    "head": jnp.zeros((8, 8))},
+                   pipeline_vars=["stages"])
+    s = Strategy(node_config=[ar_node("stages"), ar_node("head")])
+    report = analyze(s, gi, mesh={"pipe": 4, "data": 2})
+    assert not any(d.rule == "collectives/unused-parallel-axis"
+                   for d in report.warnings)
+
+
+def test_mixed_staleness_warns():
+    gi = make_gi()
+    names = [v.name for v in gi.trainable_var_infos]
+    s = Strategy(node_config=[
+        ps_node(names[0], staleness=2),
+        *[ps_node(n) for n in names[1:]]])
+    report = analyze(s, gi, mesh={"data": 8})
+    assert any(d.rule == "collectives/staleness-mixed"
+               for d in report.warnings)

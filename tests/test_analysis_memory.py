@@ -1,0 +1,149 @@
+"""Analyzer memory pass: static per-device HBM footprint goldens."""
+import jax.numpy as jnp
+import optax
+import pytest
+
+from autodist_tpu.analysis import analyze
+from autodist_tpu.graph_item import GraphItem
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.strategy.base import Strategy
+
+from _analysis_fixtures import (
+    AXES8,
+    ar_node,
+    full_cover,
+    make_gi,
+    make_spec8,
+    ps_node,
+)
+
+pytestmark = pytest.mark.analysis
+
+
+@pytest.fixture
+def gi():
+    return make_gi()
+
+
+def test_hbm_breakdown_always_emitted(gi):
+    report = analyze(full_cover(gi), gi, mesh=AXES8)
+    assert len(report.by_rule("memory/hbm-breakdown")) == 1
+
+
+def test_hbm_over_budget_is_exactly_one_error(gi):
+    report = analyze(full_cover(gi), gi, mesh=AXES8, budget_bytes=1024)
+    errors = report.errors
+    assert len(errors) == 1
+    assert errors[0].rule == "memory/hbm-over-budget"
+
+
+def test_hbm_near_budget_warns():
+    # big enough that the MiB-rounded breakdown total is precise
+    gi = GraphItem({"w": jnp.zeros((1024, 1024), jnp.float32)},
+                   optimizer=optax.adam(1e-3))
+    s = Strategy(node_config=[ar_node("w")])
+    probe = analyze(s, gi, mesh=AXES8)
+    msg = probe.by_rule("memory/hbm-breakdown")[0].message
+    total_mib = float(msg.split("≈")[1].split("MiB")[0])
+    assert total_mib > 1.0
+    budget = int(total_mib * (1 << 20) / 0.95)      # ~95% utilization
+    report = analyze(s, gi, mesh=AXES8, budget_bytes=budget)
+    assert not report.has_errors()
+    assert [d.rule for d in report.warnings] == ["memory/hbm-near-budget"]
+
+
+def test_hbm_budget_from_resource_spec(gi):
+    tiny = ResourceSpec(resource_info={
+        "nodes": [{"address": "localhost", "chips": 8}],
+        "hbm_gb": 1e-6})
+    assert tiny.hbm_bytes_per_chip == int(1e-6 * (1 << 30))
+    report = analyze(full_cover(gi), gi, mesh=AXES8, resource_spec=tiny)
+    assert [d.rule for d in report.errors] == ["memory/hbm-over-budget"]
+
+
+def test_hbm_bad_budget_rejected():
+    with pytest.raises(Exception):
+        ResourceSpec(resource_info={
+            "nodes": [{"address": "localhost", "chips": 8}],
+            "hbm_gb": -1})
+
+
+def test_opt_state_bytes_are_dtype_aware():
+    """bf16 moments (cast_opt_state) halve the counted optimizer bytes —
+    the analyzer reads dtypes out of eval_shape, not assumptions."""
+    from autodist_tpu.analysis import analyzer as _an
+    from autodist_tpu.analysis import memory as _mem
+    from autodist_tpu.ops.opt_state_dtype import cast_opt_state
+
+    params = {"w": jnp.zeros((64, 64), jnp.float32)}
+
+    def ctx_for(opt):
+        gi = GraphItem(params, optimizer=opt)
+        s = Strategy(node_config=[ar_node("w")])
+        ctx = _an.AnalysisContext(strategy=s, graph_item=gi, axes=AXES8)
+        _an.PASS_REGISTRY["legality"](ctx)
+        return ctx
+
+    wide = _mem._opt_state_bytes(ctx_for(optax.adam(1e-3)))
+    narrow = _mem._opt_state_bytes(ctx_for(cast_opt_state(optax.adam(1e-3))))
+    # adam: mu + nu are the param-shaped blocks; bf16 halves exactly those.
+    assert narrow < wide
+    assert abs(narrow - wide / 2) / wide < 0.05
+
+
+def test_ps_wus_shards_optimizer_bytes():
+    """PS (weight-update sharding) counts optimizer state at 1/8 of the
+    AllReduce (replicated) footprint on an 8-wide data axis."""
+    from autodist_tpu.analysis import analyzer as _an
+    from autodist_tpu.analysis import memory as _mem
+
+    params = {"w": jnp.zeros((64, 64), jnp.float32)}
+    gi2 = GraphItem(params, optimizer=optax.adam(1e-3))
+
+    def opt_bytes(strategy):
+        ctx = _an.AnalysisContext(strategy=strategy, graph_item=gi2,
+                                  axes=AXES8)
+        _an.PASS_REGISTRY["legality"](ctx)
+        return _mem._opt_state_bytes(ctx)
+
+    rep = opt_bytes(Strategy(node_config=[ar_node("w")]))
+    wus = opt_bytes(Strategy(node_config=[ps_node("w")]))
+    assert wus < rep / 4  # param-shaped blocks divided by 8; scalars whole
+
+
+def test_compressor_state_counted(gi):
+    """Error-feedback residuals (grad-shaped, per device) show up in the
+    sync-state term: EF strategy strictly outweighs the plain one."""
+    from autodist_tpu.analysis import analyzer as _an
+    from autodist_tpu.analysis import memory as _mem
+
+    def sync_bytes(compressor):
+        s = Strategy(node_config=[
+            ar_node(v.name, compressor=compressor)
+            for v in gi.trainable_var_infos])
+        ctx = _an.AnalysisContext(strategy=s, graph_item=gi, axes=AXES8)
+        _an.PASS_REGISTRY["legality"](ctx)
+        return _mem._sync_state_bytes(ctx)
+
+    assert sync_bytes("NoneCompressor") == 0.0
+    assert sync_bytes("HorovodCompressorEF") > 0.0
+
+
+def test_activation_estimate_is_remat_aware():
+    """Same batch, remat on vs off: the activation term shrinks."""
+    from autodist_tpu.analysis import analyzer as _an
+    from autodist_tpu.analysis import memory as _mem
+    import numpy as np
+
+    params = {"w": jnp.zeros((8, 8))}
+    batch = {"x": np.zeros((64, 128), np.float32)}
+
+    def act(remat):
+        gi = GraphItem(params, loss_fn=lambda p, b: 0.0, remat=remat)
+        s = Strategy(node_config=[ar_node("w")])
+        ctx = _an.AnalysisContext(strategy=s, graph_item=gi, axes=AXES8,
+                                  batch=batch)
+        _an.PASS_REGISTRY["legality"](ctx)
+        return _mem._activation_bytes(ctx)
+
+    assert act("full") < act("dots") < act(None)

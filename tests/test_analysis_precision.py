@@ -1,0 +1,96 @@
+"""Analyzer precision pass: compressor × dtype support-matrix goldens."""
+import jax.numpy as jnp
+import pytest
+
+from autodist_tpu.analysis import analyze
+from autodist_tpu.graph_item import GraphItem
+from autodist_tpu.strategy.base import Strategy
+
+from _analysis_fixtures import AXES8, ar_node, full_cover, make_gi, ps_node
+
+pytestmark = pytest.mark.analysis
+
+
+@pytest.fixture
+def gi():
+    return make_gi()
+
+
+def test_bf16_wire_without_error_feedback_warns(gi):
+    s = Strategy(node_config=[
+        ar_node(v.name, compressor="HorovodCompressor")
+        for v in gi.trainable_var_infos])
+    report = analyze(s, gi, mesh=AXES8)
+    assert any(d.rule == "precision/bf16-wire-no-error-feedback"
+               for d in report.warnings)
+    # EF variant is quiet on that rule
+    s2 = Strategy(node_config=[
+        ar_node(v.name, compressor="HorovodCompressorEF")
+        for v in gi.trainable_var_infos])
+    report2 = analyze(s2, gi, mesh=AXES8)
+    assert not report2.by_rule("precision/bf16-wire-no-error-feedback")
+
+
+def test_unknown_compressor_is_error(gi):
+    s = full_cover(gi, but=["dense/kernel"],
+                   extra=[ar_node("dense/kernel", compressor="NoSuch")])
+    report = analyze(s, gi, mesh=AXES8)
+    assert [d.rule for d in report.errors] == ["precision/unknown-compressor"]
+
+
+def test_integer_dtype_compression_is_error():
+    gi = GraphItem({"codes": jnp.zeros((8, 8), jnp.int32)})
+    s = Strategy(node_config=[
+        ar_node("codes", compressor="HorovodCompressor")])
+    report = analyze(s, gi, mesh=AXES8)
+    assert [d.rule for d in report.errors] == [
+        "precision/compressor-integer-dtype"]
+
+
+def test_powersgd_rank_fallback_is_info(gi):
+    s = full_cover(gi, but=["dense/bias"],
+                   extra=[ar_node("dense/bias",
+                                  compressor="PowerSGDCompressor")])
+    report = analyze(s, gi, mesh=AXES8)
+    assert report.by_rule("precision/powersgd-rank-fallback")
+    assert not report.has_errors()
+
+
+def test_bf16_storage_wire_noop_is_info():
+    gi = GraphItem({"w": jnp.zeros((8, 8), jnp.bfloat16)})
+    s = Strategy(node_config=[
+        ar_node("w", compressor="HorovodCompressor")])
+    report = analyze(s, gi, mesh=AXES8)
+    assert report.by_rule("precision/compressor-wire-noop")
+
+
+def test_sparse_compressed_warns(gi):
+    s = full_cover(gi, but=["emb/table"],
+                   extra=[ar_node("emb/table",
+                                  compressor="HorovodCompressorEF")])
+    report = analyze(s, gi, mesh=AXES8)
+    assert any(d.rule == "precision/sparse-compressed"
+               for d in report.warnings)
+
+
+def test_compressed_partition_drop_matches_runtime():
+    """The lint's fallback verdict is the runtime's own
+    partition_drop_reason — a PS-partitioned var on a pure-DP mesh
+    (sharded over the reduction axis) with any compressor in the
+    program flags the drop."""
+    gi = GraphItem({"big": jnp.zeros((64, 8)), "small": jnp.zeros((8,))})
+    s = Strategy(node_config=[
+        ps_node("big", partitioner="64,1"),
+        ar_node("small", compressor="HorovodCompressorEF")])
+    report = analyze(s, gi, mesh=AXES8)
+    assert any(d.rule == "precision/compressor-partition-dropped"
+               and d.var_name == "big" for d in report.warnings)
+
+
+def test_uncompressed_program_skips_partition_drop_lint():
+    """No compressor and no fused groups ⇒ GSPMD path ⇒ no drop lint."""
+    gi = GraphItem({"big": jnp.zeros((64, 8)), "small": jnp.zeros((8,))})
+    s = Strategy(node_config=[
+        ps_node("big", partitioner="64,1"), ar_node("small")])
+    report = analyze(s, gi, mesh=AXES8)
+    assert not report.by_rule("precision/compressor-partition-dropped")

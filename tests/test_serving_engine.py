@@ -705,3 +705,97 @@ def test_engine_prefix_bucket_edges(lm):
     want2 = _oracle(spec, params,
                     np.concatenate([prefix_b, p_small]), 3)
     np.testing.assert_array_equal(out2[rid2], want2[prefix_b.size:])
+
+
+def test_engine_rejects_below_floor_temperature(lm):
+    """Temperatures in (0, 1e-6) are rejected at submit — the sampler's
+    divide floor would otherwise silently clamp them (ADVICE r5 low #1);
+    0 (greedy) and the floor itself stay accepted."""
+    spec, params = lm
+    eng = DecodeEngine(spec, params, slots=1, window=16, chunk=2,
+                       rng=jax.random.PRNGKey(0))
+    prompt = np.arange(2, dtype=np.int32)
+    for bad in (1e-7, 9.9e-7, 1e-20):
+        with pytest.raises(ValueError, match="floor"):
+            eng.submit(prompt, 2, temperature=bad)
+    eng.submit(prompt, 2, temperature=0.0)      # greedy: fine
+    eng.submit(prompt, 2, temperature=1e-6)     # exactly the floor: fine
+    eng.run()
+
+
+def test_engine_rebase_resets_inactive_slot_bounds(lm):
+    """_rebase_tick zeroes inactive slots' start/p_end/end instead of
+    shifting them: a slot that never re-admits can no longer accumulate
+    -shift per rebase toward int32 wrap (ADVICE r5 low #2)."""
+    spec, params = lm
+    rng = np.random.RandomState(21)
+    eng = DecodeEngine(spec, params, slots=3, window=16, chunk=4)
+    eng._REBASE_AT = 24
+    # slot pool wider than the stream: slot 2 admits once, then idles
+    first = eng.submit(rng.randint(0, VOCAB, 3).astype(np.int32), 4)
+    while eng.step():
+        pass
+    eng.results()
+    assert not eng._active.any()
+    # sustained single-slot stream forces repeated rebases
+    ids = []
+    for _ in range(12):
+        ids.append(eng.submit(rng.randint(0, VOCAB, 3).astype(np.int32), 6))
+        eng.step()
+        eng.results()
+    while eng.step():
+        pass
+    eng.results()
+    # every inactive slot's bounds were reset at the last rebase: they
+    # can never be more negative than one rebase window's shift.
+    inactive = ~eng._active
+    assert inactive.all()
+    for arr in (eng._start, eng._p_end, eng._end):
+        assert int(arr[inactive].min()) > -(1 << 24), arr
+    del first, ids
+
+
+@pytest.mark.parametrize("wrap", [False, True])
+def test_engine_prefill_contiguous_and_wrapped_paths_token_exact(lm, wrap):
+    """Token-exactness pin for BOTH prefill cache-write paths: the
+    contiguous dynamic_update_slice fast path (no ring wrap) and the
+    mod-window scatter path (wrapped admission).  The wrapped case
+    arises only once the tick outgrows the window (t0 % window < P)."""
+    spec, params = lm
+    rng = np.random.RandomState(33)
+    eng = DecodeEngine(spec, params, slots=1, window=16, chunk=4)
+    reqs = [(rng.randint(0, VOCAB, 6).astype(np.int32), 7)]
+    if wrap:
+        # run enough sequential requests that an admission lands with
+        # t0 % 16 < 6 (the single slot serializes them, walking t0
+        # through every residue)
+        reqs = [(rng.randint(0, VOCAB, 6).astype(np.int32), 7)
+                for _ in range(5)]
+    ids = [eng.submit(p, n) for p, n in reqs]
+    results = eng.run()
+    for rid, (p, n) in zip(ids, reqs):
+        np.testing.assert_array_equal(
+            results[rid], _oracle(spec, params, p, n),
+            err_msg=f"wrap={wrap} request {rid}")
+    if wrap:
+        assert eng.stats.prefill_dispatches >= 2
+
+
+def test_engine_prefill_mixed_wrapness_boundary(lm):
+    """One boundary admitting a wrapping and a non-wrapping prompt
+    dispatches them as separate (static-wrapness) programs and both
+    stay oracle-exact."""
+    spec, params = lm
+    rng = np.random.RandomState(35)
+    eng = DecodeEngine(spec, params, slots=2, window=16, chunk=4)
+    # opener pair retires together at a tick t0 with 0 < t0 % 16 < 8
+    openers = [(rng.randint(0, VOCAB, 3).astype(np.int32), 7)
+               for _ in range(2)]
+    # next wave: one long prompt (wraps when t0 % 16 < 8) + one short
+    wave2 = [(rng.randint(0, VOCAB, 8).astype(np.int32), 3),
+             (rng.randint(0, VOCAB, 1).astype(np.int32), 3)]
+    ids = [eng.submit(p, n) for p, n in openers + wave2]
+    results = eng.run()
+    for rid, (p, n) in zip(ids, openers + wave2):
+        np.testing.assert_array_equal(results[rid],
+                                      _oracle(spec, params, p, n))
