@@ -60,6 +60,15 @@ class PlanLite:
     staleness: int = 0
     grad_reduce_axes: Tuple[str, ...] = ()
     synthesized: bool = False
+    # AllReduce collective lowering ("all_reduce" | "reduce_scatter") and
+    # whether the ZeRO-1 weight-update sharding actually takes effect for
+    # this var (reduce_scatter requested AND the bucketed path can absorb
+    # it — set by the legality lowering via bucketing.bucket_drop_reason,
+    # the same rule the runtime uses).  When True, the memory pass counts
+    # optimizer slots at 1/data-axis-size.
+    sync_mode: str = "all_reduce"
+    zero1: bool = False
+    bucket_bytes: int = 0
 
     def physical_shape(self) -> Tuple[int, ...]:
         shape = list(self.var.shape)

@@ -54,10 +54,13 @@ _GROUP_RE = re.compile(r"(?:^|/)(stage|expert)[_-]?(\d+)(?=/|$)")
 
 
 def _collective_entry(plan: PlanLite) -> Tuple:
-    """One variable's contribution to the static collective schedule."""
+    """One variable's contribution to the static collective schedule.
+    ``sync_mode`` is part of the identity: a stage reduce-scattering
+    what another stage all-reduces issues a different collective."""
     return (plan.sync_kind, plan.compressor or "NoneCompressor",
             bool(plan.fused), plan.group, tuple(plan.grad_reduce_axes),
-            int(plan.staleness), tuple(sorted(plan.placement.items())))
+            int(plan.staleness), tuple(sorted(plan.placement.items())),
+            getattr(plan, "sync_mode", "all_reduce"))
 
 
 def _named_groups(ctx: AnalysisContext

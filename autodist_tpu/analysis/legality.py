@@ -36,6 +36,11 @@ Rules (docs/analysis.md):
   leading dim does not divide the data axis (the step will replicate it).
 * ``legality/mesh-hint-mismatch`` (WARN) — the strategy's
   ``graph_config.mesh_axes`` hint names axes the mesh does not carry.
+* ``legality/zero1-fallback`` (WARN) — ``sync="reduce_scatter"`` (ZeRO-1)
+  requested for a variable the bucketed path cannot absorb (partitioned,
+  padded, or non-bucketable compressor); it falls back to a per-variable
+  collective with replicated optimizer state.  Shares
+  ``bucketing.bucket_drop_reason`` with the runtime.
 """
 from __future__ import annotations
 
@@ -142,6 +147,34 @@ def _wus_opt(var: VarInfo, placement: Dict[int, str],
     return out
 
 
+def _zero1_effective(mode: str, placement: Dict[int, str],
+                     pad: Optional[Tuple[int, int]], compressor: str,
+                     d: int, diags: List[Diagnostic],
+                     var: VarInfo) -> bool:
+    """Does the requested ``sync="reduce_scatter"`` actually shard this
+    var's weight update?  Shares the bucket-eligibility rule with the
+    runtime (``bucketing.bucket_drop_reason``) so the lint cannot drift;
+    emits the fallback WARN the explicit path logs at trace time."""
+    if mode != "reduce_scatter" or d <= 1:
+        return False
+    from autodist_tpu.kernel.synchronization.bucketing import (
+        bucket_drop_reason,
+    )
+    why = bucket_drop_reason(sorted(placement.items()), pad is not None,
+                             compressor or "NoneCompressor")
+    if why is None:
+        return True
+    diags.append(diag(
+        "legality/zero1-fallback", Severity.WARN,
+        f"sync='reduce_scatter' requested but this variable cannot join "
+        f"a flat gradient bucket ({why}); it falls back to its "
+        "per-variable/per-shard collective with replicated optimizer "
+        "state",
+        var=var.name,
+        fix="drop the partitioner or use a bucketable compressor"))
+    return False
+
+
 def _lower_from_strategy(ctx: AnalysisContext
                          ) -> Tuple[Dict[str, PlanLite], List[Diagnostic]]:
     from autodist_tpu.strategy.base import (
@@ -207,12 +240,17 @@ def _lower_from_strategy(ctx: AnalysisContext
                     placement, pad = _partition(var, axis, model_axis,
                                                 axes, diags)
             _apply_structural(var, placement, axes, diags)
+            mode = getattr(sync, "sync", "all_reduce") or "all_reduce"
             plans[var.name] = PlanLite(
                 var=var, sync_kind="AllReduce", placement=placement,
                 opt_placement=dict(placement), pad=pad,
                 compressor=sync.compressor or "NoneCompressor",
                 fused=bool(getattr(sync, "fused", False)), group=sync.group,
-                grad_reduce_axes=grad_axes)
+                grad_reduce_axes=grad_axes,
+                sync_mode=mode,
+                zero1=_zero1_effective(mode, placement, pad,
+                                       sync.compressor, d, diags, var),
+                bucket_bytes=int(getattr(sync, "bucket_bytes", 0) or 0))
         elif isinstance(sync, PSSynchronizerConfig):
             shard_axis = model_axis or (
                 MESH_AXIS_DATA if axis is not None else None)
@@ -346,12 +384,18 @@ def _lower_from_compiled(ctx: AnalysisContext
                     "legality/unknown-mesh-axis", Severity.ERROR,
                     f"grad_reduce_axes names unknown mesh axis {ax!r}",
                     var=name, location=ax))
+        mode = getattr(vp, "sync_mode", "all_reduce") or "all_reduce"
+        d = int(ctx.axes.get(MESH_AXIS_DATA, 1))
         plans[name] = PlanLite(
             var=var, sync_kind=vp.sync_kind, placement=placement,
             opt_placement=opt_placement, pad=pad,
             compressor=vp.compressor or "NoneCompressor",
             fused=bool(vp.fused), group=vp.group, staleness=vp.staleness,
-            grad_reduce_axes=tuple(vp.grad_reduce_axes))
+            grad_reduce_axes=tuple(vp.grad_reduce_axes),
+            sync_mode=mode,
+            zero1=_zero1_effective(mode, placement, pad, vp.compressor,
+                                   d, diags, var),
+            bucket_bytes=int(getattr(vp, "bucket_bytes", 0) or 0))
 
     for name, var in known.items():
         if name not in plans:

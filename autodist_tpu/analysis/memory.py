@@ -20,6 +20,15 @@ Rules (docs/analysis.md):
   budget (``analyze(budget_bytes=...)``, or the resource spec's
   ``hbm_gb`` yaml key).
 * ``memory/hbm-near-budget`` (WARN) — the sum exceeds 90% of the budget.
+* ``memory/zero1-unused`` (WARN) — the footprint is within 10% of the
+  budget (or over it), the mesh has a data axis, and AllReduce plans
+  keep replicated optimizer state that ZeRO-1 (``sync=
+  "reduce_scatter"`` / the ``Zero1`` builder) could legally shard 1/d —
+  emitted with the estimated per-device saving.
+
+Optimizer state under ZeRO-1 plans is counted at ``state_bytes /
+data-axis size``: the explicit path carries those slots as flat bucket
+shards, one 1/d slice per device (arXiv:2004.13336).
 
 The activation term is a deliberate coarse bound — ``multiplier ×
 per-device batch bytes``, with the multiplier shrunk under remat
@@ -84,6 +93,7 @@ def _opt_state_bytes(ctx: AnalysisContext) -> Optional[float]:
         lambda p, _: path_name(p), gi.params)
     mapped = su.opt_spec_tree(opt_shapes, gi.params, name_tree, default="")
     total = 0.0
+    d = max(ctx.data_axis_size, 1)
     for leaf, name in zip(jax.tree_util.tree_leaves(opt_shapes),
                           jax.tree_util.tree_leaves(mapped)):
         size = float(np.prod(tuple(leaf.shape) or (1,)))
@@ -93,7 +103,14 @@ def _opt_state_bytes(ctx: AnalysisContext) -> Optional[float]:
             logical = float(np.prod(plan.var.shape or (1,)))
             phys = float(np.prod(plan.physical_shape() or (1,)))
             ratio = phys / logical if logical else 1.0
-            bytes_ = bytes_ * ratio / plan.opt_denominator(ctx.axes)
+            denom = plan.opt_denominator(ctx.axes)
+            if getattr(plan, "zero1", False):
+                # Weight-update sharding (sync="reduce_scatter"): the
+                # explicit path carries this var's slots as flat bucket
+                # shards, 1/d per device (the placement dict cannot
+                # express a flat sharding, so it is accounted here).
+                denom = max(denom, 1) * d
+            bytes_ = bytes_ * ratio / denom
         total += bytes_
     return total
 
@@ -189,9 +206,10 @@ def run(ctx: AnalysisContext) -> List[Diagnostic]:
                 "memory/hbm-over-budget", Severity.ERROR,
                 f"per-device footprint ≈ {_mib(total)} exceeds the "
                 f"{_mib(budget)} budget",
-                fix="shard more state (PS/weight-update sharding), cast "
-                    "optimizer moments to bf16 (cast_opt_state), enable "
-                    "remat, or shrink the per-device batch"))
+                fix="shard more state (PS/weight-update sharding or "
+                    "ZeRO-1 sync='reduce_scatter'), cast optimizer "
+                    "moments to bf16 (cast_opt_state), enable remat, or "
+                    "shrink the per-device batch"))
         elif total > 0.9 * budget:
             diags.append(diag(
                 "memory/hbm-near-budget", Severity.WARN,
@@ -199,4 +217,51 @@ def run(ctx: AnalysisContext) -> List[Diagnostic]:
                 f"the {_mib(budget)} budget (XLA temporaries may tip it "
                 "over)",
                 fix="leave headroom: shard or remat before scaling up"))
+        if total > 0.9 * budget and opt is not None:
+            diags += _zero1_unused(ctx, opt)
     return diags
+
+
+def _zero1_unused(ctx: AnalysisContext, opt_actual: float
+                  ) -> List[Diagnostic]:
+    """WARN when the HBM pass is within 10% of budget while AllReduce
+    plans keep replicated optimizer state that ZeRO-1 could legally
+    shard (eligibility via the runtime's own bucket rule)."""
+    from autodist_tpu.kernel.synchronization.bucketing import (
+        bucket_drop_reason,
+    )
+
+    d = max(ctx.data_axis_size, 1)
+    if d <= 1:
+        return []
+    eligible = [
+        p for p in ctx.plans.values()
+        if p.sync_kind == "AllReduce" and p.var.trainable
+        and not getattr(p, "zero1", False)
+        and bucket_drop_reason(sorted(p.placement.items()),
+                               p.pad is not None,
+                               p.compressor) is None]
+    if not eligible:
+        return []
+    # Exact saving: re-run the eval_shape accounting with the eligible
+    # plans hypothetically sharded (restored afterwards).
+    for p in eligible:
+        p.zero1 = True
+    try:
+        opt_sharded = _opt_state_bytes(ctx)
+    finally:
+        for p in eligible:
+            p.zero1 = False
+    if opt_sharded is None:
+        return []
+    saving = opt_actual - opt_sharded
+    if saving <= 0:
+        return []
+    return [diag(
+        "memory/zero1-unused", Severity.WARN,
+        f"{len(eligible)} AllReduce variable(s) replicate optimizer "
+        f"state that ZeRO-1 weight-update sharding could legally cut to "
+        f"1/{d} per device (≈{_mib(saving)} saved) while the footprint "
+        "is within 10% of the HBM budget",
+        fix="use the Zero1 strategy builder or sync='reduce_scatter' "
+            "on the AllReduce config")]

@@ -1,0 +1,204 @@
+"""Gradient-bucket planning for the explicit sync path.
+
+One collective per VARIABLE is the reference's layout (an all-reduce per
+``tf.Variable``, ``all_reduce_synchronizer.py:100-127``); at transformer
+scale that is hundreds of launch latencies on the sync critical path.
+This module plans **size-capped, dtype-grouped buckets**: gradients are
+flattened and concatenated into contiguous vectors of at most
+``bucket_bytes``, and the explicit path issues ONE collective per bucket
+(the scoped-allocator/Horovod-fusion idea, done at trace time).  Buckets
+are the unit the whole sync stack now composes over:
+
+* compressors quantize **per bucket**, not per variable (the EQuARX
+  formulation, arXiv:2506.17615 — one scale grid per collective);
+* ZeRO-1 weight-update sharding (arXiv:2004.13336) reduce-scatters each
+  bucket, updates the local shard, and all-gathers fresh parameters —
+  bucket totals are padded to a multiple of the data-axis size so the
+  uneven tail shards evenly;
+* per-bucket chains are data-independent, so XLA's scheduler can overlap
+  one bucket's collective with another bucket's update math (and with
+  whatever backward compute does not feed that bucket).
+
+The planning rules here are PURE functions of ``(name, shape, dtype,
+compressor, group, mode)`` — no mesh, no arrays — so the static analyzer
+(``autodist_tpu.analysis``) and the cost model share the exact planner
+the runtime executes and can never drift from it.
+
+Bucket keying: ``(mode, dtype, compressor, group)``.  Mixed dtypes never
+share a bucket (a fused vector must be homogeneous — bf16 and f32 grads
+concatenate into separate buckets), different compressors never share a
+scale grid, and the strategy's ``group`` ids are respected so explicit
+``fused=True`` groups keep their collective identity.  Within a key,
+variables fill greedily in catalog order until ``bucket_bytes`` is
+reached; a single variable larger than the cap gets a bucket of its own
+(never split — slicing one gradient across collectives would serialize
+its producer).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+#: default bucket size cap; chosen so a handful of buckets cover a
+#: transformer block (big enough to amortize launch latency, small
+#: enough that the first collective starts long before the last
+#: gradient is produced).
+DEFAULT_BUCKET_BYTES = 4 << 20
+
+#: sync-mode vocabulary for AllReduce-family plans.
+MODE_ALL_REDUCE = "all_reduce"
+MODE_REDUCE_SCATTER = "reduce_scatter"
+SYNC_MODES = (MODE_ALL_REDUCE, MODE_REDUCE_SCATTER)
+
+
+@dataclass(frozen=True)
+class BucketVar:
+    """One variable's slot inside a bucket."""
+
+    name: str
+    shape: Tuple[int, ...]
+    offset: int          # element offset into the bucket vector
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self.shape or (1,)))
+
+
+@dataclass(frozen=True)
+class Bucket:
+    """A planned contiguous gradient bucket (one collective)."""
+
+    key: str             # stable id, also the sync/opt-state dict key
+    mode: str            # MODE_ALL_REDUCE | MODE_REDUCE_SCATTER
+    dtype: str
+    compressor: str
+    group: int
+    vars: Tuple[BucketVar, ...]
+    total: int           # sum of member sizes (elements, unpadded)
+    padded_total: int    # total rounded up to the shard divisor
+
+    @property
+    def names(self) -> Tuple[str, ...]:
+        return tuple(v.name for v in self.vars)
+
+    @property
+    def nbytes(self) -> int:
+        return self.total * np.dtype(self.dtype).itemsize
+
+    @property
+    def pad(self) -> int:
+        return self.padded_total - self.total
+
+
+def bucket_drop_reason(placement: Sequence, padded: bool,
+                       compressor: str) -> Optional[str]:
+    """Why a variable cannot join a gradient bucket, or None when it can.
+
+    Mirrors the runtime eligibility in ``explicit_sync`` and is consumed
+    by the static analyzer so the lint and the lowering share one rule
+    (the ``partition_drop_reason`` pattern).  ``placement`` is the
+    non-trivial part of the param layout ([(dim, axis), ...] or a
+    PartitionSpec's entries); partitioned variables own a per-shard
+    collective and never fuse into a flat bucket.
+    """
+    if list(placement):
+        return "partitioned/structurally sharded (owns a per-shard collective)"
+    if padded:
+        return "pad-to-divisible sharding"
+    from autodist_tpu.kernel.synchronization.compressor import _REGISTRY
+    cls = _REGISTRY.get(compressor or "NoneCompressor")
+    if cls is None:
+        return f"unknown compressor {compressor!r}"
+    if not getattr(cls, "bucketable", True):
+        return (f"{compressor} state is not flat-composable "
+                f"(e.g. PowerSGD low-rank factors)")
+    return None
+
+
+def assign_buckets(entries: Sequence[Tuple[str, Tuple[int, ...], str, str,
+                                           int, str]],
+                   bucket_bytes: int = DEFAULT_BUCKET_BYTES,
+                   shard_divisor: int = 1) -> List[Bucket]:
+    """Plan buckets over ``entries`` = [(name, shape, dtype, compressor,
+    group, mode), ...] in catalog (flatten) order.
+
+    ``bucket_bytes`` caps each bucket's UNPADDED byte size; 0 or None
+    means the default cap.  ``shard_divisor`` (the data-axis size for
+    reduce-scatter mode) rounds each bucket's ``padded_total`` up so the
+    vector splits into equal shards; the zero-padded tail is how the
+    uneven remainder is handled.
+    """
+    cap = int(bucket_bytes) if bucket_bytes else DEFAULT_BUCKET_BYTES
+    d = max(int(shard_divisor), 1)
+    open_buckets: Dict[Tuple, List[BucketVar]] = {}
+    order: List[Tuple] = []          # first-touch order of keys
+    closed: List[Tuple[Tuple, List[BucketVar]]] = []
+    seq: Dict[Tuple, int] = {}
+
+    def close(bkey: Tuple) -> None:
+        members = open_buckets.pop(bkey, None)
+        if members:
+            closed.append((bkey + (seq[bkey],), members))
+            seq[bkey] = seq[bkey] + 1
+
+    for name, shape, dtype, compressor, group, mode in entries:
+        if mode not in SYNC_MODES:
+            raise ValueError(f"unknown sync mode {mode!r} for {name}; "
+                             f"expected one of {SYNC_MODES}")
+        size = int(np.prod(tuple(shape) or (1,)))
+        nbytes = size * np.dtype(dtype).itemsize
+        bkey = (mode, str(dtype), compressor or "NoneCompressor", int(group))
+        if bkey not in seq:
+            seq[bkey] = 0
+            order.append(bkey)
+        members = open_buckets.get(bkey)
+        current = sum(v.size for v in members) if members else 0
+        current_bytes = current * np.dtype(dtype).itemsize
+        if members and current_bytes + nbytes > cap:
+            close(bkey)   # cap reached: next member starts a fresh bucket
+            members = None
+            current = 0
+        if members is None:
+            members = open_buckets.setdefault(bkey, [])
+        members.append(BucketVar(name=name, shape=tuple(shape),
+                                 offset=current))
+        # a single oversized variable still gets exactly one bucket
+        if (current + size) * np.dtype(dtype).itemsize >= cap:
+            close(bkey)
+    for bkey in order:
+        close(bkey)
+
+    buckets: List[Bucket] = []
+    for (mode, dtype, compressor, group, idx), members in closed:
+        total = sum(v.size for v in members)
+        padded = -(-total // d) * d
+        buckets.append(Bucket(
+            key=f"{mode}:{dtype}:g{group}:{idx}",
+            mode=mode, dtype=dtype, compressor=compressor, group=int(group),
+            vars=tuple(members), total=total, padded_total=padded))
+    return buckets
+
+
+# -- pack/unpack (trace-time helpers) ----------------------------------------
+
+def pack_bucket(bucket: Bucket, leaves: Sequence) -> "jax.Array":
+    """Concatenate ``leaves`` (bucket order) into the padded flat vector."""
+    import jax.numpy as jnp
+
+    parts = [jnp.ravel(x) for x in leaves]
+    if bucket.pad:
+        parts.append(jnp.zeros((bucket.pad,),
+                               dtype=np.dtype(bucket.dtype)))
+    return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+
+
+def unpack_bucket(bucket: Bucket, vec) -> List:
+    """Split the flat vector back into member-shaped arrays."""
+    import jax.numpy as jnp
+
+    out = []
+    for v in bucket.vars:
+        out.append(jnp.reshape(vec[v.offset:v.offset + v.size], v.shape))
+    return out

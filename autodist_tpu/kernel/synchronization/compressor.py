@@ -25,18 +25,38 @@ from autodist_tpu.utils import compat
 
 
 class Compressor:
-    """Base: compress → all-reduce → decompress, with optional state."""
+    """Base: compress → all-reduce → decompress, with optional state.
+
+    ``bucketable`` marks compressors whose wire format composes with the
+    FLAT gradient buckets of the explicit path (``bucketing.py``): the
+    compression must be elementwise (or flat-vector) so quantizing one
+    concatenated bucket equals quantizing its members — the EQuARX
+    per-collective scale grid.  Bucketable compressors also implement
+    :meth:`reduce_scatter`, the ZeRO-1 leg: reduce the bucket but return
+    only this shard's ``1/axis_size`` slice of the mean, so the weight
+    update can run on the local optimizer-state shard.
+    """
 
     name = "Compressor"
+    bucketable = True
 
     def init_state(self, var_value) -> Any:
-        """Per-device sync state for one variable (local shape — the explicit
-        path stacks it along a leading per-shard axis). None if stateless."""
+        """Per-device sync state for one variable or bucket (local shape —
+        the explicit path stacks it along a leading per-shard axis).
+        None if stateless."""
         return None
 
     def reduce(self, grad, state, axis_name: str) -> Tuple[Any, Any]:
         """Return (globally averaged gradient, new state)."""
         raise NotImplementedError
+
+    def reduce_scatter(self, vec, state, axis_name: str) -> Tuple[Any, Any]:
+        """Return (this shard's slice of the globally averaged ``vec``,
+        new state).  ``vec`` is a flat bucket whose length divides the
+        axis size (``bucketing`` pads the tail).  Only defined for
+        ``bucketable`` compressors."""
+        raise NotImplementedError(
+            f"{self.name} does not support reduce-scatter (ZeRO-1) mode")
 
 
 class NoneCompressor(Compressor):
@@ -46,6 +66,12 @@ class NoneCompressor(Compressor):
 
     def reduce(self, grad, state, axis_name):
         return lax.pmean(grad, axis_name), state
+
+    def reduce_scatter(self, vec, state, axis_name):
+        n = compat.axis_size(axis_name)
+        shard = lax.psum_scatter(vec, axis_name, scatter_dimension=0,
+                                 tiled=True)
+        return shard / n, state
 
 
 class HorovodCompressor(Compressor):
@@ -63,6 +89,12 @@ class HorovodCompressor(Compressor):
         compressed = grad.astype(self._wire)
         summed = lax.pmean(compressed, axis_name)
         return summed.astype(orig), state
+
+    def reduce_scatter(self, vec, state, axis_name):
+        n = compat.axis_size(axis_name)
+        shard = lax.psum_scatter(vec.astype(self._wire), axis_name,
+                                 scatter_dimension=0, tiled=True)
+        return (shard / n).astype(vec.dtype), state
 
 
 class HorovodCompressorEF(Compressor):
@@ -85,6 +117,18 @@ class HorovodCompressorEF(Compressor):
         summed = lax.pmean(compressed, axis_name)
         return summed.astype(grad.dtype), new_state
 
+    def reduce_scatter(self, vec, state, axis_name):
+        # Residual is computable locally BEFORE the scatter (it depends
+        # only on this device's quantization error), so error feedback
+        # composes with the ZeRO-1 leg at full-bucket state size.
+        n = compat.axis_size(axis_name)
+        corrected = vec + state
+        compressed = corrected.astype(self._wire)
+        new_state = corrected - compressed.astype(vec.dtype)
+        shard = lax.psum_scatter(compressed, axis_name,
+                                 scatter_dimension=0, tiled=True)
+        return (shard / n).astype(vec.dtype), new_state
+
 
 class PowerSGDCompressor(Compressor):
     """Rank-r PowerSGD (Vogels et al., 2019).  The reference carries a
@@ -96,6 +140,10 @@ class PowerSGDCompressor(Compressor):
     """
 
     name = "PowerSGDCompressor"
+    # Low-rank factors need the 2-D gradient; flattening into a bucket
+    # would silently disable the compression (every flat vector falls
+    # back to pmean), so PowerSGD vars keep their per-variable collective.
+    bucketable = False
 
     def __init__(self, rank: int = 1):
         self.rank = rank
@@ -178,6 +226,21 @@ class Int8Compressor(Compressor):
         mean = gathered.astype(jnp.float32) * (scale2 / n)
         return mean[:grad.size].reshape(grad.shape).astype(grad.dtype), \
             new_state
+
+    def reduce_scatter(self, vec, state, axis_name):
+        # ZeRO-1 leg = EQuARX stage 1 alone: the quantized all_to_all
+        # already IS a reduce-scatter with an int8 wire; the stage-2
+        # re-quantized all-gather is simply not needed (fresh params are
+        # gathered instead).  No stage-2 quantization error either.
+        n = compat.axis_size(axis_name)
+        corrected = (vec + state).astype(jnp.float32)
+        q, scale = self._quantize(corrected, axis_name)
+        err = corrected - q.astype(jnp.float32) * scale
+        new_state = err.astype(vec.dtype)
+        recv = lax.all_to_all(q.reshape(n, -1), axis_name,
+                              split_axis=0, concat_axis=0)
+        owned_mean = jnp.sum(recv.astype(jnp.float32), axis=0) * (scale / n)
+        return owned_mean.astype(vec.dtype), new_state
 
 
 _REGISTRY: Dict[str, type] = {

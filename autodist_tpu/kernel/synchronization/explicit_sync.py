@@ -1,19 +1,42 @@
-"""Explicit (shard_map) synchronization path.
+"""Explicit (shard_map) synchronization path, bucketed.
 
-The GSPMD path lets XLA insert collectives; this path takes manual control of
-the gradient all-reduce so a :class:`Compressor` can wrap it — the analog of
-the reference's AllReduceSynchronizer inserting ``collective_ops.all_reduce``
+The GSPMD path lets XLA insert collectives; this path takes manual control
+of the gradient reduction so a :class:`Compressor` can wrap it — the analog
+of the reference's AllReduceSynchronizer inserting ``collective_ops.all_reduce``
 through a compressor (``all_reduce_synchronizer.py:100-127``,
-``compressor.py:85-96``).
+``compressor.py:85-96``) — and so the sync hot path can be scheduled as
+**gradient buckets** instead of one collective per variable.
 
 Semantics: the whole train step runs inside ``shard_map`` over the mesh.
 The batch is sharded over ``data``; each device computes local gradients
 (accumulated over ``capture(accum_steps=N)`` microbatches of its local slice
-when asked — still ONE compressed collective per step), every variable's
-gradient is averaged over ``data`` through its compressor, and the update is
-applied on all devices.  Per-device compressor state (error-feedback
-residuals, PowerSGD factors) is carried as a *sync state* pytree with a
-leading per-shard axis, sharded over ``data`` so each device owns its slice.
+when asked — still one compressed collective per bucket per step), and the
+gradients synchronize in three tiers:
+
+1. **Buckets** (the default): replicated vars' gradients are flattened
+   into size-capped, dtype-grouped contiguous buckets (``bucketing.py``)
+   keyed by the strategy's collective group — ONE collective per bucket.
+   Compressors quantize per bucket (one scale grid per collective, the
+   EQuARX formulation).  Each bucket's chain is data-independent of the
+   others, so XLA overlaps one bucket's collective with other buckets'
+   compute and with backward work that does not feed it.
+2. **ZeRO-1 buckets** (``sync="reduce_scatter"`` plans): the bucket is
+   reduce-scattered ((N−1)/N of the all-reduce's reduce bytes), the
+   optimizer update runs on the LOCAL 1/N shard of a flat, bucket-major
+   optimizer state (the weight-update sharding of arXiv:2004.13336 —
+   optimizer HBM drops by the data-axis size), and updated parameters
+   are all-gathered back to their replicated layout.  The uneven tail
+   bucket is zero-padded to shard evenly; elementwise optimizers
+   (SGD/Adam family) make the sharded update exactly equal to the
+   replicated one.
+3. **Per-variable fallback**: partitioned vars keep their per-shard
+   compressed reduction (see below), and non-bucketable compressors
+   (PowerSGD needs the 2-D gradient) keep the per-variable collective.
+
+Per-device compressor state (error-feedback residuals, PowerSGD factors)
+is carried as a *sync state* pytree with a leading per-shard axis, sharded
+over ``data`` so each device owns its slice — bucket-level residuals are
+keyed by the bucket id.
 
 Partitioned variables COMPOSE with compression (the reference can express
 PartitionedAR + compressor — ``proto/synchronizers.proto:24-57``): a var
@@ -21,17 +44,15 @@ sharded over a non-data mesh axis stays sharded outside the step; inside,
 it is all-gathered for the user's loss, its gradient is sliced back to the
 local shard, and the data-axis reduction of the SHARD runs through the
 compressor — per-shard compressed reduction, each partition reduced
-independently (the reference's per-shard synchronizer structure), with the
-parameter + optimizer-state memory of true partitioning.  Per-variable
-fallback to replication (with a warning) covers the cases where the
-composition is not defined: vars sharded over ``data`` itself (PS shards on
-a pure-DP mesh — the reduction axis and the shard axis coincide),
-pad-to-divisible vars, multi-axis shardings, and PowerSGD (its low-rank
-state is not grad-shaped, so the per-shard state layout does not apply).
+independently, with the parameter + optimizer-state memory of true
+partitioning.  Per-variable fallback to replication (with a warning)
+covers the cases where the composition is not defined: vars sharded over
+``data`` itself, pad-to-divisible vars, multi-axis shardings, and
+PowerSGD (its low-rank state is not grad-shaped).
 """
 from __future__ import annotations
 
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -40,6 +61,14 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from autodist_tpu.const import MESH_AXIS_DATA
 from autodist_tpu.graph_item import GraphItem, path_name
+from autodist_tpu.kernel.synchronization import bucketing
+from autodist_tpu.kernel.synchronization.bucketing import (
+    Bucket,
+    MODE_ALL_REDUCE,
+    MODE_REDUCE_SCATTER,
+    pack_bucket,
+    unpack_bucket,
+)
 from autodist_tpu.kernel.synchronization.compressor import (
     Compressor,
     get_compressor,
@@ -49,12 +78,18 @@ from autodist_tpu.utils import compat, logging
 
 
 def uses_explicit_path(compiled: CompiledStrategy) -> bool:
-    """Compressors need manual collectives; fused grouping needs them too
-    (one concat-and-pmean per group — the reference's scoped-allocator
-    merge done literally)."""
-    if any(plan.compressor not in ("", "NoneCompressor")
-           for plan in compiled.var_plans.values()):
-        return True
+    """Compressors need manual collectives; fused grouping and explicit
+    bucketing need them too (one concat-and-reduce per bucket — the
+    reference's scoped-allocator merge done literally); ZeRO-1
+    (reduce-scatter weight-update sharding) owns its whole
+    reduce→update→gather chain."""
+    for plan in compiled.var_plans.values():
+        if plan.compressor not in ("", "NoneCompressor"):
+            return True
+        if getattr(plan, "sync_mode", "all_reduce") == MODE_REDUCE_SCATTER:
+            return True
+        if getattr(plan, "bucket_bytes", 0) > 0:
+            return True
     return (any(plan.fused for plan in compiled.var_plans.values())
             and bool(compiled.fusable_groups()))
 
@@ -149,6 +184,32 @@ def _partition_support(gi: GraphItem, compiled: CompiledStrategy,
     return part
 
 
+def plan_step_buckets(gi: GraphItem, compiled: CompiledStrategy,
+                      part: Dict[str, tuple], d: int) -> List[Bucket]:
+    """Bucket assignment for this program: every replicated synced var
+    whose compressor composes with flat buckets, in flatten order, keyed
+    by (mode, dtype, compressor, group).  Shared with the analyzer and
+    bench byte accounting — the planner the runtime executes."""
+    entries = []
+    cap = 0
+    for path, leaf in jax.tree_util.tree_flatten_with_path(gi.params)[0]:
+        name = path_name(path)
+        plan = compiled.var_plans.get(name)
+        if plan is None or name in part:
+            continue
+        comp_name = plan.compressor or "NoneCompressor"
+        if bucketing.bucket_drop_reason((), False, comp_name) is not None:
+            continue
+        mode = getattr(plan, "sync_mode", MODE_ALL_REDUCE) or MODE_ALL_REDUCE
+        arr = jnp.asarray(leaf)
+        entries.append((name, tuple(arr.shape), str(arr.dtype), comp_name,
+                        plan.group, mode))
+        cap = max(cap, getattr(plan, "bucket_bytes", 0))
+    return bucketing.assign_buckets(
+        entries, bucket_bytes=cap or bucketing.DEFAULT_BUCKET_BYTES,
+        shard_divisor=max(d, 1))
+
+
 def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
     """Returns (step_fn, init_opt_fn, init_sync_state_fn, param_sh_tree,
     opt_sh_tree) consumed by the GraphTransformer."""
@@ -173,40 +234,84 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
         # Gradient accumulation composes with compression exactly where it
         # matters most (bandwidth-starved links): the f32 accumulator scan
         # runs INSIDE the shard_map step over the device's LOCAL microbatch
-        # slices, so the compressor still sees ONE averaged gradient — one
-        # compressed all-reduce per step, N microbatches of activations.
+        # slices, so each bucket still sees ONE averaged gradient — one
+        # compressed collective per bucket per step, N microbatches of
+        # activations.
         from autodist_tpu.kernel.graph_transformer import _accumulate_grads
         vg = _accumulate_grads(vg, gi.accum_steps, gi.has_aux)
-    optimizer = gi.frozen_aware_optimizer()
     has_aux = gi.has_aux
+
+    # -- bucket plan -------------------------------------------------------
+    buckets = plan_step_buckets(gi, compiled, part, d)
+    bucketed_names = {n for b in buckets for n in b.names}
+    rs_buckets = [b for b in buckets if b.mode == MODE_REDUCE_SCATTER]
+    rs_names = {n for b in rs_buckets for n in b.names}
+    for name, plan in compiled.var_plans.items():
+        if (getattr(plan, "sync_mode", MODE_ALL_REDUCE)
+                == MODE_REDUCE_SCATTER and name not in rs_names):
+            logging.warning(
+                "explicit sync path: %s requested reduce_scatter (ZeRO-1) "
+                "but cannot join a flat bucket (partitioned or "
+                "non-bucketable compressor); falling back to its "
+                "per-variable/per-shard collective with replicated "
+                "optimizer state", name)
+
+    # -- optimizer split ---------------------------------------------------
+    # ZeRO-1 vars' optimizer state lives as flat bucket-major shards (one
+    # leaf per bucket, sharded over 'data'); everything else keeps the
+    # tree-shaped state.  The tree optimizer masks ZeRO-1 vars (and frozen
+    # vars) to zero updates / no state — the 1/N state memory win.
+    name_leaves = {n: jnp.asarray(v) for n, v in gi.name_to_leaf().items()}
+    if rs_buckets:
+        frozen = {v.name for v in gi.info.untrainable_variables}
+
+        def label_of(path, _):
+            name = path_name(path)
+            return "zero" if (name in rs_names or name in frozen) \
+                else "train"
+        labels = jax.tree_util.tree_map_with_path(label_of, gi.params)
+        tree_optimizer = optax.multi_transform(
+            {"train": gi.optimizer, "zero": optax.set_to_zero()}, labels)
+        bucket_optimizer = gi.optimizer
+    else:
+        tree_optimizer = gi.frozen_aware_optimizer()
+        bucket_optimizer = None
 
     # Optimizer-state layout: param-shaped blocks follow the effective
     # param spec (shard-local moments for partitioned vars — the real
-    # memory win of keeping the partitioning); scalars replicate.
-    opt_shape = jax.eval_shape(optimizer.init, gi.params)
-    opt_spec_tree = su.opt_spec_tree(opt_shape, gi.params, param_spec_tree)
-    opt_sh_tree = su.sharding_tree(mesh, opt_spec_tree)
+    # memory win of keeping the partitioning); scalars replicate.  ZeRO-1
+    # bucket shards ride a parallel {"zero1": ...} subtree sharded flat
+    # over 'data' (each device owns 1/d of every bucket's moments).
+    tree_opt_shape = jax.eval_shape(tree_optimizer.init, gi.params)
+    tree_opt_spec = su.opt_spec_tree(tree_opt_shape, gi.params,
+                                     param_spec_tree)
 
-    # Trace-time fusion table (reference chunk merge): vars in the same
-    # group are concatenated into ONE pmean.  Split by dtype — a fused
-    # vector must be homogeneous.  Partitioned vars own their per-shard
-    # collective and never fuse.
-    fuse_member: Dict[str, tuple] = {}
-    if d > 1:
-        leaves = gi.name_to_leaf()
-        for group, names in compiled.fusable_groups().items():
-            by_dtype: Dict[str, list] = {}
-            for n in names:
-                # fusable_groups() already excludes partitioned and
-                # compressed vars (strategy/compiler.py); a partitioned
-                # var in a fused group would double-own its collective.
-                assert n not in part, n
-                by_dtype.setdefault(str(jnp.asarray(leaves[n]).dtype),
-                                    []).append(n)
-            for dt, ns in by_dtype.items():
-                if len(ns) >= 2:
-                    for n in ns:
-                        fuse_member[n] = (group, dt)
+    def _bucket_template():
+        return {b.key: jax.ShapeDtypeStruct((b.padded_total,),
+                                            jnp.dtype(b.dtype))
+                for b in rs_buckets}
+
+    def _pack_params_vecs(params):
+        by_name = {path_name(p): x for p, x in
+                   jax.tree_util.tree_flatten_with_path(params)[0]}
+        return {b.key: pack_bucket(b, [by_name[n] for n in b.names])
+                for b in rs_buckets}
+
+    if rs_buckets:
+        template = _bucket_template()
+        z_shape = jax.eval_shape(bucket_optimizer.init, template)
+        z_spec = su.opt_spec_tree(
+            z_shape, template, {b.key: P(MESH_AXIS_DATA)
+                                for b in rs_buckets})
+        opt_spec_tree = {"vars": tree_opt_spec, "zero1": z_spec}
+
+        def init_opt(params):
+            return {"vars": tree_optimizer.init(params),
+                    "zero1": bucket_optimizer.init(_pack_params_vecs(params))}
+    else:
+        opt_spec_tree = tree_opt_spec
+        init_opt = tree_optimizer.init
+    opt_sh_tree = su.sharding_tree(mesh, opt_spec_tree)
 
     def _shard_shape(name: str, leaf) -> tuple:
         shape = list(jnp.asarray(leaf).shape)
@@ -216,12 +321,19 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
         return tuple(shape)
 
     # -- sync state --------------------------------------------------------
-    # Which vars carry state and under which spec, probed abstractly ONCE
-    # (eval_shape — no full-model state is materialized just to test for
-    # None); consumed by both the shard_map specs and init_sync_state.
-    name_leaves = {n: jnp.asarray(v) for n, v in gi.name_to_leaf().items()}
+    # Which vars/buckets carry state and under which spec, probed
+    # abstractly ONCE (eval_shape — no full-model state is materialized
+    # just to test for None); consumed by both the shard_map specs and
+    # init_sync_state.  Bucket-level residuals are keyed by bucket id
+    # (per-bucket error feedback — the EQuARX composition); per-variable
+    # state remains only for partitioned and non-bucketable vars.
     sync_specs: Dict[str, P] = {}
+    sync_builders: Dict[str, Any] = {}
     for name, leaf in name_leaves.items():
+        if name in bucketed_names or name not in comps:
+            continue
+        if compiled.var_plans.get(name) is None and name not in part:
+            continue
         probe = jax.eval_shape(
             comps[name].init_state,
             jax.ShapeDtypeStruct(_shard_shape(name, leaf), leaf.dtype))
@@ -230,12 +342,35 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
         sync_specs[name] = P(MESH_AXIS_DATA,
                              *compiled.var_plans[name].param_spec) \
             if name in part else P(MESH_AXIS_DATA)
+        sync_builders[name] = ("var", name)
+    for b in buckets:
+        comp = get_compressor(b.compressor)
+        probe = jax.eval_shape(
+            comp.init_state,
+            jax.ShapeDtypeStruct((b.padded_total,), jnp.dtype(b.dtype)))
+        if probe is None:
+            continue
+        sync_specs[b.key] = P(MESH_AXIS_DATA)
+        sync_builders[b.key] = ("bucket", b)
 
     def init_sync_state(current_params=None):
         # Compressor residuals start at zero regardless of parameter values,
         # so current_params only matters for shape (identical to capture-time).
         state: Dict[str, Any] = {}
-        for name, spec in sync_specs.items():
+        for key, (kind, ref) in sync_builders.items():
+            spec = sync_specs[key]
+            if kind == "bucket":
+                b = ref
+                per_dev = get_compressor(b.compressor).init_state(
+                    jnp.zeros((b.padded_total,), jnp.dtype(b.dtype)))
+                stacked = jax.tree_util.tree_map(
+                    lambda s: jnp.broadcast_to(s[None],
+                                               (d,) + s.shape).copy(),
+                    per_dev)
+                state[key] = jax.device_put(
+                    stacked, NamedSharding(mesh, spec))
+                continue
+            name = ref
             leaf = name_leaves[name]
             if name in part:
                 # Partitioned state is built THROUGH the compressor's own
@@ -291,14 +426,25 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
             aux = None
 
         flat, treedef = jax.tree_util.tree_flatten_with_path(grads)
+        idx_of = {path_name(path): i for i, (path, _) in enumerate(flat)}
         new_sync = dict(sync_state)
-        synced = [None] * len(flat)
-        fused_parts: Dict[tuple, list] = {}
+        synced = [g for _, g in flat]   # pass-through default (frozen vars)
+
+        def local_state_of(key):
+            st = sync_state.get(key)
+            return None if st is None else jax.tree_util.tree_map(
+                lambda x: jnp.squeeze(x, 0), st)
+
+        def store_state(key, st2):
+            if st2 is not None and key in new_sync:
+                new_sync[key] = jax.tree_util.tree_map(
+                    lambda x: jnp.expand_dims(x, 0), st2)
+
+        # Tier 3: per-variable fallbacks — partitioned per-shard reduction
+        # and non-bucketable compressors (PowerSGD).
         for i, (path, g) in enumerate(flat):
             name = path_name(path)
-            key = fuse_member.get(name)
-            if key is not None:
-                fused_parts.setdefault(key, []).append((i, g))
+            if name in bucketed_names or compiled.var_plans.get(name) is None:
                 continue
             info = part.get(name)
             if info is not None:
@@ -310,33 +456,70 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
                 size = g.shape[ax] // n
                 idx = lax.axis_index(axis_name)
                 g = lax.dynamic_slice_in_dim(g, idx * size, size, ax)
-            st = sync_state.get(name)
-            local_st = None if st is None else jax.tree_util.tree_map(
-                lambda x: jnp.squeeze(x, 0), st)
-            g2, st2 = comps[name].reduce(g, local_st, MESH_AXIS_DATA)
-            if st2 is not None and name in new_sync:
-                new_sync[name] = jax.tree_util.tree_map(
-                    lambda x: jnp.expand_dims(x, 0), st2)
+            g2, st2 = comps[name].reduce(g, local_state_of(name),
+                                         MESH_AXIS_DATA)
+            store_state(name, st2)
             synced[i] = g2
-        # One pmean per fused group: concat raveled grads, reduce, split.
-        for parts in fused_parts.values():
-            vec = jnp.concatenate([jnp.ravel(g) for _, g in parts])
-            vec = lax.pmean(vec, MESH_AXIS_DATA)
-            offset = 0
-            for i, g in parts:
-                size = g.size
-                synced[i] = jnp.reshape(vec[offset:offset + size], g.shape)
-                offset += size
-        grads = jax.tree_util.tree_unflatten(
-            treedef, synced) if synced else grads
+
+        # Tiers 1+2: one collective per bucket.  Each bucket's chain
+        # (pack → collective [→ shard update → all-gather]) depends only
+        # on its own members' gradients, so XLA's scheduler is free to
+        # overlap bucket collectives with other buckets' math and with
+        # backward compute that does not feed them.
+        rs_grad_shards: Dict[str, Any] = {}
+        for b in buckets:
+            comp = get_compressor(b.compressor)
+            vec = pack_bucket(b, [flat[idx_of[n]][1] for n in b.names])
+            if b.mode == MODE_ALL_REDUCE:
+                red, st2 = comp.reduce(vec, local_state_of(b.key),
+                                       MESH_AXIS_DATA)
+                for n, arr in zip(b.names, unpack_bucket(b, red)):
+                    synced[idx_of[n]] = arr
+            else:
+                rs_grad_shards[b.key], st2 = comp.reduce_scatter(
+                    vec, local_state_of(b.key), MESH_AXIS_DATA)
+            store_state(b.key, st2)
+        grads = jax.tree_util.tree_unflatten(treedef, synced)
 
         # Shard-local update: grads, params, and opt state all carry the
         # per-device shard shapes, so elementwise optimizers (SGD, Adam*)
         # update each partition in place.  (An optimizer coupling across
         # parameters — e.g. global-norm clipping — would need its own
         # collectives here; use the GSPMD path for those.)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
+        if rs_buckets:
+            # ZeRO-1: update the local 1/d shard of every reduce-scattered
+            # bucket, then all-gather fresh parameters ("broadcast from
+            # the PS" in reference terms).  Params are replicated inside
+            # the step, so slicing this device's shard is local.
+            shard_idx = lax.axis_index(MESH_AXIS_DATA)
+            by_name = {path_name(p): x for p, x in flat_p}
+            p_shards = {}
+            for b in rs_buckets:
+                vec = pack_bucket(b, [by_name[n] for n in b.names])
+                sz = b.padded_total // d
+                p_shards[b.key] = lax.dynamic_slice_in_dim(
+                    vec, shard_idx * sz, sz, 0)
+            z_updates, z_state = bucket_optimizer.update(
+                rs_grad_shards, opt_state["zero1"], p_shards)
+            new_shards = optax.apply_updates(p_shards, z_updates)
+
+            t_updates, t_state = tree_optimizer.update(
+                grads, opt_state["vars"], params)
+            params = optax.apply_updates(params, t_updates)
+
+            new_flat = [x for _, x in
+                        jax.tree_util.tree_flatten_with_path(params)[0]]
+            for b in rs_buckets:
+                full_vec = lax.all_gather(new_shards[b.key], MESH_AXIS_DATA,
+                                          axis=0, tiled=True)
+                for n, arr in zip(b.names, unpack_bucket(b, full_vec)):
+                    new_flat[idx_of[n]] = arr
+            params = jax.tree_util.tree_unflatten(treedef, new_flat)
+            opt_state = {"vars": t_state, "zero1": z_state}
+        else:
+            updates, opt_state = tree_optimizer.update(grads, opt_state,
+                                                       params)
+            params = optax.apply_updates(params, updates)
         metrics = {"loss": lax.pmean(loss, MESH_AXIS_DATA)}
         if aux is not None:
             metrics["aux"] = jax.tree_util.tree_map(
@@ -359,5 +542,5 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
         check_vma=False)
     step_fn = jax.jit(mapped, donate_argnums=(0, 1, 2))
 
-    init_opt_fn = jax.jit(optimizer.init, out_shardings=opt_sh_tree)
+    init_opt_fn = jax.jit(init_opt, out_shardings=opt_sh_tree)
     return step_fn, init_opt_fn, init_sync_state, param_sh_tree, opt_sh_tree

@@ -18,6 +18,20 @@ moments, and this wrapper matters in two directions:
   finetuning, or as the control arm when measuring the narrow-state
   lever).
 
+**Shard-aware**: the casting rule is deliberately SHAPE-AGNOSTIC — it
+keys on "non-scalar floating leaf", not on matching the parameter tree.
+Under ZeRO-1 weight-update sharding (``Zero1`` / ``sync=
+"reduce_scatter"``) the explicit sync path carries the moments as flat
+bucket-major shards (one 1/N slice of each gradient bucket per device,
+``kernel/synchronization/bucketing.py``), and the update runs on those
+shards only; the same wrapper casts them identically, so the two levers
+MULTIPLY: state bytes/device = full · (1/N) · (1/2).  Elementwise
+casting commutes with the flatten-concat-shard transform, so the
+sharded bf16 update equals the replicated bf16 update exactly.  Scalar
+floating leaves (schedule state, where narrow storage could perturb
+hyperparameters) and integer leaves (step counts — including the
+bucket optimizer's own count) always pass through.
+
 The bias-corrected Adam moments tolerate bf16's 8 mantissa bits well
 (the update divides two same-scale quantities).
 
@@ -28,22 +42,29 @@ Usage::
 
 Composes with every strategy builder (the state tree shape is unchanged
 — only leaf dtypes differ, so sharding specs, checkpoints, and the
-frozen-variable masking all apply as-is).
+frozen-variable masking all apply as-is), including ``Zero1``.
 """
 from __future__ import annotations
+
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
 import optax
 
 
-def _cast_state(tree, to_dtype):
-    """Cast every NON-SCALAR floating leaf (the param-shaped moments) to
-    ``to_dtype``; ints (step counts) and scalar floats (schedule state,
-    where narrow storage could perturb hyperparameters) pass through."""
+def default_cast_rule(leaf) -> bool:
+    """Cast this leaf?  True for every NON-SCALAR floating leaf — the
+    param-shaped moments of the tree layout AND the flat bucket shards
+    of the ZeRO-1 layout; ints (step counts) and scalar floats
+    (schedule state) pass through."""
+    return (hasattr(leaf, "dtype") and getattr(leaf, "ndim", 0) > 0
+            and jnp.issubdtype(leaf.dtype, jnp.floating))
+
+
+def _cast_state(tree, to_dtype, rule: Callable = default_cast_rule):
     def cast(leaf):
-        if (hasattr(leaf, "dtype") and getattr(leaf, "ndim", 0) > 0
-                and jnp.issubdtype(leaf.dtype, jnp.floating)):
+        if rule(leaf):
             return leaf.astype(to_dtype)
         return leaf
 
@@ -51,17 +72,25 @@ def _cast_state(tree, to_dtype):
 
 
 def cast_opt_state(inner: optax.GradientTransformation,
-                   state_dtype=jnp.bfloat16) -> optax.GradientTransformation:
-    """Store ``inner``'s param-shaped floating state leaves in
-    ``state_dtype``; the update computes in f32 regardless."""
+                   state_dtype=jnp.bfloat16, *,
+                   cast_rule: Optional[Callable] = None
+                   ) -> optax.GradientTransformation:
+    """Store ``inner``'s floating state leaves in ``state_dtype``; the
+    update computes in f32 regardless.
+
+    ``cast_rule`` (optional) overrides which leaves are narrowed —
+    ``cast_rule(leaf) -> bool`` on each state leaf; the default is
+    :func:`default_cast_rule` (every non-scalar floating leaf,
+    tree-shaped or bucket-sharded alike)."""
     state_dtype = jnp.dtype(state_dtype)
+    rule = cast_rule or default_cast_rule
 
     def init(params):
-        return _cast_state(inner.init(params), state_dtype)
+        return _cast_state(inner.init(params), state_dtype, rule)
 
     def update(updates, state, params=None):
-        wide = _cast_state(state, jnp.float32)
+        wide = _cast_state(state, jnp.float32, rule)
         new_updates, new_state = inner.update(updates, wide, params)
-        return new_updates, _cast_state(new_state, state_dtype)
+        return new_updates, _cast_state(new_state, state_dtype, rule)
 
     return optax.GradientTransformation(init, update)

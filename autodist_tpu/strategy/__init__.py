@@ -31,6 +31,7 @@ from autodist_tpu.strategy.random_axis_partition_all_reduce_strategy import (
     RandomAxisPartitionAR,
 )
 from autodist_tpu.strategy.uneven_partition_ps_strategy import UnevenPartitionedPS
+from autodist_tpu.strategy.zero1_strategy import Zero1
 
 __all__ = [
     "AllReduce", "AllReduceSynchronizerConfig", "AutoStrategy",
@@ -38,5 +39,6 @@ __all__ = [
     "GraphConfig", "PS", "PSLoadBalancing", "PSSynchronizerConfig", "Parallax",
     "PartitionedAR", "PartitionedPS", "RandomAxisPartitionAR", "Strategy",
     "StrategyBuilder", "StrategyCompiler", "UnevenPartitionedPS", "VarConfig",
-    "VarPlan", "estimate_cost", "parse_partitioner", "rank_strategies",
+    "VarPlan", "Zero1", "estimate_cost", "parse_partitioner",
+    "rank_strategies",
 ]

@@ -31,17 +31,31 @@ class AllReduce(StrategyBuilder):
     itself, which subsumes the reference's scoped-allocator merge.
     ``fused_groups=True``: the step runs on the explicit shard_map path and
     each group's gradients are concatenated into ONE ``pmean`` (verifiably
-    fewer collectives; see tests/test_allreduce_group.py)."""
+    fewer collectives; see tests/test_allreduce_group.py).
+
+    ``sync="reduce_scatter"`` turns on ZeRO-1 weight-update sharding for
+    every variable (see :class:`~autodist_tpu.strategy.Zero1` for the
+    dedicated builder); ``bucket_bytes`` caps the explicit path's
+    dtype-grouped gradient buckets (non-zero forces the explicit path —
+    the way to get trace-time bucketing without a compressor)."""
 
     def __init__(self, chunk_size: int = 128, all_reduce_spec: str = "AUTO",
                  compressor: str = "NoneCompressor",
-                 fused_groups: bool = False):
+                 fused_groups: bool = False, sync: str = "all_reduce",
+                 bucket_bytes: int = 0):
         if chunk_size < 1:
             raise ValueError("chunk_size must be >= 1")
+        from autodist_tpu.kernel.synchronization.bucketing import SYNC_MODES
+        if sync not in SYNC_MODES:
+            raise ValueError(f"sync must be one of {SYNC_MODES}, got {sync!r}")
+        if bucket_bytes < 0:
+            raise ValueError("bucket_bytes must be >= 0")
         self._chunk_size = chunk_size
         self._spec = all_reduce_spec
         self._compressor = compressor
         self._fused = fused_groups
+        self._sync = sync
+        self._bucket_bytes = bucket_bytes
 
     def build(self, graph_item: GraphItem, resource_spec: ResourceSpec) -> Strategy:
         node_config = [
@@ -52,6 +66,8 @@ class AllReduce(StrategyBuilder):
                     compressor=self._compressor,
                     group=i // self._chunk_size,
                     fused=self._fused,
+                    sync=self._sync,
+                    bucket_bytes=self._bucket_bytes,
                 ),
             )
             for i, var in enumerate(graph_item.trainable_var_infos)

@@ -97,7 +97,7 @@ class AutoStrategy(StrategyBuilder):
             from autodist_tpu.strategy import (
                 AllReduce, Parallax, PartitionedAR, PartitionedPS, PS,
                 PSLoadBalancing, RandomAxisPartitionAR,
-                UnevenPartitionedPS)
+                UnevenPartitionedPS, Zero1)
 
             heuristic = AutoStrategy(
                 partition_threshold=self._threshold,
@@ -106,7 +106,8 @@ class AutoStrategy(StrategyBuilder):
                           PartitionedPS(), UnevenPartitionedPS(),
                           AllReduce(chunk_size=self._chunk_size),
                           PartitionedAR(), RandomAxisPartitionAR(),
-                          Parallax()]
+                          Parallax(),
+                          Zero1(compressor=self._compressor)]
         best = None
         pruned = 0
         for builder in candidates:

@@ -50,12 +50,26 @@ class AllReduceSynchronizerConfig:
     (the reference's scoped-allocator chunking, all_reduce_strategy.py:21-90):
     on the GSPMD path it sets XLA's all-reduce combiner threshold; with
     ``fused`` the program routes through the explicit shard_map path where
-    each group is concatenated into ONE ``pmean``."""
+    each group is concatenated into ONE ``pmean``.
+
+    ``sync`` picks the collective lowering of the gradient reduction:
+    ``"all_reduce"`` (default — every replica gets the averaged gradient
+    and applies the update redundantly) or ``"reduce_scatter"`` — ZeRO-1
+    weight-update sharding (arXiv:2004.13336): each gradient bucket is
+    reduce-scattered, the optimizer update runs on the local
+    optimizer-state shard only (state HBM / data-axis size), and fresh
+    parameters are all-gathered.  ``bucket_bytes`` caps the size of the
+    dtype-grouped gradient buckets the explicit path concatenates into
+    one collective (0 = the kernel default,
+    ``bucketing.DEFAULT_BUCKET_BYTES``); any non-zero value routes the
+    program through the explicit shard_map path."""
 
     spec: str = "AUTO"  # AUTO | RING | NCCL (hint only on TPU)
     compressor: str = "NoneCompressor"  # NoneCompressor | HorovodCompressor | HorovodCompressorEF
     group: int = 0
     fused: bool = False  # explicit concat-and-pmean group fusion
+    sync: str = "all_reduce"  # all_reduce | reduce_scatter (ZeRO-1)
+    bucket_bytes: int = 0     # gradient-bucket size cap (0 = default)
 
     kind: str = "AllReduce"
 

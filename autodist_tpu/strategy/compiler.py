@@ -102,6 +102,11 @@ class VarPlan:
     compressor: str = "NoneCompressor"
     group: int = 0
     fused: bool = False                # explicit concat-and-pmean group fusion
+    # AllReduce collective lowering: "all_reduce", or "reduce_scatter" for
+    # ZeRO-1 weight-update sharding (bucketed reduce-scatter + local-shard
+    # update + param all-gather on the explicit path).
+    sync_mode: str = "all_reduce"
+    bucket_bytes: int = 0              # gradient-bucket cap (0 = default)
     reduction_destination: str = ""
     destination_coords: Optional[Dict[str, int]] = None
     staleness: int = 0
@@ -424,6 +429,9 @@ class StrategyCompiler:
                 param_spec=spec, opt_spec=spec, grad_reduce_axes=grad_axes,
                 compressor=sync.compressor, group=sync.group,
                 fused=getattr(sync, "fused", False),
+                sync_mode=getattr(sync, "sync", "all_reduce")
+                or "all_reduce",
+                bucket_bytes=int(getattr(sync, "bucket_bytes", 0) or 0),
                 partition_axis=axis if model_axis else None,
                 num_shards=num_shards if model_axis else 1,
                 sparse=var.sparse,

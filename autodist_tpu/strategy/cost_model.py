@@ -12,9 +12,17 @@ anything.
 Model (standard ring-collective algebra, cf. the scaling-book recipe):
 
 * all-reduce of ``n`` bytes over ``d`` devices moves ``2·(d−1)/d · n``
-  per device (reduce-scatter + all-gather — also exactly the PS/WUS
-  lowering this framework emits, so AR and dense-PS differ in *state
-  placement*, not wire volume);
+  per device, priced as its two legs — reduce-scatter ``(d−1)/d · n``
+  plus all-gather ``(d−1)/d · n`` (``reduce_scatter_bytes`` /
+  ``all_gather_bytes`` / ``allreduce_bytes``) — which is also exactly
+  the PS/WUS lowering this framework emits, so AR and dense-PS differ
+  in *state placement*, not wire volume; ZeRO-1 (``sync=
+  "reduce_scatter"``) pays the RS leg on (compressed) gradients and the
+  AG leg on full-precision params, with update traffic and slots /d;
+* the weight update itself is HBM-bandwidth-bound: ``(1 + slots) ·
+  param bytes`` of state touched per step, divided by ``d`` under any
+  weight-update sharding (PS, ZeRO-1) — the term that separates
+  reduce-scatter mode from all-reduce when wire volumes tie;
 * compressors scale wire bytes (bf16 ½, int8 ¼) on the gradient leg
   (all-gather of fresh params stays full-precision for PS, compressed
   all-reduce applies to both legs);
@@ -60,6 +68,11 @@ from autodist_tpu.utils import logging
 # ICI default ≈ v5e neighbor-link effective bandwidth; override per call.
 ICI_BANDWIDTH = 45e9
 COLLECTIVE_ALPHA = 5e-6
+# Per-chip HBM bandwidth (v5e ≈ 810 GB/s): clocks the optimizer-update
+# memory traffic term — the weight update is bandwidth-bound (read+write
+# params and slots), and weight-update sharding divides it by the
+# data-axis size (the arXiv:2004.13336 win beyond state memory).
+HBM_BANDWIDTH = 8.1e11
 
 # Wire-format scale factors per compressor (vs f32 gradients).
 _COMPRESSOR_SCALE = {
@@ -79,10 +92,11 @@ class VarCost:
     """Per-variable estimate."""
 
     name: str
-    sync: str                    # "allreduce" | "ps" | "ps_sparse"
+    sync: str                    # "allreduce" | "zero1" | "ps" | "ps_sparse"
     wire_bytes: float            # per chip, per step
     opt_state_bytes: float       # per chip (slot tensors)
     group: Optional[int] = None  # AllReduce fusion group, if any
+    update_bytes: float = 0.0    # HBM traffic of this var's weight update
 
 
 @dataclass
@@ -92,6 +106,7 @@ class CostReport:
     per_var: List[VarCost] = field(default_factory=list)
     wire_bytes: float = 0.0
     opt_state_bytes: float = 0.0
+    update_bytes: float = 0.0
     num_collectives: int = 0
     time_s: float = 0.0
 
@@ -104,6 +119,27 @@ class CostReport:
 
 def _ring_factor(d: int) -> float:
     return 2.0 * (d - 1) / d if d > 1 else 0.0
+
+
+# -- per-device ring-collective byte accounting ------------------------------
+# All three are exact for the standard ring/bidirectional algorithms (and
+# what ICI achieves): an all-reduce IS a reduce-scatter followed by an
+# all-gather, so it costs the sum of the two legs — never a flat `bytes`.
+
+def reduce_scatter_bytes(nbytes: float, d: int) -> float:
+    """(d−1)/d · nbytes per device: each device sends all but its own
+    1/d chunk once around the ring."""
+    return (d - 1) / d * nbytes if d > 1 else 0.0
+
+
+def all_gather_bytes(nbytes: float, d: int) -> float:
+    """(d−1)/d · nbytes per device (same ring, data flowing back)."""
+    return (d - 1) / d * nbytes if d > 1 else 0.0
+
+
+def allreduce_bytes(nbytes: float, d: int) -> float:
+    """2·(d−1)/d · nbytes per device = reduce-scatter + all-gather."""
+    return reduce_scatter_bytes(nbytes, d) + all_gather_bytes(nbytes, d)
 
 
 def _shard_count(partitioner: str) -> int:
@@ -138,7 +174,6 @@ def estimate_cost(strategy: Strategy, graph_item: GraphItem,
         the estimate must be reproducible.
     """
     d = max(resource_spec.num_chips, 1)
-    ring = _ring_factor(d)
     # Bandwidth clock per the module docstring; `ici_connected` semantics
     # are defined at ResourceSpec._parse.
     multi_node = (resource_spec.num_nodes > 1
@@ -162,45 +197,69 @@ def estimate_cost(strategy: Strategy, graph_item: GraphItem,
                     "cost model: unknown compressor %r — assuming "
                     "uncompressed wire format", sync.compressor)
                 scale = 1.0
-            wire = ring * nbytes * scale
-            # Sparse under AR densifies first — wire covers the FULL table
-            # (the reason Parallax exists); nbytes already is the table.
-            vc = VarCost(cfg.var_name, "allreduce", wire,
-                         _OPT_SLOTS * nbytes, group=sync.group)
+            mode = getattr(sync, "sync", "all_reduce") or "all_reduce"
+            if mode == "reduce_scatter" and d > 1:
+                # ZeRO-1: the compressed reduce leg moves HALF the
+                # all-reduce volume; fresh params come back through a
+                # full-precision all-gather, and the weight update (and
+                # its slots) is sharded 1/d across the data axis.
+                wire = reduce_scatter_bytes(nbytes * scale, d) \
+                    + all_gather_bytes(nbytes, d)
+                vc = VarCost(cfg.var_name, "zero1", wire,
+                             _OPT_SLOTS * nbytes / d, group=sync.group,
+                             update_bytes=(1 + _OPT_SLOTS) * nbytes / d)
+            else:
+                wire = allreduce_bytes(nbytes, d) * scale
+                # Sparse under AR densifies first — wire covers the FULL
+                # table (the reason Parallax exists); nbytes already is
+                # the table.  The update is replicated: every chip touches
+                # the full parameter + slot bytes.
+                vc = VarCost(cfg.var_name, "allreduce", wire,
+                             _OPT_SLOTS * nbytes, group=sync.group,
+                             update_bytes=(1 + _OPT_SLOTS) * nbytes)
             # Launch latency: a group shares ONE launch when the lowering
-            # fuses it — explicit concat-and-pmean (fused=True), or the
-            # assume_combiner default (XLA's combiner merges same-program
-            # psums on TPU; counted per GROUP as a conservative bound —
-            # see estimate_cost docstring).  Otherwise one per variable.
-            group_fuses = getattr(sync, "fused", False) or assume_combiner
+            # fuses it — explicit concat-and-pmean (fused=True), bucketed
+            # lowering, or the assume_combiner default (XLA's combiner
+            # merges same-program psums on TPU; counted per GROUP as a
+            # conservative bound — see estimate_cost docstring).
+            # Otherwise one per variable.  reduce_scatter mode pays two
+            # launches (RS + param AG) where all-reduce pays one.
+            group_fuses = getattr(sync, "fused", False) or assume_combiner \
+                or getattr(sync, "bucket_bytes", 0) > 0
+            launches = 2 if vc.sync == "zero1" else 1
             if d > 1:
                 if not group_fuses:
-                    report.num_collectives += 1
+                    report.num_collectives += launches
                 elif sync.group not in groups_seen:
                     groups_seen.add(sync.group)
-                    report.num_collectives += 1
+                    report.num_collectives += launches
         elif isinstance(sync, PSSynchronizerConfig):
             shards = max(_shard_count(cfg.partitioner), 1)
             if info.sparse:
                 rows = min(sparse_rows_hint, info.shape[0] or 1)
                 row_bytes = nbytes / max(info.shape[0], 1)
                 # scatter-add of touched rows to owners + gather back.
-                wire = ring * rows * row_bytes
+                wire = reduce_scatter_bytes(rows * row_bytes, d) \
+                    + all_gather_bytes(rows * row_bytes, d)
                 kind = "ps_sparse"
                 opt_bytes = _OPT_SLOTS * nbytes / d  # vocab-sharded slots
+                upd_bytes = (1 + _OPT_SLOTS) * nbytes / d
             else:
                 # reduce-scatter grads + all-gather fresh params = ring
                 # volume.  Slot layout mirrors the compiler's weight-update
                 # sharding (_wus_opt_spec): sharded over the mesh whenever
                 # the partitioner or an evenly-divisible dim allows; tiny
                 # odd variables replicate.
-                wire = ring * nbytes
+                wire = reduce_scatter_bytes(nbytes, d) \
+                    + all_gather_bytes(nbytes, d)
                 kind = "ps"
                 can_shard = shards > 1 or any(
                     s and s % d == 0 for s in info.shape)
-                opt_bytes = _OPT_SLOTS * nbytes / (
-                    d if (d > 1 and can_shard) else 1)
-            vc = VarCost(cfg.var_name, kind, wire, opt_bytes)
+                sharded = d > 1 and can_shard
+                opt_bytes = _OPT_SLOTS * nbytes / (d if sharded else 1)
+                upd_bytes = (1 + _OPT_SLOTS) * nbytes / (d if sharded else 1)
+            vc = VarCost(cfg.var_name, kind, wire, opt_bytes,
+                         update_bytes=upd_bytes)
             if d > 1:
                 report.num_collectives += 2  # RS + AG
         else:
@@ -208,8 +267,15 @@ def estimate_cost(strategy: Strategy, graph_item: GraphItem,
         report.per_var.append(vc)
         report.wire_bytes += vc.wire_bytes
         report.opt_state_bytes += vc.opt_state_bytes
+        report.update_bytes += vc.update_bytes
+    # The weight update is HBM-bandwidth-bound (read params + slots,
+    # write them back): sharded updates (PS/WUS, ZeRO-1) touch 1/d of it
+    # per chip, which is the term that separates reduce-scatter mode from
+    # all-reduce when their wire volumes tie.  Counted only when there is
+    # a distribution decision to make (d > 1).
+    update_s = report.update_bytes / HBM_BANDWIDTH if d > 1 else 0.0
     report.time_s = (report.wire_bytes / bandwidth
-                     + alpha * report.num_collectives)
+                     + alpha * report.num_collectives + update_s)
     return report
 
 
@@ -233,10 +299,12 @@ def rank_strategies(graph_item: GraphItem, resource_spec: ResourceSpec,
             PSLoadBalancing,
             RandomAxisPartitionAR,
             UnevenPartitionedPS,
+            Zero1,
         )
         builders = [PS(), PSLoadBalancing(), PartitionedPS(),
                     UnevenPartitionedPS(), AllReduce(), PartitionedAR(),
-                    RandomAxisPartitionAR(), Parallax(), AutoStrategy()]
+                    RandomAxisPartitionAR(), Parallax(), Zero1(),
+                    AutoStrategy()]
     ranked = []
     for b in builders:
         strat = b.build(graph_item, resource_spec)

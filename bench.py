@@ -193,6 +193,11 @@ def run_child(platform: str) -> None:
     # cost-analysis recompile so a hang there can't lose the metric; the
     # parent takes the LAST valid JSON line.
     mark("resnet50")
+    # Bucketed gradient sync (all_reduce vs reduce_scatter/ZeRO-1): its
+    # own child process with 8 simulated replicas, so it runs — and means
+    # the same thing — on both the TPU path and the CPU fallback.
+    _fill_grad_sync(result)
+    mark("grad_sync")
     _fill_mfu(result, dev, on_tpu, dt, sess, batch)
     if on_tpu:
         # TPU-only like the other enrichments: a projection built on a
@@ -1337,6 +1342,137 @@ def _fill_mfu(result, dev, on_tpu, dt, sess, batch) -> None:
               f"keeping analytic FLOPs", file=sys.stderr, flush=True)
 
 
+def _fill_grad_sync(result) -> None:
+    """Bucketed gradient sync: per-mode (all_reduce vs reduce_scatter)
+    wire bytes, bucket count, optimizer-state bytes/device, and measured
+    step time, on an 8-way SIMULATED replica mesh (virtual CPU devices —
+    collective byte counts are platform-independent facts of the
+    program; step times compare the modes against each other).  Runs in
+    its own child process so the device-count flag cannot disturb the
+    parent's backend."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8").strip()
+    cmd = [sys.executable, "-u", os.path.abspath(__file__),
+           "--grad-sync-child"]
+    try:
+        proc = subprocess.run(cmd, stdout=subprocess.PIPE, env=env,
+                              timeout=600)
+        payload = _extract_json(proc.stdout.decode())
+        if payload is None:
+            raise RuntimeError(f"no JSON from grad-sync child "
+                               f"(rc={proc.returncode})")
+        result["grad_sync"] = payload
+    except Exception as e:  # pragma: no cover - best-effort enrichment
+        print(f"bench: grad_sync section unavailable ({e!r})",
+              file=sys.stderr, flush=True)
+
+
+def run_grad_sync_child() -> None:
+    """The grad_sync measurement (child process, 8 virtual CPU devices)."""
+    _steer("cpu")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    os.environ["AUTODIST_IS_TESTING"] = "True"
+    from autodist_tpu.autodist import AutoDist, \
+        _reset_default_autodist_for_testing
+    from autodist_tpu.kernel.synchronization.explicit_sync import \
+        plan_step_buckets
+    from autodist_tpu.strategy import AllReduce, Zero1
+    from autodist_tpu.strategy.cost_model import (
+        all_gather_bytes,
+        allreduce_bytes,
+        reduce_scatter_bytes,
+    )
+
+    d = jax.device_count()
+    bucket_bytes = 256 << 10
+    rng = np.random.RandomState(0)
+    layers = 6
+    params = {f"l{i}": {"w": jnp.asarray(rng.randn(256, 256) * 0.05,
+                                         jnp.float32),
+                        "b": jnp.zeros(256, jnp.float32)}
+              for i in range(layers)}
+    batch = {"x": rng.randn(64, 256).astype(np.float32),
+             "y": rng.randn(64, 256).astype(np.float32)}
+
+    def loss_fn(p, b):
+        h = b["x"]
+        for i in range(layers):
+            h = jnp.tanh(h @ p[f"l{i}"]["w"] + p[f"l{i}"]["b"])
+        return jnp.mean((h - b["y"]) ** 2)
+
+    def measure(builder):
+        _reset_default_autodist_for_testing()
+        ad = AutoDist(strategy_builder=builder)
+        with ad.scope():
+            ad.capture(params=params, optimizer=optax.adam(1e-3),
+                       loss_fn=loss_fn)
+        sess = ad.create_distributed_session()
+        placed = sess.place_batch(batch)
+        dt = _measure_session(sess, placed, 3, 20)
+        opt_dev_bytes = 0
+        for leaf in jax.tree_util.tree_leaves(sess.opt_state):
+            sh = leaf.addressable_shards[0]
+            opt_dev_bytes += sh.data.size * sh.data.dtype.itemsize
+        compiled = sess._step.compiled_strategy
+        buckets = plan_step_buckets(sess._gi, compiled, {}, d)
+        gi = sess._gi
+        del sess, ad
+        _reset_default_autodist_for_testing()
+        return dt / 20, opt_dev_bytes, buckets, gi, compiled
+
+    grad_bytes = float(sum(np.asarray(leaf).nbytes
+                           for lp in params.values()
+                           for leaf in lp.values()))
+
+    out = {"dp": d, "bucket_bytes": bucket_bytes, "modes": {}}
+    # Analysis memory report: the static per-device optimizer bytes.
+    from autodist_tpu.analysis import analyzer as _an
+    _an._load_passes()   # BEFORE importing memory: a partial registry
+    from autodist_tpu.analysis import memory as _mem                # noqa: E402
+
+    for mode, builder in (
+            ("all_reduce", AllReduce(bucket_bytes=bucket_bytes)),
+            ("reduce_scatter", Zero1(bucket_bytes=bucket_bytes))):
+        step_s, opt_dev, buckets, gi, compiled = measure(builder)
+        if mode == "all_reduce":
+            reduce_leg = allreduce_bytes(grad_bytes, d)
+            gather_leg = 0.0
+        else:
+            reduce_leg = reduce_scatter_bytes(grad_bytes, d)
+            gather_leg = all_gather_bytes(grad_bytes, d)
+        ctx = _an.AnalysisContext(strategy=compiled.strategy,
+                                  graph_item=gi, axes={"data": d})
+        _an.PASS_REGISTRY["legality"](ctx)
+        opt_analysis = _mem._opt_state_bytes(ctx)
+        out["modes"][mode] = {
+            # reduce-path bytes per device per step: the gradient-sync
+            # cost proper (all-reduce = RS+AG of GRADIENTS; ZeRO-1 pays
+            # only the RS leg here and gathers PARAMS instead)
+            "sync_bytes_per_step": round(reduce_leg, 1),
+            "param_gather_bytes_per_step": round(gather_leg, 1),
+            "total_collective_bytes_per_step": round(
+                reduce_leg + gather_leg, 1),
+            "bucket_count": len(buckets),
+            "step_time_ms": round(step_s * 1e3, 3),
+            "opt_state_bytes_per_device": opt_dev,
+            "opt_state_bytes_analysis": round(opt_analysis, 1)
+            if opt_analysis is not None else None,
+        }
+    ar, rs = out["modes"]["all_reduce"], out["modes"]["reduce_scatter"]
+    out["sync_bytes_ratio"] = round(
+        rs["sync_bytes_per_step"] / ar["sync_bytes_per_step"], 4)
+    out["opt_state_ratio"] = round(
+        rs["opt_state_bytes_per_device"] / ar["opt_state_bytes_per_device"],
+        4)
+    print(json.dumps(out), flush=True)
+
+
 def run_probe() -> None:
     """Cheap TPU liveness check: real matmul, real sync."""
     import jax
@@ -1522,6 +1658,8 @@ def main() -> int:
 if __name__ == "__main__":
     if "--child" in sys.argv:
         run_child(sys.argv[sys.argv.index("--child") + 1])
+    elif "--grad-sync-child" in sys.argv:
+        run_grad_sync_child()
     elif "--probe" in sys.argv:
         run_probe()
     else:

@@ -49,7 +49,11 @@ def test_hbm_near_budget_warns():
     budget = int(total_mib * (1 << 20) / 0.95)      # ~95% utilization
     report = analyze(s, gi, mesh=AXES8, budget_bytes=budget)
     assert not report.has_errors()
-    assert [d.rule for d in report.warnings] == ["memory/hbm-near-budget"]
+    rules = [d.rule for d in report.warnings]
+    assert "memory/hbm-near-budget" in rules
+    # near budget + replicated AR optimizer state on a data axis: the
+    # ZeRO-1 advisory fires alongside (see test_zero1_unused_warn).
+    assert set(rules) <= {"memory/hbm-near-budget", "memory/zero1-unused"}
 
 
 def test_hbm_budget_from_resource_spec(gi):

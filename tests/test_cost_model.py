@@ -148,7 +148,8 @@ def test_rank_covers_all_shipped_builders(spec8):
     names = {name for name, _ in rank_strategies(make_gi(), spec8)}
     assert names == {"PS", "PSLoadBalancing", "PartitionedPS",
                      "UnevenPartitionedPS", "AllReduce", "PartitionedAR",
-                     "RandomAxisPartitionAR", "Parallax", "AutoStrategy"}
+                     "RandomAxisPartitionAR", "Parallax", "Zero1",
+                     "AutoStrategy"}
 
 
 def test_rank_strategies_prefers_sparse_aware(spec8):
