@@ -175,6 +175,12 @@ def main(argv=None) -> int:
     parser.add_argument("--budget-gb", type=float, default=None,
                         help="per-device HBM budget in GiB (overrides "
                              "the spec)")
+    parser.add_argument("--overlap", default=None,
+                        help="stamp this overlap schedule mode (auto | "
+                             "none | pipeline | ring | full) onto every "
+                             "AllReduce node before analyzing — lint a "
+                             "schedule request against the mesh "
+                             "(docs/overlap.md)")
     parser.add_argument("--passes", default=None,
                         help="comma-separated subset of passes "
                              "(default: all)")
@@ -226,6 +232,11 @@ def main(argv=None) -> int:
 
     graph_item = _build_graph_item(args.model)
     strategy = _build_strategy(args.strategy, graph_item, resource_spec)
+    if args.overlap:
+        from autodist_tpu.strategy.base import AllReduceSynchronizerConfig
+        for node in strategy.node_config:
+            if isinstance(node.synchronizer, AllReduceSynchronizerConfig):
+                node.synchronizer.overlap = args.overlap
     budget = int(args.budget_gb * (1 << 30)) if args.budget_gb else None
     passes = tuple(p.strip() for p in args.passes.split(",")) \
         if args.passes else None

@@ -69,6 +69,10 @@ class PlanLite:
     sync_mode: str = "all_reduce"
     zero1: bool = False
     bucket_bytes: int = 0
+    # Bucket-collective overlap schedule requested by the strategy
+    # (overlap.OVERLAP_MODES); the sync pass checks it against the mesh
+    # and the program (sync/ring-degenerate, sync/overlap-fallback).
+    overlap: str = "auto"
 
     def physical_shape(self) -> Tuple[int, ...]:
         shape = list(self.var.shape)

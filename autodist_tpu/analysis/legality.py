@@ -250,7 +250,8 @@ def _lower_from_strategy(ctx: AnalysisContext
                 sync_mode=mode,
                 zero1=_zero1_effective(mode, placement, pad,
                                        sync.compressor, d, diags, var),
-                bucket_bytes=int(getattr(sync, "bucket_bytes", 0) or 0))
+                bucket_bytes=int(getattr(sync, "bucket_bytes", 0) or 0),
+                overlap=getattr(sync, "overlap", "auto") or "auto")
         elif isinstance(sync, PSSynchronizerConfig):
             shard_axis = model_axis or (
                 MESH_AXIS_DATA if axis is not None else None)
@@ -395,7 +396,8 @@ def _lower_from_compiled(ctx: AnalysisContext
             sync_mode=mode,
             zero1=_zero1_effective(mode, placement, pad, vp.compressor,
                                    d, diags, var),
-            bucket_bytes=int(getattr(vp, "bucket_bytes", 0) or 0))
+            bucket_bytes=int(getattr(vp, "bucket_bytes", 0) or 0),
+            overlap=getattr(vp, "overlap", "auto") or "auto")
 
     for name, var in known.items():
         if name not in plans:

@@ -19,6 +19,24 @@ default plan and nobody notices.  Rules (docs/analysis.md):
 * ``sync/frozen-var-synced`` (WARN) — a node naming an untrainable
   (frozen) variable: it gets zero updates and no optimizer state, so
   synchronizing it is dead weight.
+
+Overlap-schedule rules (the ``overlap=`` knob, docs/overlap.md; reason
+strings shared with the runtime via
+``kernel.synchronization.overlap.overlap_drop_reason``, the
+``bucket_drop_reason`` pattern):
+
+* ``sync/overlap-unknown`` (ERROR) — ``overlap=`` value outside the
+  mode vocabulary (the builders validate it; hand-built plans land
+  here).
+* ``sync/ring-degenerate`` (ERROR) — ring decomposition
+  (``overlap="ring"``/``"full"``) requested while the data (reduction)
+  axis has size 1: there is no ring to permute over, and the explicit
+  ppermute lowering the request asks for cannot exist.
+* ``sync/overlap-fallback`` (WARN) — an overlap schedule was requested
+  (or ``"auto"`` had a win available) but this variable cannot join it:
+  per-variable fallback path (PowerSGD / partitioned), a quantizing
+  compressor blocking pipelined reduction, or ``overlap="pipeline"``
+  with no microbatch loop (``accum_steps=1``).
 """
 from __future__ import annotations
 
@@ -28,9 +46,59 @@ from autodist_tpu.analysis.analyzer import AnalysisContext, register_pass
 from autodist_tpu.analysis.diagnostics import Diagnostic, Severity, diag
 
 
+def _overlap_rules(ctx: AnalysisContext) -> List[Diagnostic]:
+    from autodist_tpu.const import MESH_AXIS_DATA
+    from autodist_tpu.kernel.synchronization.bucketing import (
+        bucket_drop_reason,
+    )
+    from autodist_tpu.kernel.synchronization import overlap as ov
+
+    diags: List[Diagnostic] = []
+    d = ctx.data_axis_size
+    accum = int(getattr(ctx.graph_item, "accum_steps", 1) or 1)
+    for name, plan in ctx.plans.items():
+        if plan.sync_kind != "AllReduce" or plan.synthesized:
+            continue
+        mode = getattr(plan, "overlap", "auto") or "auto"
+        if mode not in ov.OVERLAP_MODES:
+            diags.append(diag(
+                "sync/overlap-unknown", Severity.ERROR,
+                f"overlap={mode!r} is not a schedule mode; expected one "
+                f"of {ov.OVERLAP_MODES}",
+                var=name, fix="use auto, none, pipeline, ring, or full"))
+            continue
+        if mode in (ov.OVERLAP_RING, ov.OVERLAP_FULL) and d <= 1:
+            diags.append(diag(
+                "sync/ring-degenerate", Severity.ERROR,
+                f"ring decomposition requested (overlap={mode!r}) but "
+                f"the {MESH_AXIS_DATA!r} axis has size {d}: there is no "
+                "ring to permute over — the requested lowering cannot "
+                "exist on this mesh",
+                var=name, location=f"{MESH_AXIS_DATA}={d}",
+                fix="grow the data axis past 1 or drop the ring request"))
+            continue
+        bucketable = bucket_drop_reason(
+            sorted(plan.placement.items()), plan.pad is not None,
+            plan.compressor) is None
+        explicit = ov.explicit_hint(
+            plan.compressor, plan.sync_mode, plan.bucket_bytes,
+            fused=plan.fused, overlap=mode)
+        why = ov.overlap_drop_reason(
+            mode, accum_steps=accum, compressor=plan.compressor,
+            bucketable=bucketable, explicit_path=explicit,
+            dtype=plan.var.dtype)
+        if why is not None:
+            diags.append(diag(
+                "sync/overlap-fallback", Severity.WARN,
+                f"overlap schedule does not apply: {why}",
+                var=name,
+                fix="see docs/overlap.md for what each mode requires"))
+    return diags
+
+
 @register_pass("sync")
 def run(ctx: AnalysisContext) -> List[Diagnostic]:
-    diags: List[Diagnostic] = []
+    diags: List[Diagnostic] = _overlap_rules(ctx)
     gi = ctx.graph_item
     known = {v.name: v for v in gi.info.variables}
     seen: dict = {}

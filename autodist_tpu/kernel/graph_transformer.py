@@ -522,19 +522,29 @@ def _accumulate_grads(vg: Callable, accum: int, has_aux: bool) -> Callable:
     the full-batch mean, and likewise for their gradients.  With
     ``has_aux`` the returned aux is STACKED along a leading [accum] axis.
 
+    A leading dim that does not divide ``accum`` runs UNEVEN tail
+    microbatches: the first ``dim % accum`` microbatches carry one extra
+    row, the loop unrolls (shapes differ per microbatch, so no scan),
+    and every contribution is weighted by its row count — still exactly
+    the full-batch mean for row-mean losses.
+
     On the explicit compressor path this wrapper runs INSIDE shard_map,
     so the leading dim it splits is the device's LOCAL batch slice
-    (global batch / data-axis size) — that is what must divide accum.
+    (global batch / data-axis size) — that is what must divide (or at
+    least reach) accum.
     """
     from jax import lax
 
     def vg_accum(params, batch):
         leaves = jax.tree_util.tree_leaves(batch)
-        for leaf in leaves:
-            if leaf.shape[0] % accum:
-                raise ValueError(
-                    f"batch leading dim {leaf.shape[0]} not divisible "
-                    f"into accum_steps={accum} microbatches")
+        dims = {leaf.shape[0] for leaf in leaves}
+        if len(dims) != 1:
+            raise ValueError(
+                f"batch leaves disagree on the leading dim: {sorted(dims)}")
+        (length,) = dims
+        if length % accum:
+            return _uneven_accumulate(vg, accum, has_aux, params, batch,
+                                      length)
         mbs = jax.tree_util.tree_map(
             lambda x: x.reshape((accum, x.shape[0] // accum) + x.shape[1:]),
             batch)
@@ -568,6 +578,44 @@ def _accumulate_grads(vg: Callable, accum: int, has_aux: bool) -> Callable:
         return loss, grads
 
     return vg_accum
+
+
+def _uneven_accumulate(vg: Callable, accum: int, has_aux: bool,
+                       params, batch, length: int):
+    """Row-weighted accumulation over uneven microbatches (the tail of
+    ``_accumulate_grads``): unrolled because microbatch shapes differ.
+    ``sum_k (rows_k / length) · mean_k`` equals the full-batch mean for
+    row-mean losses, so the trajectory matches the divisible case."""
+    from jax import lax
+
+    from autodist_tpu.kernel.synchronization.overlap import microbatch_slices
+
+    slices = microbatch_slices(length, accum)
+    loss_acc = jax.numpy.float32(0.0)
+    g_acc = None
+    auxs = []
+    for off, rows in slices:
+        mb = jax.tree_util.tree_map(
+            lambda x: lax.dynamic_slice_in_dim(x, off, rows, 0), batch)
+        if has_aux:
+            (loss, aux), g = vg(params, mb)
+            auxs.append(aux)
+        else:
+            loss, g = vg(params, mb)
+        w = rows / length
+        loss_acc = loss_acc + w * loss.astype(jax.numpy.float32)
+        if g_acc is None:
+            g_acc = jax.tree_util.tree_map(
+                lambda x: w * x.astype(jax.numpy.float32), g)
+        else:
+            g_acc = jax.tree_util.tree_map(
+                lambda a, x: a + w * x.astype(jax.numpy.float32), g_acc, g)
+    grads = jax.tree_util.tree_map(
+        lambda a, x: a.astype(x.dtype), g_acc, g)
+    if has_aux:
+        aux = jax.tree_util.tree_map(lambda *xs: jax.numpy.stack(xs), *auxs)
+        return (loss_acc, aux), grads
+    return loss_acc, grads
 
 
 def _merge_metrics(metrics: Dict, extra: Dict) -> Dict:

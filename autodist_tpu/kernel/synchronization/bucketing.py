@@ -78,6 +78,12 @@ class Bucket:
     vars: Tuple[BucketVar, ...]
     total: int           # sum of member sizes (elements, unpadded)
     padded_total: int    # total rounded up to the shard divisor
+    # Plan position (catalog/flatten order) — the scheduling metadata the
+    # overlap scheduler keys on: backward produces gradients roughly in
+    # REVERSE ``order``, so the ZeRO-1 param prefetch issues all-gathers
+    # highest-order-first (``overlap.gather_schedule``) and the
+    # first-needed (lowest-order) params land clear of reduce traffic.
+    order: int = 0
 
     @property
     def names(self) -> Tuple[str, ...]:
@@ -171,13 +177,15 @@ def assign_buckets(entries: Sequence[Tuple[str, Tuple[int, ...], str, str,
         close(bkey)
 
     buckets: List[Bucket] = []
-    for (mode, dtype, compressor, group, idx), members in closed:
+    for order, ((mode, dtype, compressor, group, idx), members) \
+            in enumerate(closed):
         total = sum(v.size for v in members)
         padded = -(-total // d) * d
         buckets.append(Bucket(
             key=f"{mode}:{dtype}:g{group}:{idx}",
             mode=mode, dtype=dtype, compressor=compressor, group=int(group),
-            vars=tuple(members), total=total, padded_total=padded))
+            vars=tuple(members), total=total, padded_total=padded,
+            order=order))
     return buckets
 
 

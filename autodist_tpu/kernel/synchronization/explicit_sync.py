@@ -73,6 +73,7 @@ from autodist_tpu.kernel.synchronization.compressor import (
     Compressor,
     get_compressor,
 )
+from autodist_tpu.kernel.synchronization import overlap as overlap_mod
 from autodist_tpu.strategy.compiler import CompiledStrategy
 from autodist_tpu.utils import compat, logging
 
@@ -82,13 +83,18 @@ def uses_explicit_path(compiled: CompiledStrategy) -> bool:
     bucketing need them too (one concat-and-reduce per bucket — the
     reference's scoped-allocator merge done literally); ZeRO-1
     (reduce-scatter weight-update sharding) owns its whole
-    reduce→update→gather chain."""
+    reduce→update→gather chain, and an explicit ``overlap=`` schedule
+    request needs the schedulable shard_map lowering."""
     for plan in compiled.var_plans.values():
         if plan.compressor not in ("", "NoneCompressor"):
             return True
         if getattr(plan, "sync_mode", "all_reduce") == MODE_REDUCE_SCATTER:
             return True
         if getattr(plan, "bucket_bytes", 0) > 0:
+            return True
+        if getattr(plan, "overlap", "auto") in (
+                overlap_mod.OVERLAP_PIPELINE, overlap_mod.OVERLAP_RING,
+                overlap_mod.OVERLAP_FULL):
             return True
     return (any(plan.fused for plan in compiled.var_plans.values())
             and bool(compiled.fusable_groups()))
@@ -230,15 +236,6 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
     param_sh_tree = su.sharding_tree(mesh, param_spec_tree)
 
     vg = jax.value_and_grad(gi.loss_fn, has_aux=gi.has_aux)
-    if gi.accum_steps > 1:
-        # Gradient accumulation composes with compression exactly where it
-        # matters most (bandwidth-starved links): the f32 accumulator scan
-        # runs INSIDE the shard_map step over the device's LOCAL microbatch
-        # slices, so each bucket still sees ONE averaged gradient — one
-        # compressed collective per bucket per step, N microbatches of
-        # activations.
-        from autodist_tpu.kernel.graph_transformer import _accumulate_grads
-        vg = _accumulate_grads(vg, gi.accum_steps, gi.has_aux)
     has_aux = gi.has_aux
 
     # -- bucket plan -------------------------------------------------------
@@ -255,6 +252,63 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
                 "non-bucketable compressor); falling back to its "
                 "per-variable/per-shard collective with replicated "
                 "optimizer state", name)
+
+    # -- overlap schedule --------------------------------------------------
+    # Resolve the step-level overlap plan (``overlap.py``): which buckets
+    # pipeline with the microbatch loop, which ring-decompose, and the
+    # ZeRO-1 param-gather issue order.  Decisions share one rule set with
+    # the analyzer (`sync/overlap-*`) and the cost model.
+    ov = overlap_mod.resolve_overlap(
+        [getattr(p, "overlap", "auto") or "auto"
+         for p in compiled.var_plans.values()],
+        accum_steps=gi.accum_steps, buckets=buckets, d=d,
+        has_rs=bool(rs_buckets))
+    for key, why in ov.drops:
+        logging.warning(
+            "explicit sync path: overlap scheduling skipped for bucket "
+            "%s (%s)", key, why)
+    overlap_active = (ov.pipeline or ov.prefetch
+                      or ov.mode in (overlap_mod.OVERLAP_PIPELINE,
+                                     overlap_mod.OVERLAP_RING,
+                                     overlap_mod.OVERLAP_FULL))
+    if overlap_active:
+        known_names = set(gi.name_to_leaf())
+        for name, plan in compiled.var_plans.items():
+            if name in bucketed_names or name not in known_names:
+                continue
+            why = overlap_mod.overlap_drop_reason(
+                getattr(plan, "overlap", "auto") or "auto",
+                accum_steps=gi.accum_steps,
+                compressor=plan.compressor or "NoneCompressor",
+                bucketable=False, explicit_path=True)
+            if why is not None:
+                logging.warning(
+                    "explicit sync path: overlap scheduling skipped for "
+                    "%s (%s)", name, why)
+    pipe_buckets = [b for b in buckets
+                    if ov.pipeline
+                    and overlap_mod.pipeline_eligible(b, ov.mode,
+                                                      gi.accum_steps)]
+    pipe_keys = {b.key for b in pipe_buckets}
+    # Mean-reduction lowering per UNCOMPRESSED bucket under the schedule
+    # (ring / one-shot / XLA fused); compressed buckets keep their
+    # compressor's own wire format.
+    reduce_fns = {b.key: overlap_mod.bucket_reduce_fn(
+        b, ov, MESH_AXIS_DATA, d) for b in buckets
+        if overlap_mod.is_linear_compressor(b.compressor)}
+    reduced_sizes = {b.key: (b.padded_total // max(d, 1)
+                             if b.mode == MODE_REDUCE_SCATTER
+                             else b.padded_total) for b in buckets}
+    use_pipeline = bool(pipe_buckets) and gi.accum_steps > 1
+    if gi.accum_steps > 1 and not use_pipeline:
+        # Gradient accumulation composes with compression exactly where it
+        # matters most (bandwidth-starved links): the f32 accumulator scan
+        # runs INSIDE the shard_map step over the device's LOCAL microbatch
+        # slices, so each bucket still sees ONE averaged gradient — one
+        # compressed collective per bucket per step, N microbatches of
+        # activations.
+        from autodist_tpu.kernel.graph_transformer import _accumulate_grads
+        vg = _accumulate_grads(vg, gi.accum_steps, gi.has_aux)
 
     # -- optimizer split ---------------------------------------------------
     # ZeRO-1 vars' optimizer state lives as flat bucket-major shards (one
@@ -419,7 +473,26 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
             full_leaves.append(x)
         full_params = jax.tree_util.tree_unflatten(ptree, full_leaves)
 
-        if has_aux:
+        pipe_reduced: Dict[str, Any] = {}
+        if use_pipeline:
+            # Accumulation pipelining (overlap.py): microbatch k's bucket
+            # collectives are issued alongside microbatch k+1's backward;
+            # only the last microbatch's reduction is exposed.  `grads`
+            # carries the locally averaged tree for the per-variable and
+            # compressed-bucket tiers, whose single end-of-step
+            # collective is unchanged.
+            def single_vg(p, mb):
+                if has_aux:
+                    (loss_, aux_), g_ = vg(p, mb)
+                else:
+                    loss_, g_ = vg(p, mb)
+                    aux_ = None
+                return loss_, aux_, g_
+
+            loss, aux, grads, pipe_reduced = overlap_mod.pipelined_accumulate(
+                single_vg, gi.accum_steps, has_aux, pipe_buckets,
+                reduce_fns, reduced_sizes, full_params, batch)
+        elif has_aux:
             (loss, aux), grads = vg(full_params, batch)
         else:
             loss, grads = vg(full_params, batch)
@@ -465,19 +538,39 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
         # (pack → collective [→ shard update → all-gather]) depends only
         # on its own members' gradients, so XLA's scheduler is free to
         # overlap bucket collectives with other buckets' math and with
-        # backward compute that does not feed them.
+        # backward compute that does not feed them.  Pipelined buckets
+        # arrive already reduced (per microbatch, see above); uncompressed
+        # buckets reduce through the overlap schedule's lowering (ring
+        # decomposition / one-shot / XLA fused collective).
         rs_grad_shards: Dict[str, Any] = {}
         for b in buckets:
-            comp = get_compressor(b.compressor)
+            if b.key in pipe_keys:
+                red = pipe_reduced[b.key]
+                if b.mode == MODE_ALL_REDUCE:
+                    for n, arr in zip(b.names, unpack_bucket(b, red)):
+                        synced[idx_of[n]] = arr
+                else:
+                    rs_grad_shards[b.key] = red
+                continue
             vec = pack_bucket(b, [flat[idx_of[n]][1] for n in b.names])
-            if b.mode == MODE_ALL_REDUCE:
-                red, st2 = comp.reduce(vec, local_state_of(b.key),
-                                       MESH_AXIS_DATA)
-                for n, arr in zip(b.names, unpack_bucket(b, red)):
-                    synced[idx_of[n]] = arr
+            if b.key in reduce_fns:   # uncompressed: schedule-lowered
+                red = reduce_fns[b.key](vec)
+                st2 = None
+                if b.mode == MODE_ALL_REDUCE:
+                    for n, arr in zip(b.names, unpack_bucket(b, red)):
+                        synced[idx_of[n]] = arr
+                else:
+                    rs_grad_shards[b.key] = red
             else:
-                rs_grad_shards[b.key], st2 = comp.reduce_scatter(
-                    vec, local_state_of(b.key), MESH_AXIS_DATA)
+                comp = get_compressor(b.compressor)
+                if b.mode == MODE_ALL_REDUCE:
+                    red, st2 = comp.reduce(vec, local_state_of(b.key),
+                                           MESH_AXIS_DATA)
+                    for n, arr in zip(b.names, unpack_bucket(b, red)):
+                        synced[idx_of[n]] = arr
+                else:
+                    rs_grad_shards[b.key], st2 = comp.reduce_scatter(
+                        vec, local_state_of(b.key), MESH_AXIS_DATA)
             store_state(b.key, st2)
         grads = jax.tree_util.tree_unflatten(treedef, synced)
 
@@ -509,9 +602,19 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
 
             new_flat = [x for _, x in
                         jax.tree_util.tree_flatten_with_path(params)[0]]
-            for b in rs_buckets:
-                full_vec = lax.all_gather(new_shards[b.key], MESH_AXIS_DATA,
-                                          axis=0, tiled=True)
+            # Param prefetch: gathers issue in reverse bucket order (the
+            # last bucket's shard update completes first under the
+            # backward-interleaved schedule), and large buckets
+            # ring-decompose the gather so its legs interleave with the
+            # remaining shard updates.  See overlap.gather_schedule.
+            for b in overlap_mod.gather_schedule(rs_buckets, ov.prefetch):
+                shard = new_shards[b.key]
+                if ov.ring and d > 1 and b.nbytes >= ov.ring_threshold:
+                    full_vec = overlap_mod.ring_all_gather(
+                        shard, MESH_AXIS_DATA, d)
+                else:
+                    full_vec = lax.all_gather(shard, MESH_AXIS_DATA,
+                                              axis=0, tiled=True)
                 for n, arr in zip(b.names, unpack_bucket(b, full_vec)):
                     new_flat[idx_of[n]] = arr
             params = jax.tree_util.tree_unflatten(treedef, new_flat)
@@ -540,7 +643,21 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
                   P(MESH_AXIS_DATA)),
         out_specs=(param_spec_tree, opt_spec_tree, dict(sync_specs), P()),
         check_vma=False)
-    step_fn = jax.jit(mapped, donate_argnums=(0, 1, 2))
+    # Donation audit: params and optimizer state are rewritten every step,
+    # so donating them is always safe.  Sync state is donated ONLY when
+    # every entry is a bucket residual (rewritten unconditionally by the
+    # bucket compressor each step).  Per-variable fallback entries
+    # (partitioned / PowerSGD tier) can pass through a step untouched —
+    # e.g. a compressor that returns its state unchanged — and returning
+    # a donated input aliases a buffer whose old handle (held by a
+    # checkpoint saver or a caller inspecting ``session.sync_state``
+    # across steps) is now marked deleted.  Fallback programs keep their
+    # sync state undonated; its footprint is small (residual tensors for
+    # the handful of vars the buckets could not absorb).
+    donate_sync = all(kind == "bucket"
+                      for kind, _ in sync_builders.values())
+    step_fn = jax.jit(mapped,
+                      donate_argnums=(0, 1, 2) if donate_sync else (0, 1))
 
     init_opt_fn = jax.jit(init_opt, out_shardings=opt_sh_tree)
     return step_fn, init_opt_fn, init_sync_state, param_sh_tree, opt_sh_tree

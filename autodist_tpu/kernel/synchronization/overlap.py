@@ -1,0 +1,526 @@
+"""Overlap-aware scheduling of the explicit bucketed sync path.
+
+PR 2 made gradient buckets the unit of synchronization; this module makes
+them the unit of *scheduling*.  The phase-serial step the explicit path
+used to emit — full backward, then every bucket collective, then the
+update — leaves the interconnect idle during compute and the MXU idle
+during sync.  The MLPerf TPU-v3 report (arXiv:1909.09756) attributes a
+large share of its scaling wins to overlapping gradient summation with
+backprop, and EQuARX (arXiv:2506.17615) argues the collective itself is
+a schedulable program, not one opaque op.  Three mechanisms, selected by
+the ``overlap=`` knob on :class:`AllReduceSynchronizerConfig` /
+:class:`~autodist_tpu.strategy.Zero1` (default ``"auto"``):
+
+1. **Accumulation pipelining** (``"pipeline"``): with gradient
+   accumulation active, the microbatch loop becomes a software pipeline —
+   microbatch *k*'s bucket reduce-scatter/all-reduce is issued in the
+   same loop iteration that computes microbatch *k+1*'s backward, so the
+   two are data-independent and XLA's latency-hiding scheduler runs them
+   concurrently.  Only the LAST microbatch's collective is exposed.
+   Exact (1e-6) for linear reductions: mean-of-means equals the mean, so
+   only ``NoneCompressor`` buckets pipeline; quantizing compressors keep
+   their one-compressed-collective-per-bucket-per-step contract and fall
+   back to the end-of-step reduction (see :func:`overlap_drop_reason`).
+2. **Ring decomposition** (``"ring"``): buckets at or above
+   :data:`RING_THRESHOLD_BYTES` lower their reduce-scatter/all-gather
+   into explicit per-chunk ``ppermute`` ring steps
+   (:func:`ring_reduce_scatter` / :func:`ring_all_gather`), so the
+   scheduler can interleave individual ring legs with pack/unpack and
+   optimizer math instead of seeing one monolithic collective.  Buckets
+   below the threshold use a latency-optimal ONE-SHOT algorithm
+   (single all-gather + local reduction: one launch, no (d−1)-step
+   latency chain) when ring mode is requested explicitly.
+3. **ZeRO-1 param prefetch** (on under ``"auto"``/``"full"``): the
+   post-update parameter all-gather is issued bucket-by-bucket in
+   REVERSE bucket order.  Backward produces gradients last-layer-first,
+   so under the pipelined schedule the LAST bucket's shard update
+   completes first and its gather can start while earlier buckets are
+   still reducing; the first-needed (first-bucket) params then land
+   last-issued-first-complete-free of the reduce traffic, and the tail
+   of the gather overlaps the next step's host→device batch transfer
+   under async dispatch.
+
+``"full"`` enables all three; ``"auto"`` enables whichever applies
+without changing numerics (pipelining when ``accum_steps > 1`` and the
+bucket is uncompressed, ring only for large buckets, prefetch for
+ZeRO-1); ``"none"`` restores the phase-serial PR 2 schedule.
+
+Everything here that *decides* (rather than lowers) is a pure function
+of plan facts — no mesh, no arrays — so the static analyzer
+(``autodist_tpu.analysis``), the cost model, and the runtime share one
+rule and cannot drift (the ``bucket_drop_reason`` pattern).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from autodist_tpu.kernel.synchronization.bucketing import Bucket
+
+#: overlap-mode vocabulary for AllReduce-family plans.
+OVERLAP_AUTO = "auto"
+OVERLAP_NONE = "none"
+OVERLAP_PIPELINE = "pipeline"
+OVERLAP_RING = "ring"
+OVERLAP_FULL = "full"
+OVERLAP_MODES = (OVERLAP_AUTO, OVERLAP_NONE, OVERLAP_PIPELINE,
+                 OVERLAP_RING, OVERLAP_FULL)
+
+#: buckets at or above this byte size ring-decompose (below it, the
+#: (d−1)-step ring latency chain costs more than it hides; a one-shot
+#: gather-and-reduce or XLA's fused collective is latency-optimal).
+RING_THRESHOLD_BYTES = 256 << 10
+
+#: fraction of the ZeRO-1 param all-gather the cost model treats as
+#: hidden behind the next step's input pipeline / forward prologue when
+#: prefetch issue order is active (a ranking constant, not a prediction).
+PREFETCH_OVERLAP_FRACTION = 0.5
+
+_LINEAR_COMPRESSORS = ("", "NoneCompressor")
+
+
+def is_linear_compressor(compressor: str) -> bool:
+    return (compressor or "NoneCompressor") in _LINEAR_COMPRESSORS
+
+
+# -- shared decision rules (pure; consumed by runtime, analysis, cost) -------
+
+def overlap_drop_reason(overlap: str, *, accum_steps: int, compressor: str,
+                        bucketable: bool, explicit_path: bool,
+                        dtype: str = "float32") -> Optional[str]:
+    """Why overlap scheduling does NOT apply to one variable, or None.
+
+    The single eligibility rule shared by the runtime warning, the
+    ``sync/overlap-fallback`` analysis WARN, and the cost model's
+    overlap-aware estimate — same strings everywhere, so the lint can
+    never drift from the lowering (the ``bucket_drop_reason`` pattern).
+
+    ``overlap="none"`` is an explicit opt-out, never a fallback.  Under
+    ``"auto"`` a reason is only returned when an overlap win was
+    plausibly on the table (explicit path, or accumulation active) but a
+    property of THIS variable blocks it — quiet otherwise, so plain
+    GSPMD strategies don't warn.
+    """
+    if overlap not in OVERLAP_MODES:
+        return (f"unknown overlap mode {overlap!r}; expected one of "
+                f"{OVERLAP_MODES}")
+    if overlap == OVERLAP_NONE:
+        return None
+    if not explicit_path:
+        if overlap == OVERLAP_AUTO:
+            return None
+        return ("GSPMD path (no explicit bucketing): set bucket_bytes, a "
+                "compressor, or sync='reduce_scatter' to route the "
+                "program through the schedulable shard_map path")
+    if not bucketable:
+        return ("per-variable fallback path (partitioned or "
+                "non-bucketable compressor, e.g. PowerSGD): its "
+                "collective is issued once at end of step and cannot "
+                "join the overlapped bucket schedule")
+    wants_pipeline = overlap in (OVERLAP_PIPELINE, OVERLAP_FULL) \
+        or (overlap == OVERLAP_AUTO and accum_steps > 1)
+    if wants_pipeline and not is_linear_compressor(compressor):
+        return (f"{compressor} quantizes once per bucket per step; "
+                "per-microbatch pipelined reduction would change the "
+                "wire numerics, so the bucket keeps the end-of-step "
+                "compressed collective")
+    if (overlap == OVERLAP_AUTO and wants_pipeline
+            and np.dtype(dtype) != np.float32):
+        return (f"{np.dtype(dtype).name} bucket: per-microbatch reduction "
+                "adds a low-precision rounding per microbatch; auto keeps "
+                "the end-of-step collective (set overlap='pipeline' or "
+                "'full' to force pipelining)")
+    if overlap == OVERLAP_PIPELINE and accum_steps <= 1:
+        return ("accum_steps=1: there is no microbatch loop to "
+                "pipeline (single-microbatch degenerate case)")
+    return None
+
+
+def pipeline_applies(overlap: str, *, accum_steps: int, compressor: str,
+                     bucketable: bool = True, explicit_path: bool = True,
+                     dtype: str = "float32") -> bool:
+    """Does accumulation pipelining take effect for this variable?"""
+    if overlap not in (OVERLAP_AUTO, OVERLAP_PIPELINE, OVERLAP_FULL):
+        return False
+    if accum_steps <= 1 or not explicit_path or not bucketable:
+        return False
+    return overlap_drop_reason(
+        overlap, accum_steps=accum_steps, compressor=compressor,
+        bucketable=bucketable, explicit_path=explicit_path,
+        dtype=dtype) is None
+
+
+def pipeline_eligible(bucket: Bucket, mode: str, accum_steps: int) -> bool:
+    """Does THIS bucket join the software pipeline under ``mode``?
+    Mirrors :func:`overlap_drop_reason`: linear compressor always
+    required; under ``auto`` only f32 buckets pipeline (per-microbatch
+    reduction of a bf16 bucket adds a low-precision rounding per
+    microbatch), while explicit ``pipeline``/``full`` forces any linear
+    bucket."""
+    if accum_steps <= 1:
+        return False
+    return overlap_drop_reason(
+        mode, accum_steps=accum_steps, compressor=bucket.compressor,
+        bucketable=True, explicit_path=True, dtype=bucket.dtype) is None \
+        and mode in (OVERLAP_AUTO, OVERLAP_PIPELINE, OVERLAP_FULL)
+
+
+def prefetch_applies(overlap: str, *, sync_mode: str,
+                     explicit_path: bool = True) -> bool:
+    """Is the reverse-order ZeRO-1 param all-gather issue order active?"""
+    return (overlap in (OVERLAP_AUTO, OVERLAP_RING, OVERLAP_FULL)
+            and sync_mode == "reduce_scatter" and explicit_path)
+
+
+def explicit_hint(compressor: str, sync_mode: str, bucket_bytes: int,
+                  fused: bool = False, overlap: str = OVERLAP_AUTO) -> bool:
+    """Mirror of ``explicit_sync.uses_explicit_path`` for ONE plan —
+    mesh-free, so the analyzer and cost model can tell whether this
+    variable's sync runs on the schedulable shard_map path."""
+    if (compressor or "NoneCompressor") != "NoneCompressor":
+        return True
+    if sync_mode == "reduce_scatter":
+        return True
+    if int(bucket_bytes or 0) > 0:
+        return True
+    if overlap in (OVERLAP_PIPELINE, OVERLAP_RING, OVERLAP_FULL):
+        return True
+    return bool(fused)
+
+
+@dataclass(frozen=True)
+class OverlapPlan:
+    """The resolved step-level overlap schedule."""
+
+    mode: str                      # the winning knob value
+    pipeline: bool                 # accumulation pipelining active
+    ring: bool                     # ring-decompose large buckets
+    one_shot_small: bool           # small buckets use one-shot gather+reduce
+    prefetch: bool                 # reverse-order ZeRO-1 param all-gather
+    ring_threshold: int = RING_THRESHOLD_BYTES
+    #: per-key (var or bucket) drop reasons, for trace-time warnings.
+    drops: Tuple[Tuple[str, str], ...] = ()
+
+
+def resolve_overlap(modes: Sequence[str], *, accum_steps: int,
+                    buckets: Sequence[Bucket], d: int,
+                    has_rs: bool) -> OverlapPlan:
+    """Resolve the per-plan ``overlap=`` values into one step schedule.
+
+    Precedence: an explicit ``"none"`` anywhere wins (safety opt-out),
+    then the first explicit non-auto mode in plan order, else ``"auto"``.
+    Mechanisms then gate on program facts: pipelining needs
+    ``accum_steps > 1`` and at least one linear (uncompressed) bucket;
+    ring needs a data axis (> 1 device) to permute over; prefetch needs
+    ZeRO-1 buckets.  Explicit ring mode additionally switches
+    below-threshold buckets to the one-shot algorithm (under ``auto``
+    they keep XLA's fused collective, which is already one launch).
+    """
+    explicit = [m for m in modes if m and m != OVERLAP_AUTO]
+    if OVERLAP_NONE in explicit:
+        mode = OVERLAP_NONE
+    elif explicit:
+        mode = explicit[0]
+    else:
+        mode = OVERLAP_AUTO
+
+    drops: List[Tuple[str, str]] = []
+    pipeline = False
+    if mode in (OVERLAP_AUTO, OVERLAP_PIPELINE, OVERLAP_FULL) \
+            and accum_steps > 1:
+        pipeline = any(pipeline_eligible(b, mode, accum_steps)
+                       for b in buckets)
+        for b in buckets:
+            why = overlap_drop_reason(
+                mode, accum_steps=accum_steps, compressor=b.compressor,
+                bucketable=True, explicit_path=True, dtype=b.dtype)
+            if why is not None:
+                drops.append((b.key, why))
+    elif mode == OVERLAP_PIPELINE and accum_steps <= 1:
+        for b in buckets:
+            drops.append((b.key, overlap_drop_reason(
+                OVERLAP_PIPELINE, accum_steps=accum_steps,
+                compressor=b.compressor, bucketable=True,
+                explicit_path=True, dtype=b.dtype)))
+
+    ring = mode in (OVERLAP_AUTO, OVERLAP_RING, OVERLAP_FULL) and d > 1
+    one_shot_small = mode in (OVERLAP_RING, OVERLAP_FULL) and d > 1
+    prefetch = (mode in (OVERLAP_AUTO, OVERLAP_RING, OVERLAP_FULL)
+                and has_rs)
+    return OverlapPlan(mode=mode, pipeline=pipeline, ring=ring,
+                       one_shot_small=one_shot_small, prefetch=prefetch,
+                       drops=tuple((k, w) for k, w in drops if w))
+
+
+def gather_schedule(buckets: Sequence[Bucket],
+                    prefetch: bool) -> List[Bucket]:
+    """ZeRO-1 param all-gather issue order.  With prefetch, reverse
+    bucket order: backward fills buckets last-layer-first, so the
+    highest-``order`` bucket's shard update finishes first and its
+    gather is issued before earlier buckets finish reducing — the
+    first-needed (lowest-order) params then arrive unobstructed by
+    reduce traffic, overlapping the next step's forward prologue."""
+    ordered = sorted(buckets, key=lambda b: b.order)
+    return list(reversed(ordered)) if prefetch else ordered
+
+
+# -- ring-decomposed collectives (trace-time, inside shard_map) --------------
+
+def ring_reduce_scatter(vec, axis_name: str, n: int):
+    """Sum-reduce-scatter of a flat ``vec`` (length divisible by ``n``)
+    as n−1 explicit ``ppermute`` ring steps.
+
+    Device ``r`` ends with ``sum_d chunks_d[r]`` — the same result as
+    ``lax.psum_scatter`` up to floating-point summation order, but as
+    n−1 individually schedulable sends interleaved with n−1 chunk adds,
+    so XLA can slot unrelated compute between the legs.  The partial
+    destined for device ``r`` starts at its right neighbor ``r+1`` and
+    travels the full ring, accumulating each host's contribution.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    if n <= 1:
+        return vec
+    chunks = jnp.reshape(vec, (n, -1))
+    idx = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    acc = jnp.take(chunks, (idx - 1) % n, axis=0)
+    for s in range(1, n):
+        acc = lax.ppermute(acc, axis_name, perm)
+        acc = acc + jnp.take(chunks, (idx - 1 - s) % n, axis=0)
+    return acc
+
+
+def ring_all_gather(shard, axis_name: str, n: int):
+    """All-gather of per-device ``shard``s as n−1 ``ppermute`` ring steps;
+    returns the flat concatenation in device order (what
+    ``lax.all_gather(..., tiled=True)`` produces)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    if n <= 1:
+        return shard
+    idx = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    out = jnp.zeros((n,) + shard.shape, shard.dtype)
+    out = out.at[idx].set(shard)
+    cur = shard
+    for s in range(1, n):
+        cur = lax.ppermute(cur, axis_name, perm)
+        # after s hops rightward, ``cur`` originated at device idx − s
+        out = out.at[(idx - s) % n].set(cur)
+    return jnp.reshape(out, (n * shard.shape[0],) + shard.shape[1:])
+
+
+def ring_all_reduce_mean(vec, axis_name: str, n: int):
+    """Mean all-reduce = ring reduce-scatter + ring all-gather (the
+    standard 2(n−1)-step decomposition, each leg schedulable)."""
+    if n <= 1:
+        return vec
+    shard = ring_reduce_scatter(vec, axis_name, n) / n
+    return ring_all_gather(shard, axis_name, n)
+
+
+def one_shot_all_reduce_mean(vec, axis_name: str, n: int):
+    """Latency-optimal mean all-reduce for SMALL buckets: one all-gather
+    launch + a local reduction.  Moves (n−1)·n/(n·…) ≈ n× the ring's
+    bytes but pays ONE collective latency instead of 2(n−1) ring steps —
+    the right trade below :data:`RING_THRESHOLD_BYTES`."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    if n <= 1:
+        return vec
+    gathered = lax.all_gather(vec, axis_name, axis=0)
+    return jnp.sum(gathered, axis=0) / n
+
+
+def bucket_reduce_fn(bucket: Bucket, plan: OverlapPlan, axis_name: str,
+                     n: int) -> Callable:
+    """The mean-reduction lowering for one UNCOMPRESSED bucket under
+    ``plan``: ring decomposition at/above the threshold, one-shot below
+    it when explicitly requested, XLA's fused collective otherwise.
+    Returns ``vec -> mean(vec)`` for ``all_reduce`` buckets and
+    ``vec -> local shard of mean(vec)`` for ``reduce_scatter`` ones."""
+    from jax import lax
+
+    from autodist_tpu.kernel.synchronization.bucketing import (
+        MODE_REDUCE_SCATTER,
+    )
+
+    rs = bucket.mode == MODE_REDUCE_SCATTER
+    if plan.ring and n > 1 and bucket.nbytes >= plan.ring_threshold:
+        if rs:
+            return lambda v: ring_reduce_scatter(v, axis_name, n) / n
+        return lambda v: ring_all_reduce_mean(v, axis_name, n)
+    if plan.one_shot_small and n > 1 and not rs:
+        return lambda v: one_shot_all_reduce_mean(v, axis_name, n)
+    if rs:
+        return lambda v: lax.psum_scatter(
+            v, axis_name, scatter_dimension=0, tiled=True) / n
+    return lambda v: lax.pmean(v, axis_name)
+
+
+# -- accumulation pipelining (trace-time, inside shard_map) ------------------
+
+def microbatch_slices(length: int, accum: int) -> List[Tuple[int, int]]:
+    """Static ``(offset, rows)`` per microbatch.  Even split when
+    ``accum`` divides ``length``; otherwise the first ``length % accum``
+    microbatches carry one extra row (the uneven tail — every row is
+    consumed exactly once, and contributions are weighted by rows)."""
+    if accum > length:
+        raise ValueError(
+            f"accum_steps={accum} exceeds the local batch rows ({length})")
+    base, rem = divmod(length, accum)
+    sizes = [base + 1] * rem + [base] * (accum - rem)
+    out, off = [], 0
+    for s in sizes:
+        out.append((off, s))
+        off += s
+    return out
+
+
+def pipelined_accumulate(single_vg: Callable, accum: int, has_aux: bool,
+                         pipe_buckets: Sequence[Bucket],
+                         reduce_fns: Dict[str, Callable],
+                         reduced_sizes: Dict[str, int],
+                         params, batch):
+    """Software-pipelined gradient accumulation over ``accum``
+    microbatches: iteration *k* issues the bucket collectives for
+    microbatch *k−1*'s gradients and THEN computes microbatch *k*'s
+    backward — the two are data-independent, so the collective overlaps
+    the backward and only the final microbatch's reduction is exposed.
+
+    Returns ``(loss, aux, grads, reduced)``:
+
+    * ``loss`` — the row-weighted mean microbatch loss (== the full
+      local-batch mean for row-mean losses);
+    * ``aux`` — per-microbatch auxes stacked on a leading [accum] axis
+      (the :func:`_accumulate_grads` contract), or None;
+    * ``grads`` — the row-weighted mean LOCAL gradient tree (consumed by
+      the per-variable fallback tier and compressed buckets — their
+      single end-of-step collective is unchanged);
+    * ``reduced`` — ``{bucket.key: reduced mean vector or shard}`` for
+      every bucket in ``pipe_buckets``, already globally averaged by
+      its ``reduce_fns[key]`` leg.
+
+    Exactness: each ``reduce_fns`` leg is linear (pipelining is gated to
+    uncompressed buckets), so the weighted sum of per-microbatch means
+    equals the mean of the weighted gradient sum — bit-close (summation
+    order) to the sequential accumulate-then-reduce schedule.
+
+    Equal microbatches run as a ``lax.scan`` whose carries (gradient
+    accumulators and the previous microbatch's packed buckets) are
+    donated by XLA's loop buffer reuse; an uneven tail unrolls the loop
+    (shapes differ per microbatch) with the same weighting.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from autodist_tpu.graph_item import path_name
+    from autodist_tpu.kernel.synchronization.bucketing import pack_bucket
+
+    leaves = jax.tree_util.tree_leaves(batch)
+    if not leaves:
+        raise ValueError("pipelined accumulation needs a non-empty batch")
+    length = leaves[0].shape[0]
+    slices = microbatch_slices(length, accum)
+    even = len({rows for _, rows in slices}) == 1
+    weights = [rows / length for _, rows in slices]
+
+    def run_vg(mb):
+        loss, aux, grads = single_vg(params, mb)
+        flat, treedef = jax.tree_util.tree_flatten_with_path(grads)
+        by_name = {path_name(p): g for p, g in flat}
+        packed = {b.key: pack_bucket(b, [by_name[n] for n in b.names])
+                  for b in pipe_buckets}
+        return loss, aux, grads, packed
+
+    def f32(tree):
+        return jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.float32), tree)
+
+    def add_scaled(acc, tree, w):
+        return jax.tree_util.tree_map(
+            lambda a, x: a + w * x.astype(jnp.float32), acc, tree)
+
+    def reduce_packed(packed):
+        return {k: reduce_fns[k](v) for k, v in packed.items()}
+
+    off0, rows0 = slices[0]
+    mb0 = jax.tree_util.tree_map(
+        lambda x: lax.dynamic_slice_in_dim(x, off0, rows0, 0), batch)
+    loss0, aux0, g0, packed0 = run_vg(mb0)
+
+    g_shapes = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), g0)
+    loss_acc = weights[0] * loss0.astype(jnp.float32)
+    g_acc = add_scaled(jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, jnp.float32), g_shapes), g0, weights[0])
+    red_acc = {b.key: jnp.zeros((reduced_sizes[b.key],), jnp.float32)
+               for b in pipe_buckets}
+    auxes = [aux0] if has_aux else None
+
+    if even and accum > 1:
+        w = weights[0]  # all equal
+        mbs = jax.tree_util.tree_map(
+            lambda x: x[rows0:].reshape((accum - 1, rows0) + x.shape[1:]),
+            batch)
+
+        def body(carry, mb):
+            loss_a, g_a, red_a, prev = carry
+            # the collective for the PREVIOUS microbatch's buckets: no
+            # data dependence on this microbatch's backward below, so
+            # the scheduler overlaps them.
+            red = reduce_packed(prev)
+            red_a = {k: red_a[k] + w * red[k].astype(jnp.float32)
+                     for k in red_a}
+            loss, aux, g, packed = run_vg(mb)
+            loss_a = loss_a + w * loss.astype(jnp.float32)
+            g_a = add_scaled(g_a, g, w)
+            return (loss_a, g_a, red_a, packed), aux
+
+        (loss_acc, g_acc, red_acc, prev), scanned = lax.scan(
+            body, (loss_acc, g_acc, red_acc, packed0), mbs)
+        red = reduce_packed(prev)  # the one exposed reduction
+        red_acc = {k: red_acc[k] + w * red[k].astype(jnp.float32)
+                   for k in red_acc}
+        if has_aux:
+            aux = jax.tree_util.tree_map(
+                lambda a, rest: jnp.concatenate([a[None], rest]),
+                aux0, scanned)
+        else:
+            aux = None
+    else:
+        prev, prev_w = packed0, weights[0]
+        for k in range(1, accum):
+            red = reduce_packed(prev)
+            red_acc = {key: red_acc[key] + prev_w * red[key].astype(
+                jnp.float32) for key in red_acc}
+            off, rows = slices[k]
+            mb = jax.tree_util.tree_map(
+                lambda x: lax.dynamic_slice_in_dim(x, off, rows, 0), batch)
+            loss, aux_k, g, packed = run_vg(mb)
+            loss_acc = loss_acc + weights[k] * loss.astype(jnp.float32)
+            g_acc = add_scaled(g_acc, g, weights[k])
+            prev, prev_w = packed, weights[k]
+            if has_aux:
+                auxes.append(aux_k)
+        red = reduce_packed(prev)
+        red_acc = {key: red_acc[key] + prev_w * red[key].astype(jnp.float32)
+                   for key in red_acc}
+        if has_aux:
+            aux = jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *auxes)
+        else:
+            aux = None
+
+    grads = jax.tree_util.tree_map(
+        lambda g, s: g.astype(s.dtype), g_acc, g_shapes)
+    reduced = {b.key: red_acc[b.key].astype(np.dtype(b.dtype))
+               for b in pipe_buckets}
+    return loss_acc, aux, grads, reduced

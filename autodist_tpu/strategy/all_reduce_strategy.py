@@ -37,17 +37,24 @@ class AllReduce(StrategyBuilder):
     every variable (see :class:`~autodist_tpu.strategy.Zero1` for the
     dedicated builder); ``bucket_bytes`` caps the explicit path's
     dtype-grouped gradient buckets (non-zero forces the explicit path —
-    the way to get trace-time bucketing without a compressor)."""
+    the way to get trace-time bucketing without a compressor).
+
+    ``overlap`` picks the bucket-collective schedule (``docs/overlap.md``):
+    ``"auto"`` | ``"none"`` | ``"pipeline"`` | ``"ring"`` | ``"full"``."""
 
     def __init__(self, chunk_size: int = 128, all_reduce_spec: str = "AUTO",
                  compressor: str = "NoneCompressor",
                  fused_groups: bool = False, sync: str = "all_reduce",
-                 bucket_bytes: int = 0):
+                 bucket_bytes: int = 0, overlap: str = "auto"):
         if chunk_size < 1:
             raise ValueError("chunk_size must be >= 1")
         from autodist_tpu.kernel.synchronization.bucketing import SYNC_MODES
+        from autodist_tpu.kernel.synchronization.overlap import OVERLAP_MODES
         if sync not in SYNC_MODES:
             raise ValueError(f"sync must be one of {SYNC_MODES}, got {sync!r}")
+        if overlap not in OVERLAP_MODES:
+            raise ValueError(
+                f"overlap must be one of {OVERLAP_MODES}, got {overlap!r}")
         if bucket_bytes < 0:
             raise ValueError("bucket_bytes must be >= 0")
         self._chunk_size = chunk_size
@@ -56,6 +63,7 @@ class AllReduce(StrategyBuilder):
         self._fused = fused_groups
         self._sync = sync
         self._bucket_bytes = bucket_bytes
+        self._overlap = overlap
 
     def build(self, graph_item: GraphItem, resource_spec: ResourceSpec) -> Strategy:
         node_config = [
@@ -68,6 +76,7 @@ class AllReduce(StrategyBuilder):
                     fused=self._fused,
                     sync=self._sync,
                     bucket_bytes=self._bucket_bytes,
+                    overlap=self._overlap,
                 ),
             )
             for i, var in enumerate(graph_item.trainable_var_infos)

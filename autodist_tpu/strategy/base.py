@@ -62,7 +62,16 @@ class AllReduceSynchronizerConfig:
     dtype-grouped gradient buckets the explicit path concatenates into
     one collective (0 = the kernel default,
     ``bucketing.DEFAULT_BUCKET_BYTES``); any non-zero value routes the
-    program through the explicit shard_map path."""
+    program through the explicit shard_map path.
+
+    ``overlap`` schedules the bucket collectives against compute
+    (``kernel/synchronization/overlap.py``): ``"auto"`` (default) turns
+    on whatever overlaps without changing numerics — accumulation
+    pipelining when ``accum_steps > 1`` and the bucket is uncompressed,
+    ring decomposition for large buckets, reverse-order ZeRO-1 param
+    prefetch; ``"pipeline"`` / ``"ring"`` request one mechanism,
+    ``"full"`` all of them, ``"none"`` the phase-serial schedule.  A
+    non-default value routes the program through the explicit path."""
 
     spec: str = "AUTO"  # AUTO | RING | NCCL (hint only on TPU)
     compressor: str = "NoneCompressor"  # NoneCompressor | HorovodCompressor | HorovodCompressorEF
@@ -70,6 +79,7 @@ class AllReduceSynchronizerConfig:
     fused: bool = False  # explicit concat-and-pmean group fusion
     sync: str = "all_reduce"  # all_reduce | reduce_scatter (ZeRO-1)
     bucket_bytes: int = 0     # gradient-bucket size cap (0 = default)
+    overlap: str = "auto"     # auto | none | pipeline | ring | full
 
     kind: str = "AllReduce"
 

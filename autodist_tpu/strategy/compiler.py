@@ -107,6 +107,9 @@ class VarPlan:
     # update + param all-gather on the explicit path).
     sync_mode: str = "all_reduce"
     bucket_bytes: int = 0              # gradient-bucket cap (0 = default)
+    # Bucket-collective schedule (overlap.OVERLAP_MODES): how the explicit
+    # path overlaps this var's sync with compute — see docs/overlap.md.
+    overlap: str = "auto"
     reduction_destination: str = ""
     destination_coords: Optional[Dict[str, int]] = None
     staleness: int = 0
@@ -432,6 +435,7 @@ class StrategyCompiler:
                 sync_mode=getattr(sync, "sync", "all_reduce")
                 or "all_reduce",
                 bucket_bytes=int(getattr(sync, "bucket_bytes", 0) or 0),
+                overlap=getattr(sync, "overlap", "auto") or "auto",
                 partition_axis=axis if model_axis else None,
                 num_shards=num_shards if model_axis else 1,
                 sparse=var.sparse,

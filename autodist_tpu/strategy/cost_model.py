@@ -97,6 +97,10 @@ class VarCost:
     opt_state_bytes: float       # per chip (slot tensors)
     group: Optional[int] = None  # AllReduce fusion group, if any
     update_bytes: float = 0.0    # HBM traffic of this var's weight update
+    # Wire bytes the overlap schedule hides behind compute (accumulation
+    # pipelining on the reduce leg, ZeRO-1 prefetch on the gather leg) —
+    # the rest is EXPOSED on the step critical path.
+    hidden_bytes: float = 0.0
 
 
 @dataclass
@@ -109,9 +113,20 @@ class CostReport:
     update_bytes: float = 0.0
     num_collectives: int = 0
     time_s: float = 0.0
+    # Wire bytes left on the critical path after the overlap schedule
+    # (== wire_bytes when nothing overlaps).
+    exposed_wire_bytes: float = 0.0
+
+    @property
+    def overlap_fraction(self) -> float:
+        """Fraction of wire traffic the schedule hides behind compute."""
+        if self.wire_bytes <= 0:
+            return 0.0
+        return 1.0 - self.exposed_wire_bytes / self.wire_bytes
 
     def summary(self) -> str:
-        return (f"wire {self.wire_bytes / 1e6:.2f} MB/step/chip over "
+        return (f"wire {self.wire_bytes / 1e6:.2f} MB/step/chip "
+                f"({self.exposed_wire_bytes / 1e6:.2f} MB exposed) over "
                 f"{self.num_collectives} collectives, opt-state "
                 f"{self.opt_state_bytes / 1e6:.2f} MB/chip, "
                 f"est {self.time_s * 1e3:.3f} ms sync time")
@@ -153,13 +168,29 @@ def estimate_cost(strategy: Strategy, graph_item: GraphItem,
                   sparse_rows_hint: int = 4096,
                   ici_bandwidth: float = ICI_BANDWIDTH,
                   alpha: float = COLLECTIVE_ALPHA,
-                  assume_combiner: bool = True) -> CostReport:
+                  assume_combiner: bool = True,
+                  compute_time_s: float = 0.0) -> CostReport:
     """Estimate one strategy's per-step sync cost on ``resource_spec``.
+
+    Overlap-aware: per-variable ``overlap=`` schedules (the knob on
+    ``AllReduceSynchronizerConfig``; shared rules in
+    ``kernel.synchronization.overlap``) move wire bytes from the EXPOSED
+    to the HIDDEN column — accumulation pipelining hides
+    ``(accum−1)/accum`` of the gradient reduce leg behind the microbatch
+    backward, ZeRO-1 prefetch hides ``PREFETCH_OVERLAP_FRACTION`` of the
+    param all-gather behind the next step's prologue — and the estimate
+    becomes ``max(compute, exposed_comm) + update`` instead of the plain
+    additive sum, so a pipelined mode prices correctly against an
+    unpipelined one.  Ring decomposition is a latency-shape change, not
+    a byte change, and is priced neutrally.  ``accum_steps`` is read off
+    ``graph_item``.
 
     Args:
       sparse_rows_hint: rows a batch touches in each sparse variable (an
         upper bound: capped at the vocab size); the model cannot know the
         batch, so callers with real input stats should pass them.
+      compute_time_s: optional per-step compute time (0.0 = unknown):
+        the floor the exposed communication is maxed against.
       assume_combiner: when True (default), AllReduce variables sharing a
         strategy group are costed as ONE collective launch — the TPU
         reality, where XLA's all-reduce combiner merges same-program
@@ -181,6 +212,9 @@ def estimate_cost(strategy: Strategy, graph_item: GraphItem,
     dcn = resource_spec.network_bandwidth_gbps * 1e9 / 8
     bandwidth = min(ici_bandwidth, dcn) if multi_node else ici_bandwidth
 
+    from autodist_tpu.kernel.synchronization import overlap as ov
+
+    accum = int(getattr(graph_item, "accum_steps", 1) or 1)
     report = CostReport()
     groups_seen = set()
     infos = {v.name: v for v in graph_item.trainable_var_infos}
@@ -198,25 +232,51 @@ def estimate_cost(strategy: Strategy, graph_item: GraphItem,
                     "uncompressed wire format", sync.compressor)
                 scale = 1.0
             mode = getattr(sync, "sync", "all_reduce") or "all_reduce"
+            # Overlap schedule: which legs leave the critical path.  The
+            # eligibility rules are the runtime's own (overlap.py), keyed
+            # on the SAME knob — `bucketable` approximated by the absence
+            # of a partitioner (partitioned vars ride the per-variable
+            # fallback and never join the overlapped bucket schedule).
+            ov_mode = getattr(sync, "overlap", "auto") or "auto"
+            bucketable = not cfg.partitioner
+            explicit = ov.explicit_hint(
+                sync.compressor, mode,
+                getattr(sync, "bucket_bytes", 0),
+                fused=getattr(sync, "fused", False), overlap=ov_mode)
+            pipelined = ov.pipeline_applies(
+                ov_mode, accum_steps=accum, compressor=sync.compressor,
+                bucketable=bucketable, explicit_path=explicit,
+                dtype=info.dtype)
+            hidden = 0.0
             if mode == "reduce_scatter" and d > 1:
                 # ZeRO-1: the compressed reduce leg moves HALF the
                 # all-reduce volume; fresh params come back through a
                 # full-precision all-gather, and the weight update (and
                 # its slots) is sharded 1/d across the data axis.
-                wire = reduce_scatter_bytes(nbytes * scale, d) \
-                    + all_gather_bytes(nbytes, d)
+                reduce_leg = reduce_scatter_bytes(nbytes * scale, d)
+                gather_leg = all_gather_bytes(nbytes, d)
+                wire = reduce_leg + gather_leg
+                if pipelined:
+                    hidden += reduce_leg * (accum - 1) / accum
+                if bucketable and ov.prefetch_applies(
+                        ov_mode, sync_mode=mode, explicit_path=explicit):
+                    hidden += gather_leg * ov.PREFETCH_OVERLAP_FRACTION
                 vc = VarCost(cfg.var_name, "zero1", wire,
                              _OPT_SLOTS * nbytes / d, group=sync.group,
-                             update_bytes=(1 + _OPT_SLOTS) * nbytes / d)
+                             update_bytes=(1 + _OPT_SLOTS) * nbytes / d,
+                             hidden_bytes=hidden)
             else:
                 wire = allreduce_bytes(nbytes, d) * scale
                 # Sparse under AR densifies first — wire covers the FULL
                 # table (the reason Parallax exists); nbytes already is
                 # the table.  The update is replicated: every chip touches
                 # the full parameter + slot bytes.
+                if pipelined:
+                    hidden += wire * (accum - 1) / accum
                 vc = VarCost(cfg.var_name, "allreduce", wire,
                              _OPT_SLOTS * nbytes, group=sync.group,
-                             update_bytes=(1 + _OPT_SLOTS) * nbytes)
+                             update_bytes=(1 + _OPT_SLOTS) * nbytes,
+                             hidden_bytes=hidden)
             # Launch latency: a group shares ONE launch when the lowering
             # fuses it — explicit concat-and-pmean (fused=True), bucketed
             # lowering, or the assume_combiner default (XLA's combiner
@@ -266,6 +326,7 @@ def estimate_cost(strategy: Strategy, graph_item: GraphItem,
             continue
         report.per_var.append(vc)
         report.wire_bytes += vc.wire_bytes
+        report.exposed_wire_bytes += vc.wire_bytes - vc.hidden_bytes
         report.opt_state_bytes += vc.opt_state_bytes
         report.update_bytes += vc.update_bytes
     # The weight update is HBM-bandwidth-bound (read params + slots,
@@ -273,9 +334,16 @@ def estimate_cost(strategy: Strategy, graph_item: GraphItem,
     # per chip, which is the term that separates reduce-scatter mode from
     # all-reduce when their wire volumes tie.  Counted only when there is
     # a distribution decision to make (d > 1).
+    #
+    # Overlap-aware aggregation: only the EXPOSED wire sits on the step
+    # critical path; hidden bytes ride behind compute, so the step pays
+    # max(compute, exposed comm) — with no compute hint (0.0) the max
+    # degrades to the exposed-comm time, and with no overlap the whole
+    # formula degrades to the PR 2 additive estimate.
     update_s = report.update_bytes / HBM_BANDWIDTH if d > 1 else 0.0
-    report.time_s = (report.wire_bytes / bandwidth
-                     + alpha * report.num_collectives + update_s)
+    comm_s = (report.exposed_wire_bytes / bandwidth
+              + alpha * report.num_collectives)
+    report.time_s = max(compute_time_s, comm_s) + update_s
     return report
 
 

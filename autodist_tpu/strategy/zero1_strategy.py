@@ -53,18 +53,31 @@ class Zero1(StrategyBuilder):
         Defaults high so ``bucket_bytes`` is the binding constraint.
       compressor: optional per-bucket gradient compressor for the
         reduce-scatter leg.
+      overlap: bucket-collective schedule (``docs/overlap.md``) —
+        ``"auto"`` (default) pipelines the reduce-scatter with the
+        microbatch loop when gradient accumulation is active,
+        ring-decomposes large buckets, and issues the param all-gather
+        in reverse bucket order (prefetch); ``"none"`` restores the
+        phase-serial schedule; ``"pipeline"``/``"ring"``/``"full"``
+        request mechanisms explicitly.
     """
 
     def __init__(self, bucket_bytes: int = DEFAULT_BUCKET_BYTES,
                  chunk_size: int = 512,
-                 compressor: str = "NoneCompressor"):
+                 compressor: str = "NoneCompressor",
+                 overlap: str = "auto"):
+        from autodist_tpu.kernel.synchronization.overlap import OVERLAP_MODES
         if bucket_bytes < 1:
             raise ValueError("bucket_bytes must be >= 1")
         if chunk_size < 1:
             raise ValueError("chunk_size must be >= 1")
+        if overlap not in OVERLAP_MODES:
+            raise ValueError(
+                f"overlap must be one of {OVERLAP_MODES}, got {overlap!r}")
         self._bucket_bytes = bucket_bytes
         self._chunk_size = chunk_size
         self._compressor = compressor
+        self._overlap = overlap
 
     def build(self, graph_item: GraphItem,
               resource_spec: ResourceSpec) -> Strategy:
@@ -76,6 +89,7 @@ class Zero1(StrategyBuilder):
                     group=i // self._chunk_size,
                     sync="reduce_scatter",
                     bucket_bytes=self._bucket_bytes,
+                    overlap=self._overlap,
                 ),
             )
             for i, var in enumerate(graph_item.trainable_var_infos)

@@ -90,14 +90,27 @@ def test_accumulation_with_sparse_and_ps():
         p4, p1)
 
 
-def test_accumulation_indivisible_batch_rejected():
+def test_accumulation_uneven_tail_matches_full_batch():
+    """32 rows over accum_steps=5: the first 32 % 5 = 2 microbatches
+    carry one extra row and every contribution is row-weighted, so the
+    trajectory still equals the full-batch mean (what used to raise
+    'not divisible')."""
+    l1, p1 = _train(PSLoadBalancing(), 1)
+    l5, p5 = _train(PSLoadBalancing(), 5)   # 32 % 5 != 0 -> uneven tail
+    np.testing.assert_allclose(l5, l1, rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7),
+        p5, p1)
+
+
+def test_accumulation_more_microbatches_than_rows_rejected():
     params, loss_fn, batch = _problem()
     ad = AutoDist(strategy_builder=PSLoadBalancing())
     with ad.scope():
         ad.capture(params=params, optimizer=optax.sgd(0.1),
-                   loss_fn=loss_fn, accum_steps=5)   # 32 % 5 != 0
+                   loss_fn=loss_fn, accum_steps=33)   # > 32 rows
     sess = ad.create_distributed_session()
-    with pytest.raises(ValueError, match="not divisible"):
+    with pytest.raises(ValueError, match="exceeds"):
         sess.run(batch)
 
 
@@ -140,19 +153,29 @@ def test_accumulation_composes_with_explicit_compressor_path(
         pa, p1)
 
 
-def test_accumulation_explicit_path_local_divisibility():
+def test_accumulation_explicit_path_uneven_local_slice():
     """Inside shard_map the accumulator splits the LOCAL batch slice
-    (global/8 on the test mesh): 32 rows / 8 devices = 4 local rows do
-    not divide accum_steps=3."""
-    params, loss_fn, batch = _problem()
-    _reset_default_autodist_for_testing()
-    ad = AutoDist(strategy_builder=AllReduce(compressor="HorovodCompressor"))
-    with ad.scope():
-        ad.capture(params=params, optimizer=optax.sgd(0.1),
-                   loss_fn=loss_fn, accum_steps=3)
-    sess = ad.create_distributed_session()
-    with pytest.raises(ValueError, match="not divisible"):
-        sess.run(batch)
+    (global/8 on the test mesh): 32 rows / 8 devices = 4 local rows over
+    accum_steps=3 run as uneven [2, 1, 1]-row microbatches, row-weighted
+    — the trajectory still matches the unaccumulated run."""
+    def run(accum):
+        params, loss_fn, batch = _problem()
+        _reset_default_autodist_for_testing()
+        ad = AutoDist(
+            strategy_builder=AllReduce(compressor="HorovodCompressor"))
+        with ad.scope():
+            ad.capture(params=params, optimizer=optax.sgd(0.1),
+                       loss_fn=loss_fn, accum_steps=accum)
+        sess = ad.create_distributed_session()
+        losses = [float(sess.run(batch)["loss"]) for _ in range(4)]
+        return losses, sess.params
+
+    l1, p1 = run(1)
+    l3, p3 = run(3)
+    np.testing.assert_allclose(l3, l1, rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7),
+        p3, p1)
 
 
 def test_accum_steps_validation():
