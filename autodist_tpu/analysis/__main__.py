@@ -181,6 +181,13 @@ def main(argv=None) -> int:
                              "AllReduce node before analyzing — lint a "
                              "schedule request against the mesh "
                              "(docs/overlap.md)")
+    parser.add_argument("--elastic-from", default=None, metavar="AXES",
+                        help="validate an ELASTIC RESUME: the checkpoint "
+                             "was written at these mesh axes (e.g. "
+                             "data=8) and resumes at --mesh — runs the "
+                             "elastic/* rules plus the normal passes on "
+                             "the new mesh (ring degeneracy re-check, "
+                             "HBM at the new 1/M; docs/resilience.md)")
     parser.add_argument("--passes", default=None,
                         help="comma-separated subset of passes "
                              "(default: all)")
@@ -240,9 +247,11 @@ def main(argv=None) -> int:
     budget = int(args.budget_gb * (1 << 30)) if args.budget_gb else None
     passes = tuple(p.strip() for p in args.passes.split(",")) \
         if args.passes else None
+    elastic = {"from_axes": _parse_mesh(args.elastic_from)} \
+        if args.elastic_from else None
     report = analyze(strategy, graph_item, mesh=axes,
                      resource_spec=resource_spec, budget_bytes=budget,
-                     passes=passes)
+                     passes=passes, elastic=elastic)
 
     if args.json:
         print(json.dumps(report.to_dict(), indent=1))

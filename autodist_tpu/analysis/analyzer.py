@@ -110,6 +110,9 @@ class AnalysisContext:
     budget_bytes: Optional[int] = None
     batch: Any = None                        # pytree of arrays/shapes | None
     plans: Dict[str, PlanLite] = field(default_factory=dict)
+    # Elastic-resume provenance ({"from_axes": {...}, "buckets": [...]})
+    # — enables the elastic/* rules; None outside a resume pre-flight.
+    elastic: Optional[dict] = None
 
     @property
     def data_axis_size(self) -> int:
@@ -158,6 +161,7 @@ def _load_passes() -> None:
         return
     from autodist_tpu.analysis import (  # noqa: F401
         collectives,
+        elastic,
         legality,
         memory,
         precision,
@@ -166,13 +170,16 @@ def _load_passes() -> None:
 
 
 #: canonical pass order: legality first (it builds ctx.plans), then the
-#: coverage/resource/schedule/precision rules over the projection.
-PASS_ORDER = ("legality", "sync", "memory", "collectives", "precision")
+#: coverage/resource/schedule/precision rules over the projection, then
+#: the elastic-resume rules (inert without elastic provenance).
+PASS_ORDER = ("legality", "sync", "memory", "collectives", "precision",
+              "elastic")
 
 
 def analyze(strategy_or_compiled, graph_item: GraphItem, *,
             mesh=None, resource_spec=None, budget_bytes: Optional[int] = None,
-            batch=None, passes: Optional[Tuple[str, ...]] = None
+            batch=None, passes: Optional[Tuple[str, ...]] = None,
+            elastic: Optional[dict] = None
             ) -> AnalysisReport:
     """Run the static pass pipeline and return an :class:`AnalysisReport`.
 
@@ -193,6 +200,11 @@ def analyze(strategy_or_compiled, graph_item: GraphItem, *,
         activation-footprint estimate.
       passes: subset of :data:`PASS_ORDER` to run (e.g. only
         ``("legality", "sync")`` for the auto-strategy candidate pruner).
+      elastic: elastic-resume provenance — ``{"from_axes": {axis: size},
+        "buckets": [...]}`` (the checkpoint's mesh and recorded ZeRO-1
+        bucket layout) — enabling the ``elastic/*`` rules; the rest of
+        the pipeline runs against the NEW mesh, which is exactly the
+        re-check elastic resume needs (ring degeneracy, HBM at 1/M).
     """
     _load_passes()
     strategy, compiled, axes = _resolve_axes(
@@ -202,7 +214,8 @@ def analyze(strategy_or_compiled, graph_item: GraphItem, *,
     ctx = AnalysisContext(strategy=strategy, graph_item=graph_item,
                           axes=axes, compiled=compiled,
                           resource_spec=resource_spec,
-                          budget_bytes=budget_bytes, batch=batch)
+                          budget_bytes=budget_bytes, batch=batch,
+                          elastic=elastic)
     report = AnalysisReport()
     selected = PASS_ORDER if passes is None else tuple(passes)
     for name in selected:

@@ -18,13 +18,27 @@ autodist_meta.json}`` — separate Orbax items so the params-only interchange
 path never reads optimizer slots (~2x the params' bytes under Adam).
 Optimizer slots and per-device synchronizer state (compressor residuals) are
 saved so resume is exact.
+
+Resilience integration (docs/resilience.md):
+
+* ``autodist_meta.json`` records provenance — mesh axes, the data-axis
+  size, and the ZeRO-1 bucket layout — so :meth:`restore` can reshard a
+  flat-sharded optimizer checkpoint across a data-axis resize (elastic
+  resume, ``resilience/elastic.py``), plus per-item content checksums
+  and whatever the caller passes via ``extra_meta`` (``fit`` stores the
+  data-loader position for exact mid-epoch resume).
+* :meth:`verify` checks a step dir for truncation/corruption (shallow:
+  item presence; deep: checksum comparison); :meth:`latest_step` runs
+  the shallow check so a damaged step is skipped, not resumed.
+* ``keep=N`` garbage-collects old ``step_N`` dirs after a durable save.
 """
 from __future__ import annotations
 
 import json
 import os
 import re
-from typing import Any, Optional
+import shutil
+from typing import Any, Dict, List, Optional
 
 import jax
 import numpy as np
@@ -34,6 +48,9 @@ from autodist_tpu.kernel.sharding_utils import abstract_like as _abstract_like
 from autodist_tpu.utils import logging
 
 _STEP_RE = re.compile(r"^step_(\d+)$")
+
+#: autodist_meta schema version (1 = step/has_sync_state only).
+META_FORMAT = 2
 
 
 class Saver:
@@ -45,7 +62,8 @@ class Saver:
     save/restore is also allowed.
     """
 
-    def __init__(self, session=None, async_save: bool = False):
+    def __init__(self, session=None, async_save: bool = False,
+                 keep: Optional[int] = None, checksum: bool = True):
         """``async_save=True`` overlaps checkpoint persistence with
         training: the device→host snapshot is synchronous (so saved values
         are consistent even though the training loop immediately
@@ -53,16 +71,30 @@ class Saver:
         one background commit.  ``wait()`` — or the next save/restore
         through this Saver — blocks until the previous save is durable.
 
+        ``keep=N`` retains only the N newest committed steps: older
+        ``step_M`` dirs are deleted once a newer save is durable (chief
+        process only).  ``checksum=False`` skips the per-item content
+        digests (they cost one extra device→host pass per item; digests
+        are also skipped automatically when shards are not all
+        process-addressable).
+
         Every checkpoint is ONE composite Orbax save (params + opt_state
         [+ sync_state] + meta), committed atomically: a crash mid-save
         leaves no half-checkpoint for :meth:`latest_step` to pick up."""
+        if keep is not None and keep < 1:
+            raise ValueError("keep must be >= 1 (or None to retain all)")
         self._session = session
         self._async = async_save
+        self._keep = keep
+        self._checksum = checksum
+        self._gc_dir: Optional[str] = None
         self._ckptr = ocp.AsyncCheckpointer(ocp.CompositeCheckpointHandler())
 
     def wait(self) -> None:
-        """Block until any in-flight async save is durable on disk."""
+        """Block until any in-flight async save is durable on disk, then
+        apply retention."""
         self._ckptr.wait_until_finished()
+        self._maybe_gc()
 
     # -- helpers -----------------------------------------------------------
     @staticmethod
@@ -70,35 +102,138 @@ class Saver:
         return os.path.join(directory, f"step_{step}")
 
     @staticmethod
-    def latest_step(directory: str) -> Optional[int]:
+    def _committed_steps(directory: str) -> List[int]:
+        """Steps whose composite save committed (the whole save lands in
+        one atomic Orbax commit, so an interrupted async save leaves
+        step_N without the final ``params`` item)."""
         if not os.path.isdir(directory):
-            return None
+            return []
         steps = []
         for name in os.listdir(directory):
             m = _STEP_RE.match(name)
             if not m:
                 continue
-            # Only committed checkpoints count: the whole composite save
-            # (params + opt_state + meta) lands in one atomic Orbax
-            # commit, so an interrupted async save leaves step_N without
-            # the final `params` item — resume falls back to the previous
-            # complete step.
             if os.path.isdir(os.path.join(directory, name, "params")):
                 steps.append(int(m.group(1)))
-        return max(steps) if steps else None
+        return sorted(steps)
+
+    @staticmethod
+    def latest_step(directory: str, verify: bool = True) -> Optional[int]:
+        """Newest step that passes :meth:`verify` (shallow).  A corrupt or
+        truncated step — not just a missing ``params`` dir — is skipped
+        with a warning and resume falls back to the previous good one."""
+        for step in reversed(Saver._committed_steps(directory)):
+            path = Saver._step_dir(directory, step)
+            if not verify or Saver.verify(path):
+                return step
+            logging.warning(
+                "checkpoint %s failed verification — skipping it for "
+                "resume", path)
+        return None
 
     @staticmethod
     def latest_checkpoint(directory: str) -> Optional[str]:
         step = Saver.latest_step(directory)
         return None if step is None else Saver._step_dir(directory, step)
 
+    # -- metadata ----------------------------------------------------------
+    @staticmethod
+    def _read_meta_strict(path: str) -> dict:
+        """The composite ``autodist_meta`` item; raises when it exists but
+        cannot be parsed (corruption — verify turns that into a skip)."""
+        with ocp.Checkpointer(ocp.CompositeCheckpointHandler()) as ckptr:
+            restored = ckptr.restore(
+                os.path.abspath(path),
+                args=ocp.args.Composite(autodist_meta=ocp.args.JsonRestore()))
+        return dict(restored["autodist_meta"])
+
+    @staticmethod
+    def read_meta(path: str) -> dict:
+        """Best-effort checkpoint metadata: the composite item, a legacy
+        plain ``autodist_meta.json``, or a filename-derived step."""
+        try:
+            return Saver._read_meta_strict(path)
+        except Exception:
+            return _read_meta(path)
+
+    # -- integrity ---------------------------------------------------------
+    @staticmethod
+    def verify(path: str, deep: bool = False) -> bool:
+        """Is this step dir a usable checkpoint?
+
+        Shallow (default): every item recorded in the meta exists as a
+        non-empty directory and the meta itself parses — catches
+        interrupted/partially deleted saves.  ``deep=True`` additionally
+        restores each checksummed item to host and compares content
+        digests — catches byte-level truncation/corruption inside item
+        files.  Never raises."""
+        path = os.path.abspath(path)
+        if not os.path.isdir(path):
+            return False
+        if not _nonempty_dir(os.path.join(path, "params")):
+            return False
+        meta_present = os.path.isdir(os.path.join(path, "autodist_meta")) \
+            or os.path.exists(os.path.join(path, "autodist_meta.json"))
+        meta: dict = {}
+        if meta_present:
+            try:
+                meta = Saver._read_meta_strict(path)
+            except Exception:
+                try:
+                    meta = _read_meta(path)
+                except Exception:
+                    return False
+                if not meta:
+                    return False
+        for item in meta.get("items", []):
+            if item == "autodist_meta":
+                continue
+            if not _nonempty_dir(os.path.join(path, item)):
+                logging.warning("checkpoint %s: item %s missing/empty",
+                                path, item)
+                return False
+        if deep:
+            sums = meta.get("checksums") or {}
+            for item, want in sums.items():
+                if want is None:
+                    continue
+                try:
+                    got = _tree_digest(_restore_item_host(path, item))
+                except Exception as e:
+                    logging.warning("checkpoint %s: item %s unreadable "
+                                    "(%s)", path, item, e)
+                    return False
+                if got != want:
+                    logging.warning(
+                        "checkpoint %s: item %s checksum mismatch "
+                        "(%s != %s)", path, item, got, want)
+                    return False
+        return True
+
+    # -- retention ---------------------------------------------------------
+    def _maybe_gc(self) -> None:
+        if self._keep is None or self._gc_dir is None:
+            return
+        try:
+            if jax.process_count() > 1 and jax.process_index() != 0:
+                return   # one process owns the shared directory
+        except Exception:
+            pass
+        steps = self._committed_steps(self._gc_dir)
+        for step in steps[:-self._keep]:
+            victim = self._step_dir(self._gc_dir, step)
+            shutil.rmtree(victim, ignore_errors=True)
+            logging.info("checkpoint retention (keep=%d): removed %s",
+                         self._keep, victim)
+
     # -- save --------------------------------------------------------------
     def save(self, directory: str, step: Optional[int] = None,
-             session=None) -> str:
+             session=None, extra_meta: Optional[dict] = None) -> str:
         session = session or self._session
         if session is None:
             raise ValueError("Saver has no bound session")
         self._ckptr.wait_until_finished()   # one async save in flight max
+        self._maybe_gc()                    # previous save is durable now
         step = session.step_count if step is None else step
         path = self._step_dir(directory, step)
         # LOGICAL layout (pad-to-divisible sharding stripped): checkpoints
@@ -106,18 +241,47 @@ class Saver:
         # mesh topologies regardless of physical padding.
         params_item, opt_item = session.export_state()
         has_sync = bool(jax.tree_util.tree_leaves(session.sync_state))
+        item_names = ["params", "opt_state", "autodist_meta"] \
+            + (["sync_state"] if has_sync else [])
+        meta: Dict[str, Any] = {
+            "step": step, "has_sync_state": has_sync,
+            "format": META_FORMAT, "items": item_names,
+        }
+        try:
+            meta["mesh_axes"] = {str(k): int(v)
+                                 for k, v in dict(session.mesh.shape).items()}
+            meta["data_axis_size"] = int(getattr(session, "data_axis_size",
+                                                 1))
+        except Exception:   # sessions without a mesh (tests, stubs)
+            pass
+        zb = tuple(getattr(session, "zero1_buckets", ()) or ())
+        if zb:
+            # The flat-sharded optimizer layout: what elastic resume needs
+            # to reshard this checkpoint at a different data-axis size.
+            from autodist_tpu.resilience.elastic import bucket_layout
+            meta["zero1_buckets"] = bucket_layout(zb)
+        if self._checksum:
+            sums = {"params": _tree_digest(params_item),
+                    "opt_state": _tree_digest(opt_item)}
+            if has_sync:
+                sums["sync_state"] = _tree_digest(session.sync_state)
+            meta["checksums"] = {k: v for k, v in sums.items()
+                                 if v is not None}
+        if extra_meta:
+            meta.update(extra_meta)
         items = dict(
             params=ocp.args.StandardSave(params_item),
             opt_state=ocp.args.StandardSave(opt_item),
-            autodist_meta=ocp.args.JsonSave(
-                {"step": step, "has_sync_state": has_sync}),
+            autodist_meta=ocp.args.JsonSave(meta),
         )
         if has_sync:
             items["sync_state"] = ocp.args.StandardSave(session.sync_state)
         self._ckptr.save(os.path.abspath(path),
                          args=ocp.args.Composite(**items), force=True)
+        self._gc_dir = directory
         if not self._async:
             self._ckptr.wait_until_finished()
+            self._maybe_gc()
         logging.info("checkpoint %s: %s (step %d)",
                      "saving in background" if self._async else "saved",
                      path, step)
@@ -126,23 +290,49 @@ class Saver:
     # -- restore -----------------------------------------------------------
     def restore(self, path: str, session=None) -> int:
         """Restore params + optimizer state (+ synchronizer state) into the
-        (possibly differently sharded) session; returns the step."""
+        (possibly differently sharded) session; returns the step.
+
+        When the checkpoint's ZeRO-1 bucket layout was written at a
+        different data-axis size, the flat optimizer shards are resliced
+        for this session's axis (elastic resume — exact on the
+        params/opt path; see ``resilience/elastic.py``)."""
         session = session or self._session
         if session is None:
             raise ValueError("Saver has no bound session")
         self._ckptr.wait_until_finished()   # don't read an in-flight save
         path = os.path.abspath(path)
+        meta = self.read_meta(path)
         params_target, opt_target = session.restore_targets()
+
+        elastic = None
+        old_layout = meta.get("zero1_buckets") or []
+        new_buckets = tuple(getattr(session, "zero1_buckets", ()) or ())
+        if old_layout and new_buckets:
+            from autodist_tpu.resilience import elastic as elastic_mod
+            mismatch = elastic_mod.layout_mismatch(old_layout, new_buckets)
+            if mismatch:
+                raise elastic_mod.ElasticResumeError(
+                    f"cannot resume {path}: {mismatch}; elastic resume "
+                    "requires the same bucket membership (same "
+                    "bucket_bytes / variable catalog) at any axis size")
+            if elastic_mod.needs_reshard(old_layout, new_buckets):
+                elastic = elastic_mod
+                opt_target = elastic_mod.old_shaped_opt_target(
+                    opt_target, old_layout, new_buckets, session.mesh)
+
         restored = self._ckptr.restore(path, args=ocp.args.Composite(
             params=ocp.args.StandardRestore(params_target),
             opt_state=ocp.args.StandardRestore(opt_target)))
         params, opt_state = restored["params"], restored["opt_state"]
-        try:
-            meta = self._ckptr.restore(path, args=ocp.args.Composite(
-                autodist_meta=ocp.args.JsonRestore()))["autodist_meta"]
-        except Exception:
-            meta = None   # pre-composite checkpoint: meta is a plain file
-        meta = meta or _read_meta(path)
+        if elastic is not None:
+            opt_state = elastic.reshard_opt_state(opt_state, old_layout,
+                                                  session)
+            logging.info(
+                "elastic resume: resliced %d ZeRO-1 optimizer bucket(s) "
+                "from data-axis %s to %s (exact — only zero padding "
+                "changed)", len(old_layout),
+                meta.get("data_axis_size", "?"),
+                getattr(session, "data_axis_size", "?"))
         sync_state = None
         if meta.get("has_sync_state") and \
                 jax.tree_util.tree_leaves(session.sync_state):
@@ -173,23 +363,7 @@ class Saver:
         consume the result of any distributed run, on ANY topology (a
         single TPU chip can read a checkpoint written by a 64-chip mesh).
         Reads only the params item, never the optimizer slots."""
-        path = os.path.abspath(os.path.join(path, "params"))
-        ckptr = ocp.StandardCheckpointer()
-        # Restoring without a target replays the original device topology,
-        # which breaks across machines; build a replicated-on-current-devices
-        # target from the checkpoint's own shape/dtype metadata instead.
-        # Modern orbax wraps the tree in .item_metadata; older versions
-        # return the metadata tree directly.
-        meta = ckptr.metadata(path)
-        meta = getattr(meta, "item_metadata", meta)
-        meta = getattr(meta, "tree", meta)
-        dev = jax.local_devices()[0]
-        sharding = jax.sharding.SingleDeviceSharding(dev)
-        abstract = jax.tree_util.tree_map(
-            lambda m: jax.ShapeDtypeStruct(tuple(m.shape), m.dtype,
-                                           sharding=sharding), meta)
-        params = ckptr.restore(path, abstract)
-        return jax.tree_util.tree_map(np.asarray, params)
+        return _restore_item_host(path, "params")
 
 
 def save_params(path: str, params: Any) -> str:
@@ -204,6 +378,59 @@ def save_params(path: str, params: Any) -> str:
     return path
 
 
+def _nonempty_dir(path: str) -> bool:
+    try:
+        with os.scandir(path) as it:
+            return any(True for _ in it)
+    except OSError:
+        return False
+
+
+def _restore_item_host(path: str, item: str) -> Any:
+    """One checkpoint item as host numpy arrays, with no target tree.
+
+    Restoring without a target replays the original device topology,
+    which breaks across machines; build a single-device target from the
+    item's own shape/dtype metadata instead.  Modern orbax wraps the
+    tree in ``.item_metadata``; older versions return it directly."""
+    path = os.path.abspath(os.path.join(path, item))
+    ckptr = ocp.StandardCheckpointer()
+    meta = ckptr.metadata(path)
+    meta = getattr(meta, "item_metadata", meta)
+    meta = getattr(meta, "tree", meta)
+    dev = jax.local_devices()[0]
+    sharding = jax.sharding.SingleDeviceSharding(dev)
+    abstract = jax.tree_util.tree_map(
+        lambda m: jax.ShapeDtypeStruct(tuple(m.shape), m.dtype,
+                                       sharding=sharding), meta)
+    tree = ckptr.restore(path, abstract)
+    return jax.tree_util.tree_map(np.asarray, tree)
+
+
+def _tree_digest(tree: Any) -> Optional[str]:
+    """Content digest of a pytree: per-leaf CRC32 over (shape, dtype,
+    bytes), combined ORDER- and STRUCTURE-independently (sum mod 2^64).
+
+    Structure independence matters because the save-side tree (optax
+    NamedTuples, custom nodes) and the verify-side tree (orbax's
+    metadata-restored plain containers) flatten with different key paths;
+    content equality is what corruption detection needs.  Returns None
+    when leaves are not process-addressable (multi-host shards) — the
+    digest is then skipped, never wrong."""
+    import zlib
+
+    total = 0
+    try:
+        for leaf in jax.tree_util.tree_leaves(tree):
+            arr = np.asarray(leaf)
+            head = f"{arr.shape}|{arr.dtype}".encode()
+            crc = zlib.crc32(np.ascontiguousarray(arr).tobytes(),
+                             zlib.crc32(head))
+            total = (total + crc) & 0xFFFFFFFFFFFFFFFF
+    except Exception as e:
+        logging.debug("checkpoint digest skipped: %s", e)
+        return None
+    return f"{total:016x}"
 
 
 def _read_meta(path: str) -> dict:

@@ -34,6 +34,7 @@ import subprocess
 from typing import Dict, List, Optional
 
 from autodist_tpu.const import ENV
+from autodist_tpu.resilience.backoff import Backoff
 from autodist_tpu.resource_spec import ResourceSpec, SSHConfig
 from autodist_tpu.utils import logging
 from autodist_tpu.utils.network import is_local_address
@@ -42,14 +43,22 @@ from autodist_tpu.utils.network import is_local_address
 # 15000-16000 server port range (autodist/const.py:38).
 DEFAULT_COORDINATOR_PORT = 15000
 
+# Transient-failure schedule for the ssh/scp primitives: an SSH flake or
+# connection reset during fan-out should not kill a pod-sized launch.
+# Shares the supervisor's backoff helper (resilience/backoff.py) so every
+# retry in the stack follows one tested rule.
+DEFAULT_REMOTE_RETRY = Backoff(max_tries=3, base=0.5, cap=10.0)
+
 
 class Cluster:
     """Process fabric over the nodes of a ResourceSpec."""
 
-    def __init__(self, resource_spec: ResourceSpec):
+    def __init__(self, resource_spec: ResourceSpec,
+                 remote_retry: Optional[Backoff] = None):
         self._spec = resource_spec
         self._subprocesses: List[subprocess.Popen] = []
         self._started = False
+        self._retry = remote_retry or DEFAULT_REMOTE_RETRY
         atexit.register(self.terminate)
 
     # -- identity ----------------------------------------------------------
@@ -201,8 +210,13 @@ class Cluster:
             logging.info("DEBUG_REMOTE exec on %s: %s", address, inner)
             return None
         logging.debug("remote_exec on %s: %s", address, inner)
-        proc = subprocess.Popen(full, start_new_session=True,
-                                stdout=None, stderr=None)
+        # Only the SPAWN can be retried here (fork/exec resource errors);
+        # an ssh session dying later surfaces through the coordinator's
+        # watcher, not this call.
+        proc = self._retry.retry(
+            lambda: subprocess.Popen(full, start_new_session=True,
+                                     stdout=None, stderr=None),
+            retryable=(OSError,), label=f"remote_exec {address}")
         self._subprocesses.append(proc)
         return proc
 
@@ -222,10 +236,18 @@ class Cluster:
             return
         mkdir = self._ssh_base(address) + [
             f"mkdir -p {shlex.quote(os.path.dirname(remote_path) or '.')}"]
-        subprocess.run(mkdir, check=True)
         scp = [local_path if a == "__SRC__" else a
                for a in self._scp_base(address, remote_path)]
-        subprocess.run(scp, check=True)
+
+        def _copy():
+            subprocess.run(mkdir, check=True)
+            subprocess.run(scp, check=True)
+
+        # SSH flakes / connection resets are transient; retry the whole
+        # mkdir+scp unit (idempotent) with backoff, logging each attempt.
+        self._retry.retry(
+            _copy, retryable=(subprocess.CalledProcessError, OSError),
+            label=f"remote_copy {local_path} -> {address}:{remote_path}")
 
     def remote_file_write(self, remote_path: str, data: str,
                           address: str) -> None:
@@ -243,7 +265,10 @@ class Cluster:
         cmd = self._ssh_base(address) + [
             f"mkdir -p {shlex.quote(os.path.dirname(remote_path) or '.')} && "
             f"cat > {shlex.quote(remote_path)}"]
-        subprocess.run(cmd, input=data.encode(), check=True)
+        self._retry.retry(
+            lambda: subprocess.run(cmd, input=data.encode(), check=True),
+            retryable=(subprocess.CalledProcessError, OSError),
+            label=f"remote_file_write {address}:{remote_path}")
 
 
 class SSHCluster(Cluster):

@@ -112,6 +112,16 @@ class ENV(enum.Enum):
     AUTODIST_VALIDATE = ("AUTODIST_VALIDATE", _bool)
     # Cloud-TPU pod slice: rendezvous via TPU metadata (TPUPodCluster)
     AUTODIST_TPU_POD = ("AUTODIST_TPU_POD", _bool)
+    # coordinator watcher behavior on worker death: fail_fast (default) |
+    # ignore | restart | supervised (resilience.supervisor.policy_from_env)
+    AUTODIST_FAILURE_POLICY = ("AUTODIST_FAILURE_POLICY", _str)
+    # where a supervised job's failure markers + heartbeats live (set by
+    # resilience.Supervisor for each attempt)
+    AUTODIST_SUPERVISOR_DIR = ("AUTODIST_SUPERVISOR_DIR", _str)
+    # deterministic fault-injection spec (resilience.chaos grammar)
+    AUTODIST_CHAOS = ("AUTODIST_CHAOS", _str)
+    # which supervisor attempt this process belongs to (chaos/test filters)
+    AUTODIST_ATTEMPT = ("AUTODIST_ATTEMPT", _int0)
     # jax.distributed coordinator (host:port)
     AUTODIST_COORDINATOR_ADDRESS = ("AUTODIST_COORDINATOR_ADDRESS", _str)
     AUTODIST_NUM_PROCESSES = ("AUTODIST_NUM_PROCESSES", _int1)

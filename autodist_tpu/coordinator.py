@@ -3,9 +3,13 @@
 Parity with reference ``autodist/coordinator.py:41-110``: the chief re-launches
 the *same user script* (``python sys.argv``) on each non-chief node over SSH,
 after shipping the serialized strategy, with environment variables telling the
-worker who it is.  A watcher thread per remote process fails the whole job
-fast (``os._exit(1)``) when any worker dies — the reference's only failure-
-detection mechanism, kept here verbatim in spirit.
+worker who it is.  A watcher thread per remote process observes worker death
+— the reference fails the whole job fast (``os._exit(1)``), and that remains
+the DEFAULT here; a :class:`~autodist_tpu.resilience.supervisor.FailurePolicy`
+(constructor arg or ``AUTODIST_FAILURE_POLICY`` env) can instead ignore the
+death, relaunch the dead worker in place through the same Cluster machinery,
+or record the failing host for the job-level supervisor before aborting
+(see docs/resilience.md).
 
 The execution model is identical to SPMD: every process runs the same program.
 What the env adds on top of plain JAX multi-process is (a) strategy shipping —
@@ -29,12 +33,25 @@ from autodist_tpu.utils import logging
 class Coordinator:
     """Launches and babysits worker client processes (chief only)."""
 
-    def __init__(self, strategy, cluster: Cluster):
+    def __init__(self, strategy, cluster: Cluster, failure_policy=None):
         self._strategy = strategy
         self._cluster = cluster
         self._procs: List[Tuple[str, object]] = []
         self._watchers: List[threading.Thread] = []
         self._terminating = False
+        self._argv: Optional[List[str]] = None
+        if failure_policy is None:
+            # Env-selected policy (AUTODIST_FAILURE_POLICY); None keeps the
+            # reference fail-fast.  Lazy import: the resilience package must
+            # not load on the worker bootstrap path unless asked for.
+            try:
+                from autodist_tpu.resilience.supervisor import policy_from_env
+                failure_policy = policy_from_env()
+            except Exception as e:
+                logging.warning("failure policy from env unavailable (%s); "
+                                "using fail-fast", e)
+                failure_policy = None
+        self._policy = failure_policy
 
     def launch_clients(self, argv: Optional[List[str]] = None) -> None:
         """Re-run the user script on every non-chief node
@@ -42,66 +59,11 @@ class Coordinator:
         argv = list(argv if argv is not None else sys.argv)
         if argv and not os.path.isabs(argv[0]):
             argv[0] = os.path.abspath(argv[0])
-        spec = self._cluster.resource_spec
-
-        # Reuse the file build_strategy() already wrote; serialize only if
-        # the strategy was constructed out-of-band.
-        strategy_path = self._strategy.path
-        if not os.path.exists(strategy_path):
-            strategy_path = self._strategy.serialize()
-        for node in spec.nodes:
+        self._argv = argv
+        for node in self._cluster.resource_spec.nodes:
             if self._cluster.is_chief(node.address):
                 continue
-            # Ship the strategy file so the worker deserializes the chief's
-            # strategy (reference coordinator.py:84-88), and the resource
-            # spec so the worker's AutoDist(<same argv>) finds it at the
-            # same path.
-            remote_path = os.path.join(DEFAULT_STRATEGY_DIR,
-                                       self._strategy.id)
-            self._cluster.remote_copy(strategy_path, remote_path, node.address)
-            if spec.source_file:
-                self._cluster.remote_copy(spec.source_file, spec.source_file,
-                                          node.address)
-            # Best-effort: ship the user script itself so workers don't need
-            # a shared filesystem for the code (the reference assumed
-            # identically-deployed code; we copy the entry script when we
-            # have it — packages still must be pre-deployed).
-            if argv and os.path.isfile(argv[0]):
-                try:
-                    self._cluster.remote_copy(argv[0], argv[0], node.address)
-                except Exception as e:  # genuinely best-effort: the code may
-                    # already be deployed at a read-only path on the worker
-                    logging.warning("could not ship %s to %s (%s); assuming "
-                                    "it is already deployed", argv[0],
-                                    node.address, e)
-            env = {
-                ENV.AUTODIST_WORKER.name: node.address,
-                ENV.AUTODIST_STRATEGY_ID.name: self._strategy.id,
-                # Launcher plumbing: a worker script constructing a bare
-                # AutoDist() finds the shipped spec via env (run.py CLI).
-                **({ENV.SYS_RESOURCE_PATH.name: spec.source_file}
-                   if spec.source_file else {}),
-                ENV.AUTODIST_COORDINATOR_ADDRESS.name:
-                    self._cluster.coordinator_address,
-                ENV.AUTODIST_NUM_PROCESSES.name:
-                    str(self._cluster.num_processes),
-                ENV.AUTODIST_PROCESS_ID.name:
-                    str(self._cluster.process_id_for(node.address)),
-                ENV.AUTODIST_MIN_LOG_LEVEL.name:
-                    str(ENV.AUTODIST_MIN_LOG_LEVEL.val),
-            }
-            # Keep the cluster flavor consistent across processes: a pod
-            # chief must produce pod workers (metadata rendezvous), not SSH
-            # workers pointed at a nonexistent coordination service.  Same
-            # for the workdir — the worker must deserialize the strategy
-            # from the directory the chief copied it into.
-            for passthrough in (ENV.AUTODIST_TPU_POD.name,
-                                "AUTODIST_TPU_WORKDIR"):
-                if os.environ.get(passthrough):
-                    env[passthrough] = os.environ[passthrough]
-            proc = self._cluster.remote_exec(
-                [sys.executable or "python", "-u"] + argv,
-                address=node.address, env=env)
+            proc = self._launch_one(node.address, argv)
             if proc is None:  # AUTODIST_DEBUG_REMOTE
                 continue
             self._procs.append((node.address, proc))
@@ -112,13 +74,108 @@ class Coordinator:
             logging.info("launched worker client on %s (pid %d)",
                          node.address, proc.pid)
 
+    def _launch_one(self, address: str, argv: Optional[List[str]] = None):
+        """Ship state and start ONE worker client — the unit
+        ``launch_clients`` fans out and a relaunching failure policy
+        re-invokes for a dead worker."""
+        argv = list(argv if argv is not None else (self._argv or sys.argv))
+        spec = self._cluster.resource_spec
+
+        # Reuse the file build_strategy() already wrote; serialize only if
+        # the strategy was constructed out-of-band.
+        strategy_path = self._strategy.path
+        if not os.path.exists(strategy_path):
+            strategy_path = self._strategy.serialize()
+        # Ship the strategy file so the worker deserializes the chief's
+        # strategy (reference coordinator.py:84-88), and the resource
+        # spec so the worker's AutoDist(<same argv>) finds it at the
+        # same path.
+        remote_path = os.path.join(DEFAULT_STRATEGY_DIR, self._strategy.id)
+        self._cluster.remote_copy(strategy_path, remote_path, address)
+        if spec.source_file:
+            self._cluster.remote_copy(spec.source_file, spec.source_file,
+                                      address)
+        # Best-effort: ship the user script itself so workers don't need
+        # a shared filesystem for the code (the reference assumed
+        # identically-deployed code; we copy the entry script when we
+        # have it — packages still must be pre-deployed).
+        if argv and os.path.isfile(argv[0]):
+            try:
+                self._cluster.remote_copy(argv[0], argv[0], address)
+            except Exception as e:  # genuinely best-effort: the code may
+                # already be deployed at a read-only path on the worker
+                logging.warning("could not ship %s to %s (%s); assuming "
+                                "it is already deployed", argv[0],
+                                address, e)
+        env = {
+            ENV.AUTODIST_WORKER.name: address,
+            ENV.AUTODIST_STRATEGY_ID.name: self._strategy.id,
+            # Launcher plumbing: a worker script constructing a bare
+            # AutoDist() finds the shipped spec via env (run.py CLI).
+            **({ENV.SYS_RESOURCE_PATH.name: spec.source_file}
+               if spec.source_file else {}),
+            ENV.AUTODIST_COORDINATOR_ADDRESS.name:
+                self._cluster.coordinator_address,
+            ENV.AUTODIST_NUM_PROCESSES.name:
+                str(self._cluster.num_processes),
+            ENV.AUTODIST_PROCESS_ID.name:
+                str(self._cluster.process_id_for(address)),
+            ENV.AUTODIST_MIN_LOG_LEVEL.name:
+                str(ENV.AUTODIST_MIN_LOG_LEVEL.val),
+        }
+        # Keep the cluster flavor consistent across processes: a pod
+        # chief must produce pod workers (metadata rendezvous), not SSH
+        # workers pointed at a nonexistent coordination service.  Same
+        # for the workdir — the worker must deserialize the strategy
+        # from the directory the chief copied it into.  The resilience
+        # vars ride along so workers share the chief's chaos spec,
+        # attempt stamp, and supervisor marker dir.
+        for passthrough in (ENV.AUTODIST_TPU_POD.name,
+                            "AUTODIST_TPU_WORKDIR",
+                            ENV.AUTODIST_CHAOS.name,
+                            ENV.AUTODIST_ATTEMPT.name,
+                            ENV.AUTODIST_SUPERVISOR_DIR.name):
+            if os.environ.get(passthrough):
+                env[passthrough] = os.environ[passthrough]
+        return self._cluster.remote_exec(
+            [sys.executable or "python", "-u"] + argv,
+            address=address, env=env)
+
     def _watch(self, address: str, proc) -> None:
-        """Fail-fast on worker death (reference ``coordinator.py:98-110``)."""
-        code = proc.wait()
-        if code != 0 and not self._terminating:
+        """Observe worker death; the failure policy decides what happens
+        (default: the reference's fail-fast, ``coordinator.py:98-110``)."""
+        while True:
+            code = proc.wait()
+            if code == 0 or self._terminating:
+                return
+            action = "abort"
+            if self._policy is not None:
+                try:
+                    action = self._policy.on_worker_exit(address, code) \
+                        or "abort"
+                except Exception as e:
+                    logging.error("failure policy raised (%s); falling back "
+                                  "to abort", e)
+            if action == "ignore":
+                logging.warning("worker %s exited with code %s — ignored "
+                                "by failure policy", address, code)
+                return
+            if action == "relaunch" and not self._terminating:
+                try:
+                    new_proc = self._launch_one(address)
+                except Exception as e:
+                    logging.error("relaunch of worker %s failed (%s) — "
+                                  "aborting job", address, e)
+                    new_proc = None
+                if new_proc is not None:
+                    logging.info("relaunched worker client on %s (pid %d)",
+                                 address, new_proc.pid)
+                    self._procs.append((address, new_proc))
+                    proc = new_proc
+                    continue
             logging.error("worker %s exited with code %s — aborting job",
                           address, code)
-            os._exit(1)
+            os._exit(getattr(self._policy, "exit_code", 1))
 
     def join(self) -> None:
         """Wait for all workers (reference ``coordinator.py:92-96``)."""

@@ -164,6 +164,7 @@ def fit(session, data: DataArg, epochs: int = 1,
         callbacks: Sequence[Callback] = (), log_every: int = 0,
         checkpoint_dir: Optional[str] = None, checkpoint_every: int = 1,
         resume: bool = True, async_checkpoints: bool = False,
+        checkpoint_keep: Optional[int] = None,
         initial_epoch: Optional[int] = None,
         prefetch_depth: int = 2,
         preemption_signals: Sequence = (),
@@ -196,7 +197,16 @@ def fit(session, data: DataArg, epochs: int = 1,
         :class:`~autodist_tpu.checkpoint.saver.Saver` every
         ``checkpoint_every`` epochs, and — with ``resume`` — restore the
         latest checkpoint before training (exact resume: optimizer slots
-        and sync state included, step counter advanced).
+        and sync state included, step counter advanced).  When ``data``
+        is a :class:`~autodist_tpu.runtime.data_loader.DataLoader` (or
+        anything with ``state()``/``load_state()``), the loader position
+        (epoch + within-epoch batch offset) is persisted in the
+        checkpoint metadata and restored on resume, so a mid-epoch
+        checkpoint continues from the EXACT next batch instead of
+        re-running the partial epoch (docs/resilience.md).
+      checkpoint_keep: retain only the N newest checkpoint steps —
+        older ``step_M`` dirs are garbage-collected after each durable
+        save (``Saver(keep=)``).
       initial_epoch: epoch to start from (epochs below it are skipped);
         overrides the step-derived default after a resume.
       async_checkpoints: persist checkpoint files in the background of
@@ -236,19 +246,40 @@ def fit(session, data: DataArg, epochs: int = 1,
     handler_nums = _validate_signals(preemption_signals)
     saver = None
     resumed_step = None
+    data_resume = None
+    track_data = hasattr(data, "state") and hasattr(data, "load_state")
     if checkpoint_dir is not None:
         from autodist_tpu.checkpoint import Saver
 
-        saver = Saver(session, async_save=async_checkpoints)
+        saver = Saver(session, async_save=async_checkpoints,
+                      keep=checkpoint_keep)
         if resume:
             latest = Saver.latest_checkpoint(checkpoint_dir)
             if latest is not None:
                 resumed_step = saver.restore(latest)
                 logging.info("fit: resumed from %s at step %d",
                              latest, resumed_step)
+                if track_data:
+                    ds = Saver.read_meta(latest).get("data_state")
+                    if ds:
+                        try:
+                            data_resume = data.load_state(ds)
+                            logging.info(
+                                "fit: exact data resume — continuing at "
+                                "epoch %d batch %d", data_resume["epoch"],
+                                data_resume["offset"])
+                        except (ValueError, KeyError) as e:
+                            logging.warning(
+                                "fit: checkpoint data state unusable (%s); "
+                                "resuming at epoch granularity", e)
 
     if initial_epoch is None:
-        if resumed_step and steps_per_epoch:
+        if data_resume is not None:
+            # The loader is positioned at the exact next batch; the epoch
+            # containing it is where the loop picks up (its already-
+            # consumed prefix is skipped by the loader, not re-run).
+            initial_epoch = min(data_resume["epoch"], epochs)
+        elif resumed_step and steps_per_epoch:
             # Complete to `epochs` TOTAL: skip the epochs the restored
             # step already covers (Keras initial_epoch semantics).
             initial_epoch = min(resumed_step // steps_per_epoch, epochs)
@@ -286,6 +317,19 @@ def fit(session, data: DataArg, epochs: int = 1,
                 "validation_steps")
         validation_data = session.place_batch(validation_data)
 
+    # Data-position tracking for exact mid-epoch resume: fit counts the
+    # CONSUMED batches itself (the prefetcher pulls ahead of the training
+    # step, so the loader's own yield count over-reports) and stamps the
+    # position into every checkpoint's metadata.
+    data_track = {"enabled": bool(track_data), "pos": None, "seed": None,
+                  "base": (data_resume or {}).get("offset", 0),
+                  "start_epoch": initial_epoch}
+    if track_data:
+        try:
+            data_track["seed"] = data.state().get("seed")
+        except Exception:
+            data_track["enabled"] = False
+
     preempt = {"signum": None}
     hist = History()
     with _preemption_handlers(handler_nums, preempt):
@@ -302,12 +346,14 @@ def fit(session, data: DataArg, epochs: int = 1,
             log_every=log_every, checkpoint_dir=checkpoint_dir,
             checkpoint_every=checkpoint_every,
             prefetch_depth=prefetch_depth, initial_epoch=initial_epoch,
-            saver=saver, hist=hist, preempt=preempt)
+            saver=saver, hist=hist, preempt=preempt,
+            data_track=data_track)
 
     if (saver is not None and hist.steps_run
             and last_saved_step != session.step_count):
         # Never lose the tail epochs to the checkpoint_every stride.
-        saver.save(checkpoint_dir, step=session.step_count)
+        saver.save(checkpoint_dir, step=session.step_count,
+                   extra_meta=_data_state_meta(data_track))
     if saver is not None:
         saver.wait()   # async saves must be durable before fit returns
 
@@ -316,15 +362,27 @@ def fit(session, data: DataArg, epochs: int = 1,
     return hist
 
 
+def _data_state_meta(data_track) -> Optional[dict]:
+    """``extra_meta`` for a checkpoint save: the current data position
+    (None when tracking is off or no position is known yet)."""
+    if not data_track["enabled"] or data_track["pos"] is None:
+        return None
+    return {"data_state": dict(data_track["pos"])}
+
+
 def _fit_epochs(*, session, data, epochs, steps_per_epoch,
                 validation_data, validation_steps, callbacks, log_every,
                 checkpoint_dir, checkpoint_every, prefetch_depth,
-                initial_epoch, saver, hist, preempt):
+                initial_epoch, saver, hist, preempt, data_track):
     """The epoch loop (split out so ``fit`` can wrap it in the
     signal-handler scope; keyword-only — no positional-order hazard).
     Returns ``last_saved_step``."""
     last_saved_step = None
     for epoch in range(initial_epoch, epochs):
+        # The resumed epoch starts at the restored offset; every later
+        # epoch starts at batch 0.
+        epoch_base = data_track["base"] \
+            if epoch == data_track["start_epoch"] else 0
         for cb in callbacks:
             cb.on_epoch_begin(epoch)
         it = _epoch_iter(data, steps_per_epoch)
@@ -364,8 +422,16 @@ def _fit_epochs(*, session, data, epochs, steps_per_epoch,
                 else None
             if loss is not None and last_sampled_step != session.step_count:
                 hist._sample(session.step_count, loss)
+            if data_track["enabled"]:
+                # Mid-epoch position: the NEXT batch is epoch_base +
+                # epoch_steps of THIS epoch — resume continues exactly
+                # there instead of re-running the partial epoch.
+                data_track["pos"] = {"epoch": epoch,
+                                     "offset": epoch_base + epoch_steps,
+                                     "seed": data_track["seed"]}
             if saver is not None and hist.steps_run:
-                saver.save(checkpoint_dir, step=session.step_count)
+                saver.save(checkpoint_dir, step=session.step_count,
+                           extra_meta=_data_state_meta(data_track))
                 last_saved_step = session.step_count
             for cb in callbacks:
                 cb.on_epoch_end(epoch, {
@@ -404,6 +470,12 @@ def _fit_epochs(*, session, data, epochs, steps_per_epoch,
             hist._sample(session.step_count, loss)
         hist.history["epoch_loss"].append(loss)
         hist.epochs_run += 1
+        if data_track["enabled"]:
+            # Epoch boundary: the next batch is the start of epoch+1 (the
+            # loader's per-epoch reshuffle keys on the epoch index, so
+            # this position is exact even under a steps_per_epoch cap).
+            data_track["pos"] = {"epoch": epoch + 1, "offset": 0,
+                                 "seed": data_track["seed"]}
         logs = {"loss": loss, "epoch_steps": epoch_steps,
                 "step": session.step_count}
         if validation_data is not None:
@@ -424,7 +496,8 @@ def _fit_epochs(*, session, data, epochs, steps_per_epoch,
         for cb in callbacks:
             cb.on_epoch_end(epoch, logs)
         if saver is not None and (epoch + 1) % checkpoint_every == 0:
-            saver.save(checkpoint_dir, step=session.step_count)
+            saver.save(checkpoint_dir, step=session.step_count,
+                       extra_meta=_data_state_meta(data_track))
             last_saved_step = session.step_count
 
     return last_saved_step

@@ -63,6 +63,10 @@ class DistributedStep:
     opt_pad_info: Any = None         # opt-state-shaped info tree, or None
     logical_param_shardings: Any = None  # pad axis dropped; None = physical
     logical_opt_shardings: Any = None
+    # ZeRO-1 flat-bucket plan (explicit reduce-scatter path only; empty
+    # elsewhere): checkpoints record it so elastic resume can reslice the
+    # flat optimizer shards at a different data-axis size.
+    zero1_buckets: Any = ()
     _placer: Optional[Callable] = None
     _param_exporter: Optional[Callable] = None
     _opt_exporter: Optional[Callable] = None
@@ -470,7 +474,7 @@ class GraphTransformer:
         # GLOBAL batch — identical semantics to the GSPMD path (inside the
         # mapped step they would see only the local data shard and get
         # pmean-averaged, silently changing non-mean metrics).
-        step_fn, init_fn, init_sync, param_sh, opt_sh = \
+        step_fn, init_fn, init_sync, param_sh, opt_sh, rs_buckets = \
             explicit_sync.make_explicit_step(gi, self.compiled)
         if extra_metrics_fn is not None:
             inner_step = step_fn
@@ -493,7 +497,8 @@ class GraphTransformer:
         return DistributedStep(
             step_fn=step_fn, init_fn=init_fn, init_sync_state=init_sync,
             param_shardings=param_sh, opt_shardings=opt_sh,
-            mesh=mesh, compiled_strategy=self.compiled, eval_fn=eval_fn)
+            mesh=mesh, compiled_strategy=self.compiled, eval_fn=eval_fn,
+            zero1_buckets=tuple(rs_buckets))
 
 
 def _make_eval_step(loss_fn: Callable, has_aux: bool,

@@ -218,7 +218,10 @@ def plan_step_buckets(gi: GraphItem, compiled: CompiledStrategy,
 
 def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
     """Returns (step_fn, init_opt_fn, init_sync_state_fn, param_sh_tree,
-    opt_sh_tree) consumed by the GraphTransformer."""
+    opt_sh_tree, rs_buckets) consumed by the GraphTransformer —
+    ``rs_buckets`` is the planned ZeRO-1 bucket list (empty without
+    reduce-scatter plans), exposed so checkpoints can record the flat
+    optimizer layout for elastic resume."""
     import optax
 
     from autodist_tpu.kernel import sharding_utils as su
@@ -660,4 +663,5 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
                       donate_argnums=(0, 1, 2) if donate_sync else (0, 1))
 
     init_opt_fn = jax.jit(init_opt, out_shardings=opt_sh_tree)
-    return step_fn, init_opt_fn, init_sync_state, param_sh_tree, opt_sh_tree
+    return (step_fn, init_opt_fn, init_sync_state, param_sh_tree,
+            opt_sh_tree, list(rs_buckets))

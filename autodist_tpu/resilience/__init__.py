@@ -1,0 +1,62 @@
+"""autodist_tpu.resilience — supervised recovery for multi-host jobs.
+
+Turns worker failure from job death (the reference's ``os._exit(1)``
+fail-fast) into a recoverable event:
+
+* :mod:`~autodist_tpu.resilience.supervisor` — failure policies for the
+  coordinator's watcher plus the job-level :class:`Supervisor` restart
+  loop (backoff + retry budget, elastic host fall-through);
+* :mod:`~autodist_tpu.resilience.elastic` — restore a ZeRO-1 checkpoint
+  across a data-axis resize, exactly;
+* :mod:`~autodist_tpu.resilience.heartbeat` — liveness beacons and the
+  watchdog that tells "process exited" from "wedged in a collective";
+* :mod:`~autodist_tpu.resilience.chaos` — deterministic fault injection
+  driving the recovery tests;
+* :mod:`~autodist_tpu.resilience.backoff` — the shared retry schedule.
+
+Imports are lazy (PEP 562): ``cluster.py``/``coordinator.py`` consult
+this package on the worker bootstrap path, which must not drag jax or
+orbax into the process before ``jax.distributed.initialize``.
+"""
+from __future__ import annotations
+
+_EXPORTS = {
+    "Backoff": "autodist_tpu.resilience.backoff",
+    "HeartbeatCallback": "autodist_tpu.resilience.heartbeat",
+    "HeartbeatMonitor": "autodist_tpu.resilience.heartbeat",
+    "HeartbeatWriter": "autodist_tpu.resilience.heartbeat",
+    "ChaosCallback": "autodist_tpu.resilience.chaos",
+    "ChaosMonkey": "autodist_tpu.resilience.chaos",
+    "corrupt_checkpoint": "autodist_tpu.resilience.chaos",
+    "parse_chaos": "autodist_tpu.resilience.chaos",
+    "Attempt": "autodist_tpu.resilience.supervisor",
+    "FailFast": "autodist_tpu.resilience.supervisor",
+    "FailurePolicy": "autodist_tpu.resilience.supervisor",
+    "Ignore": "autodist_tpu.resilience.supervisor",
+    "NotifySupervisor": "autodist_tpu.resilience.supervisor",
+    "RestartWorker": "autodist_tpu.resilience.supervisor",
+    "Supervisor": "autodist_tpu.resilience.supervisor",
+    "SupervisorPolicy": "autodist_tpu.resilience.supervisor",
+    "SupervisorReport": "autodist_tpu.resilience.supervisor",
+    "policy_from_env": "autodist_tpu.resilience.supervisor",
+    "ElasticResumeError": "autodist_tpu.resilience.elastic",
+    "elastic_restore": "autodist_tpu.resilience.elastic",
+    "preflight_elastic": "autodist_tpu.resilience.elastic",
+    "remap_data_state": "autodist_tpu.resilience.elastic",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    module = _EXPORTS.get(name)
+    if module is None:
+        raise AttributeError(
+            f"module 'autodist_tpu.resilience' has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(module), name)
+
+
+def __dir__():
+    return __all__

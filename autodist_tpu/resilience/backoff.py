@@ -1,0 +1,92 @@
+"""Exponential backoff with deterministic jitter.
+
+The one retry schedule every resilience consumer shares: the job
+supervisor's relaunch loop (``resilience/supervisor.py``), the
+coordinator's per-worker restart policy, and the cluster's transient
+remote_copy/remote_exec retries (``cluster.py``) all delay through this
+helper, so "how long until we try again" is one tested rule instead of
+three ad-hoc sleeps.
+
+Jitter is the fleet-safety half of the design: a pod-wide preemption
+kills every worker at once, and N hosts relaunching on a synchronized
+schedule hammer the coordinator (and any shared checkpoint store) in
+lockstep.  Each delay is spread over ``±jitter/2`` of its nominal value;
+passing ``seed`` makes the spread deterministic — what the chaos tests
+use so every recovery timeline is reproducible.
+"""
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional, Sequence, Tuple
+
+from autodist_tpu.utils import logging
+
+
+@dataclass(frozen=True)
+class Backoff:
+    """Bounded exponential backoff schedule.
+
+    ``max_tries`` counts ATTEMPTS, not retries: ``max_tries=3`` means one
+    initial try plus up to two retries.  ``delay(i)`` is the pause after
+    failed attempt ``i`` (1-based): ``base * multiplier**(i-1)`` capped
+    at ``cap``, spread over ``±jitter/2`` of itself (mean preserved).
+    """
+
+    max_tries: int = 3
+    base: float = 0.5
+    cap: float = 30.0
+    multiplier: float = 2.0
+    jitter: float = 0.5
+    seed: Optional[int] = None
+
+    def __post_init__(self):
+        if self.max_tries < 1:
+            raise ValueError("max_tries must be >= 1")
+        if self.base < 0 or self.cap < 0:
+            raise ValueError("base/cap must be >= 0")
+        if not 0 <= self.jitter <= 1:
+            raise ValueError("jitter must be in [0, 1]")
+
+    def nominal(self, attempt: int) -> float:
+        """Un-jittered delay after failed attempt ``attempt`` (1-based)."""
+        if attempt < 1:
+            raise ValueError("attempt is 1-based")
+        return min(self.cap, self.base * self.multiplier ** (attempt - 1))
+
+    def delay(self, attempt: int) -> float:
+        d = self.nominal(attempt)
+        if self.jitter == 0 or d == 0:
+            return d
+        # Deterministic per-attempt stream when seeded: delay(i) is a pure
+        # function of (schedule, i), so a restarted supervisor replays the
+        # same timeline.
+        rng = random.Random(self.seed * 1000003 + attempt) \
+            if self.seed is not None else random
+        return d * (1 - self.jitter / 2 + self.jitter * rng.random())
+
+    def delays(self) -> Sequence[float]:
+        """The full retry schedule (``max_tries - 1`` pauses)."""
+        return [self.delay(i) for i in range(1, self.max_tries)]
+
+    def retry(self, fn: Callable, *, retryable: Tuple = (Exception,),
+              label: str = "", sleep: Callable[[float], None] = time.sleep):
+        """Call ``fn`` up to ``max_tries`` times; re-raise the last error.
+
+        Every retry is logged with its attempt count (the transient-SSH
+        audit trail the cluster layer wants); non-``retryable`` errors
+        propagate immediately.
+        """
+        for attempt in range(1, self.max_tries + 1):
+            try:
+                return fn()
+            except retryable as e:
+                if attempt >= self.max_tries:
+                    raise
+                pause = self.delay(attempt)
+                logging.warning(
+                    "%s: attempt %d/%d failed (%s); retrying in %.2fs",
+                    label or getattr(fn, "__name__", "call"), attempt,
+                    self.max_tries, e, pause)
+                sleep(pause)

@@ -1,0 +1,227 @@
+"""Deterministic fault injection ("chaos") for recovery testing.
+
+Every recovery path in the resilience stack — supervisor relaunch,
+heartbeat wedge detection, checkpoint verify fallback, preemption
+checkpointing — needs a REPRODUCIBLE failure to exercise it.  This
+module injects faults as pure functions of ``(event spec, process
+index, attempt, step)``: the same spec always fails the same process at
+the same step of the same attempt, so a multiprocess CPU test replays a
+TPU-pod failure timeline exactly.
+
+The spec rides the ``AUTODIST_CHAOS`` env var (shipped to workers like
+any other coordinator env) as ``;``-separated events::
+
+    kill@step=6,proc=1,attempt=0            # worker 1 exits 43 at step 6
+    kill@step=6,proc=1,attempt=0,code=9     # ... with exit code 9
+    preempt@step=5,signal=SIGTERM           # deliver a preemption notice
+    drop_heartbeats@step=3,proc=2           # beacons stop (wedge drill)
+    corrupt_ckpt@step=4,item=params,path=/ckpt/dir   # truncate a step dir
+
+Filters (``step``/``proc``/``attempt``) all default to "any"; an event
+fires at most once per process.  ``proc`` matches the JAX process index
+(or ``AUTODIST_PROCESS_ID`` before the runtime is up); ``attempt``
+matches ``AUTODIST_ATTEMPT``, which the job supervisor stamps on every
+relaunch — so ``attempt=0`` means "fail the first try, let the retry
+succeed", the canonical recovery drill.
+"""
+from __future__ import annotations
+
+import os
+import signal as _signal
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from autodist_tpu.utils import logging
+
+ACTIONS = ("kill", "preempt", "drop_heartbeats", "corrupt_ckpt")
+
+DEFAULT_KILL_CODE = 43   # distinct from crashes (1) and supervised aborts
+
+
+@dataclass
+class ChaosEvent:
+    """One planned fault."""
+
+    action: str
+    step: Optional[int] = None      # fire at this step (None = first check)
+    proc: Optional[int] = None      # only this process index (None = all)
+    attempt: Optional[int] = None   # only this supervisor attempt
+    args: Dict[str, str] = field(default_factory=dict)
+    fired: bool = False
+
+    def matches(self, step: int, proc: Optional[int],
+                attempt: Optional[int]) -> bool:
+        if self.fired:
+            return False
+        if self.proc is not None and proc is not None and self.proc != proc:
+            return False
+        if self.attempt is not None and attempt is not None \
+                and self.attempt != attempt:
+            return False
+        return self.step is None or step >= self.step
+
+
+def parse_chaos(spec: str) -> List[ChaosEvent]:
+    """Parse the ``AUTODIST_CHAOS`` grammar (see module docstring)."""
+    events: List[ChaosEvent] = []
+    for raw in (spec or "").split(";"):
+        raw = raw.strip()
+        if not raw:
+            continue
+        action, _, rest = raw.partition("@")
+        action = action.strip()
+        if action not in ACTIONS:
+            raise ValueError(f"unknown chaos action {action!r}; "
+                             f"expected one of {ACTIONS}")
+        ev = ChaosEvent(action=action)
+        for kv in filter(None, (p.strip() for p in rest.split(","))):
+            if "=" not in kv:
+                raise ValueError(f"bad chaos arg {kv!r} in {raw!r} "
+                                 "(use key=value)")
+            k, v = kv.split("=", 1)
+            k = k.strip()
+            if k == "step":
+                ev.step = int(v)
+            elif k == "proc":
+                ev.proc = int(v)
+            elif k == "attempt":
+                ev.attempt = int(v)
+            else:
+                ev.args[k] = v.strip()
+        events.append(ev)
+    return events
+
+
+class ChaosMonkey:
+    """Executes planned faults at step boundaries.
+
+    Drive it from the training loop (``ChaosCallback``) or call
+    :meth:`on_step` manually from a script.  All matching is
+    deterministic; ``heartbeats_enabled`` is the flag a
+    :class:`~autodist_tpu.resilience.heartbeat.HeartbeatWriter` consults
+    once a ``drop_heartbeats`` event has fired.
+    """
+
+    def __init__(self, events: List[ChaosEvent],
+                 process_index: Optional[int] = None,
+                 attempt: Optional[int] = None):
+        self._events = list(events)
+        self._proc = process_index
+        self._attempt = attempt
+        self._heartbeats = True
+        self._exit = os._exit            # patchable seam for unit tests
+
+    @classmethod
+    def from_env(cls, process_index: Optional[int] = None) -> "ChaosMonkey":
+        from autodist_tpu.const import ENV
+
+        events = parse_chaos(ENV.AUTODIST_CHAOS.val)
+        return cls(events, process_index=process_index,
+                   attempt=ENV.AUTODIST_ATTEMPT.val)
+
+    @property
+    def events(self) -> List[ChaosEvent]:
+        return list(self._events)
+
+    @property
+    def heartbeats_enabled(self) -> bool:
+        return self._heartbeats
+
+    def _process_index(self) -> Optional[int]:
+        if self._proc is not None:
+            return self._proc
+        try:    # after rendezvous the runtime knows; before it, env does
+            import jax
+            return jax.process_index()
+        except Exception:
+            pid = os.environ.get("AUTODIST_PROCESS_ID")
+            return int(pid) if pid is not None else None
+
+    def on_step(self, step: int) -> None:
+        """Fire every event matching this completed step (each once)."""
+        if not self._events:
+            return
+        proc = self._process_index()
+        for ev in self._events:
+            if ev.matches(int(step), proc, self._attempt):
+                ev.fired = True
+                self._fire(ev, step)
+
+    def _fire(self, ev: ChaosEvent, step: int) -> None:
+        logging.warning("CHAOS: firing %s at step %d (proc=%s attempt=%s)",
+                        ev.action, step, self._process_index(),
+                        self._attempt)
+        if ev.action == "kill":
+            code = int(ev.args.get("code", DEFAULT_KILL_CODE))
+            # os._exit: no atexit, no orbax flush — a real SIGKILL-grade
+            # death, which is the point.
+            self._exit(code)
+        elif ev.action == "preempt":
+            sig = getattr(_signal, ev.args.get("signal", "SIGTERM"))
+            os.kill(os.getpid(), sig)
+        elif ev.action == "drop_heartbeats":
+            self._heartbeats = False
+        elif ev.action == "corrupt_ckpt":
+            path = ev.args.get("path")
+            if not path:
+                raise ValueError("corrupt_ckpt needs path=<checkpoint dir>")
+            corrupt_checkpoint(path, item=ev.args.get("item", "params"),
+                               mode=ev.args.get("mode", "truncate"))
+
+
+class ChaosCallback:
+    """``fit`` callback driving a :class:`ChaosMonkey` at step ends
+    (duck-typed to :class:`autodist_tpu.fit.Callback`)."""
+
+    def __init__(self, monkey: ChaosMonkey):
+        self.monkey = monkey
+
+    def on_train_begin(self, session) -> None: ...
+
+    def on_epoch_begin(self, epoch: int) -> None: ...
+
+    def on_step_end(self, step: int, metrics) -> None:
+        self.monkey.on_step(step)
+
+    def on_epoch_end(self, epoch: int, logs) -> None: ...
+
+    def on_train_end(self, history) -> None: ...
+
+
+def corrupt_checkpoint(path: str, item: str = "params",
+                       mode: str = "truncate") -> List[str]:
+    """Damage one item of a checkpoint step dir, deterministically.
+
+    ``path`` is a ``step_N`` dir (or a checkpoint root, in which case
+    the NEWEST step dir is hit).  ``mode="truncate"`` zero-lengths every
+    regular file under the item (caught by ``Saver.verify(deep=True)``
+    checksum comparison); ``mode="delete"`` removes the item dir
+    entirely (caught by the shallow verify ``latest_step`` runs).
+    Returns the paths touched.
+    """
+    from autodist_tpu.checkpoint.saver import Saver
+
+    if not os.path.isdir(os.path.join(path, item)):
+        latest = Saver.latest_checkpoint(path)
+        if latest is None:
+            raise FileNotFoundError(f"no checkpoint step under {path}")
+        path = latest
+    target = os.path.join(path, item)
+    touched: List[str] = []
+    if mode == "delete":
+        import shutil
+
+        shutil.rmtree(target)
+        touched.append(target)
+    elif mode == "truncate":
+        for root, _, files in os.walk(target):
+            for name in files:
+                p = os.path.join(root, name)
+                with open(p, "w"):
+                    pass   # truncate to zero bytes
+                touched.append(p)
+    else:
+        raise ValueError(f"unknown corrupt mode {mode!r}")
+    logging.warning("CHAOS: corrupted checkpoint item %s (%s, %d paths)",
+                    target, mode, len(touched))
+    return touched

@@ -1,0 +1,229 @@
+"""Elastic resume: restore a checkpoint across a data-axis resize.
+
+A checkpoint written at data-axis size N must be usable by a session
+whose data axis is M — the surviving-hosts path after a permanent host
+loss (supervisor fall-through), or a deliberate shrink/grow between
+runs.  Params and tree-shaped optimizer state are already
+topology-portable (checkpoints store the LOGICAL layout; Orbax reshards
+on restore).  The one piece that is NOT is ZeRO-1's flat bucket-major
+optimizer state (arXiv:2004.13336, PR 2): each bucket's moments are a
+flat vector zero-padded to a multiple of N so it slices into N equal
+shards — at M the pad length changes and a naive restore
+shape-mismatches.
+
+The reshard is exact, not approximate: bucket MEMBERSHIP is a pure
+function of ``(catalog, bucket_bytes, dtype, group)`` and never of the
+axis size (``kernel/synchronization/bucketing.py``), so the first
+``total`` elements of every flat vector — the real moments — are
+identical at any N.  Elastic restore therefore (1) regathers each
+bucket at the checkpoint's bucketing, (2) re-plans buckets for the new
+axis (same membership, new ``padded_total``), and (3) truncates the old
+zero pad and re-pads to the new shard divisor before reslicing 1/M.
+Padded-tail moments are zeros by construction (gradient pads are zeros,
+so Adam's mu/nu stay zero there), which is what makes truncation
+lossless.
+
+Sync state (compressor residuals) is per-device-shaped and does NOT
+survive a resize; it reinitializes, which only matters for compressed
+runs (documented as approximate in docs/resilience.md).  Run
+:func:`preflight_elastic` (or the ``elastic/axis-resize`` analysis rule
+via the CLI) before building the resized session to validate the plan —
+ZeRO-1 reshard legality, ``sync/ring-degenerate`` on the shrunken axis,
+and the HBM re-estimate at 1/M — before any tracing happens.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+from autodist_tpu.utils import logging
+
+
+class ElasticResumeError(RuntimeError):
+    """The checkpoint cannot be resharded into this session exactly."""
+
+
+# -- bucket layout (de)serialization ----------------------------------------
+
+def bucket_layout(buckets: Sequence) -> List[dict]:
+    """Serializable description of a ZeRO-1 bucket plan — what
+    ``Saver.save`` records in ``autodist_meta.json`` so a later session
+    can reshard without re-deriving the writer's plan."""
+    out = []
+    for b in buckets:
+        out.append({
+            "key": b.key, "dtype": str(b.dtype), "total": int(b.total),
+            "padded_total": int(b.padded_total),
+            "vars": [{"name": v.name, "shape": list(v.shape)}
+                     for v in b.vars],
+        })
+    return out
+
+
+def layout_mismatch(old_layout: Sequence[dict],
+                    new_buckets: Sequence) -> Optional[str]:
+    """Why the checkpoint's bucket layout cannot map 1:1 onto this
+    session's plan (None when it can — possibly after re-padding).
+    Membership must match exactly: a drifted ``bucket_bytes`` or a
+    changed variable catalog reshuffles offsets inside the flat vectors
+    and no slicing rule can recover the moments."""
+    old = {d["key"]: d for d in old_layout}
+    new = {b.key: b for b in new_buckets}
+    if set(old) != set(new):
+        return (f"bucket keys differ: checkpoint has {sorted(old)}, "
+                f"session plans {sorted(new)} (bucket_bytes or variable "
+                "catalog changed)")
+    for key, d in old.items():
+        b = new[key]
+        if str(b.dtype) != d["dtype"]:
+            return f"bucket {key}: dtype {d['dtype']} != {b.dtype}"
+        if int(b.total) != int(d["total"]):
+            return (f"bucket {key}: element count {d['total']} != "
+                    f"{b.total}")
+        old_vars = [(v["name"], tuple(v["shape"])) for v in d["vars"]]
+        new_vars = [(v.name, tuple(v.shape)) for v in b.vars]
+        if old_vars != new_vars:
+            return (f"bucket {key}: member variables differ "
+                    f"({old_vars} != {new_vars})")
+    return None
+
+
+def needs_reshard(old_layout: Sequence[dict],
+                  new_buckets: Sequence) -> bool:
+    """True when any bucket's padded length changed — the only case the
+    plain (Orbax-resharded) restore cannot handle."""
+    new = {b.key: b for b in new_buckets}
+    return any(int(d["padded_total"]) != int(new[d["key"]].padded_total)
+               for d in old_layout if d["key"] in new)
+
+
+# -- pytree plumbing ---------------------------------------------------------
+
+def _path_keys(path) -> List[str]:
+    keys = []
+    for entry in path:
+        k = getattr(entry, "key", None)
+        if k is None:
+            k = getattr(entry, "name", None)
+        if k is None and hasattr(entry, "idx"):
+            k = entry.idx
+        keys.append(str(k))
+    return keys
+
+def _bucket_key_for(path, bucket_keys) -> Optional[str]:
+    """The bucket a leaf belongs to: the leaf sits under the ``zero1``
+    subtree and some path entry names a planned bucket key."""
+    keys = _path_keys(path)
+    if "zero1" not in keys:
+        return None
+    for k in keys:
+        if k in bucket_keys:
+            return k
+    return None
+
+
+def old_shaped_opt_target(opt_target, old_layout: Sequence[dict],
+                          new_buckets: Sequence, mesh):
+    """Rewrite a session's optimizer restore target so ZeRO-1 flat
+    leaves carry the CHECKPOINT's padded shapes (replicated), leaving
+    every other leaf — the topology-portable tree state — untouched."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    old_pad = {d["key"]: int(d["padded_total"]) for d in old_layout}
+    new_pad = {b.key: int(b.padded_total) for b in new_buckets}
+    replicated = NamedSharding(mesh, P())
+
+    def swap(path, t):
+        key = _bucket_key_for(path, old_pad)
+        if key is None or tuple(t.shape) != (new_pad[key],):
+            return t   # scalars (opt counts) and non-bucket leaves
+        return jax.ShapeDtypeStruct((old_pad[key],), t.dtype,
+                                    sharding=replicated)
+
+    return jax.tree_util.tree_map_with_path(swap, opt_target)
+
+
+def reshard_opt_state(restored_opt, old_layout: Sequence[dict],
+                      session):
+    """Truncate each flat bucket leaf to its real ``total`` and re-pad
+    to the session's shard divisor, placing the result with the
+    session's ZeRO-1 shardings.  Exact: only zero padding is dropped or
+    added."""
+    import jax
+    import numpy as np
+
+    old = {d["key"]: d for d in old_layout}
+    new_pad = {b.key: int(b.padded_total) for b in session.zero1_buckets}
+    shardings = session._step.opt_shardings
+
+    def fix(path, leaf, sh):
+        key = _bucket_key_for(path, old)
+        if key is None:
+            return leaf
+        d = old[key]
+        if tuple(np.shape(leaf)) != (int(d["padded_total"]),):
+            return leaf   # per-bucket scalars pass through
+        total = int(d["total"])
+        arr = np.asarray(leaf)
+        out = np.zeros((new_pad[key],), arr.dtype)
+        out[:total] = arr[:total]
+        return jax.device_put(out, sh)
+
+    return jax.tree_util.tree_map_with_path(fix, restored_opt, shardings)
+
+
+# -- data-loader shard remapping ---------------------------------------------
+
+def remap_data_state(state: Optional[dict], old_hosts: int,
+                     new_hosts: int) -> Optional[dict]:
+    """Translate a saved ``DataLoader.state()`` across a host-count
+    change.  The epoch index (and with it the shuffle stream) is
+    preserved; the within-epoch offset is only meaningful against the
+    OLD per-host shard (different hosts hold different rows at a
+    different count), so a mid-epoch offset resets to the epoch start —
+    the data path is epoch-exact, not batch-exact, across a resize
+    (params/opt stay bit-exact; this is documented in
+    docs/resilience.md)."""
+    if state is None or old_hosts == new_hosts:
+        return state
+    out = dict(state)
+    if int(state.get("offset", 0)):
+        logging.warning(
+            "elastic resume: dropping within-epoch offset %s — shard "
+            "layout changed (%d -> %d hosts), so epoch %s replays from "
+            "its start on the new shards", state.get("offset"), old_hosts,
+            new_hosts, state.get("epoch"))
+        out["offset"] = 0
+    return out
+
+
+# -- the one-call entry point ------------------------------------------------
+
+def preflight_elastic(session, meta: dict, context: str = "elastic") -> None:
+    """Re-run the static analysis passes against the (possibly shrunken)
+    mesh with the checkpoint's provenance attached — ZeRO-1 reshard
+    legality (``elastic/*`` rules), ``sync/ring-degenerate`` on the new
+    axis size, and the HBM re-estimate at 1/M — raising
+    ``StrategyValidationError`` before any restore or tracing."""
+    from autodist_tpu.analysis import analyze, log_report
+
+    compiled = session._step.compiled_strategy
+    report = analyze(compiled, session._gi,
+                     elastic={"from_axes": meta.get("mesh_axes") or {},
+                              "buckets": meta.get("zero1_buckets")})
+    log_report(report, context)
+    report.raise_for_errors()
+
+
+def elastic_restore(session, path: str, validate: bool = True) -> int:
+    """Restore ``path`` into ``session`` across a topology change.
+
+    Thin orchestration over :class:`~autodist_tpu.checkpoint.Saver`
+    (whose ``restore`` performs the actual reshard when needed), adding
+    the pre-flight analysis gate.  Returns the restored step."""
+    from autodist_tpu.checkpoint.saver import Saver
+
+    meta = Saver.read_meta(path)
+    if validate:
+        preflight_elastic(session, meta, context=f"elastic:{path}")
+    return Saver(session).restore(path)

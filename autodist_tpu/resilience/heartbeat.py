@@ -1,0 +1,267 @@
+"""Liveness beacons + the chief-side watchdog.
+
+The fail-fast watcher (``coordinator.py``) only ever sees ONE failure
+mode: the worker process exits.  The common TPU failure mode is the
+other one — the process is alive but wedged in a collective because a
+peer died or the fabric hiccuped, and nothing ever exits.  This module
+closes that gap with two halves:
+
+* each worker runs a :class:`HeartbeatWriter` — a tiny file beacon
+  (atomic JSON: timestamp, pid, last completed step) refreshed by a
+  daemon thread and bumped with the step number from a
+  :class:`HeartbeatCallback` in the training loop;
+* the chief (or the job supervisor) runs a :class:`HeartbeatMonitor`
+  that classifies each worker as ALIVE / WEDGED / DEAD / UNKNOWN.
+
+The classification rule distinguishes "process exited" from "process
+wedged in a collective": a stale beacon whose pid is gone is DEAD
+(relaunch it); a FRESH beacon whose *step* has not advanced within
+``step_timeout`` is WEDGED — the beacon thread keeps beating while the
+main thread is stuck in a collective, so wall-clock beacon age alone can
+never catch a hang; only step progress can.  Beacons ride the
+filesystem (worker-local for local processes, a shared/NFS checkpoint
+volume for multi-host), so no extra control channel is needed.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Sequence
+
+from autodist_tpu.utils import logging
+
+BEAT_SUFFIX = ".hb"
+
+#: worker health states (strings, so reports serialize trivially).
+ALIVE = "alive"
+WEDGED = "wedged"     # process exists but step progress stalled
+DEAD = "dead"         # beacon stale and the pid is gone
+UNKNOWN = "unknown"   # no beacon seen yet (within the grace window)
+
+
+def beat_path(directory: str, worker: str) -> str:
+    safe = worker.replace("/", "_").replace(":", "_")
+    return os.path.join(directory, safe + BEAT_SUFFIX)
+
+
+class HeartbeatWriter:
+    """Worker-side beacon: atomic JSON heartbeat file.
+
+    ``beat(step=...)`` writes immediately; ``start()`` spawns a daemon
+    thread refreshing the beacon every ``interval`` seconds so liveness
+    is reported even between steps (long compiles, eval epochs).  A
+    :class:`~autodist_tpu.resilience.chaos.ChaosMonkey` can be attached
+    to drop beacons deterministically (``drop_heartbeats`` events).
+    """
+
+    def __init__(self, directory: str, worker: str, interval: float = 5.0,
+                 chaos=None):
+        self._path = beat_path(directory, worker)
+        os.makedirs(directory, exist_ok=True)
+        self._interval = interval
+        self._chaos = chaos
+        self._last_step: Optional[int] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def path(self) -> str:
+        return self._path
+
+    def beat(self, step: Optional[int] = None) -> None:
+        if self._chaos is not None and not self._chaos.heartbeats_enabled:
+            return
+        if step is not None:
+            self._last_step = int(step)
+        payload = {"time": time.time(), "pid": os.getpid(),
+                   "step": self._last_step}
+        tmp = self._path + ".tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(payload, f)
+            os.replace(tmp, self._path)   # atomic: the monitor never sees
+            # a half-written beacon
+        except OSError as e:  # beacons are best-effort; never kill training
+            logging.warning("heartbeat write failed (%s): %s", self._path, e)
+
+    def start(self) -> "HeartbeatWriter":
+        if self._thread is None:
+            self.beat()
+            self._thread = threading.Thread(target=self._run, daemon=True,
+                                            name="autodist-heartbeat")
+            self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval):
+            self.beat()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self._interval + 1)
+            self._thread = None
+
+    def __enter__(self) -> "HeartbeatWriter":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+class HeartbeatCallback:
+    """``fit`` callback bumping the beacon with each completed step —
+    the step-progress signal :class:`HeartbeatMonitor` needs to tell a
+    wedge from a slow step.  Duck-typed to
+    :class:`autodist_tpu.fit.Callback` (all hooks optional there)."""
+
+    def __init__(self, writer: HeartbeatWriter):
+        self._writer = writer
+
+    def on_train_begin(self, session) -> None:
+        self._writer.start()
+
+    def on_epoch_begin(self, epoch: int) -> None: ...
+
+    def on_step_end(self, step: int, metrics) -> None:
+        self._writer.beat(step=step)
+
+    def on_epoch_end(self, epoch: int, logs) -> None: ...
+
+    def on_train_end(self, history) -> None:
+        self._writer.stop()
+
+
+@dataclass
+class WorkerHealth:
+    worker: str
+    state: str                        # ALIVE | WEDGED | DEAD | UNKNOWN
+    age: Optional[float] = None       # seconds since the last beacon
+    step: Optional[int] = None        # last completed step, if reported
+    pid: Optional[int] = None
+    detail: str = ""
+
+
+@dataclass
+class _Progress:
+    step: Optional[int] = None
+    since: float = field(default_factory=time.time)
+
+
+class HeartbeatMonitor:
+    """Chief/supervisor-side watchdog over a beacon directory.
+
+    Args:
+      directory: where the workers' :class:`HeartbeatWriter` files live.
+      timeout: beacon age (seconds) past which a worker is suspect; the
+        pid is then probed (same-host) to split DEAD from WEDGED.
+      step_timeout: wall-clock budget for ONE step; a worker whose
+        beacons stay fresh but whose ``step`` does not advance within it
+        is WEDGED — the wedged-in-a-collective case beacon age alone
+        cannot see.  None disables progress tracking.
+      grace: how long a worker may be beaconless after ``expect`` before
+        UNKNOWN hardens into DEAD (defaults to ``timeout``).
+    """
+
+    def __init__(self, directory: str, timeout: float = 30.0,
+                 step_timeout: Optional[float] = None,
+                 grace: Optional[float] = None,
+                 expected: Sequence[str] = ()):
+        self._dir = directory
+        self._timeout = timeout
+        self._step_timeout = step_timeout
+        self._grace = timeout if grace is None else grace
+        self._expected = list(expected)
+        self._started = time.time()
+        self._progress: Dict[str, _Progress] = {}
+
+    def expect(self, worker: str) -> None:
+        if worker not in self._expected:
+            self._expected.append(worker)
+
+    @staticmethod
+    def _pid_alive(pid: Optional[int]) -> Optional[bool]:
+        """True/False when decidable on this host, None when not."""
+        if not pid:
+            return None
+        try:
+            os.kill(pid, 0)
+            return True
+        except ProcessLookupError:
+            return False
+        except PermissionError:   # exists, owned by someone else
+            return True
+        except OSError:
+            return None
+
+    def _read(self, worker: str) -> Optional[dict]:
+        path = beat_path(self._dir, worker)
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                payload = json.load(f)
+            payload["_mtime"] = os.stat(path).st_mtime
+            return payload
+        except (OSError, ValueError):
+            return None
+
+    def _discovered(self) -> Sequence[str]:
+        try:
+            names = [n[:-len(BEAT_SUFFIX)] for n in os.listdir(self._dir)
+                     if n.endswith(BEAT_SUFFIX)]
+        except OSError:
+            names = []
+        out = list(self._expected)
+        for n in names:
+            if n not in out:
+                out.append(n)
+        return out
+
+    def check(self, worker: str, now: Optional[float] = None) -> WorkerHealth:
+        now = time.time() if now is None else now
+        payload = self._read(worker)
+        if payload is None:
+            waited = now - self._started
+            state = DEAD if waited > self._grace else UNKNOWN
+            return WorkerHealth(worker, state,
+                                detail=f"no beacon after {waited:.1f}s")
+        # mtime is the liveness clock (monotone on one filesystem even
+        # when writer/monitor wall clocks disagree); the payload time is
+        # advisory.
+        age = now - payload["_mtime"]
+        pid = payload.get("pid")
+        step = payload.get("step")
+        if age > self._timeout:
+            alive = self._pid_alive(pid)
+            if alive:
+                return WorkerHealth(worker, WEDGED, age=age, step=step,
+                                    pid=pid,
+                                    detail="beacon stale but process alive")
+            return WorkerHealth(
+                worker, DEAD, age=age, step=step, pid=pid,
+                detail="beacon stale" + ("" if alive is False
+                                         else " (pid unverifiable)"))
+        if self._step_timeout is not None and step is not None:
+            prog = self._progress.get(worker)
+            if prog is None or prog.step != step:
+                self._progress[worker] = _Progress(step=step, since=now)
+            elif now - prog.since > self._step_timeout:
+                return WorkerHealth(
+                    worker, WEDGED, age=age, step=step, pid=pid,
+                    detail=f"step {step} stalled for "
+                           f"{now - prog.since:.1f}s (beacons fresh — "
+                           "likely wedged in a collective)")
+        return WorkerHealth(worker, ALIVE, age=age, step=step, pid=pid)
+
+    def status(self) -> Dict[str, WorkerHealth]:
+        now = time.time()
+        return {w: self.check(w, now) for w in self._discovered()}
+
+    def failures(self) -> Dict[str, WorkerHealth]:
+        """Workers the supervisor should treat as failed (DEAD or
+        WEDGED — a wedged worker blocks every peer's collectives, so it
+        is terminated and relaunched exactly like a dead one)."""
+        return {w: h for w, h in self.status().items()
+                if h.state in (DEAD, WEDGED)}

@@ -98,6 +98,20 @@ class DistributedSession:
     def mesh(self):
         return self._step.mesh
 
+    @property
+    def data_axis_size(self) -> int:
+        from autodist_tpu.const import MESH_AXIS_DATA
+
+        return int(self._step.mesh.shape.get(MESH_AXIS_DATA, 1))
+
+    @property
+    def zero1_buckets(self):
+        """The ZeRO-1 flat-bucket plan of the compiled step (empty unless
+        the explicit reduce-scatter path is active).  Checkpoints record
+        it so elastic resume can reslice the flat optimizer shards at a
+        different data-axis size (``resilience/elastic.py``)."""
+        return tuple(getattr(self._step, "zero1_buckets", ()) or ())
+
     # -- running -----------------------------------------------------------
     def place_batch(self, batch: Any) -> Any:
         """Pre-place a host batch with the strategy's input shardings.

@@ -92,6 +92,11 @@ class DataLoader:
         self._prefetch_depth = prefetch_depth
         self._seed = seed
         self._epoch = 0
+        # Exact mid-epoch resume plumbing (state()/load_state()): the next
+        # __iter__ replays epoch `_epoch` skipping its first
+        # `_pending_offset` batches; `_live` tracks the in-flight epoch.
+        self._pending_offset = 0
+        self._live: Optional[dict] = None
 
         keys = self._names if self._names is not None else range(len(arrays))
         self._cast = []
@@ -125,19 +130,77 @@ class DataLoader:
             return tuple(batch_list)
         return dict(zip(self._names, batch_list))
 
+    # -- exact resume ------------------------------------------------------
+    def state(self, consumed: Optional[int] = None) -> Dict[str, int]:
+        """Snapshot the iteration position for exact resume.
+
+        Returns ``{"epoch": e, "offset": o, "seed": s}`` — the next batch
+        to produce is batch ``o`` of epoch ``e`` (the MT19937 per-epoch
+        permutation makes replay deterministic for a given seed, in both
+        native and numpy modes).  ``consumed`` overrides the within-epoch
+        count with the CALLER's number of consumed batches — required
+        when a prefetcher pulls batches ahead of the training step, since
+        this loader cannot know how many of its yields were actually
+        stepped (``fit`` passes its own step count).
+        """
+        live = self._live
+        if live is not None and not live["done"]:
+            off = live["base"] + (live["yielded"] if consumed is None
+                                  else int(consumed))
+            epoch = live["epoch"]
+            if self.num_batches and off >= self.num_batches:
+                epoch, off = epoch + 1, 0
+            return {"epoch": epoch, "offset": off, "seed": self._seed}
+        return {"epoch": self._epoch, "offset": 0, "seed": self._seed}
+
+    def load_state(self, state: Dict[str, int]) -> Dict[str, int]:
+        """Position the loader so its next iteration continues exactly at
+        the snapshot: epoch ``state['epoch']`` from batch
+        ``state['offset']`` (earlier batches of that epoch are replayed
+        and discarded — cheap host work).  Returns the normalized
+        position.  The snapshot's shuffle seed must match this loader's;
+        a different seed cannot reproduce the recorded batch order."""
+        if "seed" in state and int(state["seed"]) != self._seed:
+            raise ValueError(
+                f"data state was recorded with seed {state['seed']} but "
+                f"this loader uses seed {self._seed}; exact resume needs "
+                "the identical shuffle stream")
+        epoch = int(state["epoch"])
+        offset = int(state.get("offset", 0))
+        nb = self.num_batches
+        if nb and offset >= nb:       # snapshot at an epoch boundary
+            epoch += offset // nb
+            offset = offset % nb
+        self._epoch = epoch
+        self._pending_offset = offset
+        self._live = None
+        return {"epoch": epoch, "offset": offset, "seed": self._seed}
+
     # -- iteration ---------------------------------------------------------
     def __len__(self) -> int:
         return self.num_batches
 
     def __iter__(self):
-        epoch_seed = self._seed + self._epoch
+        epoch = self._epoch
+        epoch_seed = self._seed + epoch
         self._epoch += 1
+        start = self._pending_offset
+        self._pending_offset = 0
+        live = self._live = {"epoch": epoch, "base": start, "yielded": 0,
+                             "done": False}
         if self._arrays[0].shape[0] == 0:
+            live["done"] = True
             return  # empty split: zero batches in both modes
-        if self._use_native:
-            yield from self._iter_native(epoch_seed)
-        else:
-            yield from self._iter_numpy(epoch_seed)
+        it = self._iter_native(epoch_seed) if self._use_native \
+            else self._iter_numpy(epoch_seed)
+        for i, batch in enumerate(it):
+            if i < start:
+                continue   # replaying a resumed epoch up to the offset
+            # Count BEFORE yielding: the generator suspends at the yield,
+            # so a post-yield increment would lag the consumer by one.
+            live["yielded"] += 1
+            yield batch
+        live["done"] = True
 
     def _iter_native(self, epoch_seed: int):
         loader = _native.NativeLoader(
