@@ -45,6 +45,16 @@ def _demo_models() -> Dict[str, dict]:
                            "bias": ((8,), "float32")},
             },
         },
+        # the same net in bf16 storage — the numerics/* rules' demo
+        # (docs/numerics.md): low-precision gradients want the guard.
+        "mlp_bf16": {
+            "params": {
+                "dense1": {"kernel": ((128, 64), "bfloat16"),
+                           "bias": ((64,), "bfloat16")},
+                "dense2": {"kernel": ((64, 8), "bfloat16"),
+                           "bias": ((8,), "bfloat16")},
+            },
+        },
         # embedding LM slice (examples/lm1b): sparse vocab table
         "embedding_lm": {
             "params": {
@@ -138,6 +148,50 @@ def _build_strategy(strategy_arg: str, graph_item, resource_spec):
     return builder_cls().build(graph_item, resource_spec)
 
 
+def _parse_numerics(spec: str):
+    """``--numerics`` grammar → a NumericsConfig (or None for 'off'):
+    ``on`` / ``off`` / an on_nonfinite policy name / comma-separated
+    ``field=value`` pairs (``loss_scale`` takes auto|none|<float>;
+    ``clip_norm``/``spike_zscore`` floats; ``rollback_after`` int)."""
+    from autodist_tpu.numerics.policy import ON_NONFINITE, NumericsConfig
+
+    s = spec.strip()
+    if s in ("off", "false", "0"):
+        return None
+    if s in ("on", "true", "1", "auto"):
+        return NumericsConfig()
+    if s in ON_NONFINITE:
+        return NumericsConfig(on_nonfinite=s)
+    fields: Dict[str, object] = {}
+    for part in s.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise SystemExit(
+                f"bad --numerics entry {part!r}: use field=value, e.g. "
+                "loss_scale=65536,clip_norm=1.0 (or on/off/skip/raise/"
+                "rollback)")
+        k, v = (x.strip() for x in part.split("=", 1))
+        if k == "loss_scale":
+            fields[k] = None if v in ("none", "off") else (
+                v if v == "auto" else float(v))
+        elif k in ("clip_norm", "spike_zscore"):
+            fields[k] = None if v == "none" else float(v)
+        elif k in ("rollback_after", "spike_window", "max_rollbacks"):
+            fields[k] = int(v)
+        elif k in ("guard", "reseed_on_rollback"):
+            fields[k] = v in ("1", "true", "on", "yes")
+        elif k == "on_nonfinite":
+            fields[k] = v
+        else:
+            raise SystemExit(f"unknown --numerics field {k!r}")
+    try:
+        return NumericsConfig(**fields)
+    except (TypeError, ValueError) as e:
+        raise SystemExit(f"bad --numerics spec {spec!r}: {e}")
+
+
 def _parse_mesh(mesh_arg: str) -> Dict[str, int]:
     axes: Dict[str, int] = {}
     for part in mesh_arg.split(","):
@@ -188,6 +242,14 @@ def main(argv=None) -> int:
                              "elastic/* rules plus the normal passes on "
                              "the new mesh (ring degeneracy re-check, "
                              "HBM at the new 1/M; docs/resilience.md)")
+    parser.add_argument("--numerics", default=None, metavar="SPEC",
+                        help="stamp a numerics-guard config onto the "
+                             "program before analyzing (docs/numerics.md)"
+                             ": 'on'/'off', an on_nonfinite policy "
+                             "(skip|raise|rollback), or comma-separated "
+                             "fields like 'loss_scale=1e36,clip_norm=1' "
+                             "— lint loss scaling against quantizing "
+                             "compressors (numerics/* rules)")
     parser.add_argument("--passes", default=None,
                         help="comma-separated subset of passes "
                              "(default: all)")
@@ -238,6 +300,8 @@ def main(argv=None) -> int:
             "mesh": dict(axes)})
 
     graph_item = _build_graph_item(args.model)
+    if args.numerics:
+        graph_item.numerics = _parse_numerics(args.numerics)
     strategy = _build_strategy(args.strategy, graph_item, resource_spec)
     if args.overlap:
         from autodist_tpu.strategy.base import AllReduceSynchronizerConfig

@@ -73,6 +73,13 @@ class PlanLite:
     # (overlap.OVERLAP_MODES); the sync pass checks it against the mesh
     # and the program (sync/ring-degenerate, sync/overlap-fallback).
     overlap: str = "auto"
+    # Numerics projection (docs/numerics.md), stamped by the legality
+    # pass from the program's NumericsConfig: is the fused guard active
+    # for this var's sync, and the PEAK loss scale its gradient can ride
+    # (0.0 = scaling off) — what the numerics/* precision rules check
+    # against quantizing compressors' wire dtypes.
+    guard: bool = False
+    loss_scale: float = 0.0
 
     def physical_shape(self) -> Tuple[int, ...]:
         shape = list(self.var.shape)

@@ -462,12 +462,33 @@ def _check_mesh_hint(ctx: AnalysisContext) -> List[Diagnostic]:
     return diags
 
 
+def _stamp_numerics(ctx: AnalysisContext, plans) -> None:
+    """Project the program-level numerics config onto each plan: is the
+    fused guard active for this variable's sync, and what loss scale
+    rides its gradient?  Shares the runtime's exact resolution
+    (``numerics.loss_scale.resolve_loss_scale``) so the ``numerics/*``
+    precision rules can never drift from what the step would build."""
+    cfg = getattr(ctx.graph_item, "numerics", None)
+    if cfg is None or not cfg.guard:
+        return
+    from autodist_tpu.numerics.loss_scale import resolve_loss_scale
+
+    dtypes = [str(p.var.dtype) for p in plans.values()]
+    ls = resolve_loss_scale(cfg.loss_scale, dtypes)
+    peak = 0.0 if ls is None else (ls.max_scale if ls.dynamic else ls.init)
+    for plan in plans.values():
+        if plan.sync_kind is not None:
+            plan.guard = True
+            plan.loss_scale = float(peak)
+
+
 @register_pass("legality")
 def run(ctx: AnalysisContext) -> List[Diagnostic]:
     if ctx.compiled is not None:
         plans, diags = _lower_from_compiled(ctx)
     else:
         plans, diags = _lower_from_strategy(ctx)
+    _stamp_numerics(ctx, plans)
     ctx.plans = plans
     diags += _check_batch_layout(ctx)
     diags += _check_mesh_hint(ctx)

@@ -34,6 +34,22 @@ Rules (docs/analysis.md):
 * ``precision/sparse-compressed`` (WARN) — a compressor on a
   sparse-gradient (embedding) variable densifies the scatter-structured
   gradient before compressing it.
+
+Numerics rules (docs/numerics.md; the guard/loss-scale projection is
+stamped onto :class:`PlanLite` by the legality pass from the program's
+``capture(numerics=...)`` config, via the runtime's own resolution):
+
+* ``numerics/loss-scale-saturates-wire`` (ERROR) — a quantizing
+  compressor whose float wire dtype the configured loss scale can
+  saturate: a saturated wire value dequantizes to a FINITE number, so
+  the post-dequantize guard cannot see the overflow — the one overflow
+  class detection-inside-the-sync-path exists for.  Shares
+  ``numerics.loss_scale.scale_saturates_wire`` with the runtime's
+  build-time check.
+* ``numerics/no-loss-scale`` (WARN) — an fp16/bf16 gradient reduced
+  without the numerics guard (or with loss scaling resolved off): a
+  low-precision overflow/underflow poisons the parameters silently;
+  ``capture(numerics=True)`` turns on detection + auto scaling.
 """
 from __future__ import annotations
 
@@ -138,4 +154,41 @@ def run(ctx: AnalysisContext) -> List[Diagnostic]:
                     var=plan.var.name,
                     fix="uncompress it, or keep the program on the GSPMD "
                         "path"))
+
+    # -- numerics/* rules (docs/numerics.md) -------------------------------
+    from autodist_tpu.numerics.loss_scale import (
+        LossScale,
+        is_low_precision,
+        scale_saturates_wire,
+    )
+    for plan in ctx.plans.values():
+        if plan.sync_kind != "AllReduce":
+            continue
+        comp = plan.compressor or "NoneCompressor"
+        if plan.guard and plan.loss_scale > 0:
+            why = scale_saturates_wire(
+                LossScale(init=plan.loss_scale, dynamic=False), comp)
+            if why is not None:
+                diags.append(diag(
+                    "numerics/loss-scale-saturates-wire", Severity.ERROR,
+                    f"{why}; the saturated wire value dequantizes to a "
+                    "FINITE number, so the post-dequantize guard cannot "
+                    "see the overflow",
+                    var=plan.var.name,
+                    fix="lower max_scale/init below the wire dtype's "
+                        "range (headroom included) or drop the "
+                        "quantizing compressor"))
+        if is_low_precision(plan.var.dtype) and (
+                not plan.guard or plan.loss_scale <= 0):
+            diags.append(diag(
+                "numerics/no-loss-scale", Severity.WARN,
+                f"{plan.var.dtype} gradients reduce without "
+                + ("the numerics guard" if not plan.guard
+                   else "loss scaling")
+                + ": a low-precision overflow/underflow poisons the "
+                  "parameters silently (no detection, no skip)",
+                var=plan.var.name,
+                fix="capture(numerics=True) — fused non-finite "
+                    "detection plus auto loss scaling for low-precision "
+                    "programs"))
     return diags

@@ -107,7 +107,8 @@ class AutoDist:
                 has_aux: bool = False,
                 metrics_fn: Optional[Callable] = None,
                 grad_fn: Optional[Callable] = None,
-                accum_steps: int = 1) -> GraphItem:
+                accum_steps: int = 1,
+                numerics=None) -> GraphItem:
         """Capture the training program (the explicit analog of the
         reference's optimizer/gradient monkeypatch hooks,
         graph_item.py:72-108).  ``metrics_fn(params, batch) -> dict``
@@ -118,7 +119,16 @@ class AutoDist:
         batch B at the live activation memory of B/N for the gradient
         pass; a ``metrics_fn`` still runs one full-batch forward).  With
         ``has_aux`` the per-step aux comes back STACKED along a leading
-        ``[N]`` axis (one entry per microbatch)."""
+        ``[N]`` axis (one entry per microbatch).
+
+        ``numerics`` enables the numerics guard (docs/numerics.md):
+        ``True`` for defaults (fused non-finite detection + skip +
+        auto loss scaling), an ``on_nonfinite`` string
+        (``"skip"|"raise"|"rollback"``), a dict of
+        :class:`~autodist_tpu.numerics.NumericsConfig` fields (e.g.
+        ``{"clip_norm": 1.0}`` for exact global-norm clipping), or a
+        config instance.  Default None — no guard, byte-identical
+        steps."""
         if self.is_built():
             raise RuntimeError(
                 "Cannot capture after the distributed session was created "
@@ -128,7 +138,7 @@ class AutoDist:
             sparse_vars=sparse_vars, untrainable_vars=untrainable_vars,
             pipeline_vars=pipeline_vars, expert_vars=expert_vars,
             remat=remat, has_aux=has_aux, metrics_fn=metrics_fn,
-            grad_fn=grad_fn, accum_steps=accum_steps)
+            grad_fn=grad_fn, accum_steps=accum_steps, numerics=numerics)
         return self._graph_item
 
     @property

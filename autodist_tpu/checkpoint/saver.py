@@ -31,6 +31,14 @@ Resilience integration (docs/resilience.md):
   item presence; deep: checksum comparison); :meth:`latest_step` runs
   the shallow check so a damaged step is skipped, not resumed.
 * ``keep=N`` garbage-collects old ``step_N`` dirs after a durable save.
+
+Verified-good steps (docs/numerics.md): :meth:`mark_good` stamps a step
+that passed :meth:`verify` (deep, by default) AND whose training health
+the caller vouches for (``fit`` marks saves taken with a clean numerics
+guard).  :meth:`latest_step` prefers verified-good steps over
+merely-uncorrupted ones, :meth:`restore_last_good` is the numerics
+rollback's restore path, and retention never garbage-collects the last
+good step — the rollback anchor survives any ``keep=``.
 """
 from __future__ import annotations
 
@@ -38,6 +46,7 @@ import json
 import os
 import re
 import shutil
+import time
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -48,6 +57,9 @@ from autodist_tpu.kernel.sharding_utils import abstract_like as _abstract_like
 from autodist_tpu.utils import logging
 
 _STEP_RE = re.compile(r"^step_(\d+)$")
+
+#: marker file a verified-good step carries (see Saver.mark_good).
+GOOD_MARKER = "VERIFIED_GOOD.json"
 
 #: autodist_meta schema version (1 = step/has_sync_state only).
 META_FORMAT = 2
@@ -88,13 +100,23 @@ class Saver:
         self._keep = keep
         self._checksum = checksum
         self._gc_dir: Optional[str] = None
+        self._pending_mark: Optional[str] = None
         self._ckptr = ocp.AsyncCheckpointer(ocp.CompositeCheckpointHandler())
 
     def wait(self) -> None:
         """Block until any in-flight async save is durable on disk, then
-        apply retention."""
+        apply any deferred good-mark and retention."""
         self._ckptr.wait_until_finished()
+        self._apply_pending_mark()
         self._maybe_gc()
+
+    def _apply_pending_mark(self) -> None:
+        """Good-marking an ASYNC save must wait for durability (a deep
+        verify of an in-flight save would fail); applied here once the
+        commit is known finished."""
+        if self._pending_mark is not None:
+            path, self._pending_mark = self._pending_mark, None
+            Saver.mark_good(path)
 
     # -- helpers -----------------------------------------------------------
     @staticmethod
@@ -118,11 +140,27 @@ class Saver:
         return sorted(steps)
 
     @staticmethod
+    def good_steps(directory: str) -> List[int]:
+        """Committed steps carrying a :meth:`mark_good` marker, sorted."""
+        return [s for s in Saver._committed_steps(directory)
+                if os.path.exists(os.path.join(
+                    Saver._step_dir(directory, s), GOOD_MARKER))]
+
+    @staticmethod
     def latest_step(directory: str, verify: bool = True) -> Optional[int]:
-        """Newest step that passes :meth:`verify` (shallow).  A corrupt or
-        truncated step — not just a missing ``params`` dir — is skipped
-        with a warning and resume falls back to the previous good one."""
-        for step in reversed(Saver._committed_steps(directory)):
+        """Newest usable step, VERIFIED-GOOD steps first: a step that
+        passed :meth:`mark_good` (deep verify + healthy training state)
+        outranks a newer merely-uncorrupted one — resuming onto a
+        poisoned-but-intact checkpoint is the failure mode the numerics
+        guard exists to prevent.  Within each class, newest first; every
+        candidate still passes the shallow :meth:`verify` (a corrupt or
+        truncated step is skipped with a warning).  Directories with no
+        good markers behave exactly as before."""
+        committed = Saver._committed_steps(directory)
+        good = set(Saver.good_steps(directory))
+        ranked = [s for s in reversed(committed) if s in good] \
+            + [s for s in reversed(committed) if s not in good]
+        for step in ranked:
             path = Saver._step_dir(directory, step)
             if not verify or Saver.verify(path):
                 return step
@@ -210,6 +248,60 @@ class Saver:
                     return False
         return True
 
+    # -- verified-good steps (docs/numerics.md) ----------------------------
+    @staticmethod
+    def mark_good(path: str, deep: bool = True) -> bool:
+        """Stamp a step dir as *verified-good*: it passes :meth:`verify`
+        (``deep=True`` re-reads every checksummed item — the PR 4
+        integrity machinery) and the caller vouches for the training
+        state it froze (``fit`` only marks saves taken with a clean
+        numerics guard).  Returns False — without stamping — when
+        verification fails.  The marker makes the step preferred by
+        :meth:`latest_step`, restorable by :meth:`restore_last_good`,
+        and immune to ``keep=`` garbage collection (last one)."""
+        path = os.path.abspath(path)
+        if not Saver.verify(path, deep=deep):
+            logging.warning(
+                "mark_good: %s failed %s verification — NOT marked",
+                path, "deep" if deep else "shallow")
+            return False
+        meta = Saver.read_meta(path)
+        marker = os.path.join(path, GOOD_MARKER)
+        tmp = marker + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"step": int(meta.get("step", 0)),
+                       "deep_verified": bool(deep),
+                       "time": time.time()}, f)
+        os.replace(tmp, marker)
+        logging.info("checkpoint %s marked verified-good", path)
+        return True
+
+    @staticmethod
+    def last_good_checkpoint(directory: str) -> Optional[str]:
+        """Newest verified-good step dir that still passes verification
+        (shallow here; the marker already attests a deep pass), or None."""
+        for step in reversed(Saver.good_steps(directory)):
+            path = Saver._step_dir(directory, step)
+            if Saver.verify(path):
+                return path
+            logging.warning(
+                "checkpoint %s was marked good but no longer verifies — "
+                "skipping", path)
+        return None
+
+    def restore_last_good(self, directory: str, session=None) -> int:
+        """Restore the newest verified-good checkpoint (the numerics
+        rollback path); returns its step.  Raises FileNotFoundError when
+        no good step exists — the caller decides whether that is fatal
+        (``fit`` raises NonFiniteError)."""
+        path = self.last_good_checkpoint(directory)
+        if path is None:
+            raise FileNotFoundError(
+                f"no verified-good checkpoint under {directory} "
+                "(mark_good was never called, or every good step was "
+                "corrupted)")
+        return self.restore(path, session=session)
+
     # -- retention ---------------------------------------------------------
     def _maybe_gc(self) -> None:
         if self._keep is None or self._gc_dir is None:
@@ -220,7 +312,17 @@ class Saver:
         except Exception:
             pass
         steps = self._committed_steps(self._gc_dir)
+        good = self.good_steps(self._gc_dir)
+        protected = {max(good)} if good else set()
         for step in steps[:-self._keep]:
+            if step in protected:
+                # The last verified-good step is the rollback anchor:
+                # keep= must never delete it, or a numerics rollback
+                # would have nothing safe to restore.
+                logging.info(
+                    "checkpoint retention (keep=%d): keeping verified-"
+                    "good step_%d beyond the window", self._keep, step)
+                continue
             victim = self._step_dir(self._gc_dir, step)
             shutil.rmtree(victim, ignore_errors=True)
             logging.info("checkpoint retention (keep=%d): removed %s",
@@ -228,11 +330,18 @@ class Saver:
 
     # -- save --------------------------------------------------------------
     def save(self, directory: str, step: Optional[int] = None,
-             session=None, extra_meta: Optional[dict] = None) -> str:
+             session=None, extra_meta: Optional[dict] = None,
+             mark_good: bool = False) -> str:
+        """``mark_good=True`` additionally stamps the step verified-good
+        once durable (immediately for sync saves; at the next
+        :meth:`wait`/save boundary for async ones) — the caller's
+        attestation that the saved training state is healthy (``fit``
+        sets it from the numerics guard)."""
         session = session or self._session
         if session is None:
             raise ValueError("Saver has no bound session")
         self._ckptr.wait_until_finished()   # one async save in flight max
+        self._apply_pending_mark()
         self._maybe_gc()                    # previous save is durable now
         step = session.step_count if step is None else step
         path = self._step_dir(directory, step)
@@ -279,8 +388,11 @@ class Saver:
         self._ckptr.save(os.path.abspath(path),
                          args=ocp.args.Composite(**items), force=True)
         self._gc_dir = directory
+        if mark_good:
+            self._pending_mark = path
         if not self._async:
             self._ckptr.wait_until_finished()
+            self._apply_pending_mark()
             self._maybe_gc()
         logging.info("checkpoint %s: %s (step %d)",
                      "saving in background" if self._async else "saved",
@@ -300,6 +412,7 @@ class Saver:
         if session is None:
             raise ValueError("Saver has no bound session")
         self._ckptr.wait_until_finished()   # don't read an in-flight save
+        self._apply_pending_mark()
         path = os.path.abspath(path)
         meta = self.read_meta(path)
         params_target, opt_target = session.restore_targets()

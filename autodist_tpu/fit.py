@@ -29,6 +29,11 @@ from typing import Any, Callable, Dict, Iterable, Optional, Sequence, Union
 
 import numpy as np
 
+from autodist_tpu.numerics.policy import (  # light import: no jax
+    NonFiniteError,
+    RollbackRequest as _RollbackRequest,
+    emit_failure_marker as _emit_failure_marker,
+)
 from autodist_tpu.utils import logging
 
 
@@ -168,6 +173,7 @@ def fit(session, data: DataArg, epochs: int = 1,
         initial_epoch: Optional[int] = None,
         prefetch_depth: int = 2,
         preemption_signals: Sequence = (),
+        on_nonfinite: Optional[str] = None,
         validate: bool = False) -> History:
     """Train ``epochs`` × (``steps_per_epoch`` or len(data)) steps.
 
@@ -226,6 +232,19 @@ def fit(session, data: DataArg, epochs: int = 1,
         reference's closest facility is fail-fast process reaping
         (coordinator.py:98-110) — graceful preemption is beyond-parity.
 
+      on_nonfinite: override the captured numerics policy
+        (``capture(numerics=...)``, docs/numerics.md) for this fit:
+        ``"skip"`` (device-side zero-update, counted in
+        ``history["skipped_steps"]``), ``"raise"`` (fetch health every
+        step; :class:`~autodist_tpu.numerics.NonFiniteError` on the
+        first bad one), or ``"rollback"`` (after K consecutive bad steps
+        or a loss-spike z-score, restore the last VERIFIED-GOOD
+        checkpoint — saves taken under a clean guard are deep-verified
+        and marked — re-seed the data order when the loader supports it,
+        emit a supervisor failure marker, and resume; bounded by
+        ``NumericsConfig.max_rollbacks``).  Requires the numerics guard;
+        ``raise``/``rollback`` cost one host sync per step.
+
       validate: run the static pre-flight analyzer
         (:mod:`autodist_tpu.analysis`) on the session's compiled
         strategy before anything else — before the checkpoint restore,
@@ -244,6 +263,32 @@ def fit(session, data: DataArg, epochs: int = 1,
         preflight_session(session)
     # A bad signal name must likewise fail before any restore runs.
     handler_nums = _validate_signals(preemption_signals)
+
+    # Numerics host policy (docs/numerics.md): the captured config wins
+    # unless this fit overrides it; raise/rollback (and the loss-spike
+    # detector) need a per-step host health fetch — a StepHealthMonitor.
+    num_cfg = getattr(getattr(session, "_gi", None), "numerics", None)
+    if on_nonfinite is not None:
+        from autodist_tpu.numerics.policy import ON_NONFINITE
+        if on_nonfinite not in ON_NONFINITE:
+            raise ValueError(
+                f"on_nonfinite must be one of {ON_NONFINITE}, "
+                f"got {on_nonfinite!r}")
+        if num_cfg is None or not num_cfg.guard:
+            raise ValueError(
+                "fit(on_nonfinite=...) needs the numerics guard: pass "
+                "numerics=... to AutoDist.capture (docs/numerics.md)")
+    policy = on_nonfinite or (num_cfg.on_nonfinite if num_cfg else None)
+    monitor = None
+    if num_cfg is not None and num_cfg.guard and (
+            policy in ("raise", "rollback")
+            or num_cfg.spike_zscore is not None):
+        from autodist_tpu.numerics.policy import StepHealthMonitor
+        monitor = StepHealthMonitor(num_cfg, policy=policy)
+        if policy == "rollback" and checkpoint_dir is None:
+            raise ValueError(
+                "on_nonfinite='rollback' needs checkpoint_dir (the last "
+                "verified-good checkpoint is the rollback anchor)")
     saver = None
     resumed_step = None
     data_resume = None
@@ -332,28 +377,46 @@ def fit(session, data: DataArg, epochs: int = 1,
 
     preempt = {"signum": None}
     hist = History()
+    guard_state = {"last_finite": None, "last_skipped": None}
     with _preemption_handlers(handler_nums, preempt):
         # on_train_begin runs INSIDE the handler scope: a SIGTERM during
         # a slow user callback must still flag (and checkpoint at the
         # first step boundary), not kill the process.
         for cb in callbacks:
             cb.on_train_begin(session)
-        last_saved_step = _fit_epochs(
-            session=session, data=data, epochs=epochs,
-            steps_per_epoch=steps_per_epoch,
-            validation_data=validation_data,
-            validation_steps=validation_steps, callbacks=callbacks,
-            log_every=log_every, checkpoint_dir=checkpoint_dir,
-            checkpoint_every=checkpoint_every,
-            prefetch_depth=prefetch_depth, initial_epoch=initial_epoch,
-            saver=saver, hist=hist, preempt=preempt,
-            data_track=data_track)
+        rollbacks = 0
+        while True:
+            try:
+                last_saved_step = _fit_epochs(
+                    session=session, data=data, epochs=epochs,
+                    steps_per_epoch=steps_per_epoch,
+                    validation_data=validation_data,
+                    validation_steps=validation_steps, callbacks=callbacks,
+                    log_every=log_every, checkpoint_dir=checkpoint_dir,
+                    checkpoint_every=checkpoint_every,
+                    prefetch_depth=prefetch_depth,
+                    initial_epoch=initial_epoch,
+                    saver=saver, hist=hist, preempt=preempt,
+                    data_track=data_track, monitor=monitor,
+                    guard_state=guard_state)
+                break
+            except _RollbackRequest as rb:
+                rollbacks += 1
+                initial_epoch = _handle_rollback(
+                    session=session, saver=saver,
+                    checkpoint_dir=checkpoint_dir, data=data, rb=rb,
+                    rollbacks=rollbacks, num_cfg=num_cfg, epochs=epochs,
+                    steps_per_epoch=steps_per_epoch,
+                    data_track=data_track, hist=hist, monitor=monitor)
+                guard_state["last_finite"] = None
+                guard_state["last_skipped"] = None
 
     if (saver is not None and hist.steps_run
             and last_saved_step != session.step_count):
         # Never lose the tail epochs to the checkpoint_every stride.
         saver.save(checkpoint_dir, step=session.step_count,
-                   extra_meta=_data_state_meta(data_track))
+                   extra_meta=_data_state_meta(data_track),
+                   mark_good=_guard_clean(guard_state, monitor))
     if saver is not None:
         saver.wait()   # async saves must be durable before fit returns
 
@@ -370,13 +433,114 @@ def _data_state_meta(data_track) -> Optional[dict]:
     return {"data_state": dict(data_track["pos"])}
 
 
+def _guard_clean(guard_state, monitor) -> bool:
+    """Is the CURRENT training state attestably healthy — i.e. should a
+    checkpoint saved now be marked verified-good?  True only when the
+    numerics guard is emitting health, the last observed step was finite,
+    and no bad streak / spike is in flight."""
+    if guard_state["last_finite"] is not True:
+        return False
+    return monitor is None or monitor.bad_streak == 0
+
+
+def _observe_health(out, hist, guard_state) -> Optional[bool]:
+    """Record the step's grad_health into host-side tracking (cheap —
+    only called at points that already sync, or under an active
+    monitor).  Returns all_finite, or None when the guard is off."""
+    health = out.get("grad_health") if isinstance(out, dict) else None
+    if health is None:
+        return None
+    finite = bool(np.asarray(health.all_finite))
+    guard_state["last_finite"] = finite
+    guard_state["last_skipped"] = int(np.asarray(health.skipped_steps))
+    return finite
+
+
+def _handle_rollback(*, session, saver, checkpoint_dir, data, rb,
+                     rollbacks, num_cfg, epochs, steps_per_epoch,
+                     data_track, hist, monitor) -> int:
+    """Anomaly rollback (docs/numerics.md): restore the last
+    verified-good checkpoint, reposition (and optionally re-seed) the
+    data, emit a supervisor failure marker, and return the epoch to
+    resume from.  Raises :class:`NonFiniteError` when recovery is
+    impossible."""
+    from autodist_tpu.checkpoint import Saver
+
+    if saver is None:
+        raise NonFiniteError(
+            f"{rb}; rollback needs checkpoint_dir to restore from")
+    if rollbacks > num_cfg.max_rollbacks:
+        raise NonFiniteError(
+            f"{rb}; rollback budget exhausted "
+            f"(max_rollbacks={num_cfg.max_rollbacks})")
+    _emit_failure_marker(str(rb))
+    saver.wait()   # pending async save must settle before we re-read
+    good_path = Saver.last_good_checkpoint(checkpoint_dir)
+    if good_path is None:
+        raise NonFiniteError(
+            f"{rb}; no verified-good checkpoint under {checkpoint_dir}")
+    restored = saver.restore(good_path)
+    hist.history.setdefault("rollbacks", []).append(
+        {"at_step": rb.step, "restored_step": restored,
+         "reason": rb.reason})
+    logging.warning(
+        "numerics rollback %d/%d: %s — restored verified-good step %d "
+        "from %s", rollbacks, num_cfg.max_rollbacks, rb.reason, restored,
+        good_path)
+    if monitor is not None:
+        monitor.reset()
+
+    # Reposition the data exactly like a resume: the good checkpoint's
+    # recorded loader position when available, else epoch arithmetic.
+    next_epoch = None
+    if data_track["enabled"]:
+        ds = Saver.read_meta(good_path).get("data_state")
+        if ds:
+            try:
+                pos = data.load_state(ds)
+                next_epoch = min(pos["epoch"], epochs)
+                data_track["base"] = pos["offset"]
+                data_track["start_epoch"] = next_epoch
+            except (ValueError, KeyError) as e:
+                logging.warning(
+                    "rollback: checkpoint data state unusable (%s); "
+                    "falling back to epoch arithmetic", e)
+    if next_epoch is None:
+        if steps_per_epoch:
+            next_epoch = min(restored // steps_per_epoch, epochs)
+            if restored % steps_per_epoch:
+                logging.warning(
+                    "rollback: restored step %d is mid-epoch — resuming "
+                    "from epoch %d re-runs its partial progress",
+                    restored, next_epoch)
+            data_track["base"] = 0
+            data_track["start_epoch"] = next_epoch
+        else:
+            raise NonFiniteError(
+                f"{rb}; cannot derive the resume epoch — pass "
+                "steps_per_epoch or use a stateful DataLoader")
+    if num_cfg.reseed_on_rollback and hasattr(data, "reseed"):
+        # A bad batch ordering is one plausible spike cause: shuffle the
+        # replayed epochs differently (deterministically per attempt).
+        old_seed = data_track.get("seed") or 0
+        new_seed = old_seed + 1000003 * rollbacks
+        data.reseed(new_seed)
+        data_track["seed"] = new_seed
+        logging.warning(
+            "rollback: data order re-seeded %s -> %s", old_seed, new_seed)
+    return next_epoch
+
+
 def _fit_epochs(*, session, data, epochs, steps_per_epoch,
                 validation_data, validation_steps, callbacks, log_every,
                 checkpoint_dir, checkpoint_every, prefetch_depth,
-                initial_epoch, saver, hist, preempt, data_track):
+                initial_epoch, saver, hist, preempt, data_track,
+                monitor=None, guard_state=None):
     """The epoch loop (split out so ``fit`` can wrap it in the
     signal-handler scope; keyword-only — no positional-order hazard).
     Returns ``last_saved_step``."""
+    if guard_state is None:
+        guard_state = {"last_finite": None, "last_skipped": None}
     last_saved_step = None
     for epoch in range(initial_epoch, epochs):
         # The resumed epoch starts at the restored offset; every later
@@ -400,6 +564,28 @@ def _fit_epochs(*, session, data, epochs, steps_per_epoch,
             hist.steps_run += 1
             for cb in callbacks:
                 cb.on_step_end(session.step_count, out)
+            if monitor is not None:
+                # raise/rollback/spike policies: one host sync per step
+                # (documented cost of the active policies).
+                finite = _observe_health(out, hist, guard_state)
+                if finite is None:
+                    raise ValueError(
+                        "numerics monitoring needs grad_health in the "
+                        "step metrics — this session was built without "
+                        "the numerics guard (capture(numerics=...))")
+                action = monitor.observe(
+                    session.step_count, float(np.asarray(out["loss"])),
+                    finite)
+                if action == "raise":
+                    raise NonFiniteError(
+                        f"non-finite gradients at step "
+                        f"{session.step_count} (on_nonfinite='raise')")
+                if action == "rollback":
+                    raise _RollbackRequest(
+                        session.step_count,
+                        "loss spike" if finite
+                        else f"{monitor.bad_streak} consecutive "
+                             f"non-finite steps")
             if log_every and hist.steps_run % log_every == 0:
                 loss = float(np.asarray(out["loss"]))
                 hist._sample(session.step_count, loss)
@@ -430,8 +616,11 @@ def _fit_epochs(*, session, data, epochs, steps_per_epoch,
                                      "offset": epoch_base + epoch_steps,
                                      "seed": data_track["seed"]}
             if saver is not None and hist.steps_run:
+                if out is not None:
+                    _observe_health(out, hist, guard_state)
                 saver.save(checkpoint_dir, step=session.step_count,
-                           extra_meta=_data_state_meta(data_track))
+                           extra_meta=_data_state_meta(data_track),
+                           mark_good=_guard_clean(guard_state, monitor))
                 last_saved_step = session.step_count
             for cb in callbacks:
                 cb.on_epoch_end(epoch, {
@@ -478,6 +667,14 @@ def _fit_epochs(*, session, data, epochs, steps_per_epoch,
                                  "seed": data_track["seed"]}
         logs = {"loss": loss, "epoch_steps": epoch_steps,
                 "step": session.step_count}
+        # Guard bookkeeping at the epoch boundary (the host sync is
+        # already paid by the loss fetch above): cumulative skipped-step
+        # count into the history, health into the mark-good gate.
+        _observe_health(out, hist, guard_state)
+        if guard_state["last_skipped"] is not None:
+            hist.history.setdefault("skipped_steps", []).append(
+                guard_state["last_skipped"])
+            logs["skipped_steps"] = guard_state["last_skipped"]
         if validation_data is not None:
             val_it = _epoch_iter(validation_data, validation_steps)
             if validation_steps:
@@ -497,7 +694,8 @@ def _fit_epochs(*, session, data, epochs, steps_per_epoch,
             cb.on_epoch_end(epoch, logs)
         if saver is not None and (epoch + 1) % checkpoint_every == 0:
             saver.save(checkpoint_dir, step=session.step_count,
-                       extra_meta=_data_state_meta(data_track))
+                       extra_meta=_data_state_meta(data_track),
+                       mark_good=_guard_clean(guard_state, monitor))
             last_saved_step = session.step_count
 
     return last_saved_step

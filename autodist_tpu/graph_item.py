@@ -187,7 +187,8 @@ class GraphItem:
                  has_aux: bool = False,
                  metrics_fn: Optional[Callable] = None,
                  grad_fn: Optional[Callable] = None,
-                 accum_steps: int = 1):
+                 accum_steps: int = 1,
+                 numerics=None):
         self.params = params
         self.optimizer = optimizer
         self.loss_fn = _apply_remat(loss_fn, remat)
@@ -211,6 +212,13 @@ class GraphItem:
         if grad_fn is not None and has_aux:
             raise ValueError("grad_fn does not support has_aux")
         self.grad_fn = grad_fn
+        # Numerics guard config (docs/numerics.md): fused non-finite
+        # detection, loss scaling, global-norm clipping, step policy.
+        # None (the default) keeps every compiled step byte-identical to
+        # a guard-less build.  Coerced eagerly so a bad spec fails at
+        # capture, not at transform.
+        from autodist_tpu.numerics.policy import NumericsConfig
+        self.numerics = NumericsConfig.coerce(numerics)
         self._sparse_patterns = tuple(sparse_vars)
         self._untrainable_patterns = tuple(untrainable_vars)
         self._pipeline_patterns = tuple(pipeline_vars)

@@ -302,15 +302,13 @@ class GraphTransformer:
         else:
             vg = jax.value_and_grad(loss_fn, has_aux=gi.has_aux)
         has_aux = gi.has_aux
-        if gi.accum_steps > 1:
-            vg = _accumulate_grads(vg, gi.accum_steps, has_aux)
-            if extra_metrics_fn is not None:
-                logging.warning(
-                    "accum_steps=%d with metrics_fn: metrics run one "
-                    "FULL-batch forward in the same step, so peak "
-                    "activation memory stays O(batch) — the accumulation "
-                    "memory win applies to the gradient pass only",
-                    gi.accum_steps)
+        if gi.accum_steps > 1 and extra_metrics_fn is not None:
+            logging.warning(
+                "accum_steps=%d with metrics_fn: metrics run one "
+                "FULL-batch forward in the same step, so peak "
+                "activation memory stays O(batch) — the accumulation "
+                "memory win applies to the gradient pass only",
+                gi.accum_steps)
 
         # Bounded staleness / proxy mirrors ride in sync_state (see
         # stale_sync module; the SSP translation of the reference's token
@@ -320,13 +318,91 @@ class GraphTransformer:
         stale = StaleSync(gi, self.compiled) \
             if uses_stale_path(self.compiled) else None
 
+        # Numerics guard on the GSPMD path (docs/numerics.md): grads are
+        # already-global arrays here, so health is a fused local
+        # reduction over the gradient tree (no extra collective — XLA
+        # folds it into the update program).
+        num_cfg = getattr(gi, "numerics", None)
+        num_active = bool(num_cfg is not None and num_cfg.guard)
+        num_ls = None
+        injections: Dict[str, Any] = {}
+        guard_mod = ls_mod = None
+        if num_active and stale is not None:
+            logging.warning(
+                "numerics guard disabled: bounded-staleness/proxy sync "
+                "state owns the sync_state slot on this path; drop "
+                "staleness or route through the explicit bucketed path")
+            num_active = False
+        if num_active:
+            import numpy as _np
+
+            from autodist_tpu.numerics import guard as guard_mod
+            from autodist_tpu.numerics import loss_scale as ls_mod
+
+            dtypes = [str(_np.asarray(v).dtype)
+                      for v in gi.name_to_leaf().values()]
+            num_ls = ls_mod.resolve_loss_scale(num_cfg.loss_scale, dtypes)
+            if num_ls is not None and gi.grad_fn is not None:
+                logging.warning(
+                    "numerics: loss scaling disabled — capture(grad_fn=...)"
+                    " owns the backward pass, so the scale cannot be "
+                    "threaded through it (guard/clip/skip stay active)")
+                num_ls = None
+            injections = guard_mod.resolve_injections(
+                (), list(gi.name_to_leaf()))
+            logging.info(
+                "numerics guard: ON (GSPMD path, loss_scale=%s, "
+                "clip_norm=%s, on_nonfinite=%s)",
+                "off" if num_ls is None else "%g" % num_ls.init,
+                num_cfg.clip_norm, num_cfg.on_nonfinite)
+        else:
+            from autodist_tpu.kernel.synchronization.explicit_sync import \
+                chaos_grad_events_probe
+            if list(chaos_grad_events_probe()):
+                logging.warning(
+                    "AUTODIST_CHAOS requests a gradient injection but the "
+                    "numerics guard is off — nan_grad/inf_grad need "
+                    "capture(numerics=...); ignoring the event")
+        if num_active and num_ls is not None:
+            def _scaled_loss(p, batch, scale):
+                if has_aux:
+                    loss_, aux_ = loss_fn(p, batch)
+                    return loss_ * scale, aux_
+                return loss_fn(p, batch) * scale
+            vg_scaled = jax.value_and_grad(_scaled_loss, has_aux=has_aux)
+        else:
+            vg_scaled = None
+        if gi.accum_steps > 1 and not num_active:
+            vg = _accumulate_grads(vg, gi.accum_steps, has_aux)
+        frozen_names = {v.name for v in gi.info.untrainable_variables}
+
         def step(params, opt_state, sync_state, batch):
+            import jax.numpy as jnp
+
+            params_in, opt_in = params, opt_state
             grad_params = params if stale is None \
                 else stale.before_grads(params, sync_state)
-            if has_aux:
-                (loss, aux), grads = vg(grad_params, batch)
+            if num_active:
+                from autodist_tpu.numerics.guard import NUMERICS_KEY
+                ns = sync_state[NUMERICS_KEY]
+                scale = ns["scale"] if num_ls is not None else None
+                if scale is None:
+                    vg_local = vg
+                else:
+                    vg_local = lambda p, b: vg_scaled(p, b, scale)  # noqa: E731
+                if injections:
+                    vg_local = guard_mod.wrap_injections(
+                        vg_local, injections, ns["step"])
+                if gi.accum_steps > 1:
+                    vg_local = _accumulate_grads(vg_local, gi.accum_steps,
+                                                 has_aux)
             else:
-                loss, grads = vg(grad_params, batch)
+                scale = None
+                vg_local = vg
+            if has_aux:
+                (loss, aux), grads = vg_local(grad_params, batch)
+            else:
+                loss, grads = vg_local(grad_params, batch)
                 aux = None
             # Force the gradient layout the synchronizers chose: for PS/WUS
             # variables this lowers the data-axis reduction to
@@ -335,6 +411,30 @@ class GraphTransformer:
             grads = su.constrain(grads, grad_sh)
             if stale is not None:
                 grads, sync_state = stale.exchange(grads, sync_state)
+            all_finite = gnorm = per_bucket = None
+            if num_active:
+                # Health over the (already-global) gradient tree — the
+                # per-variable analog of the bucketed guard; frozen vars
+                # are excluded (their updates are masked to zero anyway).
+                from autodist_tpu.graph_item import path_name as _pn
+                health = guard_mod.HealthAccumulator(1)
+                for path, g in \
+                        jax.tree_util.tree_flatten_with_path(grads)[0]:
+                    if _pn(path) not in frozen_names:
+                        health.add(_pn(path), g)
+                inv_scale = jnp.float32(1.0) if scale is None \
+                    else jnp.float32(1.0) / scale
+                all_finite, gnorm, per_bucket = health.finalize(
+                    (), loss, inv_scale)
+                mult = inv_scale
+                clip = guard_mod.clip_multiplier(gnorm, num_cfg.clip_norm)
+                if clip is not None:
+                    mult = mult * clip
+                if clip is not None or scale is not None:
+                    grads = jax.tree_util.tree_map_with_path(
+                        lambda p, g: g if _pn(p) in frozen_names
+                        else (g.astype(jnp.float32) * mult).astype(g.dtype),
+                        grads)
             updates, opt_state = optimizer.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             if pad_info is not None:
@@ -347,6 +447,21 @@ class GraphTransformer:
             if stale is not None:
                 sync_state = stale.after_update(params, sync_state)
             metrics = {"loss": loss}
+            if num_active:
+                from autodist_tpu.numerics import loss_scale as _lsm
+                params = guard_mod.tree_select(all_finite, params, params_in)
+                opt_state = guard_mod.tree_select(all_finite, opt_state,
+                                                  opt_in)
+                new_ns = _lsm.update_state(ns, all_finite, num_ls)
+                sync_state = dict(sync_state)
+                sync_state[NUMERICS_KEY] = new_ns
+                if scale is not None:
+                    metrics["loss"] = loss * inv_scale
+                metrics["grad_health"] = guard_mod.GradHealth(
+                    all_finite=all_finite, global_norm=gnorm,
+                    loss_scale=ns["scale"],
+                    skipped_steps=new_ns["skipped"],
+                    per_bucket=per_bucket)
             if aux is not None:
                 metrics["aux"] = aux
             if extra_metrics_fn is not None:
@@ -378,7 +493,10 @@ class GraphTransformer:
             step,
             in_shardings=(param_sh, opt_sh, sync_sh, None),
             out_shardings=(param_sh, opt_sh, sync_sh, None),
-            donate_argnums=(0, 1) if stale is None else (0, 1, 2),
+            # Numerics state, like stale-sync state, is rewritten every
+            # step — donation-safe.
+            donate_argnums=(0, 1) if stale is None and not num_active
+            else (0, 1, 2),
             **jit_kwargs,
         )
 
@@ -388,7 +506,12 @@ class GraphTransformer:
             _make_eval_step(loss_fn, has_aux, extra_metrics_fn),
             in_shardings=(param_sh, None))
         init_fn = jax.jit(optimizer.init, out_shardings=opt_sh)
-        if stale is None:
+        if stale is None and num_active:
+            def init_sync_state(current_params=None):
+                from autodist_tpu.numerics import loss_scale as _lsm
+                from autodist_tpu.numerics.guard import NUMERICS_KEY
+                return {NUMERICS_KEY: _lsm.init_state(num_ls)}
+        elif stale is None:
             def init_sync_state(current_params=None):
                 return {}
         else:

@@ -38,6 +38,18 @@ is carried as a *sync state* pytree with a leading per-shard axis, sharded
 over ``data`` so each device owns its slice — bucket-level residuals are
 keyed by the bucket id.
 
+With ``capture(numerics=...)`` the **fused numerics guard**
+(docs/numerics.md) rides the bucket chain: per-bucket finiteness bits
+are a byproduct of the pack, squared-norm partials come from the
+reduced values (the reduce-scattered SHARDS under ZeRO-1 — their psum
+is exactly the full norm), compressors report pre-quantization wire
+saturation, and one small all-axis psum rolls everything into a
+``GradHealth`` struct returned with the step metrics.  The same scalars
+drive exact global-norm clipping (applied before the local 1/N update),
+dynamic loss scaling (state carried under ``"~numerics"`` in the sync
+state, checkpointed), and the skip gate (a non-finite step keeps params
+and optimizer state bit-identical).
+
 Partitioned variables COMPOSE with compression (the reference can express
 PartitionedAR + compressor — ``proto/synchronizers.proto:24-57``): a var
 sharded over a non-data mesh axis stays sharded outside the step; inside,
@@ -98,6 +110,18 @@ def uses_explicit_path(compiled: CompiledStrategy) -> bool:
             return True
     return (any(plan.fused for plan in compiled.var_plans.values())
             and bool(compiled.fusable_groups()))
+
+
+def chaos_grad_events_probe():
+    """The ``nan_grad``/``inf_grad`` chaos events for this process, or
+    [] when none apply / the harness is unavailable — probed so a grad
+    injection requested without the numerics guard warns instead of
+    silently never firing."""
+    try:
+        from autodist_tpu.resilience import chaos as chaos_mod
+        return chaos_mod.grad_injections()
+    except Exception:  # pragma: no cover - chaos env parse errors
+        return []
 
 
 def _compressors_for(gi: GraphItem, compiled: CompiledStrategy
@@ -228,6 +252,10 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
 
     mesh = compiled.mesh
     d = mesh.shape.get(MESH_AXIS_DATA, 1)
+    mesh_axis_names = tuple(mesh.axis_names)
+    n_devices = 1
+    for _a in mesh_axis_names:
+        n_devices *= int(mesh.shape[_a])
     comps = _compressors_for(gi, compiled)
     part = _partition_support(gi, compiled, comps)
 
@@ -293,6 +321,46 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
                     and overlap_mod.pipeline_eligible(b, ov.mode,
                                                       gi.accum_steps)]
     pipe_keys = {b.key for b in pipe_buckets}
+
+    # -- numerics guard (docs/numerics.md) ---------------------------------
+    # Resolved at build time: loss-scale activation (auto = any
+    # low-precision param/bucket dtype), the wire-saturation safety
+    # check, and any chaos grad injections (compiled into the step).
+    num_cfg = getattr(gi, "numerics", None)
+    num_active = bool(num_cfg is not None and num_cfg.guard)
+    num_ls = None
+    injections: Dict[str, Any] = {}
+    if num_active:
+        from autodist_tpu.numerics import guard as guard_mod
+        from autodist_tpu.numerics import loss_scale as ls_mod
+
+        leaf_dtypes = [str(jnp.asarray(v).dtype)
+                       for v in gi.name_to_leaf().values()]
+        num_ls = ls_mod.resolve_loss_scale(
+            num_cfg.loss_scale,
+            leaf_dtypes + [b.dtype for b in buckets])
+        for b in buckets:
+            why = ls_mod.scale_saturates_wire(num_ls, b.compressor)
+            if why is not None:
+                raise ValueError(
+                    f"numerics: bucket {b.key}: {why}; lower the loss "
+                    "scale ceiling or drop the quantizing compressor "
+                    "(rule numerics/loss-scale-saturates-wire)")
+        injections = guard_mod.resolve_injections(
+            buckets, list(gi.name_to_leaf()))
+        logging.info(
+            "numerics guard: ON (%d buckets, loss_scale=%s, clip_norm=%s, "
+            "on_nonfinite=%s)", len(buckets),
+            "off" if num_ls is None else
+            ("%g dynamic" % num_ls.init if num_ls.dynamic
+             else "%g static" % num_ls.init),
+            num_cfg.clip_norm, num_cfg.on_nonfinite)
+    elif list(chaos_grad_events_probe()):
+        logging.warning(
+            "AUTODIST_CHAOS requests a gradient injection but the "
+            "numerics guard is off — nan_grad/inf_grad need "
+            "capture(numerics=...) (the guard owns the device step "
+            "counter the injection keys on); ignoring the event")
     # Mean-reduction lowering per UNCOMPRESSED bucket under the schedule
     # (ring / one-shot / XLA fused); compressed buckets keep their
     # compressor's own wire format.
@@ -303,15 +371,32 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
                              if b.mode == MODE_REDUCE_SCATTER
                              else b.padded_total) for b in buckets}
     use_pipeline = bool(pipe_buckets) and gi.accum_steps > 1
-    if gi.accum_steps > 1 and not use_pipeline:
+    if gi.accum_steps > 1 and not use_pipeline and not num_active:
         # Gradient accumulation composes with compression exactly where it
         # matters most (bandwidth-starved links): the f32 accumulator scan
         # runs INSIDE the shard_map step over the device's LOCAL microbatch
         # slices, so each bucket still sees ONE averaged gradient — one
         # compressed collective per bucket per step, N microbatches of
-        # activations.
+        # activations.  (With the numerics guard the wrap happens inside
+        # local_step instead — the loss scale and chaos injections bind
+        # to per-step state first.)
         from autodist_tpu.kernel.graph_transformer import _accumulate_grads
         vg = _accumulate_grads(vg, gi.accum_steps, gi.has_aux)
+
+    if num_ls is not None:
+        # Loss scaling: the loss is multiplied by the (power-of-two)
+        # scale BEFORE the backward pass so small gradients survive a
+        # low-precision exponent range; reduced gradients are divided by
+        # it before clipping and the update.  Built as a 3-arg
+        # value-and-grad so the scale can come from the step's state.
+        def _scaled_loss(p, batch, scale):
+            if has_aux:
+                loss_, aux_ = gi.loss_fn(p, batch)
+                return loss_ * scale, aux_
+            return gi.loss_fn(p, batch) * scale
+        vg_scaled = jax.value_and_grad(_scaled_loss, has_aux=has_aux)
+    else:
+        vg_scaled = None
 
     # -- optimizer split ---------------------------------------------------
     # ZeRO-1 vars' optimizer state lives as flat bucket-major shards (one
@@ -409,6 +494,13 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
             continue
         sync_specs[b.key] = P(MESH_AXIS_DATA)
         sync_builders[b.key] = ("bucket", b)
+    if num_active:
+        # Numerics state (loss scale + health counters): replicated
+        # scalars carried in the step like optimizer state — and
+        # checkpointed with the sync state, so resume keeps the scale.
+        from autodist_tpu.numerics.guard import NUMERICS_KEY
+        sync_specs[NUMERICS_KEY] = P()
+        sync_builders[NUMERICS_KEY] = ("numerics", None)
 
     def init_sync_state(current_params=None):
         # Compressor residuals start at zero regardless of parameter values,
@@ -416,6 +508,11 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
         state: Dict[str, Any] = {}
         for key, (kind, ref) in sync_builders.items():
             spec = sync_specs[key]
+            if kind == "numerics":
+                from autodist_tpu.numerics import loss_scale as ls_mod
+                state[key] = jax.device_put(
+                    ls_mod.init_state(num_ls), NamedSharding(mesh, spec))
+                continue
             if kind == "bucket":
                 b = ref
                 per_dev = get_compressor(b.compressor).init_state(
@@ -463,6 +560,7 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
 
     # -- the local (per-shard) step ---------------------------------------
     def local_step(params, opt_state, sync_state, batch):
+        params_in, opt_in = params, opt_state
         # Reconstruct full tensors for the user's loss: sharded vars are
         # all-gathered over their partition axis (what GSPMD inserts for
         # a fully-consumed sharded param; here it is explicit).
@@ -476,6 +574,30 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
             full_leaves.append(x)
         full_params = jax.tree_util.tree_unflatten(ptree, full_leaves)
 
+        # Numerics guard: bind this step's loss scale / device step
+        # counter, then assemble the value-and-grad the tiers below run
+        # (scale → chaos injection → accumulation, innermost first).
+        if num_active:
+            ns = sync_state[NUMERICS_KEY]
+            scale = ns["scale"] if num_ls is not None else None
+            health = guard_mod.HealthAccumulator(n_devices)
+            if scale is None:
+                vg_local = vg
+            else:
+                vg_local = lambda p, b: vg_scaled(p, b, scale)  # noqa: E731
+            if injections:
+                vg_local = guard_mod.wrap_injections(
+                    vg_local, injections, ns["step"])
+            if gi.accum_steps > 1 and not use_pipeline:
+                from autodist_tpu.kernel.graph_transformer import \
+                    _accumulate_grads
+                vg_local = _accumulate_grads(vg_local, gi.accum_steps,
+                                             has_aux)
+        else:
+            scale = None
+            vg_local = vg
+        guarded_idx: List[int] = []
+
         pipe_reduced: Dict[str, Any] = {}
         if use_pipeline:
             # Accumulation pipelining (overlap.py): microbatch k's bucket
@@ -486,9 +608,9 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
             # collective is unchanged.
             def single_vg(p, mb):
                 if has_aux:
-                    (loss_, aux_), g_ = vg(p, mb)
+                    (loss_, aux_), g_ = vg_local(p, mb)
                 else:
-                    loss_, g_ = vg(p, mb)
+                    loss_, g_ = vg_local(p, mb)
                     aux_ = None
                 return loss_, aux_, g_
 
@@ -496,9 +618,9 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
                 single_vg, gi.accum_steps, has_aux, pipe_buckets,
                 reduce_fns, reduced_sizes, full_params, batch)
         elif has_aux:
-            (loss, aux), grads = vg(full_params, batch)
+            (loss, aux), grads = vg_local(full_params, batch)
         else:
-            loss, grads = vg(full_params, batch)
+            loss, grads = vg_local(full_params, batch)
             aux = None
 
         flat, treedef = jax.tree_util.tree_flatten_with_path(grads)
@@ -536,6 +658,19 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
                                          MESH_AXIS_DATA)
             store_state(name, st2)
             synced[i] = g2
+            guarded_idx.append(i)
+            if num_active:
+                # Finiteness from the PRE-compress local gradient (the
+                # injected/overflowed value a lossy compressor could
+                # mask); the norm partial from the reduced value the
+                # update will consume.  Partitioned shards psum over
+                # their model axis too, so nothing is double counted.
+                health.add(
+                    name, g2,
+                    shard_axes_size=part[name][2] if info is not None else 1,
+                    finite_src=g,
+                    saturation=guard_mod.wire_saturation(
+                        g, ls_mod.wire_dtype_of(comps[name].name)))
 
         # Tiers 1+2: one collective per bucket.  Each bucket's chain
         # (pack → collective [→ shard update → all-gather]) depends only
@@ -547,11 +682,18 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
         # decomposition / one-shot / XLA fused collective).
         rs_grad_shards: Dict[str, Any] = {}
         for b in buckets:
+            rs = b.mode == MODE_REDUCE_SCATTER
             if b.key in pipe_keys:
                 red = pipe_reduced[b.key]
+                if num_active:
+                    # Pipelined buckets are uncompressed (linear), so a
+                    # NaN survives the per-microbatch reduction — the
+                    # accumulated reduced value IS the finiteness source.
+                    health.add(b.key, red, shard_axes_size=d if rs else 1)
                 if b.mode == MODE_ALL_REDUCE:
                     for n, arr in zip(b.names, unpack_bucket(b, red)):
                         synced[idx_of[n]] = arr
+                        guarded_idx.append(idx_of[n])
                 else:
                     rs_grad_shards[b.key] = red
                 continue
@@ -559,29 +701,74 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
             if b.key in reduce_fns:   # uncompressed: schedule-lowered
                 red = reduce_fns[b.key](vec)
                 st2 = None
+                if num_active:
+                    # The per-bucket finiteness bit is a byproduct of the
+                    # pack (the local packed vector); the norm partial
+                    # comes from the reduced value — the scattered SHARD
+                    # for ZeRO-1 buckets, whose shard sq-norms psum to
+                    # exactly the full bucket norm.
+                    health.add(b.key, red, shard_axes_size=d if rs else 1,
+                               finite_src=vec)
                 if b.mode == MODE_ALL_REDUCE:
                     for n, arr in zip(b.names, unpack_bucket(b, red)):
                         synced[idx_of[n]] = arr
+                        guarded_idx.append(idx_of[n])
                 else:
                     rs_grad_shards[b.key] = red
             else:
                 comp = get_compressor(b.compressor)
+                sat = guard_mod.wire_saturation(
+                    vec, ls_mod.wire_dtype_of(b.compressor)) \
+                    if num_active else None
                 if b.mode == MODE_ALL_REDUCE:
                     red, st2 = comp.reduce(vec, local_state_of(b.key),
                                            MESH_AXIS_DATA)
+                    if num_active:
+                        health.add(b.key, red, shard_axes_size=1,
+                                   finite_src=vec, saturation=sat)
                     for n, arr in zip(b.names, unpack_bucket(b, red)):
                         synced[idx_of[n]] = arr
+                        guarded_idx.append(idx_of[n])
                 else:
                     rs_grad_shards[b.key], st2 = comp.reduce_scatter(
                         vec, local_state_of(b.key), MESH_AXIS_DATA)
+                    if num_active:
+                        health.add(b.key, rs_grad_shards[b.key],
+                                   shard_axes_size=d, finite_src=vec,
+                                   saturation=sat)
             store_state(b.key, st2)
+
+        # -- fused guard roll-up: ONE psum combines every bucket/var
+        # partial; unscale + global-norm clip multiply into the synced
+        # gradients before any update (exact under ZeRO-1: the factor is
+        # computed from the psum of shard norms, identical on every
+        # device).
+        all_finite = gnorm = per_bucket = new_ns = None
+        if num_active:
+            inv_scale = jnp.float32(1.0) if scale is None \
+                else jnp.float32(1.0) / scale
+            all_finite, gnorm, per_bucket = health.finalize(
+                mesh_axis_names, loss, inv_scale)
+            mult = inv_scale
+            clip = guard_mod.clip_multiplier(gnorm, num_cfg.clip_norm)
+            if clip is not None:
+                mult = mult * clip
+            if clip is not None or scale is not None:
+                for i in set(guarded_idx):
+                    g_i = synced[i]
+                    synced[i] = (g_i.astype(jnp.float32)
+                                 * mult).astype(g_i.dtype)
+                rs_grad_shards = {
+                    k: (v.astype(jnp.float32) * mult).astype(v.dtype)
+                    for k, v in rs_grad_shards.items()}
         grads = jax.tree_util.tree_unflatten(treedef, synced)
 
         # Shard-local update: grads, params, and opt state all carry the
         # per-device shard shapes, so elementwise optimizers (SGD, Adam*)
-        # update each partition in place.  (An optimizer coupling across
-        # parameters — e.g. global-norm clipping — would need its own
-        # collectives here; use the GSPMD path for those.)
+        # update each partition in place.  (Global-norm clipping — the
+        # one cross-parameter coupling that matters — is handled by the
+        # numerics guard above, whose psum'd norm makes the sharded clip
+        # exact; other coupled optimizers still need the GSPMD path.)
         if rs_buckets:
             # ZeRO-1: update the local 1/d shard of every reduce-scattered
             # bucket, then all-gather fresh parameters ("broadcast from
@@ -626,7 +813,29 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
             updates, opt_state = tree_optimizer.update(grads, opt_state,
                                                        params)
             params = optax.apply_updates(params, updates)
-        metrics = {"loss": lax.pmean(loss, MESH_AXIS_DATA)}
+        mean_loss = lax.pmean(loss, MESH_AXIS_DATA)
+        metrics = {"loss": mean_loss}
+        if num_active:
+            # Skip gate: a non-finite step keeps params AND optimizer
+            # state bit-identical (zero-update), backs the loss scale
+            # off, and counts the skip — the step policy's device half.
+            params = guard_mod.tree_select(all_finite, params, params_in)
+            opt_state = guard_mod.tree_select(all_finite, opt_state, opt_in)
+            # Compressor state (error-feedback residuals, PowerSGD
+            # factors) must roll back too: a skipped step's poisoned
+            # residual would otherwise re-contaminate every later step.
+            for key in list(new_sync):
+                if key != NUMERICS_KEY and key in sync_state:
+                    new_sync[key] = guard_mod.tree_select(
+                        all_finite, new_sync[key], sync_state[key])
+            new_ns = ls_mod.update_state(ns, all_finite, num_ls)
+            new_sync[NUMERICS_KEY] = new_ns
+            if scale is not None:
+                metrics["loss"] = mean_loss * inv_scale
+            metrics["grad_health"] = guard_mod.GradHealth(
+                all_finite=all_finite, global_norm=gnorm,
+                loss_scale=ns["scale"], skipped_steps=new_ns["skipped"],
+                per_bucket=per_bucket)
         if aux is not None:
             metrics["aux"] = jax.tree_util.tree_map(
                 lambda x: lax.pmean(x, MESH_AXIS_DATA), aux)
@@ -657,7 +866,9 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
     # across steps) is now marked deleted.  Fallback programs keep their
     # sync state undonated; its footprint is small (residual tensors for
     # the handful of vars the buckets could not absorb).
-    donate_sync = all(kind == "bucket"
+    # (Numerics state is rewritten unconditionally every step, so it is
+    # donation-safe like bucket residuals.)
+    donate_sync = all(kind in ("bucket", "numerics")
                       for kind, _ in sync_builders.values())
     step_fn = jax.jit(mapped,
                       donate_argnums=(0, 1, 2) if donate_sync else (0, 1))

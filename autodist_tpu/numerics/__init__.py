@@ -1,0 +1,62 @@
+"""autodist_tpu.numerics — numerical-failure detection and recovery.
+
+PR 4 (``autodist_tpu.resilience``) made *process* failure a recoverable
+event; this package does the same for *numerical* failure — the NaN/Inf
+gradient, the compressed-bucket overflow, the loss spike after a bad
+batch — which otherwise poisons the parameters silently and burns the
+whole attempt.  Four pieces (docs/numerics.md):
+
+* :mod:`~autodist_tpu.numerics.guard` — the fused gradient-health guard:
+  per-bucket finiteness bits and squared-norm partials computed as a
+  byproduct of the bucketed pack/reduce in the explicit sync path (one
+  extra small psum piggybacked on the bucket chain — no second pass over
+  the gradients), rolled into a :class:`GradHealth` struct returned with
+  every step's metrics;
+* :mod:`~autodist_tpu.numerics.loss_scale` — dynamic loss scaling
+  (:class:`LossScale`: init/growth/backoff), state carried in the step
+  like optimizer state and checkpointed, auto-enabled when parameters or
+  gradient buckets are low-precision;
+* global-norm clipping that is **exact under ZeRO-1 and pipelined
+  overlap**: norm partials come from the reduce-scattered shards (a psum
+  of shard squared-norms, replication divided out), and the clip factor
+  is applied before the local 1/N optimizer update;
+* :mod:`~autodist_tpu.numerics.policy` — the step policy
+  (``on_nonfinite="skip"|"raise"|"rollback"``): skip applies a
+  zero-update (with loss-scale backoff) and counts it; rollback restores
+  the last *verified-good* checkpoint
+  (:meth:`~autodist_tpu.checkpoint.saver.Saver.restore_last_good`) after
+  K consecutive bad steps or a loss-spike z-score, and emits a failure
+  marker the PR 4 :class:`~autodist_tpu.resilience.Supervisor`
+  understands.
+
+Enable with ``AutoDist.capture(..., numerics=True)`` (or a
+:class:`NumericsConfig`); everything is OFF by default so existing
+programs are byte-identical.  Imports are lazy (PEP 562) so the
+analysis CLI can consult the pure rules without dragging jax in.
+"""
+from __future__ import annotations
+
+_EXPORTS = {
+    "GradHealth": "autodist_tpu.numerics.guard",
+    "NUMERICS_KEY": "autodist_tpu.numerics.guard",
+    "LossScale": "autodist_tpu.numerics.loss_scale",
+    "resolve_loss_scale": "autodist_tpu.numerics.loss_scale",
+    "scale_saturates_wire": "autodist_tpu.numerics.loss_scale",
+    "wire_dtype_of": "autodist_tpu.numerics.loss_scale",
+    "NumericsConfig": "autodist_tpu.numerics.policy",
+    "NonFiniteError": "autodist_tpu.numerics.policy",
+    "StepHealthMonitor": "autodist_tpu.numerics.policy",
+    "ON_NONFINITE": "autodist_tpu.numerics.policy",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    module = _EXPORTS.get(name)
+    if module is None:
+        raise AttributeError(
+            f"module 'autodist_tpu.numerics' has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(module), name)

@@ -1,0 +1,259 @@
+"""The fused gradient-health guard.
+
+Detection has to live *inside* the sync path, not after it: EQuARX-style
+quantized collectives (arXiv:2506.17615) can saturate on the wire while
+the post-dequantize values look finite, and a second full pass over the
+gradients would double the sync path's HBM traffic.  So the guard is
+computed as a **byproduct of the existing bucketed pack/reduce**
+(``kernel/synchronization/explicit_sync.py``):
+
+* the per-bucket *finiteness bit* is an elementwise ``isfinite``
+  reduction of the already-packed bucket vector (pipelined buckets use
+  the reduced accumulator instead — their reduction is linear, so a NaN
+  survives it);
+* the per-bucket *squared-norm partial* comes from the already-reduced
+  value — for ZeRO-1 buckets that is the reduce-scattered SHARD, whose
+  shard sq-norms psum to exactly the full bucket norm (the shards
+  partition the vector);
+* compressors with a float wire additionally report pre-quantization
+  *saturation* (a finite value that casts to Inf on the wire);
+* everything rolls into ONE small psum piggybacked on the bucket chain
+  (a ``[3 × n_keys]`` f32 vector over every mesh axis, each contribution
+  divided by its replication factor so nothing is double counted).
+
+The result is a :class:`GradHealth` struct returned with the step
+metrics, and the scalar inputs for exact global-norm clipping and the
+skip/backoff update gate.  Everything here is traced inside the step;
+the pure decision rules live in :mod:`~autodist_tpu.numerics.loss_scale`
+and :mod:`~autodist_tpu.numerics.policy`.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+#: reserved sync-state key for the numerics step state (loss scale +
+#: health counters).  The ``~`` prefix cannot appear in a variable path
+#: or a bucket key, so it never collides.
+NUMERICS_KEY = "~numerics"
+
+
+class GradHealth(NamedTuple):
+    """Per-step gradient health, returned in ``metrics["grad_health"]``.
+
+    ``per_bucket`` maps bucket key (or variable name for the
+    per-variable tier) → ``{"finite": bool, "sq_norm": f32[,
+    "saturated": bool]}``.  ``sq_norm`` values and ``global_norm`` are
+    UNSCALED (the loss scale is divided out).  ``skipped_steps`` is the
+    cumulative count of skipped (zero-update) steps this run."""
+
+    all_finite: Any
+    global_norm: Any
+    loss_scale: Any
+    skipped_steps: Any
+    per_bucket: Dict[str, Dict[str, Any]]
+
+
+class HealthAccumulator:
+    """Collects per-key health contributions inside the step, then
+    finalizes them with one psum (or locally, on the GSPMD path where
+    values are already global)."""
+
+    def __init__(self, total_devices: int = 1):
+        self._n = max(int(total_devices), 1)
+        #: key -> (sq_partial, nonfinite_count, saturated_count, has_sat)
+        self._rows: List[Tuple[str, Any, Any, Any, bool]] = []
+
+    def add(self, key: str, value, *, shard_axes_size: int = 0,
+            finite_src=None, saturation=None) -> None:
+        """Record one synced value's contribution.
+
+        ``value`` is the REDUCED tensor this key's optimizer update will
+        consume (the mean gradient, or its local shard for ZeRO-1 /
+        partitioned vars).  ``shard_axes_size`` is the product of mesh
+        axis sizes the value is SHARDED over (0 or 1 = fully replicated);
+        the contribution is divided by its replication factor so the
+        all-axis psum counts every element exactly once.  ``finite_src``
+        optionally supplies a different tensor for the finiteness bit
+        (the pre-reduce packed vector — the pack-time byproduct);
+        ``saturation`` is an optional extra 0/1 scalar (pre-quantization
+        wire saturation from the compressor)."""
+        import jax.numpy as jnp
+
+        repl = self._n / max(int(shard_axes_size) or 1, 1)
+        v32 = value.astype(jnp.float32)
+        sq = jnp.sum(v32 * v32) / repl
+        fin_t = value if finite_src is None else finite_src
+        nonfinite = (1.0 - jnp.all(jnp.isfinite(fin_t)).astype(
+            jnp.float32)) / self._n
+        sat = (saturation.astype(jnp.float32) / self._n
+               if saturation is not None else jnp.float32(0.0))
+        self._rows.append((key, sq, nonfinite, sat, saturation is not None))
+
+    def finalize(self, axis_names: Sequence[str], loss,
+                 inv_scale) -> Tuple[Any, Any, Dict[str, Dict[str, Any]]]:
+        """One psum over ``axis_names`` (empty = already-global values)
+        combining every contribution; returns ``(all_finite,
+        global_norm, per_bucket)`` with the loss scale divided out of the
+        norms.  A non-finite LOSS also trips ``all_finite`` (a NaN loss
+        with finite gradients still means the step must not count as
+        clean)."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        keys = [k for k, _, _, _, _ in self._rows]
+        if self._rows:
+            stacked = jnp.stack(
+                [jnp.stack([sq, nf, sat])
+                 for _, sq, nf, sat, _ in self._rows])    # [n_keys, 3]
+        else:
+            stacked = jnp.zeros((0, 3), jnp.float32)
+        loss_nf = (1.0 - jnp.all(jnp.isfinite(loss)).astype(jnp.float32)) \
+            / self._n
+        packed = jnp.concatenate([stacked.ravel(), loss_nf[None]])
+        if axis_names:
+            packed = lax.psum(packed, tuple(axis_names))
+        totals = packed[:-1].reshape((-1, 3)) if keys \
+            else jnp.zeros((0, 3), jnp.float32)
+        loss_bad = packed[-1]
+
+        inv2 = inv_scale * inv_scale
+        per_bucket: Dict[str, Dict[str, Any]] = {}
+        bad_count = loss_bad
+        total_sq = jnp.float32(0.0)
+        for i, key in enumerate(keys):
+            sq = totals[i, 0] * inv2
+            nf, sat = totals[i, 1], totals[i, 2]
+            entry = {"finite": nf == 0, "sq_norm": sq}
+            if self._rows[i][4]:
+                entry["saturated"] = sat > 0
+            per_bucket[key] = entry
+            bad_count = bad_count + nf + sat
+            total_sq = total_sq + sq
+        global_norm = jnp.sqrt(total_sq)
+        all_finite = (bad_count == 0) & jnp.isfinite(global_norm)
+        return all_finite, global_norm, per_bucket
+
+
+def wire_saturation(vec, wire_dtype: Optional[str]):
+    """0/1 scalar: does casting finite ``vec`` entries to the wire dtype
+    produce a non-finite value (pre-quantization saturation)?  None when
+    the compressor has no float wire."""
+    import jax.numpy as jnp
+
+    if wire_dtype is None:
+        return None
+    wired = vec.astype(jnp.dtype(wire_dtype))
+    sat = jnp.any(jnp.isfinite(vec) & ~jnp.isfinite(wired))
+    return sat
+
+
+def clip_multiplier(global_norm, clip_norm: Optional[float]):
+    """The global-norm clip factor — ``optax.clip_by_global_norm``'s
+    exact formula (``clip / max(norm, clip)``), so the sharded clip
+    matches the unsharded optax chain to float round-off.  Returns None
+    when clipping is off."""
+    import jax.numpy as jnp
+
+    if clip_norm is None:
+        return None
+    c = jnp.float32(clip_norm)
+    return c / jnp.maximum(global_norm, c)
+
+
+def tree_select(pred, on_true, on_false):
+    """``jnp.where(pred, a, b)`` over a pytree — the skip gate: with
+    ``pred`` False every leaf (params AND optimizer state) keeps its old
+    value bit-identically."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(
+        lambda a, b: jnp.where(pred, a, b), on_true, on_false)
+
+
+# -- chaos gradient injection (trace-time) -----------------------------------
+
+def resolve_injections(buckets: Sequence, known_names: Sequence[str],
+                       ) -> Dict[str, List[Tuple[int, float]]]:
+    """Map the ``nan_grad``/``inf_grad`` chaos events (AUTODIST_CHAOS)
+    onto gradient-tree leaf names: ``bucket=<key>`` poisons the first
+    member of that bucket, ``var=<name>`` the named variable, neither —
+    the first known variable.  Resolved at trace time (the same
+    deterministic step/proc/attempt filtering as every other chaos
+    event); returns ``{var_name: [(step, value), ...]}``."""
+    from autodist_tpu.resilience import chaos as chaos_mod
+    from autodist_tpu.utils import logging
+
+    out: Dict[str, List[Tuple[int, float]]] = {}
+    by_key = {b.key: b for b in buckets}
+    for ev in chaos_mod.grad_injections():
+        value = float("nan") if ev.action == "nan_grad" else float("inf")
+        name: Optional[str] = None
+        if "bucket" in ev.args:
+            b = by_key.get(ev.args["bucket"])
+            if b is None:
+                logging.warning(
+                    "CHAOS: %s names bucket %r but this program plans %s; "
+                    "ignoring the event", ev.action, ev.args["bucket"],
+                    sorted(by_key) or "no buckets")
+                continue
+            name = b.names[0]
+        elif "var" in ev.args:
+            name = ev.args["var"]
+            if name not in known_names:
+                logging.warning(
+                    "CHAOS: %s names unknown variable %r; ignoring the "
+                    "event", ev.action, name)
+                continue
+        elif known_names:
+            name = list(known_names)[0]
+        if name is None:
+            continue
+        step = ev.step if ev.step is not None else 0
+        out.setdefault(name, []).append((int(step), value))
+        logging.warning(
+            "CHAOS: will inject %s into grad of %s at step %d "
+            "(trace-time, fires on the device step counter)",
+            ev.action, name, step)
+    return out
+
+
+def _poison_leaf(g, cur_step, step: int, value: float):
+    import jax.numpy as jnp
+
+    if not jnp.issubdtype(g.dtype, jnp.floating):
+        return g
+    hit = cur_step == step
+    bad = jnp.asarray(value, g.dtype)
+    if g.ndim == 0:
+        return jnp.where(hit, bad, g)
+    flat = g.reshape(-1)
+    flat = flat.at[0].set(jnp.where(hit, bad, flat[0]))
+    return flat.reshape(g.shape)
+
+
+def wrap_injections(vg_fn,
+                    injections: Dict[str, List[Tuple[int, float]]],
+                    cur_step):
+    """Wrap a value-and-grad so the chaos-named gradient leaves are
+    poisoned when the device step counter matches — the single injection
+    point every sync tier (per-variable, bucketed, ZeRO-1, pipelined)
+    flows through, so one chaos spec exercises all of them."""
+    import jax
+
+    from autodist_tpu.graph_item import path_name
+
+    if not injections:
+        return vg_fn
+
+    def wrapped(params, batch):
+        out, grads = vg_fn(params, batch)
+        flat, treedef = jax.tree_util.tree_flatten_with_path(grads)
+        poisoned = []
+        for path, g in flat:
+            for step, value in injections.get(path_name(path), ()):
+                g = _poison_leaf(g, cur_step, step, value)
+            poisoned.append(g)
+        return out, jax.tree_util.tree_unflatten(treedef, poisoned)
+
+    return wrapped

@@ -1,0 +1,195 @@
+"""Dynamic loss scaling: configuration, pure rules, and the step-state
+transition.
+
+Mixed-precision step-skipping/loss-scaling is table stakes for
+large-scale TPU training (the MLPerf TPU-v3 report, arXiv:1909.09756);
+the mechanism here is the standard one: multiply the loss by ``scale``
+before the backward pass (so small gradients survive the low-precision
+exponent range), divide the *reduced* gradients by ``scale`` before
+clipping and the optimizer update, and adapt ``scale`` dynamically —
+back off when a step produced non-finite gradients (the skipped-step
+signal from the fused guard), grow after ``growth_interval`` consecutive
+clean steps.  All factors are powers of two by default, so scaling and
+unscaling are EXACT in floating point — enabling the guard on an
+all-f32 program does not perturb the trajectory.
+
+Everything that *decides* here (activation, wire saturation) is a pure
+function of dtypes and config — no jax — so the static analyzer
+(``analysis/precision.py`` ``numerics/*`` rules) shares the exact rule
+the runtime applies (the ``bucket_drop_reason`` pattern).  The state
+transition (:func:`update_state`) is traced into the step.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import numpy as np
+
+#: dtypes considered "low-precision" for loss-scale auto-enablement.
+#: float16's 5-bit exponent underflows real gradients without scaling;
+#: bfloat16 keeps f32's range, but scaling is exact (powers of two) and
+#: protects the f32 master copy of a bf16-compute program, so auto
+#: enables for both — the lint (numerics/no-loss-scale) mirrors this set.
+LOW_PRECISION_DTYPES = ("float16", "bfloat16")
+
+#: safety headroom between the largest loss-scaled gradient the rule
+#: assumes (|g| * scale with |g| up to this factor) and the wire dtype's
+#: finite max — the numerics/loss-scale-saturates-wire rule.
+WIRE_HEADROOM = 1e4
+
+
+@dataclass(frozen=True)
+class LossScale:
+    """Loss-scale configuration (the optimizer-state-like *state* it
+    drives is a plain dict of scalars carried in the step's sync state
+    and checkpointed with it).
+
+    ``dynamic=False`` freezes the scale at ``init`` (no growth/backoff;
+    non-finite steps still skip).  Defaults are the standard dynamic
+    schedule: start high, halve on overflow, double after
+    ``growth_interval`` clean steps, clamped to [min_scale, max_scale].
+    """
+
+    init: float = 2.0 ** 15
+    growth_factor: float = 2.0
+    backoff_factor: float = 0.5
+    growth_interval: int = 2000
+    min_scale: float = 1.0
+    max_scale: float = 2.0 ** 24
+    dynamic: bool = True
+
+    def __post_init__(self):
+        if self.init <= 0:
+            raise ValueError(f"loss scale init must be > 0, got {self.init}")
+        if self.growth_factor < 1 or self.backoff_factor > 1 \
+                or self.backoff_factor <= 0:
+            raise ValueError(
+                "loss scale needs growth_factor >= 1 and 0 < backoff_factor "
+                f"<= 1, got {self.growth_factor}/{self.backoff_factor}")
+        if self.growth_interval < 1:
+            raise ValueError("growth_interval must be >= 1")
+
+
+def is_low_precision(dtype) -> bool:
+    return str(np.dtype(dtype) if dtype != "bfloat16" else dtype) \
+        in LOW_PRECISION_DTYPES or str(dtype) in LOW_PRECISION_DTYPES
+
+
+def resolve_loss_scale(spec, dtypes: Sequence[str]) -> Optional[LossScale]:
+    """The effective loss-scale config for a program whose parameters /
+    gradient buckets carry ``dtypes``.
+
+    ``spec`` is the :class:`NumericsConfig.loss_scale` value: ``"auto"``
+    (enable the default dynamic schedule iff any dtype is low-precision),
+    ``None``/``"none"``/``"off"`` (disabled), a number (STATIC scale at
+    that value), or a :class:`LossScale`.  Returns None when scaling is
+    inactive (the step then runs with scale == 1 exactly).
+    """
+    if spec is None or spec in ("none", "off", False):
+        return None
+    if isinstance(spec, LossScale):
+        return spec
+    if spec == "auto" or spec is True:
+        if any(is_low_precision(d) for d in dtypes):
+            return LossScale()
+        return None
+    if isinstance(spec, (int, float)):
+        return LossScale(init=float(spec), dynamic=False)
+    raise ValueError(
+        f"loss_scale must be 'auto', None, a number, or a LossScale; "
+        f"got {spec!r}")
+
+
+def wire_dtype_of(compressor: str) -> Optional[str]:
+    """The float dtype a quantizing compressor puts on the wire, or None
+    when the wire is full-precision / scale-normalized.  Int8Compressor
+    normalizes by the bucket amax before quantizing, so a large loss
+    scale cannot saturate its grid (NaN/Inf scales are caught by the
+    guard's finiteness bits instead)."""
+    if compressor in ("HorovodCompressor", "HorovodCompressorEF"):
+        return "bfloat16"
+    return None
+
+
+def _finfo_max(dtype: str) -> float:
+    if dtype == "bfloat16":
+        try:  # ml_dtypes registers bfloat16 with numpy under jax
+            import ml_dtypes
+            return float(np.finfo(ml_dtypes.bfloat16).max)
+        except Exception:  # pragma: no cover - ml_dtypes always ships w/ jax
+            return 3.3895e38
+    return float(np.finfo(np.dtype(dtype)).max)
+
+
+def scale_saturates_wire(scale: Optional[LossScale],
+                         compressor: str) -> Optional[str]:
+    """Why this (loss scale, compressor) combination can saturate the
+    compressor's wire dtype, or None when it cannot — the pure rule
+    behind the ``numerics/loss-scale-saturates-wire`` ERROR, shared by
+    the analyzer and the runtime build-time check.
+
+    The test is conservative: the largest scale the schedule can reach
+    (``max_scale`` for dynamic, ``init`` for static) times a
+    :data:`WIRE_HEADROOM` gradient-magnitude allowance must stay below
+    the wire dtype's finite max.  A saturated wire value dequantizes to
+    a FINITE (clamped/inf-collapsed) number, so the post-dequantize
+    guard cannot see the overflow — which is why this is an ERROR, not a
+    WARN."""
+    if scale is None:
+        return None
+    wire = wire_dtype_of(compressor)
+    if wire is None:
+        return None
+    peak = scale.max_scale if scale.dynamic else scale.init
+    wire_max = _finfo_max(wire)
+    if peak * WIRE_HEADROOM > wire_max:
+        return (f"loss scale can reach {peak:.3g}; gradients scaled that "
+                f"far saturate the {compressor} {wire} wire "
+                f"(finite max {wire_max:.3g}, headroom {WIRE_HEADROOM:.0e})")
+    return None
+
+
+# -- step-state transition (traced) ------------------------------------------
+
+def init_state(scale: Optional[LossScale]):
+    """The numerics step state: loss scale + health counters, all scalar
+    leaves (replicated across the mesh).  Carried in the step like
+    optimizer state and checkpointed with the sync state."""
+    import jax.numpy as jnp
+
+    init = float(scale.init) if scale is not None else 1.0
+    return {
+        "scale": jnp.float32(init),
+        "good_steps": jnp.int32(0),
+        "bad_steps": jnp.int32(0),      # consecutive non-finite steps
+        "skipped": jnp.int32(0),        # cumulative skipped updates
+        "step": jnp.int32(0),           # device-side step counter
+    }
+
+
+def update_state(state, all_finite, scale: Optional[LossScale]):
+    """One transition of the numerics state given this step's health.
+    Pure/traced: clean step → good_steps+1 (growth at the interval);
+    non-finite step → backoff + skip counters.  With ``scale`` None the
+    scale stays exactly 1 and only the counters move."""
+    import jax.numpy as jnp
+
+    ok = all_finite
+    good = jnp.where(ok, state["good_steps"] + 1, 0)
+    bad = jnp.where(ok, 0, state["bad_steps"] + 1)
+    skipped = state["skipped"] + jnp.where(ok, 0, 1).astype(jnp.int32)
+    s = state["scale"]
+    if scale is not None and scale.dynamic:
+        grown = jnp.where(good >= scale.growth_interval,
+                          s * scale.growth_factor, s)
+        good = jnp.where(good >= scale.growth_interval, 0, good)
+        s = jnp.where(ok, grown, s * scale.backoff_factor)
+        s = jnp.clip(s, scale.min_scale, scale.max_scale)
+    return {
+        "scale": s.astype(jnp.float32),
+        "good_steps": good.astype(jnp.int32),
+        "bad_steps": bad.astype(jnp.int32),
+        "skipped": skipped,
+        "step": (state["step"] + 1).astype(jnp.int32),
+    }

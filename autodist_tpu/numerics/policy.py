@@ -1,0 +1,212 @@
+"""The numerics step policy: configuration, the host-side health
+monitor, and rollback plumbing.
+
+``on_nonfinite`` decides what a bad step costs:
+
+* ``"skip"`` (default) — the step applies a ZERO update (params and
+  optimizer state bit-identical, loss scale backs off) and counts it;
+  purely device-side, no host sync per step.
+* ``"raise"`` — ``fit`` fetches the health scalar every step and raises
+  :class:`NonFiniteError` on the first bad one (a debugging mode; the
+  per-step host sync serializes dispatch).
+* ``"rollback"`` — after ``rollback_after`` CONSECUTIVE bad steps, or a
+  loss spike beyond ``spike_zscore`` standard deviations of the recent
+  window, ``fit`` restores the last *verified-good* checkpoint
+  (:meth:`Saver.restore_last_good`), optionally re-seeds the data order
+  so the offending batch sequence is not replayed verbatim, emits a
+  failure marker the PR 4 Supervisor understands, and resumes.  Also
+  per-step host sync.
+
+The config rides :meth:`AutoDist.capture(numerics=...)`; ``fit`` can
+override the host policy with ``fit(on_nonfinite=...)``.
+"""
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, List, Optional
+
+from autodist_tpu.utils import logging
+
+ON_NONFINITE = ("skip", "raise", "rollback")
+
+#: failure-marker code for a numerics rollback (distinct from worker
+#: exits; the Supervisor records it for attribution like any marker).
+NUMERICS_MARKER_CODE = 74
+
+
+class NonFiniteError(RuntimeError):
+    """Raised by ``fit(on_nonfinite="raise")`` on a non-finite step, and
+    by rollback when no recovery is possible (no checkpoint_dir, no
+    verified-good step, or the rollback budget is exhausted)."""
+
+
+@dataclass(frozen=True)
+class NumericsConfig:
+    """Everything the numerics guard needs, resolved at capture time.
+
+    ``loss_scale``: ``"auto"`` (dynamic scaling iff params or gradient
+    buckets are low-precision — fp16/bf16), ``None`` (off), a number
+    (static scale), or a :class:`~autodist_tpu.numerics.LossScale`.
+    ``clip_norm``: global-norm clip threshold (optax formula; exact
+    under ZeRO-1 and pipelined overlap).  ``spike_zscore``: enable the
+    loss-spike detector at this z-score over the last ``spike_window``
+    finite losses (None = off).  ``rollback_after``: consecutive bad
+    steps before a rollback triggers.  ``max_rollbacks`` bounds how many
+    times one ``fit`` call may roll back before giving up with
+    :class:`NonFiniteError`."""
+
+    guard: bool = True
+    clip_norm: Optional[float] = None
+    loss_scale: Any = "auto"
+    on_nonfinite: str = "skip"
+    rollback_after: int = 3
+    spike_zscore: Optional[float] = None
+    spike_window: int = 32
+    max_rollbacks: int = 2
+    reseed_on_rollback: bool = True
+
+    def __post_init__(self):
+        if self.on_nonfinite not in ON_NONFINITE:
+            raise ValueError(
+                f"on_nonfinite must be one of {ON_NONFINITE}, "
+                f"got {self.on_nonfinite!r}")
+        if self.clip_norm is not None and self.clip_norm <= 0:
+            raise ValueError("clip_norm must be > 0 (or None)")
+        if self.rollback_after < 1:
+            raise ValueError("rollback_after must be >= 1")
+        if self.spike_window < 4:
+            raise ValueError("spike_window must be >= 4")
+
+    @staticmethod
+    def coerce(value) -> Optional["NumericsConfig"]:
+        """Normalize the ``capture(numerics=...)`` argument: None/False
+        (off), True (defaults), one of :data:`ON_NONFINITE` (defaults
+        with that policy), a dict of fields, or a config instance."""
+        if value is None or value is False:
+            return None
+        if value is True:
+            return NumericsConfig()
+        if isinstance(value, str):
+            return NumericsConfig(on_nonfinite=value)
+        if isinstance(value, dict):
+            return NumericsConfig(**value)
+        if isinstance(value, NumericsConfig):
+            return value
+        raise ValueError(
+            "numerics must be None/bool, an on_nonfinite string, a dict "
+            f"of NumericsConfig fields, or a NumericsConfig; got {value!r}")
+
+
+@dataclass
+class RollbackRequest(Exception):
+    """Internal signal from the step loop to ``fit``'s rollback handler
+    (an Exception so it unwinds the epoch loop cleanly)."""
+
+    step: int
+    reason: str
+
+    def __str__(self):
+        return f"rollback requested at step {self.step}: {self.reason}"
+
+
+class StepHealthMonitor:
+    """Host-side per-step health tracking for ``raise``/``rollback``
+    policies and the loss-spike detector.
+
+    ``observe`` returns None (healthy), ``"raise"``, or ``"rollback"``.
+    Chaos ``loss_spike`` events (AUTODIST_CHAOS) multiply the OBSERVED
+    loss once at their step — a synthetic detector drill that leaves the
+    real trajectory untouched, so a rollback test can still match an
+    uninterrupted oracle exactly."""
+
+    #: minimum finite-loss samples before the z-score test is trusted.
+    MIN_SAMPLES = 8
+
+    def __init__(self, config: NumericsConfig,
+                 policy: Optional[str] = None):
+        from autodist_tpu.resilience import chaos as chaos_mod
+
+        self.config = config
+        self.policy = policy or config.on_nonfinite
+        self._bad = 0
+        self._losses: deque = deque(maxlen=config.spike_window)
+        self._spikes: List = list(chaos_mod.loss_spike_events())
+
+    @property
+    def bad_streak(self) -> int:
+        """Current run of consecutive unhealthy steps."""
+        return self._bad
+
+    def reset(self) -> None:
+        """After a rollback restore: the bad-step streak clears.  The
+        loss window is KEPT — it describes the healthy trajectory the
+        restore rejoined, so the spike detector stays armed through the
+        replayed steps instead of needing MIN_SAMPLES fresh ones."""
+        self._bad = 0
+
+    def _chaos_factor(self, step: int) -> float:
+        """At most ONE loss_spike event fires per observation (each event
+        fires once) — N queued events spike N successive observations
+        that reach their step, which is how the budget-exhaustion drill
+        spikes every post-rollback replay."""
+        for ev in self._spikes:
+            if not ev.fired and (ev.step is None or step >= ev.step):
+                ev.fired = True
+                factor = float(ev.args.get("factor", 1e6))
+                logging.warning(
+                    "CHAOS: loss_spike observed at step %d (factor %g)",
+                    step, factor)
+                return factor
+        return 1.0
+
+    def observe(self, step: int, loss: float,
+                all_finite: bool) -> Optional[str]:
+        import math
+
+        loss = loss * self._chaos_factor(step)
+        spiked = False
+        if all_finite and math.isfinite(loss):
+            if (self.config.spike_zscore is not None
+                    and len(self._losses) >= self.MIN_SAMPLES):
+                n = len(self._losses)
+                mean = sum(self._losses) / n
+                var = sum((x - mean) ** 2 for x in self._losses) / n
+                std = math.sqrt(var)
+                if std > 0 and (loss - mean) / std > self.config.spike_zscore:
+                    spiked = True
+                    logging.warning(
+                        "numerics: loss spike at step %d (%.4g vs window "
+                        "mean %.4g, z > %.1f)", step, loss, mean,
+                        self.config.spike_zscore)
+            if not spiked:
+                self._losses.append(loss)
+                self._bad = 0
+                return None
+        self._bad += 1
+        if not all_finite and self.policy == "raise":
+            return "raise"
+        if self.policy == "rollback" and (
+                spiked or self._bad >= self.config.rollback_after):
+            return "rollback"
+        return None
+
+
+def emit_failure_marker(reason: str) -> Optional[str]:
+    """Write a numerics failure marker into the supervisor's marker dir
+    (AUTODIST_SUPERVISOR_DIR) when one is configured — the same file
+    format the PR 4 :class:`Supervisor` reads for failure attribution,
+    with the numerics reason attached."""
+    import socket
+
+    from autodist_tpu.const import ENV
+    from autodist_tpu.resilience.supervisor import write_failure_marker
+
+    marker_dir = ENV.AUTODIST_SUPERVISOR_DIR.val
+    if not marker_dir:
+        return None
+    path = write_failure_marker(marker_dir, socket.gethostname(),
+                                NUMERICS_MARKER_CODE, reason=reason)
+    logging.warning("numerics: failure marker written to %s (%s)",
+                    path, reason)
+    return path

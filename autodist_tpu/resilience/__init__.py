@@ -28,6 +28,8 @@ _EXPORTS = {
     "ChaosCallback": "autodist_tpu.resilience.chaos",
     "ChaosMonkey": "autodist_tpu.resilience.chaos",
     "corrupt_checkpoint": "autodist_tpu.resilience.chaos",
+    "grad_injections": "autodist_tpu.resilience.chaos",
+    "loss_spike_events": "autodist_tpu.resilience.chaos",
     "parse_chaos": "autodist_tpu.resilience.chaos",
     "Attempt": "autodist_tpu.resilience.supervisor",
     "FailFast": "autodist_tpu.resilience.supervisor",

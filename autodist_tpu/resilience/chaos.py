@@ -16,6 +16,9 @@ any other coordinator env) as ``;``-separated events::
     preempt@step=5,signal=SIGTERM           # deliver a preemption notice
     drop_heartbeats@step=3,proc=2           # beacons stop (wedge drill)
     corrupt_ckpt@step=4,item=params,path=/ckpt/dir   # truncate a step dir
+    nan_grad@step=3,bucket=all_reduce:float32:g0:0   # NaN into a bucket
+    inf_grad@step=3,var=l0/w                # Inf into one grad leaf
+    loss_spike@step=9,factor=1e6            # spike the MONITORED loss
 
 Filters (``step``/``proc``/``attempt``) all default to "any"; an event
 fires at most once per process.  ``proc`` matches the JAX process index
@@ -23,6 +26,19 @@ fires at most once per process.  ``proc`` matches the JAX process index
 matches ``AUTODIST_ATTEMPT``, which the job supervisor stamps on every
 relaunch — so ``attempt=0`` means "fail the first try, let the retry
 succeed", the canonical recovery drill.
+
+Numerics events (docs/numerics.md) drive the PR 5 guard/rollback tests
+through this same path, but fire differently from the host-side
+actions above: ``nan_grad``/``inf_grad`` are consumed at TRACE time by
+the numerics guard (:func:`grad_injections`) and compiled into the step
+— the poison lands in the named gradient bucket (``bucket=<key>``) or
+variable (``var=<name>``) when the step's on-device counter matches, so
+detection is exact on every sync path.  They require
+``capture(numerics=...)``.  ``loss_spike`` is consumed by the host-side
+:class:`~autodist_tpu.numerics.StepHealthMonitor`: it multiplies the
+OBSERVED loss once (``factor=``, default 1e6) without touching the real
+trajectory — the synthetic detector drill behind the
+rollback-vs-oracle parity test.
 """
 from __future__ import annotations
 
@@ -33,7 +49,13 @@ from typing import Dict, List, Optional
 
 from autodist_tpu.utils import logging
 
-ACTIONS = ("kill", "preempt", "drop_heartbeats", "corrupt_ckpt")
+ACTIONS = ("kill", "preempt", "drop_heartbeats", "corrupt_ckpt",
+           "nan_grad", "inf_grad", "loss_spike")
+
+#: events NOT executed by ChaosMonkey.on_step: grad injections compile
+#: into the step (numerics guard), loss_spike rides the health monitor.
+GRAD_ACTIONS = ("nan_grad", "inf_grad")
+MONITOR_ACTIONS = ("loss_spike",)
 
 DEFAULT_KILL_CODE = 43   # distinct from crashes (1) and supervised aborts
 
@@ -138,11 +160,16 @@ class ChaosMonkey:
             return int(pid) if pid is not None else None
 
     def on_step(self, step: int) -> None:
-        """Fire every event matching this completed step (each once)."""
+        """Fire every event matching this completed step (each once).
+        Numerics events (``nan_grad``/``inf_grad``/``loss_spike``) are
+        consumed elsewhere (trace-time injection / the health monitor)
+        and are skipped here."""
         if not self._events:
             return
         proc = self._process_index()
         for ev in self._events:
+            if ev.action in GRAD_ACTIONS or ev.action in MONITOR_ACTIONS:
+                continue
             if ev.matches(int(step), proc, self._attempt):
                 ev.fired = True
                 self._fire(ev, step)
@@ -225,3 +252,53 @@ def corrupt_checkpoint(path: str, item: str = "params",
     logging.warning("CHAOS: corrupted checkpoint item %s (%s, %d paths)",
                     target, mode, len(touched))
     return touched
+
+
+# -- numerics events (PR 5 guard/rollback drills) ----------------------------
+
+def _env_events_for(actions, process_index: Optional[int] = None
+                    ) -> List[ChaosEvent]:
+    """Parse ``AUTODIST_CHAOS`` and keep the ``actions`` events that
+    apply to THIS process/attempt.  proc/attempt filtering happens here
+    — eagerly — because these events are consumed at trace time or by a
+    long-lived monitor, not at a step boundary."""
+    from autodist_tpu.const import ENV
+
+    spec = ENV.AUTODIST_CHAOS.val
+    if not spec:
+        return []
+    attempt = ENV.AUTODIST_ATTEMPT.val
+    if process_index is None:
+        try:
+            import jax
+            process_index = jax.process_index()
+        except Exception:
+            pid = os.environ.get("AUTODIST_PROCESS_ID")
+            process_index = int(pid) if pid is not None else None
+    out = []
+    for ev in parse_chaos(spec):
+        if ev.action not in actions:
+            continue
+        if ev.proc is not None and process_index is not None \
+                and ev.proc != process_index:
+            continue
+        if ev.attempt is not None and attempt is not None \
+                and ev.attempt != attempt:
+            continue
+        out.append(ev)
+    return out
+
+
+def grad_injections(process_index: Optional[int] = None) -> List[ChaosEvent]:
+    """The ``nan_grad``/``inf_grad`` events for this process/attempt —
+    consumed at trace time by the numerics guard, which compiles the
+    poison into the step (see ``numerics/guard.py`` and
+    docs/numerics.md)."""
+    return _env_events_for(GRAD_ACTIONS, process_index)
+
+
+def loss_spike_events(process_index: Optional[int] = None
+                      ) -> List[ChaosEvent]:
+    """The ``loss_spike`` events for this process/attempt — consumed by
+    the host-side :class:`~autodist_tpu.numerics.StepHealthMonitor`."""
+    return _env_events_for(MONITOR_ACTIONS, process_index)

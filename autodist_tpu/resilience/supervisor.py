@@ -124,14 +124,19 @@ class NotifySupervisor(FailurePolicy):
         return ABORT
 
 
-def write_failure_marker(marker_dir: str, address: str, code: int) -> str:
+def write_failure_marker(marker_dir: str, address: str, code: int,
+                         reason: Optional[str] = None) -> str:
+    """``reason`` (optional, e.g. a numerics rollback cause) rides along
+    in the marker; readers that predate it ignore the extra key."""
     os.makedirs(marker_dir, exist_ok=True)
     safe = address.replace("/", "_").replace(":", "_")
     path = os.path.join(marker_dir, f"{_MARKER_PREFIX}{safe}.json")
     tmp = path + ".tmp"
+    payload = {"address": address, "code": int(code), "time": time.time()}
+    if reason:
+        payload["reason"] = str(reason)
     with open(tmp, "w", encoding="utf-8") as f:
-        json.dump({"address": address, "code": int(code),
-                   "time": time.time()}, f)
+        json.dump(payload, f)
     os.replace(tmp, path)
     return path
 

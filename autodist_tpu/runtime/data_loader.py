@@ -176,6 +176,16 @@ class DataLoader:
         self._live = None
         return {"epoch": epoch, "offset": offset, "seed": self._seed}
 
+    def reseed(self, seed: int) -> None:
+        """Switch the shuffle seed for FUTURE epochs (the numerics
+        rollback's re-seeding hook, docs/numerics.md: after restoring a
+        verified-good checkpoint, replaying the epochs under a different
+        permutation avoids re-hitting a pathological batch ordering).
+        Deliberately NOT part of ``load_state`` — exact resume requires
+        the identical stream, so changing the seed is an explicit act."""
+        self._seed = int(seed)
+        self._live = None
+
     # -- iteration ---------------------------------------------------------
     def __len__(self) -> int:
         return self.num_batches

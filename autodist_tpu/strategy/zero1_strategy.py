@@ -24,6 +24,16 @@ pad-to-divisible, PowerSGD-compressed) fall back to their usual per-
 variable collective with replicated optimizer state — the fallback is
 warned at trace time and visible to ``autodist_tpu.analysis``.
 
+Numerics (docs/numerics.md): do NOT put ``optax.clip_by_global_norm``
+in the optimizer chain under ZeRO-1 — the bucket optimizer updates
+LOCAL 1/N shards, so a chained clip would compute shard-local norms and
+silently clip differently per device.  Use
+``capture(numerics={"clip_norm": ...})`` instead: the fused guard psums
+the reduce-scattered shards' squared norms (÷ replication), so the clip
+factor is the true global norm's — exact to 1e-6 against unsharded
+clipping, including under pipelined overlap.  The guard's per-bucket
+finiteness bits and the loss-scale state ride the same bucket chain.
+
 No reference analog: the OSS reference synchronizes one variable at a
 time and replicates optimizer state on every replica.
 """

@@ -1406,15 +1406,16 @@ def run_grad_sync_child() -> None:
             h = jnp.tanh(h @ p[f"l{i}"]["w"] + p[f"l{i}"]["b"])
         return jnp.mean((h - b["y"]) ** 2)
 
-    def measure(builder, accum=1):
+    def measure(builder, accum=1, numerics=None, steps=20):
         _reset_default_autodist_for_testing()
         ad = AutoDist(strategy_builder=builder)
         with ad.scope():
             ad.capture(params=params, optimizer=optax.adam(1e-3),
-                       loss_fn=loss_fn, accum_steps=accum)
+                       loss_fn=loss_fn, accum_steps=accum,
+                       numerics=numerics)
         sess = ad.create_distributed_session()
         placed = sess.place_batch(batch)
-        dt = _measure_session(sess, placed, 3, 20)
+        dt = _measure_session(sess, placed, 3, steps)
         opt_dev_bytes = 0
         for leaf in jax.tree_util.tree_leaves(sess.opt_state):
             sh = leaf.addressable_shards[0]
@@ -1424,7 +1425,7 @@ def run_grad_sync_child() -> None:
         gi = sess._gi
         del sess, ad
         _reset_default_autodist_for_testing()
-        return dt / 20, opt_dev_bytes, buckets, gi, compiled
+        return dt / steps, opt_dev_bytes, buckets, gi, compiled
 
     grad_bytes = float(sum(np.asarray(leaf).nbytes
                            for lp in params.values()
@@ -1505,6 +1506,42 @@ def run_grad_sync_child() -> None:
                 cost_off.exposed_wire_bytes / ICI_BANDWIDTH * 1e3, 4),
             "overlap_fraction": round(cost_on.overlap_fraction, 4),
         }
+
+    # -- numerics guard overhead (docs/numerics.md) -----------------------
+    # Same ZeRO-1 pipelined-accum program with the fused guard off vs on
+    # (detection + skip gate: finiteness bits as a pack byproduct, norm
+    # partials from the reduce-scattered shards, one small psum), and
+    # additionally with exact global-norm clipping — the clip factor
+    # JOINS every bucket's norm partial before the shard updates, so its
+    # cost is reported separately from the guard proper.  Runs are
+    # INTERLEAVED and minima compared: host-load drift between serial
+    # measurement blocks otherwise dwarfs a percent-level delta on a
+    # shared CPU host (whose 8 "devices" also share one memory bus —
+    # the absolute overheads here are an upper bound on the TPU regime).
+    accum = 4
+    cfgs = (("off", None),
+            ("detect", {"clip_norm": None, "loss_scale": None}),
+            ("clip", {"clip_norm": 1.0, "loss_scale": None}))
+    ts = {k: [] for k, _ in cfgs}
+    for trial in range(4):
+        order = cfgs if trial % 2 == 0 else tuple(reversed(cfgs))
+        for key, numerics in order:
+            t, _, _, _, _ = measure(Zero1(bucket_bytes=bucket_bytes),
+                                    accum=accum, numerics=numerics,
+                                    steps=50)
+            ts[key].append(t)
+    t_off = min(ts["off"])
+    t_detect, t_clip = min(ts["detect"]), min(ts["clip"])
+    out["guard"] = {
+        "accum_steps": accum,
+        "mode": "reduce_scatter",
+        "step_time_ms_guard_off": round(t_off * 1e3, 3),
+        "step_time_ms_guard_on": round(t_detect * 1e3, 3),
+        "step_time_ms_guard_clip": round(t_clip * 1e3, 3),
+        "overhead_fraction": round((t_detect - t_off) / t_off, 4),
+        "overhead_fraction_with_clip": round((t_clip - t_off) / t_off, 4),
+        "target_overhead_fraction": 0.02,
+    }
     print(json.dumps(out), flush=True)
 
 

@@ -1,0 +1,458 @@
+"""Numerics guard: fused non-finite detection, exact clipping, loss
+scaling, verified-good checkpoints, and anomaly rollback
+(docs/numerics.md).
+
+The guard matrix drives one chaos ``nan_grad`` injection through every
+sync tier — GSPMD, per-variable fallback, bucketed, ZeRO-1, and
+pipelined overlap — and requires detection on the EXACT step plus a
+bit-identical skip.  The clipping parity tests hold the sharded
+(ZeRO-1 + overlap) clip to 1e-6 against an unsharded optax chain.  The
+rollback drill replays the resilience harness pattern: a chaos-driven
+anomaly, recovery from the last verified-good checkpoint, and exact
+parity with an uninterrupted oracle.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from autodist_tpu.autodist import AutoDist, _reset_default_autodist_for_testing
+from autodist_tpu.strategy import AllReduce, Zero1
+
+pytestmark = pytest.mark.numerics
+
+RTOL = 1e-6
+
+
+def _params():
+    rng = np.random.RandomState(0)
+    return {"l0": {"w": jnp.asarray(rng.randn(16, 16) * 0.1, jnp.float32),
+                   "b": jnp.zeros((16,), jnp.float32)},
+            "l1": {"w": jnp.asarray(rng.randn(16, 4) * 0.1, jnp.float32)}}
+
+
+def _batches(n=5, rows=32):
+    rng = np.random.RandomState(7)
+    return [{"x": rng.randn(rows, 16).astype(np.float32),
+             "y": rng.randn(rows, 4).astype(np.float32)} for _ in range(n)]
+
+
+def _loss_fn(p, b):
+    h = jnp.tanh(b["x"] @ p["l0"]["w"] + p["l0"]["b"])
+    return jnp.mean((h @ p["l1"]["w"] - b["y"]) ** 2)
+
+
+def _session(builder, numerics, accum=1, params=None, optimizer=None):
+    _reset_default_autodist_for_testing()
+    ad = AutoDist(strategy_builder=builder)
+    with ad.scope():
+        ad.capture(params=params or _params(),
+                   optimizer=optimizer or optax.adam(1e-2),
+                   loss_fn=_loss_fn, accum_steps=accum, numerics=numerics)
+    return ad.create_distributed_session()
+
+
+def _host(tree):
+    return jax.tree_util.tree_map(np.asarray, tree)
+
+
+def _assert_trees_equal(a, b):
+    for x, y in zip(jax.tree_util.tree_leaves(a),
+                    jax.tree_util.tree_leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# -- the guard matrix --------------------------------------------------------
+
+PATHS = {
+    # per-variable tier: PowerSGD is non-bucketable, so every var keeps
+    # its own collective on the explicit path.
+    "gspmd": (lambda: AllReduce(), 1),
+    "per_variable": (lambda: AllReduce(compressor="PowerSGDCompressor"), 1),
+    "bucketed": (lambda: AllReduce(bucket_bytes=1 << 20), 1),
+    "zero1": (lambda: Zero1(bucket_bytes=1 << 20), 1),
+    "pipelined": (lambda: Zero1(bucket_bytes=1 << 20), 4),
+}
+
+
+@pytest.mark.parametrize("path", sorted(PATHS))
+def test_injected_nan_detected_on_exact_step_and_skip_is_bitwise(
+        path, monkeypatch):
+    monkeypatch.setenv("AUTODIST_CHAOS", "nan_grad@step=1,var=l0/w")
+    builder, accum = PATHS[path]
+    sess = _session(builder(), True, accum=accum)
+    batch = _batches(1)[0]
+    for step in range(3):
+        pre_p = _host(sess.params)
+        pre_o = _host(jax.tree_util.tree_leaves(sess.opt_state))
+        out = sess.run(batch)
+        h = out["grad_health"]
+        if step == 1:
+            assert not bool(h.all_finite), \
+                f"{path}: NaN not detected on the injected step"
+            # skip: params AND optimizer state bit-identical
+            _assert_trees_equal(pre_p, _host(sess.params))
+            _assert_trees_equal(
+                pre_o, _host(jax.tree_util.tree_leaves(sess.opt_state)))
+            assert int(h.skipped_steps) == 1
+        else:
+            assert bool(h.all_finite), \
+                f"{path}: step {step} falsely flagged non-finite"
+            assert np.isfinite(float(h.global_norm))
+    assert int(out["grad_health"].skipped_steps) == 1
+
+
+def test_per_bucket_health_keys_cover_the_plan():
+    sess = _session(Zero1(bucket_bytes=1 << 20), True)
+    out = sess.run(_batches(1)[0])
+    pb = out["grad_health"].per_bucket
+    assert any(k.startswith("reduce_scatter:") for k in pb)
+    for entry in pb.values():
+        assert bool(entry["finite"])
+        assert float(entry["sq_norm"]) >= 0.0
+
+
+# -- exact global-norm clipping ---------------------------------------------
+
+@pytest.mark.parametrize("path", ["gspmd", "bucketed", "zero1", "pipelined"])
+def test_clip_matches_unsharded_optax_chain(path):
+    clip = 0.05
+    batches = _batches(5)
+    opt = optax.chain(optax.clip_by_global_norm(clip), optax.adam(1e-2))
+    ref_p, ref_s = _params(), None
+    ref_s = opt.init(ref_p)
+
+    @jax.jit
+    def ref_step(p, s, b):
+        _, g = jax.value_and_grad(_loss_fn)(p, b)
+        u, s = opt.update(g, s, p)
+        return optax.apply_updates(p, u), s
+
+    for b in batches:
+        ref_p, ref_s = ref_step(ref_p, ref_s, b)
+    ref = _host(ref_p)
+
+    builder, accum = PATHS[path]
+    sess = _session(builder(), {"clip_norm": clip, "loss_scale": None},
+                    accum=accum)
+    for b in batches:
+        out = sess.run(b)
+    assert bool(out["grad_health"].all_finite)
+    for a, g in zip(jax.tree_util.tree_leaves(ref),
+                    jax.tree_util.tree_leaves(sess.params)):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(a),
+                                   rtol=RTOL, atol=RTOL)
+
+
+# -- dynamic loss scaling ----------------------------------------------------
+
+def test_loss_scale_backoff_and_growth(monkeypatch):
+    from autodist_tpu.numerics import LossScale
+
+    monkeypatch.setenv("AUTODIST_CHAOS", "nan_grad@step=1")
+    sess = _session(
+        Zero1(bucket_bytes=1 << 20),
+        {"loss_scale": LossScale(init=4.0, growth_factor=2.0,
+                                 backoff_factor=0.5, growth_interval=2,
+                                 min_scale=0.25)})
+    batch = _batches(1)[0]
+    scales, skipped = [], []
+    for _ in range(5):
+        h = sess.run(batch)["grad_health"]
+        scales.append(float(h.loss_scale))
+        skipped.append(int(h.skipped_steps))
+    # step0 clean (good=1) -> step1 NaN: backoff 4->2 -> steps 2,3 clean
+    # (good hits the interval after step3 -> grow back to 4 for step 4).
+    assert scales == [4.0, 4.0, 2.0, 2.0, 4.0]
+    assert skipped == [0, 1, 1, 1, 1]
+
+
+def test_loss_scale_auto_enables_for_bf16_only():
+    p32 = _params()
+    sess = _session(AllReduce(bucket_bytes=1 << 20), True, params=p32)
+    assert float(sess.run(_batches(1)[0])["grad_health"].loss_scale) == 1.0
+
+    p16 = jax.tree_util.tree_map(lambda x: x.astype(jnp.bfloat16), p32)
+    sess = _session(AllReduce(bucket_bytes=1 << 20), True, params=p16)
+    h = sess.run(_batches(1)[0])["grad_health"]
+    assert float(h.loss_scale) == 2.0 ** 15
+    assert bool(h.all_finite)
+
+
+def test_reported_loss_is_unscaled():
+    batch = _batches(1)[0]
+    plain = _session(AllReduce(bucket_bytes=1 << 20), None)
+    ref = float(plain.run(batch)["loss"])
+    scaled = _session(AllReduce(bucket_bytes=1 << 20),
+                      {"loss_scale": 1024.0})
+    out = scaled.run(batch)
+    np.testing.assert_allclose(float(out["loss"]), ref, rtol=1e-5)
+
+
+def test_loss_scale_state_rides_checkpoints(tmp_path, monkeypatch):
+    from autodist_tpu.checkpoint import Saver
+
+    monkeypatch.setenv("AUTODIST_CHAOS", "nan_grad@step=0")
+    sess = _session(Zero1(bucket_bytes=1 << 20),
+                    {"loss_scale": 256.0, "on_nonfinite": "skip"})
+    batch = _batches(1)[0]
+    sess.run(batch)   # static scale: stays 256 even after the skip
+    saver = Saver(sess)
+    path = saver.save(str(tmp_path / "ck"))
+    assert Saver.read_meta(path)["has_sync_state"]
+
+    monkeypatch.delenv("AUTODIST_CHAOS")
+    sess2 = _session(Zero1(bucket_bytes=1 << 20),
+                     {"loss_scale": 256.0, "on_nonfinite": "skip"})
+    saver2 = Saver(sess2)
+    step = saver2.restore(path)
+    assert step == sess.step_count
+    h = sess2.run(batch)["grad_health"]
+    # the cumulative skip counter survived the checkpoint round-trip
+    assert int(h.skipped_steps) == 1
+    assert float(h.loss_scale) == 256.0
+
+
+# -- build-time safety -------------------------------------------------------
+
+def test_saturating_scale_with_quantizing_compressor_raises():
+    with pytest.raises(ValueError, match="saturate"):
+        _session(AllReduce(compressor="HorovodCompressorEF",
+                           bucket_bytes=1 << 20),
+                 {"loss_scale": 1e36})
+
+
+def test_wire_saturation_flag_pure():
+    from autodist_tpu.numerics.guard import wire_saturation
+
+    vec = jnp.asarray([1e5, 1.0], jnp.float32)     # 1e5 overflows fp16
+    assert wire_saturation(vec, None) is None
+    assert bool(wire_saturation(vec, "float16"))
+    assert not bool(wire_saturation(vec, "bfloat16"))
+
+
+# -- chaos harness events ----------------------------------------------------
+
+def test_chaos_parses_numerics_events_and_on_step_ignores_them():
+    from autodist_tpu.resilience.chaos import ChaosMonkey, parse_chaos
+
+    events = parse_chaos("nan_grad@step=3,bucket=b0;inf_grad@step=4,"
+                         "var=l0/w;loss_spike@step=9,factor=1e6")
+    assert [e.action for e in events] == ["nan_grad", "inf_grad",
+                                          "loss_spike"]
+    assert events[0].args["bucket"] == "b0"
+    assert events[2].args["factor"] == "1e6"
+    monkey = ChaosMonkey(events, process_index=0)
+    monkey.on_step(9)   # must NOT fire (grad/monitor events ride elsewhere)
+    assert not any(e.fired for e in monkey.events)
+
+
+def test_grad_injections_filter_by_proc_and_attempt(monkeypatch):
+    from autodist_tpu.resilience import chaos
+
+    monkeypatch.setenv(
+        "AUTODIST_CHAOS",
+        "nan_grad@step=1,proc=3;inf_grad@step=2;kill@step=9")
+    evs = chaos.grad_injections(process_index=0)
+    assert [e.action for e in evs] == ["inf_grad"]
+    evs = chaos.grad_injections(process_index=3)
+    assert [e.action for e in evs] == ["nan_grad", "inf_grad"]
+    monkeypatch.setenv("AUTODIST_CHAOS", "loss_spike@step=5,attempt=1")
+    monkeypatch.setenv("AUTODIST_ATTEMPT", "0")
+    assert chaos.loss_spike_events(process_index=0) == []
+
+
+# -- verified-good checkpoints ----------------------------------------------
+
+def test_mark_good_prefers_and_protects(tmp_path):
+    from autodist_tpu.checkpoint import Saver
+
+    sess = _session(AllReduce(bucket_bytes=1 << 20), True)
+    batch = _batches(1)[0]
+    ckdir = str(tmp_path / "ck")
+    saver = Saver(sess)
+    paths = {}
+    for want in (1, 2, 3):
+        while sess.step_count < want:
+            sess.run(batch)
+        paths[want] = saver.save(ckdir)
+    assert Saver.latest_step(ckdir) == 3
+
+    assert Saver.mark_good(paths[2])
+    # verified-good step 2 outranks the newer merely-uncorrupted step 3
+    assert Saver.good_steps(ckdir) == [2]
+    assert Saver.latest_step(ckdir) == 2
+    assert Saver.last_good_checkpoint(ckdir) == paths[2]
+
+    # restore_last_good restores THE good step, not the newest
+    sess.run(batch)
+    restored = saver.restore_last_good(ckdir)
+    assert restored == 2 and sess.step_count == 2
+
+    # retention never GCs the last good step
+    saver_keep = Saver(sess, keep=1)
+    while sess.step_count < 5:
+        sess.run(batch)
+    saver_keep.save(ckdir)
+    kept = Saver._committed_steps(ckdir)
+    assert 2 in kept, "keep=1 deleted the verified-good rollback anchor"
+    assert 5 in kept
+    assert 1 not in kept and 3 not in kept
+
+
+def test_mark_good_refuses_corrupt_step(tmp_path):
+    from autodist_tpu.checkpoint import Saver
+    from autodist_tpu.resilience.chaos import corrupt_checkpoint
+
+    sess = _session(AllReduce(bucket_bytes=1 << 20), True)
+    sess.run(_batches(1)[0])
+    saver = Saver(sess)
+    path = saver.save(str(tmp_path / "ck"))
+    corrupt_checkpoint(path, item="params", mode="truncate")
+    assert not Saver.mark_good(path)
+    assert Saver.good_steps(str(tmp_path / "ck")) == []
+
+
+# -- fit policies ------------------------------------------------------------
+
+def _fit_session(numerics):
+    return _session(AllReduce(bucket_bytes=1 << 20), numerics)
+
+
+def test_fit_skip_counts_in_history(monkeypatch):
+    monkeypatch.setenv("AUTODIST_CHAOS", "nan_grad@step=2")
+    sess = _fit_session(True)
+    hist = sess.fit(_batches(4), epochs=2, steps_per_epoch=4)
+    assert hist.history["skipped_steps"][-1] == 1
+    assert hist.steps_run == 8
+
+
+def test_fit_on_nonfinite_raise(monkeypatch):
+    from autodist_tpu.numerics import NonFiniteError
+
+    monkeypatch.setenv("AUTODIST_CHAOS", "nan_grad@step=2")
+    sess = _fit_session(True)
+    with pytest.raises(NonFiniteError, match="step 3"):
+        # step counter is 1-based after the run; injection hits the
+        # step whose on-device counter is 2 (the third step).
+        sess.fit(_batches(4), epochs=2, steps_per_epoch=4,
+                 on_nonfinite="raise")
+
+
+def test_fit_on_nonfinite_requires_guard():
+    sess = _session(AllReduce(bucket_bytes=1 << 20), None)
+    with pytest.raises(ValueError, match="numerics"):
+        sess.fit(_batches(2), epochs=1, on_nonfinite="raise")
+
+
+# -- the rollback drill ------------------------------------------------------
+
+def test_chaos_loss_spike_rollback_matches_uninterrupted_oracle(
+        tmp_path, monkeypatch):
+    """The acceptance drill (resilience-harness pattern): a chaos
+    loss_spike trips the z-score detector mid-epoch; fit restores the
+    last verified-good checkpoint, replays, and the recovered run's
+    final parameters match an uninterrupted oracle exactly (the spike
+    only touched the MONITORED loss, and list data replays verbatim)."""
+    from autodist_tpu.checkpoint import Saver
+
+    batches = _batches(4, rows=32)
+    numerics = {"on_nonfinite": "rollback", "spike_zscore": 3.0,
+                "spike_window": 8, "rollback_after": 2}
+
+    # ORACLE: same program, chaos off.
+    sess = _fit_session(numerics)
+    oracle_hist = sess.fit(batches, epochs=4, steps_per_epoch=4,
+                           checkpoint_dir=str(tmp_path / "oracle"))
+    oracle = _host(sess.params)
+    assert "rollbacks" not in oracle_hist.history
+    # clean-guard saves are marked verified-good
+    assert Saver.good_steps(str(tmp_path / "oracle"))
+
+    # DRILL: spike the monitored loss at step 11 (epoch 2, mid-epoch).
+    monkeypatch.setenv("AUTODIST_CHAOS", "loss_spike@step=11,factor=1e6")
+    marker_dir = str(tmp_path / "markers")
+    monkeypatch.setenv("AUTODIST_SUPERVISOR_DIR", marker_dir)
+    sess = _fit_session(numerics)
+    hist = sess.fit(batches, epochs=4, steps_per_epoch=4,
+                    checkpoint_dir=str(tmp_path / "drill"))
+
+    rb = hist.history["rollbacks"]
+    assert len(rb) == 1
+    assert rb[0]["at_step"] == 11 and rb[0]["reason"] == "loss spike"
+    assert rb[0]["restored_step"] == 8   # last epoch-boundary good save
+    assert sess.step_count == 16
+
+    # the failure marker the Supervisor understands, with the reason
+    from autodist_tpu.resilience.supervisor import read_failure_markers
+    markers = read_failure_markers(marker_dir)
+    assert len(markers) == 1
+    assert "loss spike" in markers[0]["reason"]
+    assert markers[0]["code"] == 74
+
+    # exact-resume parity vs the uninterrupted oracle
+    for a, b in zip(jax.tree_util.tree_leaves(oracle),
+                    jax.tree_util.tree_leaves(_host(sess.params))):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_rollback_budget_exhaustion_raises(tmp_path, monkeypatch):
+    from autodist_tpu.numerics import NonFiniteError
+
+    # an unrecoverable spike source: three queued events — one fires per
+    # observation reaching step 11, so every post-rollback replay spikes
+    # again until the budget (max_rollbacks=2) is exhausted.
+    monkeypatch.setenv(
+        "AUTODIST_CHAOS",
+        "loss_spike@step=11,factor=1e6;loss_spike@step=11,factor=1e6;"
+        "loss_spike@step=11,factor=1e6")
+    numerics = {"on_nonfinite": "rollback", "spike_zscore": 3.0,
+                "spike_window": 8, "max_rollbacks": 2}
+    sess = _fit_session(numerics)
+    with pytest.raises(NonFiniteError, match="budget"):
+        sess.fit(_batches(4), epochs=4, steps_per_epoch=4,
+                 checkpoint_dir=str(tmp_path / "ck"))
+
+
+# -- analysis rules ----------------------------------------------------------
+
+@pytest.mark.analysis
+def test_numerics_rules():
+    from autodist_tpu.analysis import analyze
+    from autodist_tpu.graph_item import GraphItem
+    from autodist_tpu.resource_spec import ResourceSpec
+
+    spec = ResourceSpec(resource_info={
+        "nodes": [{"address": "localhost", "chips": 8}]})
+
+    # ERROR: quantizing compressor x saturating loss scale
+    gi = GraphItem({"w": jax.ShapeDtypeStruct((64, 64), "float32")},
+                   numerics={"loss_scale": 1e36})
+    strat = AllReduce(compressor="HorovodCompressorEF").build(gi, spec)
+    rep = analyze(strat, gi, mesh={"data": 8})
+    assert rep.by_rule("numerics/loss-scale-saturates-wire")
+    assert rep.has_errors()
+
+    # WARN: bf16 gradients without the guard
+    gi = GraphItem({"w": jax.ShapeDtypeStruct((64, 64), "bfloat16")})
+    rep = analyze(AllReduce().build(gi, spec), gi, mesh={"data": 8})
+    warn = rep.by_rule("numerics/no-loss-scale")
+    assert warn and warn[0].severity.name == "WARN"
+
+    # guard on (auto scale) clears both
+    gi = GraphItem({"w": jax.ShapeDtypeStruct((64, 64), "bfloat16")},
+                   numerics=True)
+    rep = analyze(AllReduce().build(gi, spec), gi, mesh={"data": 8})
+    assert not rep.by_rule("numerics/no-loss-scale")
+    assert not rep.has_errors()
+
+
+@pytest.mark.analysis
+def test_cli_numerics_flag():
+    from autodist_tpu.analysis.__main__ import main
+
+    assert main(["mlp_bf16", "AllReduce", "--mesh", "data=8",
+                 "--warn-as-error"]) == 1      # no-loss-scale WARN
+    assert main(["mlp_bf16", "AllReduce", "--mesh", "data=8",
+                 "--numerics", "on", "--warn-as-error"]) == 0

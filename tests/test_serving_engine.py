@@ -33,6 +33,7 @@ def _oracle(spec, params, prompt, n, eos_id=None):
     return np.asarray(out)[0]
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("prefill", [False, True])
 def test_engine_matches_generate_exactly(lm, prefill):
     """Varied prompt/output lengths across fewer slots than requests:
@@ -65,6 +66,7 @@ def test_engine_matches_generate_exactly(lm, prefill):
         assert eng.stats.prefill_admissions == 0
 
 
+@pytest.mark.slow
 def test_engine_ring_wraps_without_reset(lm):
     """Requests whose spans exceed the remaining window admit anyway —
     the ring wraps each slot's writes mod window (the pre-ring design
@@ -88,6 +90,7 @@ def test_engine_ring_wraps_without_reset(lm):
     assert eng.stats.slot_utilization > 0.6
 
 
+@pytest.mark.slow
 def test_engine_tick_rebase_under_sustained_load(lm):
     """The absolute tick rebases by a multiple of window mid-stream
     (guarding int32 growth under sustained load) without disturbing
@@ -122,6 +125,7 @@ def test_engine_tick_rebase_under_sustained_load(lm):
                                       _oracle(spec, params, p, n))
 
 
+@pytest.mark.slow
 def test_engine_no_head_of_line_blocking(lm):
     """One long request must not stall the pool: short requests keep
     cycling through the other slot while it runs, so total engine ticks
@@ -217,6 +221,7 @@ def test_engine_partial_streaming(lm):
         np.testing.assert_array_equal(s, final[:s.size])
 
 
+@pytest.mark.slow
 def test_engine_mesh_sharded_slots(lm):
     """Multi-chip serving: the slot pool sharded over a mesh axis gives
     exactly the per-request oracle results, and the state buffers keep
@@ -252,6 +257,7 @@ def test_engine_mesh_sharded_slots(lm):
                      slot_axis="model")
 
 
+@pytest.mark.slow
 def test_engine_tp_params_with_sharded_slots(lm):
     """The composition the docstring promises: model-axis (TP) sharded
     params AND a data-axis sharded slot pool on one 2-D mesh, token-
@@ -322,6 +328,7 @@ def test_engine_sampling_smoke(lm):
     del rid
 
 
+@pytest.mark.slow
 def test_engine_batched_prefill_single_dispatch(lm):
     """Two slots retiring at the same boundary admit their replacements
     through ONE batched prefill program (prefill_dispatches counts
@@ -388,6 +395,7 @@ def test_engine_prefill_dedup_shared_prompt(lm):
     assert not np.array_equal(a, bseq)
 
 
+@pytest.mark.slow
 def test_engine_prefill_single_token_requests(lm):
     """max_new_tokens=1 through the prefill path finishes a request AT
     admission — the scheduler must keep draining the queue without
@@ -408,6 +416,7 @@ def test_engine_prefill_single_token_requests(lm):
     assert eng.stats.prefill_admissions >= 4
 
 
+@pytest.mark.slow
 def test_engine_with_session_sharded_params(lm):
     """The engine decodes straight off a session's mesh-sharded params
     (vocab-sharded embed under Parallax on a model-axis mesh), exactly
@@ -440,6 +449,7 @@ def test_engine_with_session_sharded_params(lm):
                                       _oracle(spec, params, prompt, n))
 
 
+@pytest.mark.slow
 def test_engine_long_prompt_prefill(lm):
     """A long (130-token) prompt stays oracle-exact through prefill;
     its pow-2 bucket overruns the window so it also exercises the
@@ -465,6 +475,7 @@ def test_engine_long_prompt_prefill(lm):
     assert eng.stats.prefilled_tokens == 132
 
 
+@pytest.mark.slow
 def test_engine_quantized_params(lm):
     """Weight-only int8 tree through the engine: matches the int8
     generate() oracle exactly (the tick math routes through the same
@@ -583,6 +594,7 @@ def test_engine_per_request_temperature_needs_rng(lm):
         eng.submit(np.arange(2, dtype=np.int32), 4, eos_id=VOCAB + 3)
 
 
+@pytest.mark.slow
 def test_engine_per_request_validation_edges(lm):
     """NaN/inf/f32-underflow temperatures are rejected; eos_id=-1
     explicitly disables an engine-default eos for one request."""
@@ -673,6 +685,7 @@ def test_engine_prefix_validation(lm):
     eng.set_prefix(np.arange(3, dtype=np.int32))   # idle again: fine
 
 
+@pytest.mark.slow
 def test_engine_prefix_bucket_edges(lm):
     """The pow-2 buckets must not outrun pos_embed (max_len 48 here):
     (a) a prompt whose bucket extends past max_len under a prefix —
@@ -781,6 +794,7 @@ def test_engine_prefill_contiguous_and_wrapped_paths_token_exact(lm, wrap):
         assert eng.stats.prefill_dispatches >= 2
 
 
+@pytest.mark.slow
 def test_engine_prefill_mixed_wrapness_boundary(lm):
     """One boundary admitting a wrapping and a non-wrapping prompt
     dispatches them as separate (static-wrapness) programs and both

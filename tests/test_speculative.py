@@ -36,6 +36,7 @@ def draft():
     return _lm(1, seed=9)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("gamma", [1, 3, 5])
 def test_exact_greedy_equivalence_bad_draft(target, draft, gamma):
     """An unrelated draft model: low acceptance, identical output."""
@@ -52,6 +53,7 @@ def test_exact_greedy_equivalence_bad_draft(target, draft, gamma):
     assert int(stats["proposed"]) >= int(stats["accepted"]) >= 0
 
 
+@pytest.mark.slow
 def test_perfect_draft_accepts_everything(target):
     """draft == target: every proposal matches the target's argmax, so
     each verify pass lands gamma+1 tokens and the loop runs
@@ -69,6 +71,7 @@ def test_perfect_draft_accepts_everything(target):
     assert int(stats["accepted"]) == int(stats["proposed"]), stats
 
 
+@pytest.mark.slow
 def test_ragged_acceptance_rows_advance_independently(target, draft):
     """Rows accept different counts per iteration (per-row position
     vector): a batch mixing an easy row (prompt repeated tokens) and

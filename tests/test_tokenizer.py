@@ -185,6 +185,7 @@ def test_serve_wires_tokenizer_eos(tok):
         srv.close()
 
 
+@pytest.mark.slow
 def test_train_on_repo_corpus():
     """Train on a real multi-hundred-KB corpus (this repo's docs +
     README): round-trips exactly, compresses, and native matches the

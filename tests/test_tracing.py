@@ -117,6 +117,7 @@ def test_tracing_off_writes_nothing(tmp_path, monkeypatch):
     assert not (tmp_path / "traces").exists()
 
 
+@pytest.mark.slow
 def test_partial_window_flushes_before_next_session(tracing_env):
     """Regression: a session running fewer steps than AUTODIST_TRACE_STEPS
     must still write its (partial) trace, and a second session must be able
