@@ -120,6 +120,10 @@ class AnalysisContext:
     # Elastic-resume provenance ({"from_axes": {...}, "buckets": [...]})
     # — enables the elastic/* rules; None outside a resume pre-flight.
     elastic: Optional[dict] = None
+    # Telemetry provenance ({"measured_step_time_s": ...,
+    # "predicted_step_time_s": ...} — predicted_vs_measured() output)
+    # — enables the telemetry/* rules; None without a recorded run.
+    telemetry: Optional[dict] = None
 
     @property
     def data_axis_size(self) -> int:
@@ -173,20 +177,23 @@ def _load_passes() -> None:
         memory,
         precision,
         sync_coverage,
+        telemetry,
     )
 
 
 #: canonical pass order: legality first (it builds ctx.plans), then the
 #: coverage/resource/schedule/precision rules over the projection, then
-#: the elastic-resume rules (inert without elastic provenance).
+#: the elastic-resume and telemetry rules (each inert without its
+#: provenance).
 PASS_ORDER = ("legality", "sync", "memory", "collectives", "precision",
-              "elastic")
+              "elastic", "telemetry")
 
 
 def analyze(strategy_or_compiled, graph_item: GraphItem, *,
             mesh=None, resource_spec=None, budget_bytes: Optional[int] = None,
             batch=None, passes: Optional[Tuple[str, ...]] = None,
-            elastic: Optional[dict] = None
+            elastic: Optional[dict] = None,
+            telemetry: Optional[dict] = None
             ) -> AnalysisReport:
     """Run the static pass pipeline and return an :class:`AnalysisReport`.
 
@@ -212,6 +219,10 @@ def analyze(strategy_or_compiled, graph_item: GraphItem, *,
         bucket layout) — enabling the ``elastic/*`` rules; the rest of
         the pipeline runs against the NEW mesh, which is exactly the
         re-check elastic resume needs (ring degeneracy, HBM at 1/M).
+      telemetry: measurement provenance — a
+        ``telemetry.calibration.predicted_vs_measured()`` summary of a
+        recorded run — enabling the ``telemetry/*`` rules
+        (``telemetry/model-drift``); inert when None.
     """
     _load_passes()
     strategy, compiled, axes = _resolve_axes(
@@ -222,7 +233,7 @@ def analyze(strategy_or_compiled, graph_item: GraphItem, *,
                           axes=axes, compiled=compiled,
                           resource_spec=resource_spec,
                           budget_bytes=budget_bytes, batch=batch,
-                          elastic=elastic)
+                          elastic=elastic, telemetry=telemetry)
     report = AnalysisReport()
     selected = PASS_ORDER if passes is None else tuple(passes)
     for name in selected:

@@ -1,0 +1,69 @@
+"""Telemetry pass: is the cost model still telling the truth?
+
+The analyzer's other passes reason about what a strategy WILL do; this
+one closes the loop with what a run actually DID.  Feed
+``analyze(..., telemetry=...)`` a measurement summary — most usefully
+:func:`autodist_tpu.telemetry.calibration.predicted_vs_measured` over a
+recorded run's StepRecords — and the pass checks the analytic cost
+model's step-time prediction against the measurement.  Inert without
+provenance (the ``elastic`` pass pattern): a plain pre-flight run never
+sees these rules.
+
+Rules (docs/observability.md):
+
+* ``telemetry/model-drift`` (WARN) — measured step time diverges from
+  the model's prediction by more than
+  :data:`~autodist_tpu.telemetry.calibration.DRIFT_THRESHOLD` in either
+  direction.  The reason string is the SHARED pure rule
+  :func:`~autodist_tpu.telemetry.calibration.model_drift_reason` (the
+  ``bucket_drop_reason`` pattern), so the lint, the CLI report, and any
+  runtime check can never disagree about what counts as drift.  An
+  AutoStrategy search ranked by a drifted model picks wrong — the fix
+  is ``telemetry.calibration.fit_constants`` on the run's records.
+* ``telemetry/no-measurement`` (INFO) — telemetry provenance was passed
+  but holds no usable measured/predicted pair (e.g. a run recorded with
+  the cost predictor unavailable); the drift check could not run.
+
+``telemetry`` provenance dict keys: ``measured_step_time_s``,
+``predicted_step_time_s`` (both seconds; the
+``predicted_vs_measured()`` output is accepted directly), optional
+``threshold`` override.
+"""
+from __future__ import annotations
+
+from typing import List
+
+from autodist_tpu.analysis.analyzer import AnalysisContext, register_pass
+from autodist_tpu.analysis.diagnostics import Diagnostic, Severity, diag
+
+
+@register_pass("telemetry")
+def run(ctx: AnalysisContext) -> List[Diagnostic]:
+    from autodist_tpu.telemetry.calibration import (
+        DRIFT_THRESHOLD,
+        model_drift_reason,
+    )
+
+    tel = getattr(ctx, "telemetry", None)
+    if not tel:
+        return []
+    measured = tel.get("measured_step_time_s")
+    predicted = tel.get("predicted_step_time_s")
+    if not measured or not predicted:
+        return [diag(
+            "telemetry/no-measurement", Severity.INFO,
+            "telemetry provenance has no usable measured/predicted "
+            "step-time pair — the model-drift check did not run",
+            fix="record a run with telemetry enabled (StepRecords carry "
+                "the cost model's prediction) and pass "
+                "predicted_vs_measured() output")]
+    threshold = float(tel.get("threshold", DRIFT_THRESHOLD))
+    why = model_drift_reason(float(predicted), float(measured),
+                             threshold=threshold)
+    if why is None:
+        return []
+    return [diag(
+        "telemetry/model-drift", Severity.WARN, why,
+        fix="refit ICI_BANDWIDTH/COLLECTIVE_ALPHA via "
+            "telemetry.calibration.fit_constants(records) and pass them "
+            "to estimate_cost/AutoStrategy")]

@@ -260,7 +260,12 @@ class Saver:
         :meth:`latest_step`, restorable by :meth:`restore_last_good`,
         and immune to ``keep=`` garbage collection (last one)."""
         path = os.path.abspath(path)
-        if not Saver.verify(path, deep=deep):
+        t_verify = time.perf_counter()
+        ok = Saver.verify(path, deep=deep)
+        from autodist_tpu.telemetry import emit_event
+        emit_event("checkpoint/verify", path=path, deep=deep, ok=ok,
+                   duration_s=round(time.perf_counter() - t_verify, 6))
+        if not ok:
             logging.warning(
                 "mark_good: %s failed %s verification — NOT marked",
                 path, "deep" if deep else "shallow")
@@ -340,6 +345,7 @@ class Saver:
         session = session or self._session
         if session is None:
             raise ValueError("Saver has no bound session")
+        t_save = time.perf_counter()
         self._ckptr.wait_until_finished()   # one async save in flight max
         self._apply_pending_mark()
         self._maybe_gc()                    # previous save is durable now
@@ -397,6 +403,13 @@ class Saver:
         logging.info("checkpoint %s: %s (step %d)",
                      "saving in background" if self._async else "saved",
                      path, step)
+        # Journal the save (docs/observability.md).  For async saves the
+        # duration covers snapshot + dispatch; durability lands at the
+        # next wait()/save boundary.
+        from autodist_tpu.telemetry import emit_event
+        emit_event("checkpoint/save", step=int(step), path=path,
+                   duration_s=round(time.perf_counter() - t_save, 6),
+                   async_save=self._async, mark_good=mark_good)
         return path
 
     # -- restore -----------------------------------------------------------
@@ -411,6 +424,7 @@ class Saver:
         session = session or self._session
         if session is None:
             raise ValueError("Saver has no bound session")
+        t_restore = time.perf_counter()
         self._ckptr.wait_until_finished()   # don't read an in-flight save
         self._apply_pending_mark()
         path = os.path.abspath(path)
@@ -446,6 +460,11 @@ class Saver:
                 "changed)", len(old_layout),
                 meta.get("data_axis_size", "?"),
                 getattr(session, "data_axis_size", "?"))
+            from autodist_tpu.telemetry import emit_event
+            emit_event("elastic/reshard", path=path,
+                       buckets=len(old_layout),
+                       from_axis=meta.get("data_axis_size"),
+                       to_axis=getattr(session, "data_axis_size", None))
         sync_state = None
         if meta.get("has_sync_state") and \
                 jax.tree_util.tree_leaves(session.sync_state):
@@ -467,6 +486,10 @@ class Saver:
         step = int(meta.get("step", 0))
         session.import_state(params, opt_state, step, sync_state=sync_state)
         logging.info("checkpoint restored: %s (step %d)", path, step)
+        from autodist_tpu.telemetry import emit_event
+        emit_event("checkpoint/restore", step=step, path=path,
+                   duration_s=round(time.perf_counter() - t_restore, 6),
+                   elastic=elastic is not None)
         return step
 
     @staticmethod

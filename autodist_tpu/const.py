@@ -20,6 +20,7 @@ DEFAULT_TRACE_DIR = os.path.join(DEFAULT_WORKING_DIR, "traces")
 DEFAULT_GRAPH_DIR = os.path.join(DEFAULT_WORKING_DIR, "graphs")
 DEFAULT_LOG_DIR = os.path.join(DEFAULT_WORKING_DIR, "logs")
 DEFAULT_CHECKPOINT_DIR = os.path.join(DEFAULT_WORKING_DIR, "checkpoints")
+DEFAULT_TELEMETRY_DIR = os.path.join(DEFAULT_WORKING_DIR, "telemetry")
 
 # Canonical mesh-axis names.  These are the TPU-native replacement for the
 # reference's device lists in Strategy.graph_config.replicas: instead of
@@ -96,6 +97,20 @@ class ENV(enum.Enum):
     # profiler-trace the first N session steps (0 = off); SURVEY §5.1 parity
     # with the reference's RunOptions.trace_level timelines (runner.py:64-75)
     AUTODIST_TRACE_STEPS = ("AUTODIST_TRACE_STEPS", _int0)
+    # re-armable capture windows: comma-separated step numbers at which a
+    # profiler-trace window OPENS mid-run (each window spans
+    # AUTODIST_TRACE_STEPS steps, min 1); windows never overlap — an open
+    # window is flushed before the next one starts (utils/tracing.py)
+    AUTODIST_TRACE_AT = ("AUTODIST_TRACE_AT", _str)
+    # telemetry master switch (docs/observability.md): metrics registry,
+    # per-step StepRecords, and the event journal.  Disabled paths are
+    # near-zero-cost no-ops (BENCH_telemetry.json measures the enabled
+    # overhead)
+    AUTODIST_TELEMETRY = ("AUTODIST_TELEMETRY", _bool_default_true)
+    # when set, StepRecord ring buffers and the event journal flush as
+    # JSONL under this run directory (one writer per process;
+    # chief-mergeable — `python -m autodist_tpu.telemetry <dir>`)
+    AUTODIST_TELEMETRY_DIR = ("AUTODIST_TELEMETRY_DIR", _str)
     # dump staged program snapshots (plan table, StableHLO, optimized HLO);
     # parity with the reference's per-stage graph dumps
     # (kernel/graph_transformer.py:62-90)

@@ -443,17 +443,67 @@ def _guard_clean(guard_state, monitor) -> bool:
     return monitor is None or monitor.bad_streak == 0
 
 
-def _observe_health(out, hist, guard_state) -> Optional[bool]:
+def _observe_health(out, hist, guard_state, session=None) -> Optional[bool]:
     """Record the step's grad_health into host-side tracking (cheap —
     only called at points that already sync, or under an active
-    monitor).  Returns all_finite, or None when the guard is off."""
+    monitor).  Returns all_finite, or None when the guard is off.
+    When the session records telemetry, the GradHealth summary is
+    annotated onto the latest StepRecord and skip-count increases are
+    journaled (docs/observability.md)."""
     health = out.get("grad_health") if isinstance(out, dict) else None
     if health is None:
         return None
     finite = bool(np.asarray(health.all_finite))
+    prev_skipped = guard_state["last_skipped"]
     guard_state["last_finite"] = finite
     guard_state["last_skipped"] = int(np.asarray(health.skipped_steps))
+    rec = getattr(session, "telemetry", None) if session is not None \
+        else None
+    if rec is not None:
+        rec.annotate(all_finite=finite,
+                     global_norm=float(np.asarray(health.global_norm)),
+                     loss_scale=float(np.asarray(health.loss_scale)),
+                     skipped_steps=guard_state["last_skipped"])
+    if prev_skipped is not None \
+            and guard_state["last_skipped"] > prev_skipped:
+        from autodist_tpu.telemetry import emit_event
+        emit_event("numerics/skip",
+                   step=getattr(session, "step_count", None),
+                   skipped_total=guard_state["last_skipped"],
+                   new_skips=guard_state["last_skipped"] - prev_skipped)
     return finite
+
+
+def _host_loss(out, session) -> float:
+    """Fetch the step loss to host, timing the blocking device→host
+    sync as the ``blocking_fetch`` telemetry phase and annotating the
+    latest StepRecord with the value."""
+    rec = getattr(session, "telemetry", None)
+    t0 = time.perf_counter()
+    loss = float(np.asarray(out["loss"]))
+    if rec is not None:
+        rec.add_phase("blocking_fetch", time.perf_counter() - t0)
+        rec.annotate(loss=loss)
+    return loss
+
+
+def _timed_batches(it, rec):
+    """Wrap the epoch's batch iterator so time spent PULLING batches
+    (the input pipeline's host half) lands in the ``data_load`` phase of
+    the step timeline.  Identity when telemetry is off."""
+    if rec is None:
+        return it
+
+    def gen():
+        while True:
+            t0 = time.perf_counter()
+            try:
+                b = next(it)
+            except StopIteration:
+                return
+            rec.add_phase("data_load", time.perf_counter() - t0)
+            yield b
+    return gen()
 
 
 def _handle_rollback(*, session, saver, checkpoint_dir, data, rb,
@@ -483,6 +533,13 @@ def _handle_rollback(*, session, saver, checkpoint_dir, data, rb,
     hist.history.setdefault("rollbacks", []).append(
         {"at_step": rb.step, "restored_step": restored,
          "reason": rb.reason})
+    from autodist_tpu.telemetry import emit_event
+    emit_event("numerics/rollback", step=rb.step, reason=rb.reason,
+               restored_step=restored, rollback_index=rollbacks,
+               max_rollbacks=num_cfg.max_rollbacks)
+    rec = getattr(session, "telemetry", None)
+    if rec is not None:
+        rec.annotate(step=rb.step, rolled_back=True)
     logging.warning(
         "numerics rollback %d/%d: %s — restored verified-good step %d "
         "from %s", rollbacks, num_cfg.max_rollbacks, rb.reason, restored,
@@ -555,6 +612,7 @@ def _fit_epochs(*, session, data, epochs, steps_per_epoch,
             # prefetcher pull (and drop) batches beyond the cap — silently
             # skipping data when one shared iterator spans epochs.
             it = itertools.islice(it, steps_per_epoch)
+        it = _timed_batches(it, getattr(session, "telemetry", None))
         out = None
         epoch_steps = 0
         last_sampled_step = None
@@ -567,15 +625,14 @@ def _fit_epochs(*, session, data, epochs, steps_per_epoch,
             if monitor is not None:
                 # raise/rollback/spike policies: one host sync per step
                 # (documented cost of the active policies).
-                finite = _observe_health(out, hist, guard_state)
+                finite = _observe_health(out, hist, guard_state, session)
                 if finite is None:
                     raise ValueError(
                         "numerics monitoring needs grad_health in the "
                         "step metrics — this session was built without "
                         "the numerics guard (capture(numerics=...))")
                 action = monitor.observe(
-                    session.step_count, float(np.asarray(out["loss"])),
-                    finite)
+                    session.step_count, _host_loss(out, session), finite)
                 if action == "raise":
                     raise NonFiniteError(
                         f"non-finite gradients at step "
@@ -587,7 +644,7 @@ def _fit_epochs(*, session, data, epochs, steps_per_epoch,
                         else f"{monitor.bad_streak} consecutive "
                              f"non-finite steps")
             if log_every and hist.steps_run % log_every == 0:
-                loss = float(np.asarray(out["loss"]))
+                loss = _host_loss(out, session)
                 hist._sample(session.step_count, loss)
                 last_sampled_step = session.step_count
                 tp = session.throughput()
@@ -604,8 +661,7 @@ def _fit_epochs(*, session, data, epochs, steps_per_epoch,
             # epoch stays out of epochs_run (resume re-derives its place
             # from the step counter).
             hist.preempted = True
-            loss = float(np.asarray(out["loss"])) if out is not None \
-                else None
+            loss = _host_loss(out, session) if out is not None else None
             if loss is not None and last_sampled_step != session.step_count:
                 hist._sample(session.step_count, loss)
             if data_track["enabled"]:
@@ -617,7 +673,7 @@ def _fit_epochs(*, session, data, epochs, steps_per_epoch,
                                      "seed": data_track["seed"]}
             if saver is not None and hist.steps_run:
                 if out is not None:
-                    _observe_health(out, hist, guard_state)
+                    _observe_health(out, hist, guard_state, session)
                 saver.save(checkpoint_dir, step=session.step_count,
                            extra_meta=_data_state_meta(data_track),
                            mark_good=_guard_clean(guard_state, monitor))
@@ -654,7 +710,7 @@ def _fit_epochs(*, session, data, epochs, steps_per_epoch,
         # landed on a log_every boundary — reuse that sample).
         loss = hist.history["loss"][-1] \
             if last_sampled_step == session.step_count \
-            else float(np.asarray(out["loss"]))
+            else _host_loss(out, session)
         if last_sampled_step != session.step_count:
             hist._sample(session.step_count, loss)
         hist.history["epoch_loss"].append(loss)
@@ -670,7 +726,7 @@ def _fit_epochs(*, session, data, epochs, steps_per_epoch,
         # Guard bookkeeping at the epoch boundary (the host sync is
         # already paid by the loss fetch above): cumulative skipped-step
         # count into the history, health into the mark-good gate.
-        _observe_health(out, hist, guard_state)
+        _observe_health(out, hist, guard_state, session)
         if guard_state["last_skipped"] is not None:
             hist.history.setdefault("skipped_steps", []).append(
                 guard_state["last_skipped"])

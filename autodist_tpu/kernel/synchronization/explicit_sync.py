@@ -87,6 +87,7 @@ from autodist_tpu.kernel.synchronization.compressor import (
 )
 from autodist_tpu.kernel.synchronization import overlap as overlap_mod
 from autodist_tpu.strategy.compiler import CompiledStrategy
+from autodist_tpu.telemetry.timeline import sync_span
 from autodist_tpu.utils import compat, logging
 
 
@@ -654,8 +655,9 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
                 size = g.shape[ax] // n
                 idx = lax.axis_index(axis_name)
                 g = lax.dynamic_slice_in_dim(g, idx * size, size, ax)
-            g2, st2 = comps[name].reduce(g, local_state_of(name),
-                                         MESH_AXIS_DATA)
+            with sync_span(f"per_var_reduce/{name}"):
+                g2, st2 = comps[name].reduce(g, local_state_of(name),
+                                             MESH_AXIS_DATA)
             store_state(name, st2)
             synced[i] = g2
             guarded_idx.append(i)
@@ -699,7 +701,12 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
                 continue
             vec = pack_bucket(b, [flat[idx_of[n]][1] for n in b.names])
             if b.key in reduce_fns:   # uncompressed: schedule-lowered
-                red = reduce_fns[b.key](vec)
+                # Profiler attribution (docs/observability.md): the
+                # named scope prefixes this bucket's lowered collective
+                # ops, so a trace shows reduce-scatter vs all-gather vs
+                # update time per bucket by name.
+                with sync_span(f"bucket_reduce/{b.key}"):
+                    red = reduce_fns[b.key](vec)
                 st2 = None
                 if num_active:
                     # The per-bucket finiteness bit is a byproduct of the
@@ -721,8 +728,9 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
                     vec, ls_mod.wire_dtype_of(b.compressor)) \
                     if num_active else None
                 if b.mode == MODE_ALL_REDUCE:
-                    red, st2 = comp.reduce(vec, local_state_of(b.key),
-                                           MESH_AXIS_DATA)
+                    with sync_span(f"bucket_compressed_reduce/{b.key}"):
+                        red, st2 = comp.reduce(vec, local_state_of(b.key),
+                                               MESH_AXIS_DATA)
                     if num_active:
                         health.add(b.key, red, shard_axes_size=1,
                                    finite_src=vec, saturation=sat)
@@ -730,8 +738,9 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
                         synced[idx_of[n]] = arr
                         guarded_idx.append(idx_of[n])
                 else:
-                    rs_grad_shards[b.key], st2 = comp.reduce_scatter(
-                        vec, local_state_of(b.key), MESH_AXIS_DATA)
+                    with sync_span(f"bucket_compressed_reduce/{b.key}"):
+                        rs_grad_shards[b.key], st2 = comp.reduce_scatter(
+                            vec, local_state_of(b.key), MESH_AXIS_DATA)
                     if num_active:
                         health.add(b.key, rs_grad_shards[b.key],
                                    shard_axes_size=d, finite_src=vec,
@@ -747,8 +756,9 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
         if num_active:
             inv_scale = jnp.float32(1.0) if scale is None \
                 else jnp.float32(1.0) / scale
-            all_finite, gnorm, per_bucket = health.finalize(
-                mesh_axis_names, loss, inv_scale)
+            with sync_span("guard_rollup"):
+                all_finite, gnorm, per_bucket = health.finalize(
+                    mesh_axis_names, loss, inv_scale)
             mult = inv_scale
             clip = guard_mod.clip_multiplier(gnorm, num_cfg.clip_norm)
             if clip is not None:
@@ -782,13 +792,15 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
                 sz = b.padded_total // d
                 p_shards[b.key] = lax.dynamic_slice_in_dim(
                     vec, shard_idx * sz, sz, 0)
-            z_updates, z_state = bucket_optimizer.update(
-                rs_grad_shards, opt_state["zero1"], p_shards)
-            new_shards = optax.apply_updates(p_shards, z_updates)
+            with sync_span("zero1_shard_update"):
+                z_updates, z_state = bucket_optimizer.update(
+                    rs_grad_shards, opt_state["zero1"], p_shards)
+                new_shards = optax.apply_updates(p_shards, z_updates)
 
-            t_updates, t_state = tree_optimizer.update(
-                grads, opt_state["vars"], params)
-            params = optax.apply_updates(params, t_updates)
+            with sync_span("tree_update"):
+                t_updates, t_state = tree_optimizer.update(
+                    grads, opt_state["vars"], params)
+                params = optax.apply_updates(params, t_updates)
 
             new_flat = [x for _, x in
                         jax.tree_util.tree_flatten_with_path(params)[0]]
@@ -799,20 +811,22 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
             # remaining shard updates.  See overlap.gather_schedule.
             for b in overlap_mod.gather_schedule(rs_buckets, ov.prefetch):
                 shard = new_shards[b.key]
-                if ov.ring and d > 1 and b.nbytes >= ov.ring_threshold:
-                    full_vec = overlap_mod.ring_all_gather(
-                        shard, MESH_AXIS_DATA, d)
-                else:
-                    full_vec = lax.all_gather(shard, MESH_AXIS_DATA,
-                                              axis=0, tiled=True)
+                with sync_span(f"param_gather/{b.key}"):
+                    if ov.ring and d > 1 and b.nbytes >= ov.ring_threshold:
+                        full_vec = overlap_mod.ring_all_gather(
+                            shard, MESH_AXIS_DATA, d)
+                    else:
+                        full_vec = lax.all_gather(shard, MESH_AXIS_DATA,
+                                                  axis=0, tiled=True)
                 for n, arr in zip(b.names, unpack_bucket(b, full_vec)):
                     new_flat[idx_of[n]] = arr
             params = jax.tree_util.tree_unflatten(treedef, new_flat)
             opt_state = {"vars": t_state, "zero1": z_state}
         else:
-            updates, opt_state = tree_optimizer.update(grads, opt_state,
-                                                       params)
-            params = optax.apply_updates(params, updates)
+            with sync_span("tree_update"):
+                updates, opt_state = tree_optimizer.update(grads, opt_state,
+                                                           params)
+                params = optax.apply_updates(params, updates)
         mean_loss = lax.pmean(loss, MESH_AXIS_DATA)
         metrics = {"loss": mean_loss}
         if num_active:

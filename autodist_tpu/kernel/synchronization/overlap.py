@@ -278,9 +278,14 @@ def ring_reduce_scatter(vec, axis_name: str, n: int):
     so XLA can slot unrelated compute between the legs.  The partial
     destined for device ``r`` starts at its right neighbor ``r+1`` and
     travels the full ring, accumulating each host's contribution.
+
+    Each leg carries a ``telemetry.sync_span`` named scope, so profiler
+    traces attribute device time to individual ring hops.
     """
     import jax.numpy as jnp
     from jax import lax
+
+    from autodist_tpu.telemetry.timeline import sync_span
 
     if n <= 1:
         return vec
@@ -289,8 +294,9 @@ def ring_reduce_scatter(vec, axis_name: str, n: int):
     perm = [(i, (i + 1) % n) for i in range(n)]
     acc = jnp.take(chunks, (idx - 1) % n, axis=0)
     for s in range(1, n):
-        acc = lax.ppermute(acc, axis_name, perm)
-        acc = acc + jnp.take(chunks, (idx - 1 - s) % n, axis=0)
+        with sync_span(f"ring_reduce_scatter/leg{s}"):
+            acc = lax.ppermute(acc, axis_name, perm)
+            acc = acc + jnp.take(chunks, (idx - 1 - s) % n, axis=0)
     return acc
 
 
@@ -301,6 +307,8 @@ def ring_all_gather(shard, axis_name: str, n: int):
     import jax.numpy as jnp
     from jax import lax
 
+    from autodist_tpu.telemetry.timeline import sync_span
+
     if n <= 1:
         return shard
     idx = lax.axis_index(axis_name)
@@ -309,9 +317,10 @@ def ring_all_gather(shard, axis_name: str, n: int):
     out = out.at[idx].set(shard)
     cur = shard
     for s in range(1, n):
-        cur = lax.ppermute(cur, axis_name, perm)
-        # after s hops rightward, ``cur`` originated at device idx − s
-        out = out.at[(idx - s) % n].set(cur)
+        with sync_span(f"ring_all_gather/leg{s}"):
+            cur = lax.ppermute(cur, axis_name, perm)
+            # after s hops rightward, ``cur`` originated at device idx − s
+            out = out.at[(idx - s) % n].set(cur)
     return jnp.reshape(out, (n * shard.shape[0],) + shard.shape[1:])
 
 
@@ -332,10 +341,13 @@ def one_shot_all_reduce_mean(vec, axis_name: str, n: int):
     import jax.numpy as jnp
     from jax import lax
 
+    from autodist_tpu.telemetry.timeline import sync_span
+
     if n <= 1:
         return vec
-    gathered = lax.all_gather(vec, axis_name, axis=0)
-    return jnp.sum(gathered, axis=0) / n
+    with sync_span("one_shot_all_reduce"):
+        gathered = lax.all_gather(vec, axis_name, axis=0)
+        return jnp.sum(gathered, axis=0) / n
 
 
 def bucket_reduce_fn(bucket: Bucket, plan: OverlapPlan, axis_name: str,
@@ -350,18 +362,33 @@ def bucket_reduce_fn(bucket: Bucket, plan: OverlapPlan, axis_name: str,
     from autodist_tpu.kernel.synchronization.bucketing import (
         MODE_REDUCE_SCATTER,
     )
+    from autodist_tpu.telemetry.timeline import sync_span
 
     rs = bucket.mode == MODE_REDUCE_SCATTER
+
+    def named(leg: str, fn):
+        # Named scope around the fused-collective lowerings too, so a
+        # profiler trace splits reduce-scatter from all-gather from
+        # all-reduce time regardless of which algorithm lowered the leg
+        # (ring legs additionally carry their own per-hop scopes).
+        def wrapped(v):
+            with sync_span(leg):
+                return fn(v)
+        return wrapped
+
     if plan.ring and n > 1 and bucket.nbytes >= plan.ring_threshold:
         if rs:
-            return lambda v: ring_reduce_scatter(v, axis_name, n) / n
-        return lambda v: ring_all_reduce_mean(v, axis_name, n)
+            return named("reduce_scatter",
+                         lambda v: ring_reduce_scatter(v, axis_name, n) / n)
+        return named("all_reduce",
+                     lambda v: ring_all_reduce_mean(v, axis_name, n))
     if plan.one_shot_small and n > 1 and not rs:
-        return lambda v: one_shot_all_reduce_mean(v, axis_name, n)
+        return named("all_reduce",
+                     lambda v: one_shot_all_reduce_mean(v, axis_name, n))
     if rs:
-        return lambda v: lax.psum_scatter(
-            v, axis_name, scatter_dimension=0, tiled=True) / n
-    return lambda v: lax.pmean(v, axis_name)
+        return named("reduce_scatter", lambda v: lax.psum_scatter(
+            v, axis_name, scatter_dimension=0, tiled=True) / n)
+    return named("all_reduce", lambda v: lax.pmean(v, axis_name))
 
 
 # -- accumulation pipelining (trace-time, inside shard_map) ------------------

@@ -178,6 +178,13 @@ class ChaosMonkey:
         logging.warning("CHAOS: firing %s at step %d (proc=%s attempt=%s)",
                         ev.action, step, self._process_index(),
                         self._attempt)
+        # Journal the injection BEFORE executing it: a `kill` os._exit
+        # leaves no later chance, and the post-mortem timeline must show
+        # the fault was DELIBERATE (docs/observability.md).
+        from autodist_tpu.telemetry import emit_event
+        emit_event("chaos/" + ev.action, step=int(step),
+                   proc=self._process_index(), attempt=self._attempt,
+                   args=dict(ev.args))
         if ev.action == "kill":
             code = int(ev.args.get("code", DEFAULT_KILL_CODE))
             # os._exit: no atexit, no orbax flush — a real SIGKILL-grade

@@ -64,6 +64,7 @@ class HeartbeatWriter:
         self._interval = interval
         self._chaos = chaos
         self._last_step: Optional[int] = None
+        self._last_snapshot: Optional[dict] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -71,13 +72,23 @@ class HeartbeatWriter:
     def path(self) -> str:
         return self._path
 
-    def beat(self, step: Optional[int] = None) -> None:
+    def beat(self, step: Optional[int] = None,
+             snapshot: Optional[dict] = None) -> None:
+        """``snapshot`` is the latest StepRecord summary (step, loss,
+        step_time_ms — ``telemetry.StepRecorder.snapshot()``): it rides
+        the beacon so the monitor can report what this worker was DOING
+        when it went DEAD/WEDGED, not just how old its beacon is.  The
+        daemon-thread refresh re-sends the last snapshot."""
         if self._chaos is not None and not self._chaos.heartbeats_enabled:
             return
         if step is not None:
             self._last_step = int(step)
+        if snapshot is not None:
+            self._last_snapshot = dict(snapshot)
         payload = {"time": time.time(), "pid": os.getpid(),
                    "step": self._last_step}
+        if self._last_snapshot is not None:
+            payload["snapshot"] = self._last_snapshot
         tmp = self._path + ".tmp"
         try:
             with open(tmp, "w", encoding="utf-8") as f:
@@ -116,18 +127,27 @@ class HeartbeatCallback:
     """``fit`` callback bumping the beacon with each completed step —
     the step-progress signal :class:`HeartbeatMonitor` needs to tell a
     wedge from a slow step.  Duck-typed to
-    :class:`autodist_tpu.fit.Callback` (all hooks optional there)."""
+    :class:`autodist_tpu.fit.Callback` (all hooks optional there).
+
+    When the session records telemetry, each beat also carries the
+    latest StepRecord snapshot (step, loss, step_time) — host-cheap
+    (never touches device arrays), and it is what lets the monitor say
+    *what* a worker was doing when it died."""
 
     def __init__(self, writer: HeartbeatWriter):
         self._writer = writer
+        self._session = None
 
     def on_train_begin(self, session) -> None:
+        self._session = session
         self._writer.start()
 
     def on_epoch_begin(self, epoch: int) -> None: ...
 
     def on_step_end(self, step: int, metrics) -> None:
-        self._writer.beat(step=step)
+        rec = getattr(self._session, "telemetry", None)
+        self._writer.beat(step=step,
+                          snapshot=rec.snapshot() if rec else None)
 
     def on_epoch_end(self, epoch: int, logs) -> None: ...
 
@@ -143,6 +163,21 @@ class WorkerHealth:
     step: Optional[int] = None        # last completed step, if reported
     pid: Optional[int] = None
     detail: str = ""
+    #: latest StepRecord summary the beacon carried (step, loss,
+    #: step_time_ms) — what the worker was DOING at its last beat.
+    snapshot: Optional[dict] = None
+
+    def doing(self) -> str:
+        """Human summary of the carried snapshot ('' when absent)."""
+        if not self.snapshot:
+            return ""
+        parts = [f"step {self.snapshot['step']}"] \
+            if "step" in self.snapshot else []
+        if "loss" in self.snapshot:
+            parts.append(f"loss {self.snapshot['loss']:g}")
+        if "step_time_ms" in self.snapshot:
+            parts.append(f"{self.snapshot['step_time_ms']:g} ms/step")
+        return "last doing: " + ", ".join(parts) if parts else ""
 
 
 @dataclass
@@ -177,6 +212,7 @@ class HeartbeatMonitor:
         self._expected = list(expected)
         self._started = time.time()
         self._progress: Dict[str, _Progress] = {}
+        self._reported: Dict[str, str] = {}   # worker -> journaled state
 
     def expect(self, worker: str) -> None:
         if worker not in self._expected:
@@ -233,14 +269,15 @@ class HeartbeatMonitor:
         age = now - payload["_mtime"]
         pid = payload.get("pid")
         step = payload.get("step")
+        snap = payload.get("snapshot")
         if age > self._timeout:
             alive = self._pid_alive(pid)
             if alive:
                 return WorkerHealth(worker, WEDGED, age=age, step=step,
-                                    pid=pid,
+                                    pid=pid, snapshot=snap,
                                     detail="beacon stale but process alive")
             return WorkerHealth(
-                worker, DEAD, age=age, step=step, pid=pid,
+                worker, DEAD, age=age, step=step, pid=pid, snapshot=snap,
                 detail="beacon stale" + ("" if alive is False
                                          else " (pid unverifiable)"))
         if self._step_timeout is not None and step is not None:
@@ -250,10 +287,12 @@ class HeartbeatMonitor:
             elif now - prog.since > self._step_timeout:
                 return WorkerHealth(
                     worker, WEDGED, age=age, step=step, pid=pid,
+                    snapshot=snap,
                     detail=f"step {step} stalled for "
                            f"{now - prog.since:.1f}s (beacons fresh — "
                            "likely wedged in a collective)")
-        return WorkerHealth(worker, ALIVE, age=age, step=step, pid=pid)
+        return WorkerHealth(worker, ALIVE, age=age, step=step, pid=pid,
+                            snapshot=snap)
 
     def status(self) -> Dict[str, WorkerHealth]:
         now = time.time()
@@ -262,6 +301,21 @@ class HeartbeatMonitor:
     def failures(self) -> Dict[str, WorkerHealth]:
         """Workers the supervisor should treat as failed (DEAD or
         WEDGED — a wedged worker blocks every peer's collectives, so it
-        is terminated and relaunched exactly like a dead one)."""
-        return {w: h for w, h in self.status().items()
-                if h.state in (DEAD, WEDGED)}
+        is terminated and relaunched exactly like a dead one).  Each
+        DEAD/WEDGED verdict is journaled ONCE per state transition
+        (``heartbeat/verdict`` events, docs/observability.md), with the
+        beacon's carried StepRecord snapshot so the event says what the
+        worker was doing."""
+        bad = {w: h for w, h in self.status().items()
+               if h.state in (DEAD, WEDGED)}
+        from autodist_tpu.telemetry import emit_event
+        for w, h in bad.items():
+            if self._reported.get(w) != h.state:
+                self._reported[w] = h.state
+                emit_event("heartbeat/verdict", worker=w, state=h.state,
+                           detail=h.detail, step=h.step,
+                           beacon_age_s=h.age, snapshot=h.snapshot)
+        for w in list(self._reported):
+            if w not in bad:   # recovered: re-arm the transition report
+                del self._reported[w]
+        return bad

@@ -40,6 +40,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Mapping, Optional, Sequence, Union
 
 from autodist_tpu.resilience.backoff import Backoff
+from autodist_tpu.telemetry import emit_event
 from autodist_tpu.utils import logging
 
 #: coordinator watcher actions a failure policy may request.
@@ -300,6 +301,8 @@ class Supervisor:
                 self._policy.max_restarts + 1, len(att.hosts),
                 f", resuming from step {att.resume_step}"
                 if att.resume_step is not None else "")
+            emit_event("supervisor/attempt_start", attempt=index,
+                       hosts=list(att.hosts), resume_step=att.resume_step)
             procs = launch(att)
             if isinstance(procs, subprocess.Popen):
                 procs = {"job": procs}
@@ -309,11 +312,16 @@ class Supervisor:
                 report.hosts = list(self._hosts)
                 logging.info("supervisor: job completed after %d attempt(s)",
                              index + 1)
+                emit_event("supervisor/completed", attempts=index + 1,
+                           hosts=list(self._hosts))
                 return report
             report.failures.append(failure)
             self._terminate(procs)
             logging.warning("supervisor: attempt %d failed (%s: %s)",
                             index + 1, failure.kind, failure.detail)
+            emit_event("supervisor/attempt_failure", attempt=index,
+                       failure_kind=failure.kind, culprit=failure.culprit,
+                       detail=failure.detail)
             if failure.culprit:
                 n = self._host_failures.get(failure.culprit, 0) + 1
                 self._host_failures[failure.culprit] = n
@@ -328,6 +336,9 @@ class Supervisor:
                             "declaring it gone; next attempt runs "
                             "elastically on %d surviving host(s)",
                             failure.culprit, n, len(self._hosts))
+                        emit_event("supervisor/host_dropped",
+                                   host=failure.culprit, failures=n,
+                                   surviving_hosts=list(self._hosts))
                     elif not self._policy.elastic:
                         logging.warning(
                             "supervisor: host %s exhausted its failure "
@@ -339,11 +350,15 @@ class Supervisor:
             pause = self._policy.backoff.delay(index + 1)
             logging.info("supervisor: backing off %.2fs before relaunch",
                          pause)
+            emit_event("supervisor/backoff", attempt=index,
+                       pause_s=round(pause, 3))
             time.sleep(pause)
         report.hosts = list(self._hosts)
         report.gave_up = (f"retry budget exhausted after "
                           f"{report.attempts} attempt(s)")
         logging.error("supervisor: %s", report.gave_up)
+        emit_event("supervisor/gave_up", attempts=report.attempts,
+                   reason=report.gave_up)
         return report
 
     # -- internals ---------------------------------------------------------
@@ -375,9 +390,11 @@ class Supervisor:
                 bad = monitor.failures()
                 if bad:
                     worker, health = next(iter(bad.items()))
+                    doing = health.doing()
                     return AttemptFailure(
                         att.index, "heartbeat", worker,
-                        f"{worker} is {health.state} ({health.detail})")
+                        f"{worker} is {health.state} ({health.detail})"
+                        + (f"; {doing}" if doing else ""))
             time.sleep(self._policy.poll_interval)
 
     def _culprit(self, att: Attempt) -> Optional[str]:

@@ -11,6 +11,7 @@ the object users run steps against — and the feed/fetch ``Remapper``
 """
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Dict, Optional
 
 import jax
@@ -43,6 +44,14 @@ class DistributedSession:
         # run identifier the reference used for its artifact paths.
         self._run_id = dist_step.compiled_strategy.strategy.id
         self._tracer = tracing.RunTracer(self._run_id)
+        # Telemetry (docs/observability.md): one StepRecord per step —
+        # wall step time, host-phase breakdown, and the cost model's
+        # prediction for this strategy (the calibration bridge).  None
+        # when AUTODIST_TELEMETRY=0, so the hot loop pays one identity
+        # check.
+        from autodist_tpu.telemetry.timeline import StepRecorder
+        self._telemetry = StepRecorder.create(self._run_id,
+                                              predictor=self._predict_cost)
         if tracing.dumps_enabled():
             tracing.dump_stage(self._run_id, "1-strategy-plans",
                                tracing.plan_table(dist_step.compiled_strategy))
@@ -136,6 +145,8 @@ class DistributedSession:
         ``sync`` (the default), or as device arrays when ``sync=False`` so
         back-to-back steps dispatch asynchronously without a host round-trip
         per step."""
+        rec = self._telemetry
+        t0 = time.perf_counter() if rec is not None else 0.0
         batch = self._step.place_batch(batch)
         if self._step_count == 0 and tracing.dumps_enabled():
             self._dump_programs(batch)
@@ -144,15 +155,64 @@ class DistributedSession:
                 self._step.step_fn(self._params, self._opt_state,
                                    self._sync_state, batch)
         self._tracer.after_step(self._step_count)
+        step_index = self._step_count
         self._step_count += 1
         # Shapes/dtypes only — retaining the real batch would pin multi-GB
         # host buffers for the session lifetime.
         self._last_batch = jax.tree_util.tree_map(
             lambda x: jax.ShapeDtypeStruct(np.shape(x), x.dtype), batch)
         self._meter.tick()
+        if rec is not None:
+            # Dispatch time is the host-side cost of issuing the step
+            # (async: excludes device execution — the wall step_time_s
+            # converges to true step time once the pipeline fills).
+            rec.add_phase("dispatch", time.perf_counter() - t0)
+            items, tokens = self._batch_sizes()
+            rec.record_step(step_index, items=items, tokens=tokens)
         if not sync:
             return out
         return jax.tree_util.tree_map(lambda x: np.asarray(x), out)
+
+    def _batch_sizes(self):
+        """(items, tokens) of the last batch from shapes alone: items =
+        leading dim; tokens = rows x seq for a 2-D integer leaf (token
+        ids) when one exists."""
+        if self._last_batch is None:
+            return None, None
+        items = tokens = None
+        for leaf in jax.tree_util.tree_leaves(self._last_batch):
+            shape = leaf.shape
+            if not shape:
+                continue
+            if items is None:
+                items = int(shape[0])
+            if (tokens is None and len(shape) == 2
+                    and np.issubdtype(leaf.dtype, np.integer)):
+                tokens = int(shape[0]) * int(shape[1])
+        return items, tokens
+
+    def _predict_cost(self) -> Optional[dict]:
+        """The cost model's estimate for this session's strategy on a
+        spec synthesized from the mesh — stamped into every StepRecord
+        (measured-vs-predicted is the calibration bridge,
+        telemetry/calibration.py).  Advisory: any failure returns None."""
+        try:
+            from autodist_tpu.resource_spec import ResourceSpec
+            from autodist_tpu.strategy.cost_model import estimate_cost
+
+            n = int(self.mesh.devices.size)
+            spec = ResourceSpec(resource_info={"nodes": [
+                {"address": "localhost", "chips": n, "chief": True}]})
+            report = estimate_cost(self._step.compiled_strategy.strategy,
+                                   self._gi, spec)
+            return {
+                "time_s": report.time_s,
+                "wire_bytes": report.wire_bytes,
+                "exposed_wire_bytes": report.exposed_wire_bytes,
+                "num_collectives": report.num_collectives,
+            }
+        except Exception:
+            return None
 
     def _dump_programs(self, batch) -> None:
         """Staged program dumps at first run, when concrete shapes exist:
@@ -236,6 +296,14 @@ class DistributedSession:
 
     # -- instrumentation (SURVEY §5: the reference only measured throughput
     # in example scripts; here it's a session feature) ----------------------
+    @property
+    def telemetry(self):
+        """The session's :class:`~autodist_tpu.telemetry.timeline.
+        StepRecorder` (None when AUTODIST_TELEMETRY=0).  One StepRecord
+        per step; ``fit`` adds host-phase timings and health
+        annotations; JSONL flushes under AUTODIST_TELEMETRY_DIR."""
+        return self._telemetry
+
     def throughput(self, items_per_step: Optional[int] = None
                    ) -> Dict[str, Any]:
         """Sliding-window step timing: step_time_ms / steps_per_sec (+

@@ -24,7 +24,11 @@ Endpoints
   ``"prompt": "text"`` is accepted and ``"text"`` is returned.  Streaming
   responses are Server-Sent Events, one ``data:`` JSON per new-token delta.
 - ``POST /v1/cancel`` — body ``{"id": N}``.
-- ``GET /v1/stats`` — engine counters + server counters.
+- ``GET /v1/stats`` — engine counters + server counters (+ request
+  latency p50/p99 estimated from the latency histogram).
+- ``GET /metrics`` — Prometheus text exposition
+  (``autodist_serving_*``: request latency + queue-depth histograms,
+  served/failed counters, outstanding gauge — docs/observability.md).
 - ``GET /healthz``.
 """
 from __future__ import annotations
@@ -39,6 +43,12 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from autodist_tpu.serving.engine import DecodeEngine
+from autodist_tpu.telemetry.registry import (
+    DEPTH_BUCKETS,
+    MetricsRegistry,
+    TIME_BUCKETS,
+    render_prometheus,
+)
 from autodist_tpu.utils import logging
 
 _MAX_BODY_BYTES = 8 << 20
@@ -108,6 +118,28 @@ class EngineServer:
         self._stop = False
         self.requests_served = 0
         self.requests_failed = 0
+        # Telemetry (docs/observability.md): an EXPLICIT registry — the
+        # /metrics endpoint is a server feature, live regardless of the
+        # AUTODIST_TELEMETRY instrumentation switch.  Fixed-bound
+        # histograms so a multi-replica deployment's scrapes merge
+        # exactly.
+        self._registry = MetricsRegistry()
+        self._m_latency = self._registry.histogram(
+            "autodist_serving_request_latency_seconds",
+            "end-to-end completion latency (submit to final token)",
+            buckets=TIME_BUCKETS)
+        self._m_queue = self._registry.histogram(
+            "autodist_serving_queue_depth",
+            "requests outstanding at submit time",
+            buckets=DEPTH_BUCKETS)
+        self._m_served = self._registry.counter(
+            "autodist_serving_requests_served_total",
+            "completion requests answered successfully")
+        self._m_failed = self._registry.counter(
+            "autodist_serving_requests_failed_total",
+            "completion requests failed/cancelled/timed out")
+        self._m_outstanding = self._registry.gauge(
+            "autodist_serving_outstanding", "requests currently in flight")
 
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
@@ -201,11 +233,13 @@ class EngineServer:
         with self._locked():
             if self._stop or self._engine_error is not None:
                 raise _Unavailable()
+            self._m_queue.observe(float(len(self._outstanding)))
             rid = self._engine.submit(prompt, max_new,
                                       temperature=temperature,
                                       eos_id=eos_id,
                                       use_prefix=use_prefix)
             self._outstanding.add(rid)
+            self._m_outstanding.set(len(self._outstanding))
             self._events[rid] = threading.Event()
             self._work.notify()
             return rid
@@ -259,14 +293,20 @@ class EngineServer:
             self._events.pop(rid, None)
             return self._done.pop(rid, None)
 
-    def count_request(self, *, served: bool) -> None:
+    def count_request(self, *, served: bool,
+                      latency_s: Optional[float] = None) -> None:
         """Bump the served/failed counter (handler threads race here;
-        '+=' alone loses updates)."""
+        '+=' alone loses updates); ``latency_s`` feeds the request
+        latency histogram when the terminal path knows it."""
         with self._meta_lock:
             if served:
                 self.requests_served += 1
             else:
                 self.requests_failed += 1
+        (self._m_served if served else self._m_failed).inc()
+        if latency_s is not None:
+            self._m_latency.observe(latency_s)
+        self._m_outstanding.set(len(self._outstanding))
 
     def stats(self) -> Dict[str, Any]:
         with self._locked():
@@ -280,7 +320,17 @@ class EngineServer:
             st["requests_served"] = self.requests_served
             st["requests_failed"] = self.requests_failed
             st["engine_failed"] = self._engine_error is not None
+            p50 = self._m_latency.percentile(0.5)
+            p99 = self._m_latency.percentile(0.99)
+            if p50 is not None:
+                st["latency_p50_ms"] = round(p50 * 1e3, 3)
+                st["latency_p99_ms"] = round(p99 * 1e3, 3)
             return st
+
+    def render_metrics(self) -> str:
+        """Prometheus text exposition of the server registry (the
+        ``/metrics`` scrape body)."""
+        return render_prometheus(self._registry)
 
     # -- body parsing ------------------------------------------------------
 
@@ -366,6 +416,15 @@ class _Handler(BaseHTTPRequestHandler):
             raise ValueError("body must be a JSON object")
         return body
 
+    def _text(self, code: int, body: str,
+              content_type: str = "text/plain; version=0.0.4") -> None:
+        data = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
     def do_GET(self) -> None:   # noqa: N802
         srv: EngineServer = self.server.owner
         if self.path == "/healthz":
@@ -373,6 +432,8 @@ class _Handler(BaseHTTPRequestHandler):
                              and not srv._stop})
         elif self.path == "/v1/stats":
             self._json(200, srv.stats())
+        elif self.path == "/metrics":
+            self._text(200, srv.render_metrics())
         else:
             self._json(404, {"error": f"unknown path {self.path}"})
 
@@ -396,6 +457,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(404, {"error": f"unknown path {self.path}"})
 
     def _completions(self, srv: EngineServer, body: Dict[str, Any]) -> None:
+        t0 = time.perf_counter()
         try:
             prompt = srv.parse_prompt(body)
             max_new = body.get("max_new_tokens", 16)
@@ -421,30 +483,34 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(400, {"error": str(e)})
             return
         if body.get("stream"):
-            self._stream(srv, rid, prompt.size)
+            self._stream(srv, rid, prompt.size, t0)
             return
         try:
             tokens = srv._wait(rid, srv._timeout)
         except _Timeout:
-            srv.count_request(served=False)
+            srv.count_request(served=False,
+                              latency_s=time.perf_counter() - t0)
             self._json(504, {"error": f"request {rid} timed out and was "
                              f"cancelled", "id": rid})
             return
         except _Unavailable:
-            srv.count_request(served=False)
+            srv.count_request(served=False,
+                              latency_s=time.perf_counter() - t0)
             self._json(503, {"error": "engine unavailable", "id": rid})
             return
         if tokens is _CANCELLED:
             # counted as failed so served+failed covers every handled
             # completion request
-            srv.count_request(served=False)
+            srv.count_request(served=False,
+                              latency_s=time.perf_counter() - t0)
             self._json(409, {"error": f"request {rid} was cancelled",
                              "id": rid})
             return
-        srv.count_request(served=True)
+        srv.count_request(served=True, latency_s=time.perf_counter() - t0)
         self._json(200, srv.render(rid, tokens, prompt.size))
 
-    def _stream(self, srv: EngineServer, rid: int, prompt_len: int) -> None:
+    def _stream(self, srv: EngineServer, rid: int, prompt_len: int,
+                t0: Optional[float] = None) -> None:
         """SSE: one ``data:`` event per new-token delta, final event
         carries the full result.  Deltas surface at chunk boundaries
         (the engine's streaming granularity, ``DecodeEngine.partial``).
@@ -462,6 +528,7 @@ class _Handler(BaseHTTPRequestHandler):
 
         sent = prompt_len
         deadline = time.monotonic() + srv._timeout
+        t0 = time.perf_counter() if t0 is None else t0
         # Exactly-once counting: each terminal path counts, and the
         # OSError handler counts only if no terminal path did (a final
         # emit that fails AFTER counting must not count again).
@@ -471,7 +538,8 @@ class _Handler(BaseHTTPRequestHandler):
             nonlocal counted
             if not counted:
                 counted = True
-                srv.count_request(served=served)
+                srv.count_request(served=served,
+                                  latency_s=time.perf_counter() - t0)
 
         try:
             while True:

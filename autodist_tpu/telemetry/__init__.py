@@ -1,0 +1,89 @@
+"""Unified telemetry: metrics registry, step timelines, event journal,
+calibration bridge (docs/observability.md).
+
+The repo could *predict* what a strategy costs (the analytic cost
+model) but not *see* what a step actually did — sync vs compute vs
+update time, exposed wire bytes, guard overhead, restart churn, serving
+latency.  This package is the seeing half, in four tiers:
+
+* :mod:`~autodist_tpu.telemetry.registry` — process-local counters /
+  gauges / fixed-bound histograms with exact cross-host merge and
+  near-zero-cost disabled paths; Prometheus text exposition via
+  :func:`render_prometheus`.
+* :mod:`~autodist_tpu.telemetry.timeline` — per-step
+  :class:`StepRecord`s (ring-buffered, JSONL-flushed) with host-phase
+  timers and profiler span helpers for the sync legs.
+* :mod:`~autodist_tpu.telemetry.events` — the structured event journal
+  (supervisor restarts, heartbeat verdicts, chaos injections,
+  checkpoint durations, elastic resizes, numerics decisions).
+* :mod:`~autodist_tpu.telemetry.calibration` — regress the cost
+  model's bandwidth/overhead constants from accumulated records;
+  shared ``telemetry/model-drift`` rule.
+
+``python -m autodist_tpu.telemetry <run_dir>`` summarizes a recorded
+run (step-time percentiles, phase breakdown, event timeline,
+predicted-vs-measured).  Master switch: ``AUTODIST_TELEMETRY`` (default
+on); JSONL output lands under ``AUTODIST_TELEMETRY_DIR`` when set.
+
+This ``__init__`` (and everything except ``timeline``'s span helpers)
+imports without jax, so the CLI runs on accelerator-free hosts.
+"""
+from autodist_tpu.telemetry.calibration import (
+    CalibratedConstants,
+    DRIFT_THRESHOLD,
+    fit_constants,
+    model_drift_reason,
+    predicted_vs_measured,
+    prediction_error,
+)
+from autodist_tpu.telemetry.events import (
+    EventJournal,
+    configure as configure_events,
+    emit_event,
+    get_journal,
+    load_run_events,
+    read_events,
+)
+from autodist_tpu.telemetry.registry import (
+    DEFAULT_REGISTRY,
+    MetricsRegistry,
+    counter,
+    gauge,
+    histogram,
+    render_prometheus,
+    telemetry_enabled,
+)
+from autodist_tpu.telemetry.timeline import (
+    StepRecord,
+    StepRecorder,
+    host_span,
+    load_step_records,
+    sync_span,
+)
+
+__all__ = [
+    "CalibratedConstants",
+    "DRIFT_THRESHOLD",
+    "DEFAULT_REGISTRY",
+    "EventJournal",
+    "MetricsRegistry",
+    "StepRecord",
+    "StepRecorder",
+    "configure_events",
+    "counter",
+    "emit_event",
+    "fit_constants",
+    "gauge",
+    "get_journal",
+    "histogram",
+    "host_span",
+    "load_run_events",
+    "load_step_records",
+    "model_drift_reason",
+    "predicted_vs_measured",
+    "prediction_error",
+    "read_events",
+    "render_prometheus",
+    "sync_span",
+    "telemetry_enabled",
+]

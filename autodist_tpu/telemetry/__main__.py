@@ -1,0 +1,196 @@
+"""CLI: ``python -m autodist_tpu.telemetry <run_dir>``.
+
+Summarize a recorded run directory — the JSONL a
+:class:`~autodist_tpu.telemetry.timeline.StepRecorder` and the event
+journal flushed (``AUTODIST_TELEMETRY_DIR``), or what bench.py emitted
+next to its BENCH_*.json artifacts:
+
+* step-time percentiles (p50/p90/p99) and throughput,
+* host-phase breakdown (data_load / dispatch / blocking_fetch ...),
+* the structured event timeline (supervisor, heartbeat, chaos,
+  checkpoint, numerics events),
+* the predicted-vs-measured table with the ``telemetry/model-drift``
+  verdict, and — with ``--fit`` — calibrated cost-model constants
+  (:func:`~autodist_tpu.telemetry.calibration.fit_constants`).
+
+Deliberately jax-free (numpy + stdlib): runs on any host that can read
+the files.  Exits 0 on success, 2 when the directory holds no telemetry.
+
+Examples::
+
+    python -m autodist_tpu.telemetry /tmp/autodist_tpu/telemetry/run1
+    python -m autodist_tpu.telemetry ./telemetry_run --fit --json
+    python -m autodist_tpu.telemetry ./run --events 50
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from autodist_tpu.telemetry.calibration import (
+    fit_constants,
+    predicted_vs_measured,
+)
+from autodist_tpu.telemetry.events import load_run_events
+from autodist_tpu.telemetry.timeline import StepRecord, load_step_records
+
+
+def _percentiles(values: List[float]) -> dict:
+    arr = np.asarray(values, dtype=np.float64)
+    return {
+        "n": int(arr.size),
+        "mean_ms": round(float(arr.mean()) * 1e3, 3),
+        "p50_ms": round(float(np.percentile(arr, 50)) * 1e3, 3),
+        "p90_ms": round(float(np.percentile(arr, 90)) * 1e3, 3),
+        "p99_ms": round(float(np.percentile(arr, 99)) * 1e3, 3),
+        "max_ms": round(float(arr.max()) * 1e3, 3),
+    }
+
+
+def summarize_steps(records: List[StepRecord]) -> Optional[dict]:
+    """Step-time percentiles, throughput, phase breakdown, health
+    counters — the machine half of the report (also the ``--json``
+    payload)."""
+    times = [r.step_time_s for r in records if r.step_time_s]
+    if not records:
+        return None
+    out: dict = {"steps": len(records)}
+    if times:
+        out["step_time"] = _percentiles(times)
+    items = [r.items_per_s for r in records if r.items_per_s]
+    if items:
+        out["items_per_s_mean"] = round(float(np.mean(items)), 2)
+    tokens = [r.tokens_per_s for r in records if r.tokens_per_s]
+    if tokens:
+        out["tokens_per_s_mean"] = round(float(np.mean(tokens)), 2)
+    phases: dict = {}
+    for r in records:
+        for name, s in (r.phases or {}).items():
+            acc = phases.setdefault(name, [0.0, 0])
+            acc[0] += s
+            acc[1] += 1
+    if phases:
+        total_time = sum(t for t in times) or None
+        out["phases"] = {
+            name: {
+                "total_s": round(tot, 6),
+                "mean_ms": round(tot / n * 1e3, 3),
+                "fraction_of_step_time": (
+                    round(tot / total_time, 4) if total_time else None),
+            }
+            for name, (tot, n) in sorted(phases.items())}
+    skipped = [r.skipped_steps for r in records
+               if r.skipped_steps is not None]
+    if skipped:
+        out["skipped_steps"] = int(max(skipped))
+    if any(r.rolled_back for r in records):
+        out["rollbacks_observed"] = True
+    pm = predicted_vs_measured(records)
+    if pm:
+        out["predicted_vs_measured"] = pm
+    return out
+
+
+def _fmt_event(rec: dict, t0: float) -> str:
+    extras = {k: v for k, v in rec.items()
+              if k not in ("time", "kind", "host", "pid")}
+    detail = " ".join(f"{k}={v}" for k, v in sorted(extras.items()))
+    return (f"  +{rec.get('time', t0) - t0:10.3f}s  "
+            f"{rec.get('kind', '?'):32s} {detail}"[:120])
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m autodist_tpu.telemetry",
+        description="Summarize a telemetry run directory "
+                    "(StepRecord JSONL + event journal).")
+    p.add_argument("run_dir", help="directory holding steps-*.jsonl / "
+                                   "events-*.jsonl (searched recursively)")
+    p.add_argument("--events", type=int, default=20, metavar="N",
+                   help="show at most N timeline events (default 20)")
+    p.add_argument("--fit", action="store_true",
+                   help="fit cost-model constants from the records "
+                        "(telemetry.calibration.fit_constants)")
+    p.add_argument("--json", action="store_true",
+                   help="emit one machine-readable JSON object instead "
+                        "of the human report")
+    args = p.parse_args(argv)
+
+    records = load_step_records(args.run_dir)
+    events = load_run_events(args.run_dir)
+    if not records and not events:
+        print(f"no telemetry under {args.run_dir} (expected steps-*.jsonl "
+              "or events-*.jsonl; set AUTODIST_TELEMETRY_DIR when running)",
+              file=sys.stderr)
+        return 2
+
+    summary = summarize_steps(records) or {}
+    fit = fit_constants(records) if args.fit and records else None
+    if fit is not None:
+        summary["calibration"] = {
+            "ici_bandwidth": fit.ici_bandwidth,
+            "alpha": fit.alpha,
+            "n_records": fit.n_records,
+            "mean_abs_error_ms": round(fit.mean_abs_error_s * 1e3, 4),
+            "baseline_mean_abs_error_ms": round(
+                fit.baseline_mean_abs_error_s * 1e3, 4),
+            "improved": fit.improved,
+        }
+
+    if args.json:
+        payload = dict(summary)
+        payload["events"] = events
+        print(json.dumps(payload, default=str))
+        return 0
+
+    print(f"telemetry summary: {args.run_dir}")
+    if summary.get("steps"):
+        st = summary.get("step_time") or {}
+        print(f"  steps: {summary['steps']}"
+              + (f"  |  step time p50 {st.get('p50_ms')} ms  "
+                 f"p90 {st.get('p90_ms')} ms  p99 {st.get('p99_ms')} ms"
+                 if st else ""))
+        if "items_per_s_mean" in summary:
+            print(f"  throughput: {summary['items_per_s_mean']} items/s"
+                  + (f", {summary['tokens_per_s_mean']} tokens/s"
+                     if "tokens_per_s_mean" in summary else ""))
+        for name, ph in (summary.get("phases") or {}).items():
+            frac = ph["fraction_of_step_time"]
+            print(f"  phase {name:16s} mean {ph['mean_ms']:9.3f} ms"
+                  + (f"  ({frac:.1%} of step time)"
+                     if frac is not None else ""))
+        if "skipped_steps" in summary:
+            print(f"  numerics: {summary['skipped_steps']} skipped step(s)"
+                  + (" + rollback(s)" if summary.get("rollbacks_observed")
+                     else ""))
+        pm = summary.get("predicted_vs_measured")
+        if pm and pm.get("predicted_step_time_s"):
+            print(f"  predicted vs measured: "
+                  f"{pm['predicted_step_time_s'] * 1e3:.3f} ms predicted, "
+                  f"{pm['measured_step_time_s'] * 1e3:.3f} ms measured "
+                  f"(x{pm['ratio']:.2f})")
+            if pm.get("drift"):
+                print(f"  WARN telemetry/model-drift: {pm['drift']}")
+    cal = summary.get("calibration")
+    if cal:
+        print(f"  calibrated: bandwidth {cal['ici_bandwidth']:.3e} B/s, "
+              f"alpha {cal['alpha']:.3e} s/collective "
+              f"({cal['n_records']} records; mean abs error "
+              f"{cal['mean_abs_error_ms']} ms vs "
+              f"{cal['baseline_mean_abs_error_ms']} ms uncalibrated)")
+    if events:
+        t0 = events[0].get("time", time.time())
+        shown = events[:max(args.events, 0)]
+        print(f"  events ({len(events)} total, showing {len(shown)}):")
+        for rec in shown:
+            print(_fmt_event(rec, t0))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,225 @@
+"""The calibration bridge: measured StepRecords → cost-model constants.
+
+The analytic cost model (``strategy/cost_model.py``) prices a strategy
+as ``max(compute, exposed_bytes / bandwidth + alpha · collectives) +
+update`` with hand-set constants (``ICI_BANDWIDTH``,
+``COLLECTIVE_ALPHA``).  Its own docstring is honest: times are
+order-of-magnitude, for ranking.  Automap (arXiv:2112.02958) and the
+MLPerf TPU-pod report (arXiv:1909.09756) both attribute search quality
+to MEASURED calibration — so every :class:`~autodist_tpu.telemetry.
+timeline.StepRecord` carries the model's prediction next to the
+measured step time, and :func:`fit_constants` regresses the constants
+from accumulated records (bench runs and real runs emit the same JSONL,
+so both feed this path).
+
+The regression is deliberately tiny: ordinary least squares of
+``step_time ≈ exposed_bytes · (1/bandwidth) + collectives · alpha``
+over the records, with positivity fallbacks for degenerate inputs (one
+run has constant bytes per step; a compute-bound CPU host has comm ≈ 0).
+Whatever it returns plugs straight into
+``estimate_cost(..., ici_bandwidth=..., alpha=...)``.
+
+:func:`model_drift_reason` is the shared pure rule behind the
+``telemetry/model-drift`` analysis WARN (the ``bucket_drop_reason``
+pattern: one string, used by the lint, the CLI, and any runtime check —
+they cannot drift from each other).
+
+This module is numpy-only (no jax): the CLI runs it on hosts with no
+accelerator stack.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+#: measured/predicted step-time ratio beyond which the model is
+#: declared drifted (in either direction) — the ``telemetry/model-drift``
+#: threshold.
+DRIFT_THRESHOLD = 3.0
+
+# Defaults mirrored from strategy/cost_model.py without importing it
+# (cost_model pulls in jax via GraphItem; this module must stay light).
+DEFAULT_ICI_BANDWIDTH = 45e9
+DEFAULT_ALPHA = 5e-6
+
+_MIN_BANDWIDTH = 1e6       # 1 MB/s: slower than any real interconnect
+_MAX_BANDWIDTH = 1e15      # effectively "comm is free on this host"
+
+#: records whose step time exceeds this multiple of the run's median are
+#: excluded from fitting/error: compile steps, open profiler-trace
+#: windows, and checkpoint stalls are host hiccups, not the steady-state
+#: step time the model predicts (one 4-second trace write would
+#: otherwise dominate a least-squares fit over hundreds of 2 ms steps).
+OUTLIER_FACTOR = 10.0
+
+
+def model_drift_reason(predicted_s: Optional[float],
+                       measured_s: Optional[float],
+                       threshold: float = DRIFT_THRESHOLD
+                       ) -> Optional[str]:
+    """Why the cost model has drifted from measurement, or None.
+
+    Fires when the measured/predicted step-time ratio exceeds
+    ``threshold`` in EITHER direction — an overestimating model
+    mis-ranks strategies just as surely as an underestimating one.
+    Quiet when either side is missing or nonpositive (no measurement ≠
+    drift)."""
+    if not predicted_s or not measured_s:
+        return None
+    if predicted_s <= 0 or measured_s <= 0:
+        return None
+    ratio = measured_s / predicted_s
+    if ratio > threshold:
+        return (f"measured step time {measured_s * 1e3:.3f} ms is "
+                f"{ratio:.1f}x the cost model's {predicted_s * 1e3:.3f} ms "
+                f"prediction (threshold {threshold:g}x); recalibrate with "
+                "telemetry.calibration.fit_constants on this run's records")
+    if ratio < 1.0 / threshold:
+        return (f"measured step time {measured_s * 1e3:.3f} ms is "
+                f"{1 / ratio:.1f}x BELOW the cost model's "
+                f"{predicted_s * 1e3:.3f} ms prediction (threshold "
+                f"{threshold:g}x); the model overprices this strategy — "
+                "recalibrate with telemetry.calibration.fit_constants")
+    return None
+
+
+@dataclass
+class CalibratedConstants:
+    """What :func:`fit_constants` returns — drop-in overrides for
+    ``estimate_cost(ici_bandwidth=..., alpha=...)``."""
+
+    ici_bandwidth: float
+    alpha: float
+    n_records: int
+    mean_abs_error_s: float            # with the fitted constants
+    baseline_mean_abs_error_s: float   # with the defaults
+
+    @property
+    def improved(self) -> bool:
+        return self.mean_abs_error_s <= self.baseline_mean_abs_error_s
+
+    def as_cost_kwargs(self) -> dict:
+        return {"ici_bandwidth": self.ici_bandwidth, "alpha": self.alpha}
+
+
+def _rows(records) -> np.ndarray:
+    """(exposed_bytes, collectives, step_time) rows for usable records:
+    a positive measured step time and a known (possibly zero) predicted
+    byte count.  Steady-state only: rows beyond
+    :data:`OUTLIER_FACTOR` x the median step time (compiles, trace
+    windows, checkpoint stalls) are dropped."""
+    rows = []
+    for r in records:
+        step_time = getattr(r, "step_time_s", None) if not isinstance(
+            r, dict) else r.get("step_time_s")
+        exposed = getattr(r, "exposed_bytes", None) if not isinstance(
+            r, dict) else r.get("exposed_bytes")
+        ncoll = getattr(r, "num_collectives", None) if not isinstance(
+            r, dict) else r.get("num_collectives")
+        if step_time is None or step_time <= 0 or exposed is None:
+            continue
+        rows.append((float(exposed), float(ncoll or 0), float(step_time)))
+    arr = np.asarray(rows, dtype=np.float64)
+    if arr.size:
+        keep = arr[:, 2] <= OUTLIER_FACTOR * float(np.median(arr[:, 2]))
+        arr = arr[keep]
+    return arr
+
+
+def comm_time_s(exposed_bytes: float, num_collectives: float,
+                ici_bandwidth: float, alpha: float) -> float:
+    """The model's exposed-communication time under given constants."""
+    return exposed_bytes / ici_bandwidth + alpha * num_collectives
+
+
+def prediction_error(records: Sequence,
+                     ici_bandwidth: float = DEFAULT_ICI_BANDWIDTH,
+                     alpha: float = DEFAULT_ALPHA) -> Optional[float]:
+    """Mean |measured − modeled| step time (seconds) over the records'
+    communication model under the given constants; None without usable
+    records.  The figure calibration must reduce."""
+    rows = _rows(records)
+    if rows.size == 0:
+        return None
+    pred = comm_time_s(rows[:, 0], rows[:, 1], ici_bandwidth, alpha)
+    return float(np.mean(np.abs(rows[:, 2] - pred)))
+
+
+def fit_constants(records: Sequence,
+                  default_bandwidth: float = DEFAULT_ICI_BANDWIDTH,
+                  default_alpha: float = DEFAULT_ALPHA
+                  ) -> Optional[CalibratedConstants]:
+    """Least-squares fit of (bandwidth, alpha) from StepRecords (objects
+    or dicts).  Returns None without usable records.
+
+    Degenerate inputs are handled explicitly rather than by blowing up:
+
+    * one run ⇒ constant (bytes, collectives) per row — the normal
+      matrix is rank-1 and ``lstsq``'s min-norm solution splits the
+      observed time between the two terms; the fit is exact for THAT
+      workload, which is precisely what "calibrated on this run's
+      records" promises;
+    * nonpositive solutions (a compute-bound host where time does not
+      grow with bytes) clamp: bandwidth into
+      [:data:`_MIN_BANDWIDTH`, :data:`_MAX_BANDWIDTH`], alpha to ≥ 0,
+      each refit with the other term held.
+    """
+    rows = _rows(records)
+    if rows.size == 0:
+        return None
+    x, n, y = rows[:, 0], rows[:, 1], rows[:, 2]
+    A = np.stack([x, n], axis=1)
+    sol, *_ = np.linalg.lstsq(A, y, rcond=None)
+    inv_bw, alpha = float(sol[0]), float(sol[1])
+    if alpha < 0:
+        alpha = 0.0
+        denom = float(np.dot(x, x))
+        inv_bw = float(np.dot(x, y) / denom) if denom > 0 else 0.0
+    if inv_bw <= 0:
+        # Comm time does not grow with bytes here (compute-bound):
+        # bandwidth pegs at "free" and alpha absorbs what it can.
+        inv_bw = 1.0 / _MAX_BANDWIDTH
+        denom = float(np.dot(n, n))
+        alpha = max(float(np.dot(n, y - x * inv_bw) / denom), 0.0) \
+            if denom > 0 else 0.0
+    bandwidth = float(np.clip(1.0 / inv_bw, _MIN_BANDWIDTH, _MAX_BANDWIDTH))
+    fitted_err = prediction_error(records, bandwidth, alpha)
+    baseline_err = prediction_error(records, default_bandwidth,
+                                    default_alpha)
+    return CalibratedConstants(
+        ici_bandwidth=bandwidth, alpha=alpha, n_records=int(len(rows)),
+        mean_abs_error_s=float(fitted_err),
+        baseline_mean_abs_error_s=float(baseline_err))
+
+
+def predicted_vs_measured(records: Sequence) -> Optional[dict]:
+    """Aggregate comparison for reporting: MEDIAN measured step time
+    (robust to compile/trace-window outliers — one 4 s profiler flush
+    must not declare the model drifted) vs the records' carried
+    full-model prediction, plus the drift verdict.  None without usable
+    records."""
+    steps: List[float] = []
+    preds: List[float] = []
+    for r in records:
+        get = (lambda k, rr=r: rr.get(k)) if isinstance(r, dict) \
+            else (lambda k, rr=r: getattr(rr, k, None))
+        st = get("step_time_s")
+        if st is None or st <= 0:
+            continue
+        steps.append(float(st))
+        p = get("predicted_step_time_s")
+        if p:
+            preds.append(float(p))
+    if not steps:
+        return None
+    measured = float(np.median(steps))
+    predicted = float(np.median(preds)) if preds else None
+    return {
+        "n_steps": len(steps),
+        "measured_step_time_s": measured,
+        "predicted_step_time_s": predicted,
+        "ratio": (measured / predicted) if predicted else None,
+        "drift": model_drift_reason(predicted, measured),
+    }

@@ -100,46 +100,88 @@ def flush_active_trace() -> None:
         logging.info("profiler trace written → %s", t._dir)
 
 
+def _parse_trace_at(spec: str) -> tuple:
+    """``AUTODIST_TRACE_AT="120,5000"`` → sorted unique step numbers at
+    which a capture window opens (empty tuple when unset)."""
+    steps = set()
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            steps.add(int(part))
+        except ValueError:
+            raise ValueError(
+                f"AUTODIST_TRACE_AT must be comma-separated step numbers, "
+                f"got {spec!r}")
+    return tuple(sorted(steps))
+
+
 class RunTracer:
-    """Profiler-trace controller for a session's first N steps.
+    """Profiler-trace controller with re-armable capture windows.
 
     ``AUTODIST_TRACE_STEPS=N`` captures steps 0..N-1 of every
-    DistributedSession into one ``jax.profiler`` trace.  Viewable with
-    TensorBoard's profile plugin or perfetto.
+    DistributedSession into one ``jax.profiler`` trace (the original
+    behavior).  ``AUTODIST_TRACE_AT=<step>[,<step>...]`` instead opens a
+    window at each listed step MID-RUN — e.g. ``AUTODIST_TRACE_AT=5000``
+    profiles the steady state instead of the compile-skewed warmup —
+    each window spanning ``AUTODIST_TRACE_STEPS`` steps (min 1) and
+    written to its own ``step<K>/`` subdirectory.  Windows never
+    overlap: an open window is flushed (``flush_active_trace``) before
+    the next one starts, and the JAX profiler's one-active-trace
+    invariant is preserved across sessions and interpreter exit.
+    Viewable with TensorBoard's profile plugin or perfetto.
     """
 
     def __init__(self, run_id: str):
         self._steps = ENV.AUTODIST_TRACE_STEPS.val
-        self._dir = os.path.join(DEFAULT_TRACE_DIR, run_id)
+        self._at = _parse_trace_at(ENV.AUTODIST_TRACE_AT.val)
+        # Window starts: the explicit re-arm list, else the legacy
+        # steps-0..N-1 single window.
+        self._starts = set(self._at) if self._at \
+            else ({0} if self._steps > 0 else set())
+        self._window_len = max(self._steps, 1) if self._starts else 0
+        self._base_dir = os.path.join(DEFAULT_TRACE_DIR, run_id)
+        self._dir = self._base_dir
         self._active = False
+        self._window_end = -1
 
     @property
     def enabled(self) -> bool:
-        return self._steps > 0
+        return bool(self._starts)
 
     def step(self, step_count: int):
         """Returns a context manager annotating this step; starts/stops the
         trace session at the capture-window edges."""
         if not self.enabled:
             return _NULL_CTX
-        if step_count == 0 and not self._active:
+        if step_count in self._starts:
             global _active_tracer, _atexit_registered
-            flush_active_trace()  # a prior session's partial window
+            # Flush whichever window is open — a prior session's partial
+            # window, or THIS tracer's still-open window when two start
+            # steps sit closer than the window length (no overlap, ever).
+            flush_active_trace()
+            self._active = False
             if not _atexit_registered:
                 import atexit
                 atexit.register(flush_active_trace)
                 _atexit_registered = True
+            # Re-armable windows land in per-window subdirectories so a
+            # later window never clobbers an earlier capture.
+            self._dir = os.path.join(self._base_dir, f"step{step_count}") \
+                if self._at else self._base_dir
             os.makedirs(self._dir, exist_ok=True)
             jax.profiler.start_trace(self._dir)
             self._active = True
+            self._window_end = step_count + self._window_len
             _active_tracer = self
             logging.info("profiler trace started → %s (%d steps)",
-                         self._dir, self._steps)
+                         self._dir, self._window_len)
         return jax.profiler.StepTraceAnnotation("autodist_step",
                                                 step_num=step_count)
 
     def after_step(self, step_count: int) -> None:
-        if self._active and step_count + 1 >= self._steps:
+        if self._active and step_count + 1 >= self._window_end:
             flush_active_trace()
 
 

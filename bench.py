@@ -1423,6 +1423,11 @@ def run_grad_sync_child() -> None:
         compiled = sess._step.compiled_strategy
         buckets = plan_step_buckets(sess._gi, compiled, {}, d)
         gi = sess._gi
+        # Stash the session's StepRecords (telemetry, when enabled) so
+        # the bench can emit them as JSONL — bench runs and real runs
+        # feed the same calibration path (telemetry/calibration.py).
+        measure.last_records = list(sess.telemetry.records) \
+            if sess.telemetry is not None else []
         del sess, ad
         _reset_default_autodist_for_testing()
         return dt / steps, opt_dev_bytes, buckets, gi, compiled
@@ -1541,6 +1546,61 @@ def run_grad_sync_child() -> None:
         "overhead_fraction": round((t_detect - t_off) / t_off, 4),
         "overhead_fraction_with_clip": round((t_clip - t_off) / t_off, 4),
         "target_overhead_fraction": 0.02,
+    }
+
+    # -- telemetry overhead + StepRecord emission (docs/observability.md)
+    # Same ZeRO-1 program with AUTODIST_TELEMETRY off vs on (interleaved
+    # minima, like the guard block: percent-level deltas drown in host
+    # drift otherwise).  The enabled runs' StepRecords are written as
+    # JSONL next to the BENCH_*.json artifacts so bench measurements
+    # feed the same calibration path as real runs
+    # (telemetry.calibration.fit_constants).
+    tel_env = os.environ.get("AUTODIST_TELEMETRY")
+    ts = {"off": [], "on": []}
+    tel_records = []
+    for trial in range(4):
+        order = ("off", "on") if trial % 2 == 0 else ("on", "off")
+        for key in order:
+            os.environ["AUTODIST_TELEMETRY"] = \
+                "0" if key == "off" else "1"
+            t, _, _, _, _ = measure(Zero1(bucket_bytes=bucket_bytes),
+                                    steps=50)
+            ts[key].append(t)
+            if key == "on":
+                tel_records = measure.last_records or tel_records
+    if tel_env is None:
+        os.environ.pop("AUTODIST_TELEMETRY", None)
+    else:
+        os.environ["AUTODIST_TELEMETRY"] = tel_env
+    t_tel_off, t_tel_on = min(ts["off"]), min(ts["on"])
+    records_path = None
+    if tel_records:
+        records_path = os.path.join(REPO, "BENCH_telemetry_steps.jsonl")
+        with open(records_path, "w", encoding="utf-8") as f:
+            for r in tel_records:
+                f.write(r.to_json() + "\n")
+    calibration = None
+    if tel_records:
+        from autodist_tpu.telemetry.calibration import fit_constants
+        fc = fit_constants(tel_records)
+        if fc is not None:
+            calibration = {
+                "ici_bandwidth": fc.ici_bandwidth,
+                "alpha": fc.alpha,
+                "n_records": fc.n_records,
+                "mean_abs_error_ms": round(fc.mean_abs_error_s * 1e3, 4),
+                "baseline_mean_abs_error_ms": round(
+                    fc.baseline_mean_abs_error_s * 1e3, 4),
+                "improved": fc.improved,
+            }
+    out["telemetry"] = {
+        "mode": "reduce_scatter",
+        "step_time_ms_telemetry_off": round(t_tel_off * 1e3, 3),
+        "step_time_ms_telemetry_on": round(t_tel_on * 1e3, 3),
+        "overhead_fraction": round((t_tel_on - t_tel_off) / t_tel_off, 4),
+        "target_overhead_fraction": 0.01,
+        "step_records_path": records_path,
+        "calibration": calibration,
     }
     print(json.dumps(out), flush=True)
 
