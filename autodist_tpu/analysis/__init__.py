@@ -3,9 +3,11 @@
 A pre-flight pass pipeline over ``(Strategy | CompiledStrategy,
 GraphItem, mesh axes, resource spec)`` that rejects bad distribution
 plans in milliseconds with rule-tagged diagnostics, instead of minutes
-into an XLA compile.  Five passes ship: sharding legality, sync
-coverage, static per-device HBM footprint, collective-schedule
-consistency (pipeline/MoE deadlock lint), and precision lint.  See
+into an XLA compile.  The passes: sharding legality, sync coverage,
+static per-device HBM footprint, collective-schedule consistency
+(pipeline/MoE deadlock lint, exact over the sync-schedule IR), the
+static schedule verifier (docs/schedule-ir.md), precision lint, and
+the provenance-gated elastic-resume and telemetry passes.  See
 docs/analysis.md for every rule id and the severity semantics.
 
 Entry points:

@@ -253,6 +253,15 @@ def main(argv=None) -> int:
     parser.add_argument("--passes", default=None,
                         help="comma-separated subset of passes "
                              "(default: all)")
+    parser.add_argument("--dump-ir", nargs="?", const="json",
+                        choices=("json", "dot"), default=None,
+                        metavar="FORMAT",
+                        help="emit the sync-schedule IR this plan lowers "
+                             "to (docs/schedule-ir.md) instead of the "
+                             "diagnostics table: 'json' (default) or "
+                             "'dot' for a Graphviz dep-graph view; the "
+                             "printed JSON carries the schedule_fingerprint "
+                             "telemetry and checkpoints stamp")
     parser.add_argument("--json", action="store_true",
                         help="emit the report as JSON")
     parser.add_argument("--warn-as-error", action="store_true",
@@ -313,6 +322,32 @@ def main(argv=None) -> int:
         if args.passes else None
     elastic = {"from_axes": _parse_mesh(args.elastic_from)} \
         if args.elastic_from else None
+
+    if args.dump_ir:
+        # Build the plan projection (legality lowering) and emit the
+        # schedule IR it lowers to — no diagnostics table, exit 0
+        # unless the projection itself cannot be built.
+        from autodist_tpu.analysis import analyzer as _an
+        from autodist_tpu.analysis.schedule import ir_for
+        _an._load_passes()
+        strategy_r, compiled, axes_r = _an._resolve_axes(
+            strategy, axes, resource_spec)
+        ctx = _an.AnalysisContext(strategy=strategy_r,
+                                  graph_item=graph_item, axes=axes_r,
+                                  compiled=compiled,
+                                  resource_spec=resource_spec)
+        _an.PASS_REGISTRY["legality"](ctx)
+        ir = ir_for(ctx)
+        if ir is None:
+            print("no synced variables: the plan lowers to an empty "
+                  "schedule", file=sys.stderr)
+            return 1
+        if args.dump_ir == "dot":
+            print(ir.to_dot())
+        else:
+            print(ir.to_json(indent=1))
+        return 0
+
     report = analyze(strategy, graph_item, mesh=axes,
                      resource_spec=resource_spec, budget_bytes=budget,
                      passes=passes, elastic=elastic)

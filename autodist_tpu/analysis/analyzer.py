@@ -124,6 +124,9 @@ class AnalysisContext:
     # "predicted_step_time_s": ...} — predicted_vs_measured() output)
     # — enables the telemetry/* rules; None without a recorded run.
     telemetry: Optional[dict] = None
+    # Sync-schedule IR cache (built once by analysis.schedule.ir_for;
+    # shared with the collectives pass and the CLI --dump-ir).
+    schedule_ir: Any = None
 
     @property
     def data_axis_size(self) -> int:
@@ -167,8 +170,11 @@ def register_pass(name: str):
 
 
 def _load_passes() -> None:
-    """Import the pass modules once (each registers itself)."""
-    if PASS_REGISTRY:
+    """Import the pass modules once (each registers itself).  Keyed on
+    the full pass set, not mere non-emptiness: importing one pass
+    module directly (e.g. ``analysis.schedule`` for ``ir_for``) must
+    not short-circuit loading the rest."""
+    if all(name in PASS_REGISTRY for name in PASS_ORDER):
         return
     from autodist_tpu.analysis import (  # noqa: F401
         collectives,
@@ -176,17 +182,20 @@ def _load_passes() -> None:
         legality,
         memory,
         precision,
+        schedule,
         sync_coverage,
         telemetry,
     )
 
 
 #: canonical pass order: legality first (it builds ctx.plans), then the
-#: coverage/resource/schedule/precision rules over the projection, then
-#: the elastic-resume and telemetry rules (each inert without its
+#: coverage/resource rules over the projection, the collectives pass
+#: (which consumes the schedule IR for its exact cross-stage check),
+#: the schedule verifier over the IR itself, precision, then the
+#: elastic-resume and telemetry rules (each inert without its
 #: provenance).
-PASS_ORDER = ("legality", "sync", "memory", "collectives", "precision",
-              "elastic", "telemetry")
+PASS_ORDER = ("legality", "sync", "memory", "collectives", "schedule",
+              "precision", "elastic", "telemetry")
 
 
 def analyze(strategy_or_compiled, graph_item: GraphItem, *,

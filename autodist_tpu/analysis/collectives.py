@@ -11,10 +11,13 @@ psum, or one stage fuses its group into a single concat-and-pmean while
 another issues per-variable reductions, the stages disagree on the
 *number and order* of collectives — the classic SPMD hang.
 
-The pass reconstructs, per stage/expert group, the ordered collective
-sequence the plan implies (catalog order: one entry per synced variable
-— kind, compressor wire, fused-group id, reduce axes, staleness) and
-requires the sequences to be identical across groups.  Stage identity
+The pass used to reconstruct per-stage collective sequences from a
+lossy plan tuple; it now consumes the **sync-schedule IR**
+(``kernel/synchronization/schedule_ir.py``, shared with the runtime
+lowerings and the ``schedule`` verifier pass), whose legs carry the
+bucketed collective schedule the runtime will actually issue — with
+microbatch slots, ring hop chains, and per-bucket algorithms — so the
+cross-stage comparison is exact instead of heuristic.  Stage identity
 comes from two sources:
 
 * **stacked** parameters (``pipeline_vars``/``expert_vars``): one
@@ -23,12 +26,16 @@ comes from two sources:
 * **named** per-stage parameter groups — a path component matching
   ``stage<k>`` / ``expert<k>`` (e.g. ``stage0/attn/kernel``) — the
   layout of hand-built non-stacked pipelines, where the lint has real
-  teeth.
+  teeth.  Stage-tagged IR legs (per-stage buckets and per-variable
+  fallbacks) must form identical ordered sequences per microbatch
+  slot; a bucket spanning every stage is uniform by construction.
 
 Rules (docs/analysis.md):
 
 * ``collectives/stage-collective-mismatch`` (ERROR) — per-stage groups
-  issue different ordered collective sequences (length or entry).
+  issue different ordered collective sequences (length, entry, or
+  microbatch slot) — the IR-level ``schedule/collective-mismatch``
+  check surfaced under this pass's established rule id.
 * ``collectives/stage-stack-heterogeneous`` (WARN) — stacked pipeline
   (or expert) variables disagree on the stage/expert stack size.
 * ``collectives/unused-parallel-axis`` (WARN) — the mesh carries a
@@ -40,85 +47,37 @@ Rules (docs/analysis.md):
 from __future__ import annotations
 
 import re
-from typing import Dict, List, Tuple
+from typing import List
 
 from autodist_tpu.analysis.analyzer import (
     AnalysisContext,
-    PlanLite,
     register_pass,
 )
 from autodist_tpu.analysis.diagnostics import Diagnostic, Severity, diag
 from autodist_tpu.const import MESH_AXIS_EXPERT, MESH_AXIS_PIPE
 
-_GROUP_RE = re.compile(r"(?:^|/)(stage|expert)[_-]?(\d+)(?=/|$)")
-
-
-def _collective_entry(plan: PlanLite) -> Tuple:
-    """One variable's contribution to the static collective schedule.
-    ``sync_mode`` is part of the identity: a stage reduce-scattering
-    what another stage all-reduces issues a different collective."""
-    return (plan.sync_kind, plan.compressor or "NoneCompressor",
-            bool(plan.fused), plan.group, tuple(plan.grad_reduce_axes),
-            int(plan.staleness), tuple(sorted(plan.placement.items())),
-            getattr(plan, "sync_mode", "all_reduce"))
-
-
-def _named_groups(ctx: AnalysisContext
-                  ) -> Dict[str, Dict[int, List[Tuple[str, PlanLite]]]]:
-    """{kind: {index: [(name-with-index-erased, plan), ...]}} in catalog
-    order — the per-stage sequences to compare."""
-    groups: Dict[str, Dict[int, List[Tuple[str, PlanLite]]]] = {}
-    for var in ctx.graph_item.info.variables:  # catalog order = schedule order
-        plan = ctx.plans.get(var.name)
-        if plan is None or plan.sync_kind is None:
-            continue
-        m = _GROUP_RE.search(var.name)
-        if not m:
-            continue
-        kind, idx = m.group(1), int(m.group(2))
-        erased = var.name[:m.start()] + f"/{kind}<i>" + var.name[m.end():]
-        groups.setdefault(kind, {}).setdefault(idx, []).append(
-            (erased.lstrip("/"), plan))
-    return groups
-
 
 def _check_named_groups(ctx: AnalysisContext) -> List[Diagnostic]:
+    """Exact cross-stage deadlock check over the shared schedule IR:
+    the verifier's ``schedule/collective-mismatch`` violations surface
+    here under this pass's established rule id (the IR is built once
+    and cached on the context — see ``analysis.schedule.ir_for``)."""
+    from autodist_tpu.analysis.schedule import ir_for
+    from autodist_tpu.kernel.synchronization import schedule_ir as sir
+
+    ir = ir_for(ctx)
+    if ir is None:
+        return []
     diags: List[Diagnostic] = []
-    for kind, by_idx in _named_groups(ctx).items():
-        if len(by_idx) < 2:
+    for v in sir.verify(ir):
+        if v.rule != sir.RULE_COLLECTIVE_MISMATCH:
             continue
-        sequences = {
-            idx: [(name, _collective_entry(plan)) for name, plan in entries]
-            for idx, entries in by_idx.items()}
-        base_idx = min(sequences)
-        base = sequences[base_idx]
-        for idx in sorted(sequences):
-            if idx == base_idx:
-                continue
-            seq = sequences[idx]
-            if len(seq) != len(base):
-                diags.append(diag(
-                    "collectives/stage-collective-mismatch", Severity.ERROR,
-                    f"{kind} {idx} issues {len(seq)} collective(s) but "
-                    f"{kind} {base_idx} issues {len(base)}: the manual "
-                    "schedule's shards would block on unmatched "
-                    "collectives",
-                    location=f"{kind}{idx}",
-                    fix=f"give every {kind} the same synced variables"))
-                continue
-            for (n_a, e_a), (n_b, e_b) in zip(base, seq):
-                if e_a != e_b:
-                    diags.append(diag(
-                        "collectives/stage-collective-mismatch",
-                        Severity.ERROR,
-                        f"{kind} {idx} syncs {n_b!r} as {e_b} but "
-                        f"{kind} {base_idx} syncs {n_a!r} as {e_a}: "
-                        "shards would issue different collective "
-                        "sequences (deadlock under manual scheduling)",
-                        location=f"{kind}{idx}",
-                        fix="use one synchronizer/compressor/grouping "
-                            f"config across all {kind}s"))
-                    break
+        kind = re.match(r"[a-z]+", v.location or "stage").group(0)
+        diags.append(diag(
+            "collectives/stage-collective-mismatch", Severity.ERROR,
+            v.message, location=v.location,
+            fix="use one synchronizer/compressor/grouping/overlap "
+                f"config across all {kind}s"))
     return diags
 
 

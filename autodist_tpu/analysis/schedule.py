@@ -1,0 +1,164 @@
+"""Schedule-verifier pass: model-check the sync-schedule IR statically.
+
+The passes before this one lint the *plan projection* (PlanLite); this
+pass lints the *program*: it constructs the same sync-schedule IR the
+runtime lowers (``kernel/synchronization/schedule_ir.py`` — built from
+the identical pure planner, so it cannot drift) and runs the static
+schedule verifier over the leg partial order.  Rules surface under the
+verifier's own ids (docs/schedule-ir.md):
+
+* ``schedule/unknown-dep`` / ``schedule/dep-cycle`` (ERROR) — the leg
+  partial order is malformed / unexecutable.
+* ``schedule/ring-degenerate`` (ERROR) — ppermute ring hops over an
+  axis of size <= 1.
+* ``schedule/ring-hop-order`` (ERROR) — a ring hop chain is not the
+  consecutive dep-ordered 1..n-1 sequence (swapped/duplicated/missing
+  hops deadlock the ppermute).
+* ``schedule/quantized-pipelined`` (ERROR) — a quantized collective in
+  the accumulation pipeline, or two quantized collectives for one
+  bucket in one step.
+* ``schedule/read-after-donate`` (ERROR) — a donated sync-state buffer
+  with a read reachable after a write.
+* ``schedule/reduction-order-divergence`` (WARN) — a low-precision or
+  compressed bucket whose ring order diverges from the GSPMD psum
+  tree.
+* ``schedule/elastic-resize`` (INFO) — under elastic provenance
+  (``--elastic-from`` / ``preflight_elastic``): the exact leg-level
+  delta of the resize (ring hop counts, leg totals), emitted after the
+  NEW mesh's schedule verified cleanly.
+* ``schedule/fingerprint-drift`` (WARN) — elastic provenance carries a
+  recorded ``schedule_fingerprint``, the mesh did NOT change, and this
+  program's IR hashes differently: the sync config itself drifted from
+  what the checkpoint executed.
+
+Cross-stage sequence violations (``schedule/collective-mismatch``) are
+deliberately NOT emitted here — the ``collectives`` pass consumes the
+same IR and reports them under its established rule id
+``collectives/stage-collective-mismatch``.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from autodist_tpu.analysis.analyzer import AnalysisContext, register_pass
+from autodist_tpu.analysis.diagnostics import Diagnostic, Severity, diag
+
+
+def ir_for(ctx: AnalysisContext):
+    """The schedule IR for this context, built once and cached.
+
+    A :class:`CompiledStrategy` run uses the runtime's own lowered plan
+    facts; a plain Strategy run uses the legality pass's PlanLite
+    projection — both feed ``schedule_ir.ir_from_facts``, which routes
+    through the SAME ``assign_buckets``/``resolve_overlap`` planner the
+    runtime executes."""
+    cached = getattr(ctx, "schedule_ir", None)
+    if cached is not None:
+        return cached
+    ir = _build_ir(ctx, ctx.axes)
+    ctx.schedule_ir = ir
+    return ir
+
+
+def _build_ir(ctx: AnalysisContext, axes) -> Optional[object]:
+    from autodist_tpu.kernel.synchronization import schedule_ir as sir
+
+    facts = []
+    guard = False
+    for var in ctx.graph_item.info.variables:   # catalog order
+        plan = ctx.plans.get(var.name)
+        if plan is None or plan.sync_kind is None or not var.trainable:
+            continue
+        facts.append(sir.fact_from_planlite(var.name, plan))
+        guard = guard or bool(getattr(plan, "guard", False))
+    if not facts:
+        return None
+    accum = int(getattr(ctx.graph_item, "accum_steps", 1) or 1)
+    return sir.ir_from_facts(facts, axes=dict(axes), accum_steps=accum,
+                             guard=guard)
+
+
+_SEVERITY = {"error": Severity.ERROR, "warn": Severity.WARN}
+
+_FIXES = {
+    "schedule/ring-hop-order":
+        "restore the consecutive hop order the planner emits "
+        "(overlap.ring_reduce_scatter)",
+    "schedule/ring-degenerate":
+        "grow the axis past 1 or drop the ring decomposition",
+    "schedule/quantized-pipelined":
+        "keep quantized buckets on the end-of-step collective "
+        "(overlap auto does this) or drop the compressor",
+    "schedule/read-after-donate":
+        "undonate the sync state or move the read before the write",
+    "schedule/dep-cycle": "break the dependency cycle",
+    "schedule/unknown-dep": "fix the dangling dep edge",
+    "schedule/reduction-order-divergence":
+        "expect >1e-6 explicit-vs-GSPMD divergence for this bucket, or "
+        "keep it f32/uncompressed",
+}
+
+
+@register_pass("schedule")
+def run(ctx: AnalysisContext) -> List[Diagnostic]:
+    from autodist_tpu.kernel.synchronization import schedule_ir as sir
+
+    ir = ir_for(ctx)
+    if ir is None:
+        return []
+    diags: List[Diagnostic] = []
+    for v in sir.verify(ir):
+        if v.rule == sir.RULE_COLLECTIVE_MISMATCH:
+            continue   # reported by the collectives pass (same IR)
+        diags.append(diag(
+            v.rule, _SEVERITY.get(v.severity, Severity.WARN), v.message,
+            location=v.location or v.leg, fix=_FIXES.get(v.rule)))
+    diags.extend(_elastic_recheck(ctx, ir))
+    return diags
+
+
+def _elastic_recheck(ctx: AnalysisContext, new_ir) -> List[Diagnostic]:
+    """Elastic-resume provenance: re-verify is already done (the pass
+    ran on the NEW mesh); here we report the exact leg-level delta the
+    resize causes and flag schedule drift on a same-mesh resume."""
+    info = getattr(ctx, "elastic", None)
+    if not info:
+        return []
+    diags: List[Diagnostic] = []
+    from_axes = {str(k): int(v)
+                 for k, v in (info.get("from_axes") or {}).items()}
+    axes_changed = any(
+        from_axes.get(a, 1) != ctx.axes.get(a, 1)
+        for a in set(from_axes) | set(ctx.axes)) if from_axes else False
+
+    if from_axes and axes_changed:
+        old_ir = _build_ir(ctx, from_axes)
+        if old_ir is not None:
+            from autodist_tpu.kernel.synchronization import schedule_ir \
+                as sir
+
+            def hops(ir):
+                return sum(1 for l in ir.legs
+                           if l.kind == sir.LEG_PPERMUTE_HOP)
+            diags.append(diag(
+                "schedule/elastic-resize", Severity.INFO,
+                f"resize re-verified exactly: schedule "
+                f"{old_ir.fingerprint()} -> {new_ir.fingerprint()}, "
+                f"{len(old_ir.legs)} -> {len(new_ir.legs)} leg(s), "
+                f"{hops(old_ir)} -> {hops(new_ir)} ring hop(s); the new "
+                "mesh's full leg order passed the schedule verifier",
+                location="->".join(
+                    f"{k}={v}" for k, v in sorted(from_axes.items()))))
+
+    recorded = info.get("schedule_fingerprint")
+    if recorded and not axes_changed \
+            and recorded != new_ir.fingerprint():
+        diags.append(diag(
+            "schedule/fingerprint-drift", Severity.WARN,
+            f"checkpoint recorded sync schedule {recorded} but this "
+            f"program plans {new_ir.fingerprint()} on the SAME mesh: "
+            "the sync config (bucket_bytes / overlap / compressor / "
+            "guard) drifted from what the checkpoint executed",
+            fix="resume with the writer's sync config, or accept the "
+                "schedule change knowingly"))
+    return diags

@@ -48,10 +48,8 @@ from autodist_tpu.analysis.diagnostics import Diagnostic, Severity, diag
 
 def _overlap_rules(ctx: AnalysisContext) -> List[Diagnostic]:
     from autodist_tpu.const import MESH_AXIS_DATA
-    from autodist_tpu.kernel.synchronization.bucketing import (
-        bucket_drop_reason,
-    )
     from autodist_tpu.kernel.synchronization import overlap as ov
+    from autodist_tpu.kernel.synchronization import schedule_ir as sir
 
     diags: List[Diagnostic] = []
     d = ctx.data_axis_size
@@ -77,12 +75,10 @@ def _overlap_rules(ctx: AnalysisContext) -> List[Diagnostic]:
                 var=name, location=f"{MESH_AXIS_DATA}={d}",
                 fix="grow the data axis past 1 or drop the ring request"))
             continue
-        bucketable = bucket_drop_reason(
-            sorted(plan.placement.items()), plan.pad is not None,
-            plan.compressor) is None
-        explicit = ov.explicit_hint(
-            plan.compressor, plan.sync_mode, plan.bucket_bytes,
-            fused=plan.fused, overlap=mode)
+        # Routing projection shared with the schedule IR builder
+        # (schedule_ir.plan_route) — one rule, no reconstruction here.
+        bucketable, explicit = sir.plan_route(
+            sir.fact_from_planlite(name, plan))
         why = ov.overlap_drop_reason(
             mode, accum_steps=accum, compressor=plan.compressor,
             bucketable=bucketable, explicit_path=explicit,

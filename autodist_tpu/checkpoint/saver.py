@@ -375,6 +375,13 @@ class Saver:
             # to reshard this checkpoint at a different data-axis size.
             from autodist_tpu.resilience.elastic import bucket_layout
             meta["zero1_buckets"] = bucket_layout(zb)
+        # Sync-schedule provenance (docs/schedule-ir.md): the fingerprint
+        # of the schedule this checkpoint's session executed, so a resume
+        # (same mesh) can detect planned-vs-executed schedule drift and an
+        # elastic resize re-verifies against the recorded plan.
+        fp = getattr(session, "schedule_fingerprint", None)
+        if fp:
+            meta["schedule_fingerprint"] = fp
         if self._checksum:
             sums = {"params": _tree_digest(params_item),
                     "opt_state": _tree_digest(opt_item)}
@@ -485,6 +492,24 @@ class Saver:
                     "(%s); reinitializing it — resume is approximate", path, e)
         step = int(meta.get("step", 0))
         session.import_state(params, opt_state, step, sync_state=sync_state)
+        # Schedule drift: a resume on the SAME mesh should execute the
+        # schedule the checkpoint was trained under; a differing
+        # fingerprint means bucketing/overlap/guard config drifted (an
+        # elastic resize legitimately changes it — hop counts scale with
+        # the axis — and is reported at INFO by the analysis pass).
+        old_fp = meta.get("schedule_fingerprint")
+        new_fp = getattr(session, "schedule_fingerprint", None)
+        if old_fp and new_fp and old_fp != new_fp:
+            same_mesh = (meta.get("mesh_axes") or {}) == {
+                str(k): int(v)
+                for k, v in dict(session.mesh.shape).items()} \
+                if meta.get("mesh_axes") else False
+            (logging.warning if same_mesh else logging.info)(
+                "checkpoint %s was written under sync schedule %s but "
+                "this session executes %s%s", path, old_fp, new_fp,
+                " — same mesh, so the sync config itself drifted "
+                "(bucket_bytes/overlap/compressor/guard)" if same_mesh
+                else " (expected across an elastic mesh resize)")
         logging.info("checkpoint restored: %s (step %d)", path, step)
         from autodist_tpu.telemetry import emit_event
         emit_event("checkpoint/restore", step=step, path=path,

@@ -67,6 +67,10 @@ class DistributedStep:
     # elsewhere): checkpoints record it so elastic resume can reslice the
     # flat optimizer shards at a different data-axis size.
     zero1_buckets: Any = ()
+    # The verified sync-schedule IR this step lowered (docs/schedule-ir.md)
+    # — both paths build one; its fingerprint rides telemetry StepRecords
+    # and checkpoint meta so planned-vs-executed drift is detectable.
+    schedule_ir: Any = None
     _placer: Optional[Callable] = None
     _param_exporter: Optional[Callable] = None
     _opt_exporter: Optional[Callable] = None
@@ -376,6 +380,24 @@ class GraphTransformer:
             vg = _accumulate_grads(vg, gi.accum_steps, has_aux)
         frozen_names = {v.name for v in gi.info.untrainable_variables}
 
+        # Schedule IR (docs/schedule-ir.md): the GSPMD lowering of the
+        # sync program — per-variable psum-tree collectives plus the
+        # guard roll-up — built from the SAME plan facts the explicit
+        # path buckets from, verified before tracing, and carried on the
+        # step for telemetry/checkpoint fingerprints.
+        from autodist_tpu.kernel.synchronization import schedule_ir as sir
+        facts = []
+        for name, plan in self.compiled.var_plans.items():
+            vi = gi.info.by_name(name)
+            if vi is None or name in frozen_names:
+                continue
+            facts.append(sir.fact_from_varplan(plan, vi))
+        sched = sir.ir_from_facts(
+            facts, axes={str(k): int(v)
+                         for k, v in dict(mesh.shape).items()},
+            accum_steps=gi.accum_steps, guard=num_active)
+        sir.assert_verified(sched, "gspmd build")
+
         def step(params, opt_state, sync_state, batch):
             import jax.numpy as jnp
 
@@ -555,7 +577,8 @@ class GraphTransformer:
             eval_fn=eval_fn,
             pad_info=pad_info, opt_pad_info=opt_pad_info,
             logical_param_shardings=logical_param_sh,
-            logical_opt_shardings=logical_opt_sh)
+            logical_opt_shardings=logical_opt_sh,
+            schedule_ir=sched)
 
     def _combiner_bytes(self) -> int:
         """Largest collective-group byte sum — the all-reduce combiner
@@ -597,8 +620,8 @@ class GraphTransformer:
         # GLOBAL batch — identical semantics to the GSPMD path (inside the
         # mapped step they would see only the local data shard and get
         # pmean-averaged, silently changing non-mean metrics).
-        step_fn, init_fn, init_sync, param_sh, opt_sh, rs_buckets = \
-            explicit_sync.make_explicit_step(gi, self.compiled)
+        (step_fn, init_fn, init_sync, param_sh, opt_sh, rs_buckets,
+         sched) = explicit_sync.make_explicit_step(gi, self.compiled)
         if extra_metrics_fn is not None:
             inner_step = step_fn
 
@@ -621,7 +644,7 @@ class GraphTransformer:
             step_fn=step_fn, init_fn=init_fn, init_sync_state=init_sync,
             param_shardings=param_sh, opt_shardings=opt_sh,
             mesh=mesh, compiled_strategy=self.compiled, eval_fn=eval_fn,
-            zero1_buckets=tuple(rs_buckets))
+            zero1_buckets=tuple(rs_buckets), schedule_ir=sched)
 
 
 def _make_eval_step(loss_fn: Callable, has_aux: bool,

@@ -181,8 +181,17 @@ def assign_buckets(entries: Sequence[Tuple[str, Tuple[int, ...], str, str,
             in enumerate(closed):
         total = sum(v.size for v in members)
         padded = -(-total // d) * d
+        # The compressor is part of the bucket IDENTITY (it is part of
+        # the grouping key above), so it must be part of the key too:
+        # without it, a compressed and an uncompressed bucket of the
+        # same (mode, dtype, group) collide — and the key is the
+        # sync-state / reduce-fn / opt-shard dict key downstream.
+        # Uncompressed buckets keep the historical short form (stable
+        # checkpoint bucket layouts for every linear plan).
+        comp_tag = "" if compressor in ("", "NoneCompressor") \
+            else f"{compressor}:"
         buckets.append(Bucket(
-            key=f"{mode}:{dtype}:g{group}:{idx}",
+            key=f"{mode}:{dtype}:{comp_tag}g{group}:{idx}",
             mode=mode, dtype=dtype, compressor=compressor, group=int(group),
             vars=tuple(members), total=total, padded_total=padded,
             order=order))

@@ -86,6 +86,7 @@ from autodist_tpu.kernel.synchronization.compressor import (
     get_compressor,
 )
 from autodist_tpu.kernel.synchronization import overlap as overlap_mod
+from autodist_tpu.kernel.synchronization import schedule_ir
 from autodist_tpu.strategy.compiler import CompiledStrategy
 from autodist_tpu.telemetry.timeline import sync_span
 from autodist_tpu.utils import compat, logging
@@ -243,10 +244,12 @@ def plan_step_buckets(gi: GraphItem, compiled: CompiledStrategy,
 
 def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
     """Returns (step_fn, init_opt_fn, init_sync_state_fn, param_sh_tree,
-    opt_sh_tree, rs_buckets) consumed by the GraphTransformer —
-    ``rs_buckets`` is the planned ZeRO-1 bucket list (empty without
-    reduce-scatter plans), exposed so checkpoints can record the flat
-    optimizer layout for elastic resume."""
+    opt_sh_tree, rs_buckets, schedule_ir) consumed by the
+    GraphTransformer — ``rs_buckets`` is the planned ZeRO-1 bucket list
+    (empty without reduce-scatter plans), exposed so checkpoints can
+    record the flat optimizer layout for elastic resume;
+    ``schedule_ir`` is the verified sync-schedule program this lowering
+    consumed (docs/schedule-ir.md)."""
     import optax
 
     from autodist_tpu.kernel import sharding_utils as su
@@ -259,6 +262,7 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
         n_devices *= int(mesh.shape[_a])
     comps = _compressors_for(gi, compiled)
     part = _partition_support(gi, compiled, comps)
+    name_leaves = {n: jnp.asarray(v) for n, v in gi.name_to_leaf().items()}
 
     # Effective per-var specs: the plan's spec for supported partitioned
     # vars, replicated for everything else.
@@ -317,12 +321,6 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
                 logging.warning(
                     "explicit sync path: overlap scheduling skipped for "
                     "%s (%s)", name, why)
-    pipe_buckets = [b for b in buckets
-                    if ov.pipeline
-                    and overlap_mod.pipeline_eligible(b, ov.mode,
-                                                      gi.accum_steps)]
-    pipe_keys = {b.key for b in pipe_buckets}
-
     # -- numerics guard (docs/numerics.md) ---------------------------------
     # Resolved at build time: loss-scale activation (auto = any
     # low-precision param/bucket dtype), the wire-saturation safety
@@ -362,11 +360,115 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
             "numerics guard is off — nan_grad/inf_grad need "
             "capture(numerics=...) (the guard owns the device step "
             "counter the injection keys on); ignoring the event")
-    # Mean-reduction lowering per UNCOMPRESSED bucket under the schedule
-    # (ring / one-shot / XLA fused); compressed buckets keep their
-    # compressor's own wire format.
+
+    def _shard_shape(name: str, leaf) -> tuple:
+        shape = list(jnp.asarray(leaf).shape)
+        if name in part:
+            _, ax, n = part[name]
+            shape[ax] //= n
+        return tuple(shape)
+
+    # -- sync state --------------------------------------------------------
+    # Which vars/buckets carry state and under which spec, probed
+    # abstractly ONCE (eval_shape — no full-model state is materialized
+    # just to test for None); consumed by the schedule IR below, the
+    # shard_map specs, and init_sync_state.  Bucket-level residuals are
+    # keyed by bucket id (per-bucket error feedback — the EQuARX
+    # composition); per-variable state remains only for partitioned and
+    # non-bucketable vars.
+    sync_specs: Dict[str, P] = {}
+    sync_builders: Dict[str, Any] = {}
+    for name, leaf in name_leaves.items():
+        if name in bucketed_names or name not in comps:
+            continue
+        if compiled.var_plans.get(name) is None and name not in part:
+            continue
+        probe = jax.eval_shape(
+            comps[name].init_state,
+            jax.ShapeDtypeStruct(_shard_shape(name, leaf), leaf.dtype))
+        if probe is None:
+            continue
+        sync_specs[name] = P(MESH_AXIS_DATA,
+                             *compiled.var_plans[name].param_spec) \
+            if name in part else P(MESH_AXIS_DATA)
+        sync_builders[name] = ("var", name)
+    for b in buckets:
+        comp = get_compressor(b.compressor)
+        probe = jax.eval_shape(
+            comp.init_state,
+            jax.ShapeDtypeStruct((b.padded_total,), jnp.dtype(b.dtype)))
+        if probe is None:
+            continue
+        sync_specs[b.key] = P(MESH_AXIS_DATA)
+        sync_builders[b.key] = ("bucket", b)
+    if num_active:
+        # Numerics state (loss scale + health counters): replicated
+        # scalars carried in the step like optimizer state — and
+        # checkpointed with the sync state, so resume keeps the scale.
+        from autodist_tpu.numerics.guard import NUMERICS_KEY
+        sync_specs[NUMERICS_KEY] = P()
+        sync_builders[NUMERICS_KEY] = ("numerics", None)
+    # Donation audit: params and optimizer state are rewritten every step,
+    # so donating them is always safe.  Sync state is donated ONLY when
+    # every entry is a bucket residual (rewritten unconditionally by the
+    # bucket compressor each step).  Per-variable fallback entries
+    # (partitioned / PowerSGD tier) can pass through a step untouched —
+    # e.g. a compressor that returns its state unchanged — and returning
+    # a donated input aliases a buffer whose old handle (held by a
+    # checkpoint saver or a caller inspecting ``session.sync_state``
+    # across steps) is now marked deleted.  Fallback programs keep their
+    # sync state undonated; its footprint is small (residual tensors for
+    # the handful of vars the buckets could not absorb).
+    # (Numerics state is rewritten unconditionally every step, so it is
+    # donation-safe like bucket residuals.)  The schedule verifier
+    # re-proves this as the schedule/read-after-donate rule.
+    donate_sync = all(kind in ("bucket", "numerics")
+                      for kind, _ in sync_builders.values())
+
+    # -- schedule IR (docs/schedule-ir.md) ---------------------------------
+    # The sync program as a first-class artifact: one IR instance built
+    # from the planner + overlap + guard + donation facts above; this
+    # lowering CONSUMES it (pipeline membership, per-bucket reduce
+    # algorithm, ZeRO-1 gather issue order), and the static verifier
+    # model-checks it before anything traces.  The same instance rides
+    # the DistributedStep for telemetry fingerprints and checkpoints.
+    per_var_entries = []
+    for name, plan in compiled.var_plans.items():
+        if name in bucketed_names or name not in name_leaves:
+            continue
+        vi = gi.info.by_name(name)
+        if vi is None:
+            continue
+        leaf = name_leaves[name]
+        per_var_entries.append(schedule_ir.PerVarEntry(
+            name=name, dtype=str(leaf.dtype),
+            nbytes=int(leaf.size) * leaf.dtype.itemsize,
+            sync_kind="AllReduce",
+            compressor=plan.compressor or "NoneCompressor",
+            sig=schedule_ir.fact_from_varplan(plan, vi).sig(),
+            stateful=name in sync_builders))
+    ir = schedule_ir.build_schedule_ir(
+        axes={str(a): int(mesh.shape[a]) for a in mesh_axis_names},
+        accum_steps=gi.accum_steps, buckets=buckets, plan=ov,
+        per_var=per_var_entries, guard=num_active,
+        donated=tuple(f"sync:{k}" for k in sync_builders) if donate_sync
+        else (),
+        stateful_keys={k for k, (kind, _) in sync_builders.items()
+                       if kind == "bucket"})
+    schedule_ir.assert_verified(ir, "explicit sync build")
+    logging.info(
+        "explicit sync path: schedule IR %s (%d bucket(s), %d leg(s), "
+        "overlap=%s)", ir.fingerprint(), len(ir.buckets), len(ir.legs),
+        ir.overlap_mode)
+
+    pipe_keys = ir.pipelined_keys()
+    pipe_buckets = [b for b in buckets if b.key in pipe_keys]
+    # Mean-reduction lowering per UNCOMPRESSED bucket under the IR's
+    # resolved algorithm (ring / one-shot / XLA fused); compressed
+    # buckets keep their compressor's own wire format.
     reduce_fns = {b.key: overlap_mod.bucket_reduce_fn(
-        b, ov, MESH_AXIS_DATA, d) for b in buckets
+        b, ov, MESH_AXIS_DATA, d, alg=ir.reduce_alg(b.key))
+        for b in buckets
         if overlap_mod.is_linear_compressor(b.compressor)}
     reduced_sizes = {b.key: (b.padded_total // max(d, 1)
                              if b.mode == MODE_REDUCE_SCATTER
@@ -404,7 +506,6 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
     # leaf per bucket, sharded over 'data'); everything else keeps the
     # tree-shaped state.  The tree optimizer masks ZeRO-1 vars (and frozen
     # vars) to zero updates / no state — the 1/N state memory win.
-    name_leaves = {n: jnp.asarray(v) for n, v in gi.name_to_leaf().items()}
     if rs_buckets:
         frozen = {v.name for v in gi.info.untrainable_variables}
 
@@ -455,53 +556,6 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
         opt_spec_tree = tree_opt_spec
         init_opt = tree_optimizer.init
     opt_sh_tree = su.sharding_tree(mesh, opt_spec_tree)
-
-    def _shard_shape(name: str, leaf) -> tuple:
-        shape = list(jnp.asarray(leaf).shape)
-        if name in part:
-            _, ax, n = part[name]
-            shape[ax] //= n
-        return tuple(shape)
-
-    # -- sync state --------------------------------------------------------
-    # Which vars/buckets carry state and under which spec, probed
-    # abstractly ONCE (eval_shape — no full-model state is materialized
-    # just to test for None); consumed by both the shard_map specs and
-    # init_sync_state.  Bucket-level residuals are keyed by bucket id
-    # (per-bucket error feedback — the EQuARX composition); per-variable
-    # state remains only for partitioned and non-bucketable vars.
-    sync_specs: Dict[str, P] = {}
-    sync_builders: Dict[str, Any] = {}
-    for name, leaf in name_leaves.items():
-        if name in bucketed_names or name not in comps:
-            continue
-        if compiled.var_plans.get(name) is None and name not in part:
-            continue
-        probe = jax.eval_shape(
-            comps[name].init_state,
-            jax.ShapeDtypeStruct(_shard_shape(name, leaf), leaf.dtype))
-        if probe is None:
-            continue
-        sync_specs[name] = P(MESH_AXIS_DATA,
-                             *compiled.var_plans[name].param_spec) \
-            if name in part else P(MESH_AXIS_DATA)
-        sync_builders[name] = ("var", name)
-    for b in buckets:
-        comp = get_compressor(b.compressor)
-        probe = jax.eval_shape(
-            comp.init_state,
-            jax.ShapeDtypeStruct((b.padded_total,), jnp.dtype(b.dtype)))
-        if probe is None:
-            continue
-        sync_specs[b.key] = P(MESH_AXIS_DATA)
-        sync_builders[b.key] = ("bucket", b)
-    if num_active:
-        # Numerics state (loss scale + health counters): replicated
-        # scalars carried in the step like optimizer state — and
-        # checkpointed with the sync state, so resume keeps the scale.
-        from autodist_tpu.numerics.guard import NUMERICS_KEY
-        sync_specs[NUMERICS_KEY] = P()
-        sync_builders[NUMERICS_KEY] = ("numerics", None)
 
     def init_sync_state(current_params=None):
         # Compressor residuals start at zero regardless of parameter values,
@@ -804,15 +858,17 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
 
             new_flat = [x for _, x in
                         jax.tree_util.tree_flatten_with_path(params)[0]]
-            # Param prefetch: gathers issue in reverse bucket order (the
-            # last bucket's shard update completes first under the
-            # backward-interleaved schedule), and large buckets
-            # ring-decompose the gather so its legs interleave with the
-            # remaining shard updates.  See overlap.gather_schedule.
-            for b in overlap_mod.gather_schedule(rs_buckets, ov.prefetch):
+            # Param prefetch: gathers issue in the IR's recorded order —
+            # reverse bucket order under prefetch (the last bucket's
+            # shard update completes first under the backward-interleaved
+            # schedule), and large buckets ring-decompose the gather so
+            # its legs interleave with the remaining shard updates.
+            rs_by_key = {b.key: b for b in rs_buckets}
+            for key, gather_alg in ir.gather_plan():
+                b = rs_by_key[key]
                 shard = new_shards[b.key]
                 with sync_span(f"param_gather/{b.key}"):
-                    if ov.ring and d > 1 and b.nbytes >= ov.ring_threshold:
+                    if gather_alg == schedule_ir.ALG_RING and d > 1:
                         full_vec = overlap_mod.ring_all_gather(
                             shard, MESH_AXIS_DATA, d)
                     else:
@@ -869,24 +925,12 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
                   P(MESH_AXIS_DATA)),
         out_specs=(param_spec_tree, opt_spec_tree, dict(sync_specs), P()),
         check_vma=False)
-    # Donation audit: params and optimizer state are rewritten every step,
-    # so donating them is always safe.  Sync state is donated ONLY when
-    # every entry is a bucket residual (rewritten unconditionally by the
-    # bucket compressor each step).  Per-variable fallback entries
-    # (partitioned / PowerSGD tier) can pass through a step untouched —
-    # e.g. a compressor that returns its state unchanged — and returning
-    # a donated input aliases a buffer whose old handle (held by a
-    # checkpoint saver or a caller inspecting ``session.sync_state``
-    # across steps) is now marked deleted.  Fallback programs keep their
-    # sync state undonated; its footprint is small (residual tensors for
-    # the handful of vars the buckets could not absorb).
-    # (Numerics state is rewritten unconditionally every step, so it is
-    # donation-safe like bucket residuals.)
-    donate_sync = all(kind in ("bucket", "numerics")
-                      for kind, _ in sync_builders.values())
+    # Donation decision proven above (schedule/read-after-donate): sync
+    # state is donated only when every entry is a bucket residual or the
+    # numerics scalars — both rewritten unconditionally every step.
     step_fn = jax.jit(mapped,
                       donate_argnums=(0, 1, 2) if donate_sync else (0, 1))
 
     init_opt_fn = jax.jit(init_opt, out_shardings=opt_sh_tree)
     return (step_fn, init_opt_fn, init_sync_state, param_sh_tree,
-            opt_sh_tree, list(rs_buckets))
+            opt_sh_tree, list(rs_buckets), ir)

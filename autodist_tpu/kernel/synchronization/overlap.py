@@ -351,12 +351,17 @@ def one_shot_all_reduce_mean(vec, axis_name: str, n: int):
 
 
 def bucket_reduce_fn(bucket: Bucket, plan: OverlapPlan, axis_name: str,
-                     n: int) -> Callable:
+                     n: int, alg: Optional[str] = None) -> Callable:
     """The mean-reduction lowering for one UNCOMPRESSED bucket under
     ``plan``: ring decomposition at/above the threshold, one-shot below
     it when explicitly requested, XLA's fused collective otherwise.
     Returns ``vec -> mean(vec)`` for ``all_reduce`` buckets and
-    ``vec -> local shard of mean(vec)`` for ``reduce_scatter`` ones."""
+    ``vec -> local shard of mean(vec)`` for ``reduce_scatter`` ones.
+
+    ``alg`` pins the algorithm a schedule-IR bucket node resolved to
+    (``"ring"`` | ``"one_shot"`` | ``"fused"`` — the explicit path
+    passes ``ScheduleIR.reduce_alg``); None re-derives it from ``plan``
+    with the identical rule, so the two can never disagree."""
     from jax import lax
 
     from autodist_tpu.kernel.synchronization.bucketing import (
@@ -365,6 +370,13 @@ def bucket_reduce_fn(bucket: Bucket, plan: OverlapPlan, axis_name: str,
     from autodist_tpu.telemetry.timeline import sync_span
 
     rs = bucket.mode == MODE_REDUCE_SCATTER
+    if alg is None:
+        if plan.ring and n > 1 and bucket.nbytes >= plan.ring_threshold:
+            alg = "ring"
+        elif plan.one_shot_small and n > 1 and not rs:
+            alg = "one_shot"
+        else:
+            alg = "fused"
 
     def named(leg: str, fn):
         # Named scope around the fused-collective lowerings too, so a
@@ -376,13 +388,13 @@ def bucket_reduce_fn(bucket: Bucket, plan: OverlapPlan, axis_name: str,
                 return fn(v)
         return wrapped
 
-    if plan.ring and n > 1 and bucket.nbytes >= plan.ring_threshold:
+    if alg == "ring" and n > 1:
         if rs:
             return named("reduce_scatter",
                          lambda v: ring_reduce_scatter(v, axis_name, n) / n)
         return named("all_reduce",
                      lambda v: ring_all_reduce_mean(v, axis_name, n))
-    if plan.one_shot_small and n > 1 and not rs:
+    if alg == "one_shot" and n > 1 and not rs:
         return named("all_reduce",
                      lambda v: one_shot_all_reduce_mean(v, axis_name, n))
     if rs:

@@ -202,15 +202,22 @@ def remap_data_state(state: Optional[dict], old_hosts: int,
 def preflight_elastic(session, meta: dict, context: str = "elastic") -> None:
     """Re-run the static analysis passes against the (possibly shrunken)
     mesh with the checkpoint's provenance attached — ZeRO-1 reshard
-    legality (``elastic/*`` rules), ``sync/ring-degenerate`` on the new
-    axis size, and the HBM re-estimate at 1/M — raising
-    ``StrategyValidationError`` before any restore or tracing."""
+    legality (``elastic/*`` rules), the full schedule verifier on the
+    new mesh (``schedule/*`` rules: ring hop chains and bucket leg
+    order are re-checked EXACTLY, not just HBM and ring degeneracy —
+    an elastic resize changes hop counts and leg order), and the HBM
+    re-estimate at 1/M — raising ``StrategyValidationError`` before any
+    restore or tracing.  The checkpoint's recorded
+    ``schedule_fingerprint`` rides along so a same-mesh resume with a
+    drifted sync config is flagged (``schedule/fingerprint-drift``)."""
     from autodist_tpu.analysis import analyze, log_report
 
     compiled = session._step.compiled_strategy
     report = analyze(compiled, session._gi,
                      elastic={"from_axes": meta.get("mesh_axes") or {},
-                              "buckets": meta.get("zero1_buckets")})
+                              "buckets": meta.get("zero1_buckets"),
+                              "schedule_fingerprint":
+                                  meta.get("schedule_fingerprint")})
     log_report(report, context)
     report.raise_for_errors()
 

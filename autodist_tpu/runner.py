@@ -114,6 +114,24 @@ class DistributedSession:
         return int(self._step.mesh.shape.get(MESH_AXIS_DATA, 1))
 
     @property
+    def schedule_fingerprint(self):
+        """Short hash of the step's sync-schedule IR
+        (docs/schedule-ir.md), or None for steps built before the IR
+        existed.  Stamped into telemetry StepRecords and checkpoint
+        meta so planned-vs-executed schedule drift is detectable across
+        resume and elastic resize."""
+        ir = getattr(self._step, "schedule_ir", None)
+        try:
+            return ir.fingerprint() if ir is not None else None
+        except Exception:   # pragma: no cover - advisory only
+            return None
+
+    @property
+    def schedule_ir(self):
+        """The step's sync-schedule IR (docs/schedule-ir.md)."""
+        return getattr(self._step, "schedule_ir", None)
+
+    @property
     def zero1_buckets(self):
         """The ZeRO-1 flat-bucket plan of the compiled step (empty unless
         the explicit reduce-scatter path is active).  Checkpoints record
@@ -210,6 +228,7 @@ class DistributedSession:
                 "wire_bytes": report.wire_bytes,
                 "exposed_wire_bytes": report.exposed_wire_bytes,
                 "num_collectives": report.num_collectives,
+                "schedule_fingerprint": self.schedule_fingerprint,
             }
         except Exception:
             return None

@@ -347,6 +347,58 @@ def estimate_cost(strategy: Strategy, graph_item: GraphItem,
     return report
 
 
+def estimate_ir_cost(ir, *, ici_bandwidth: float = ICI_BANDWIDTH,
+                     alpha: float = COLLECTIVE_ALPHA,
+                     compute_time_s: float = 0.0) -> CostReport:
+    """Price a sync-schedule IR (docs/schedule-ir.md) leg by leg.
+
+    Where :func:`estimate_cost` prices the *plan projection* (it must
+    guess which legs the lowering emits), this prices the PROGRAM: each
+    collective leg's bytes land in the exposed or hidden column from
+    its own microbatch slot — reduce legs in slots ``0..accum-2`` ride
+    behind the next microbatch's backward, only the final slot is
+    exposed; ZeRO-1 gather legs hide ``PREFETCH_OVERLAP_FRACTION``
+    under prefetch issue order — and every leg (each ring hop
+    individually) pays one ``alpha`` launch, which is exactly the
+    latency-shape difference between a ring chain and a fused
+    collective that the plan-level estimate prices neutrally.
+    Per-device ring-collective byte algebra: a leg's recorded
+    ``nbytes`` is the full vector, scaled here by ``(d-1)/d`` per leg
+    direction (hop legs already carry per-hop bytes)."""
+    from autodist_tpu.kernel.synchronization import overlap as ov
+    from autodist_tpu.kernel.synchronization import schedule_ir as sir
+
+    report = CostReport()
+    accum = max(int(ir.accum_steps), 1)
+    for leg in ir.legs:
+        if leg.kind not in sir.COLLECTIVE_KINDS:
+            continue
+        d = max(int(ir.axes.get(leg.axis, 1)), 1) if leg.axis else 1
+        if leg.kind == sir.LEG_PPERMUTE_HOP:
+            wire = float(leg.nbytes)          # already per-hop bytes
+        elif leg.kind == sir.LEG_ALL_REDUCE:
+            wire = allreduce_bytes(float(leg.nbytes), d)
+        elif leg.kind in (sir.LEG_REDUCE_SCATTER, sir.LEG_ALL_GATHER):
+            wire = reduce_scatter_bytes(float(leg.nbytes), d)
+        elif leg.kind == sir.LEG_PS_EXCHANGE:
+            wire = allreduce_bytes(float(leg.nbytes), d)
+        else:                                 # guard psum: scalar-sized
+            wire = float(leg.nbytes)
+        hidden = 0.0
+        if leg.slot != sir.END_OF_STEP and leg.slot < accum - 1:
+            hidden = wire                     # rides behind backward k+1
+        elif leg.kind == sir.LEG_ALL_GATHER and ir.prefetch:
+            hidden = wire * ov.PREFETCH_OVERLAP_FRACTION
+        report.wire_bytes += wire
+        report.exposed_wire_bytes += wire - hidden
+        if d > 1 or leg.kind == sir.LEG_PSUM_GUARD:
+            report.num_collectives += 1
+    comm_s = (report.exposed_wire_bytes / ici_bandwidth
+              + alpha * report.num_collectives)
+    report.time_s = max(compute_time_s, comm_s)
+    return report
+
+
 def rank_strategies(graph_item: GraphItem, resource_spec: ResourceSpec,
                     builders: Optional[Sequence] = None, **cost_kwargs
                     ) -> List[Tuple[str, CostReport]]:

@@ -81,6 +81,10 @@ class StepRecord:
     exposed_bytes: Optional[float] = None       # predicted exposed wire B
     num_collectives: Optional[int] = None
     predicted_step_time_s: Optional[float] = None
+    # Short hash of the step's sync-schedule IR (docs/schedule-ir.md):
+    # records stamped with a different fingerprint than the checkpoint
+    # they resumed from executed a DIFFERENT schedule than planned.
+    schedule_fingerprint: Optional[str] = None
 
     def to_json(self) -> str:
         d = {k: v for k, v in asdict(self).items() if v not in (None, {})}
@@ -184,7 +188,8 @@ class StepRecorder:
             sync_bytes=pred.get("wire_bytes"),
             exposed_bytes=pred.get("exposed_wire_bytes"),
             num_collectives=pred.get("num_collectives"),
-            predicted_step_time_s=pred.get("time_s"))
+            predicted_step_time_s=pred.get("time_s"),
+            schedule_fingerprint=pred.get("schedule_fingerprint"))
         self._pending_phases = {}
         self._ring.append(rec)
         self._m_steps.inc()

@@ -1414,6 +1414,28 @@ def run_grad_sync_child() -> None:
                        loss_fn=loss_fn, accum_steps=accum,
                        numerics=numerics)
         sess = ad.create_distributed_session()
+        # Schedule-verifier gate (docs/schedule-ir.md): every mode's
+        # sync program must pass the static verifier BEFORE it is
+        # timed — a verifier failure fails the bench run outright, not
+        # just a lint.  The fingerprint and verify wall time ride the
+        # per-mode payload (the <1s pre-trace-gate budget is asserted
+        # in tests/test_schedule_ir.py on the largest fixture).
+        from autodist_tpu.kernel.synchronization import schedule_ir as sir
+        ir = sess.schedule_ir
+        if ir is None:
+            raise RuntimeError("bench: session has no schedule IR")
+        t_v = time.perf_counter()
+        sir.assert_verified(ir, f"bench grad_sync [{type(builder).__name__}]")
+        from autodist_tpu.strategy.cost_model import estimate_ir_cost
+        ir_cost = estimate_ir_cost(ir)
+        measure.last_ir = {
+            "schedule_fingerprint": ir.fingerprint(),
+            "ir_leg_count": len(ir.legs),
+            "ir_verify_ms": round((time.perf_counter() - t_v) * 1e3, 3),
+            # leg-priced estimate (estimate_ir_cost): exposed wire after
+            # the IR's own slot/prefetch accounting, per chip per step
+            "ir_exposed_wire_bytes": round(ir_cost.exposed_wire_bytes, 1),
+        }
         placed = sess.place_batch(batch)
         dt = _measure_session(sess, placed, 3, steps)
         opt_dev_bytes = 0
@@ -1469,6 +1491,9 @@ def run_grad_sync_child() -> None:
             "opt_state_bytes_per_device": opt_dev,
             "opt_state_bytes_analysis": round(opt_analysis, 1)
             if opt_analysis is not None else None,
+            # The verified sync-schedule program this mode executed
+            # (docs/schedule-ir.md): fingerprint + verifier gate time.
+            **(getattr(measure, "last_ir", None) or {}),
         }
     ar, rs = out["modes"]["all_reduce"], out["modes"]["reduce_scatter"]
     out["sync_bytes_ratio"] = round(
