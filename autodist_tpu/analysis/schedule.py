@@ -14,9 +14,11 @@ verifier's own ids (docs/schedule-ir.md):
 * ``schedule/ring-hop-order`` (ERROR) — a ring hop chain is not the
   consecutive dep-ordered 1..n-1 sequence (swapped/duplicated/missing
   hops deadlock the ppermute).
-* ``schedule/quantized-pipelined`` (ERROR) — a quantized collective in
-  the accumulation pipeline, or two quantized collectives for one
-  bucket in one step.
+* ``schedule/quantized-pipelined`` (ERROR) — a quantized bucket's
+  collectives violate the pipelining contract: anything other than one
+  end-of-step quantized collective, or (int8/fp8 under an explicit
+  pipeline request) exactly one quantized collective per microbatch
+  slot ``0..accum-1``.
 * ``schedule/read-after-donate`` (ERROR) — a donated sync-state buffer
   with a read reachable after a write.
 * ``schedule/reduction-order-divergence`` (WARN) — a low-precision or
@@ -87,8 +89,9 @@ _FIXES = {
     "schedule/ring-degenerate":
         "grow the axis past 1 or drop the ring decomposition",
     "schedule/quantized-pipelined":
-        "keep quantized buckets on the end-of-step collective "
-        "(overlap auto does this) or drop the compressor",
+        "a quantized bucket owes ONE quantized collective per step, or "
+        "— int8/fp8 under explicit overlap='pipeline'/'full' — exactly "
+        "one per microbatch slot; restore one of those shapes",
     "schedule/read-after-donate":
         "undonate the sync state or move the read before the write",
     "schedule/dep-cycle": "break the dependency cycle",

@@ -34,9 +34,12 @@ strings shared with the runtime via
   ppermute lowering the request asks for cannot exist.
 * ``sync/overlap-fallback`` (WARN) — an overlap schedule was requested
   (or ``"auto"`` had a win available) but this variable cannot join it:
-  per-variable fallback path (PowerSGD / partitioned), a quantizing
-  compressor blocking pipelined reduction, or ``overlap="pipeline"``
-  with no microbatch loop (``accum_steps=1``).
+  per-variable fallback path (PowerSGD / partitioned), a cast-based
+  compressor blocking pipelined reduction (quantized-ring int8/fp8
+  compressors DO pipeline under an explicit ``"pipeline"``/``"full"`` —
+  one quantized collective per microbatch slot — and only fall back
+  under ``"auto"``), or ``overlap="pipeline"`` with no microbatch loop
+  (``accum_steps=1``).
 """
 from __future__ import annotations
 

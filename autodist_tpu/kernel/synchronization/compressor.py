@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from autodist_tpu.kernel.synchronization import quant_ring
 from autodist_tpu.utils import compat
 
 
@@ -35,6 +36,14 @@ class Compressor:
     :meth:`reduce_scatter`, the ZeRO-1 leg: reduce the bucket but return
     only this shard's ``1/axis_size`` slice of the mean, so the weight
     update can run on the local optimizer-state shard.
+
+    Quantized-wire compressors (int8/fp8, ``quant_ring.WIRE_FORMATS``)
+    additionally implement the bucket-level :meth:`bucket_reduce` /
+    :meth:`bucket_reduce_scatter` entry points the explicit path lowers
+    through: they take the schedule IR's resolved algorithm (per-hop
+    requantizing ring vs one-shot collective) and return the
+    post-quantization saturation count alongside the reduced value and
+    the new error-feedback state.
     """
 
     name = "Compressor"
@@ -175,77 +184,94 @@ class PowerSGDCompressor(Compressor):
         return approx, {"q": new_q, "residual": new_residual}
 
 
-class Int8Compressor(Compressor):
-    """Tensor-scaled int8 quantized all-reduce with error feedback
-    (EQuARX-style, arxiv 2506.17615: quantized collectives cut ICI/DCN
-    bytes ~4x vs f32 at negligible quality loss when error-compensated).
+class QuantizedRingCompressor(Compressor):
+    """Quantized-wire all-reduce with error feedback on the per-chunk
+    scale grid (EQuARX-style, arxiv 2506.17615: quantized collectives
+    cut ICI/DCN bytes ~4x vs f32 at negligible quality loss when
+    error-compensated).
 
-    The all-reduce is built MANUALLY so int8 is what actually crosses the
-    wire (a dtype round-trip in front of ``psum`` would still move 4
-    bytes/element): quantized reduce-scatter via ``all_to_all``, local
-    dequantize-and-sum in f32, then a re-quantized ``all_gather`` — the
-    EQuARX double-quantization scheme.  Scales are shared via scalar
-    ``pmax`` so every shard uses one grid.  Stage-1 quantization error is
-    carried as local error-feedback state (Karimireddy et al., 2019);
-    stage-2 (post-aggregation) error is uncompensated, as in EQuARX.
+    The collectives are built MANUALLY so the 1-byte wire format is what
+    actually crosses the interconnect (a dtype round-trip in front of
+    ``psum`` would still move 4 bytes/element).  ALL tiers share one
+    quantization rule — ``quant_ring.quantize_blocks``'s per-chunk
+    scale grid, scales traveling with the payload: the single-collective
+    ``all_to_all`` reduce-scatter + re-quantized ``all_gather`` used
+    here and by the GSPMD/per-variable tier, and the per-hop
+    requantizing ppermute ring the explicit bucketed path lowers to via
+    :meth:`bucket_reduce` when the schedule IR resolves ``alg="ring"``.
+    Stage-1 quantization error is carried as local error-feedback state
+    (Karimireddy et al., 2019); stage-2 (post-aggregation) error is
+    uncompensated, as in EQuARX.  Subclasses pin the wire format
+    (int8 or fp8 e4m3 via ml_dtypes).
     """
 
-    name = "Int8Compressor"
+    name = "QuantizedRingCompressor"
+    wire = quant_ring.WIRE_INT8
 
     def init_state(self, var_value):
         return jnp.zeros_like(var_value)
 
-    @staticmethod
-    def _quantize(x, axis_name):
-        amax = lax.pmax(jnp.max(jnp.abs(x)), axis_name)
-        scale = jnp.maximum(amax / 127.0, 1e-30)
-        q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
-        return q, scale
-
     def reduce(self, grad, state, axis_name):
         n = compat.axis_size(axis_name)
-        corrected = (grad + state).astype(jnp.float32)
-        flat = corrected.ravel()
+        flat = (grad + state).astype(jnp.float32).ravel()
         pad = (-flat.size) % n
         if pad:
             flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
-
-        q, scale = self._quantize(flat, axis_name)
-        err = flat - q.astype(jnp.float32) * scale            # stage-1 error
-        new_state = err[:grad.size].reshape(grad.shape).astype(grad.dtype)
-
-        # Quantized reduce-scatter: chunk j of every shard lands on shard j
-        # (int8 wire), then dequantize + sum in f32 locally.
-        recv = lax.all_to_all(q.reshape(n, -1), axis_name,
-                              split_axis=0, concat_axis=0)
-        owned_sum = jnp.sum(recv.astype(jnp.float32), axis=0) * scale
-
-        # Re-quantized all-gather of the aggregated chunk (int8 wire again).
-        q2, scale2 = self._quantize(owned_sum, axis_name)
-        gathered = lax.all_gather(q2, axis_name, axis=0).reshape(-1)
-        mean = gathered.astype(jnp.float32) * (scale2 / n)
+        mean, new_state, _ = quant_ring.quant_bucket_reduce(
+            flat, jnp.zeros_like(flat), axis_name, n, self.wire,
+            mode="all_reduce", alg="fused")
+        new_state = new_state[:grad.size].reshape(grad.shape) \
+            .astype(grad.dtype)
         return mean[:grad.size].reshape(grad.shape).astype(grad.dtype), \
             new_state
 
     def reduce_scatter(self, vec, state, axis_name):
-        # ZeRO-1 leg = EQuARX stage 1 alone: the quantized all_to_all
-        # already IS a reduce-scatter with an int8 wire; the stage-2
+        # ZeRO-1 leg = EQuARX stage 1 alone: the quantized reduce-scatter
+        # already puts 1-byte payloads on the wire; the stage-2
         # re-quantized all-gather is simply not needed (fresh params are
         # gathered instead).  No stage-2 quantization error either.
         n = compat.axis_size(axis_name)
-        corrected = (vec + state).astype(jnp.float32)
-        q, scale = self._quantize(corrected, axis_name)
-        err = corrected - q.astype(jnp.float32) * scale
-        new_state = err.astype(vec.dtype)
-        recv = lax.all_to_all(q.reshape(n, -1), axis_name,
-                              split_axis=0, concat_axis=0)
-        owned_mean = jnp.sum(recv.astype(jnp.float32), axis=0) * (scale / n)
-        return owned_mean.astype(vec.dtype), new_state
+        shard, new_state, _ = self.bucket_reduce_scatter(
+            vec, state, axis_name, n, alg="fused")
+        return shard, new_state
+
+    # -- bucket-level entry points (explicit path; docs/overlap.md) -------
+    def bucket_reduce(self, vec, state, axis_name, n, alg="fused"):
+        """Full mean of flat ``vec`` through the quantized wire under
+        the IR-resolved ``alg``; returns ``(mean, new_state,
+        sat_count)`` — the saturation counter feeds GradHealth."""
+        return quant_ring.quant_bucket_reduce(
+            vec, state, axis_name, n, self.wire,
+            mode="all_reduce", alg=alg)
+
+    def bucket_reduce_scatter(self, vec, state, axis_name, n, alg="fused"):
+        """This device's 1/n mean shard (ZeRO-1 leg) — the update runs
+        on the f32-dequantized shard; returns ``(shard, new_state,
+        sat_count)``."""
+        return quant_ring.quant_bucket_reduce(
+            vec, state, axis_name, n, self.wire,
+            mode="reduce_scatter", alg=alg)
+
+
+class Int8Compressor(QuantizedRingCompressor):
+    """Int8 wire (±127 grid), per-chunk scales."""
+
+    name = "Int8Compressor"
+    wire = quant_ring.WIRE_INT8
+
+
+class Fp8Compressor(QuantizedRingCompressor):
+    """Fp8 e4m3 wire (``ml_dtypes.float8_e4m3fn``, max finite 448):
+    same byte count as int8 with a floating grid — more dynamic range
+    per block, coarser steps near the block amax."""
+
+    name = "Fp8Compressor"
+    wire = quant_ring.WIRE_FP8_E4M3
 
 
 _REGISTRY: Dict[str, type] = {
     c.name: c for c in (NoneCompressor, HorovodCompressor, HorovodCompressorEF,
-                        PowerSGDCompressor, Int8Compressor)
+                        PowerSGDCompressor, Int8Compressor, Fp8Compressor)
 }
 
 

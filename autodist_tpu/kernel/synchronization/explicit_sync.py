@@ -86,6 +86,7 @@ from autodist_tpu.kernel.synchronization.compressor import (
     get_compressor,
 )
 from autodist_tpu.kernel.synchronization import overlap as overlap_mod
+from autodist_tpu.kernel.synchronization import quant_ring
 from autodist_tpu.kernel.synchronization import schedule_ir
 from autodist_tpu.strategy.compiler import CompiledStrategy
 from autodist_tpu.telemetry.timeline import sync_span
@@ -470,6 +471,29 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
         b, ov, MESH_AXIS_DATA, d, alg=ir.reduce_alg(b.key))
         for b in buckets
         if overlap_mod.is_linear_compressor(b.compressor)}
+    # Quantized-wire buckets (int8/fp8, docs/overlap.md) lower through
+    # the stateful bucket entry point under the IR-resolved algorithm:
+    # (vec, error-feedback state) -> (reduced, new state, saturation
+    # count).  The same closures serve the end-of-step tier and the
+    # per-microbatch-slot pipeline.
+    quant_fns = {}
+    for b in buckets:
+        if quant_ring.wire_format_of(b.compressor) is None:
+            continue
+        comp = get_compressor(b.compressor)
+        if b.mode == MODE_REDUCE_SCATTER:
+            quant_fns[b.key] = (
+                lambda v, s, comp=comp, alg=ir.reduce_alg(b.key):
+                comp.bucket_reduce_scatter(v, s, MESH_AXIS_DATA, d, alg=alg))
+        else:
+            quant_fns[b.key] = (
+                lambda v, s, comp=comp, alg=ir.reduce_alg(b.key):
+                comp.bucket_reduce(v, s, MESH_AXIS_DATA, d, alg=alg))
+    pipe_quant_fns = {k: f for k, f in quant_fns.items() if k in pipe_keys}
+    # Saturation counters are per-data-rank events replicated across the
+    # other mesh axes; this factor makes the guard's all-axis psum
+    # return the true global count.
+    sat_norm = d / float(n_devices)
     reduced_sizes = {b.key: (b.padded_total // max(d, 1)
                              if b.mode == MODE_REDUCE_SCATTER
                              else b.padded_total) for b in buckets}
@@ -654,13 +678,17 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
         guarded_idx: List[int] = []
 
         pipe_reduced: Dict[str, Any] = {}
+        pipe_qstates: Dict[str, Any] = {}
+        pipe_qsats: Dict[str, Any] = {}
         if use_pipeline:
             # Accumulation pipelining (overlap.py): microbatch k's bucket
             # collectives are issued alongside microbatch k+1's backward;
             # only the last microbatch's reduction is exposed.  `grads`
             # carries the locally averaged tree for the per-variable and
-            # compressed-bucket tiers, whose single end-of-step
-            # collective is unchanged.
+            # non-pipelined compressed-bucket tiers, whose single
+            # end-of-step collective is unchanged.  Quantized pipelined
+            # buckets issue one quantized collective per slot with their
+            # error-feedback residual threaded through the loop.
             def single_vg(p, mb):
                 if has_aux:
                     (loss_, aux_), g_ = vg_local(p, mb)
@@ -669,9 +697,15 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
                     aux_ = None
                 return loss_, aux_, g_
 
-            loss, aux, grads, pipe_reduced = overlap_mod.pipelined_accumulate(
+            qstates0 = {
+                k: jax.tree_util.tree_map(lambda x: jnp.squeeze(x, 0),
+                                          sync_state[k])
+                for k in pipe_quant_fns if k in sync_state}
+            (loss, aux, grads, pipe_reduced, pipe_qstates,
+             pipe_qsats) = overlap_mod.pipelined_accumulate(
                 single_vg, gi.accum_steps, has_aux, pipe_buckets,
-                reduce_fns, reduced_sizes, full_params, batch)
+                reduce_fns, reduced_sizes, full_params, batch,
+                quant_fns=pipe_quant_fns, quant_states=qstates0)
         elif has_aux:
             (loss, aux), grads = vg_local(full_params, batch)
         else:
@@ -742,16 +776,23 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
             if b.key in pipe_keys:
                 red = pipe_reduced[b.key]
                 if num_active:
-                    # Pipelined buckets are uncompressed (linear), so a
-                    # NaN survives the per-microbatch reduction — the
-                    # accumulated reduced value IS the finiteness source.
-                    health.add(b.key, red, shard_axes_size=d if rs else 1)
+                    # Linear pipelined buckets: a NaN survives the linear
+                    # per-microbatch reduction — the accumulated reduced
+                    # value IS the finiteness source.  Quantized
+                    # pipelined buckets additionally report the
+                    # saturation events counted inside their ring legs
+                    # (a quantizer can mask a NaN on the wire; the
+                    # counter cannot).
+                    health.add(b.key, red, shard_axes_size=d if rs else 1,
+                               sat_count=pipe_qsats[b.key] * sat_norm
+                               if b.key in pipe_qsats else None)
                 if b.mode == MODE_ALL_REDUCE:
                     for n, arr in zip(b.names, unpack_bucket(b, red)):
                         synced[idx_of[n]] = arr
                         guarded_idx.append(idx_of[n])
                 else:
                     rs_grad_shards[b.key] = red
+                store_state(b.key, pipe_qstates.get(b.key))
                 continue
             vec = pack_bucket(b, [flat[idx_of[n]][1] for n in b.names])
             if b.key in reduce_fns:   # uncompressed: schedule-lowered
@@ -776,6 +817,30 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
                         guarded_idx.append(idx_of[n])
                 else:
                     rs_grad_shards[b.key] = red
+            elif b.key in quant_fns:
+                # Quantized wire (int8/fp8): the bucket lowers through
+                # quant_ring under the IR-resolved algorithm (per-hop
+                # requantizing ring or one-shot all_to_all), and the
+                # post-quantization saturation counter — clipped-to-rail
+                # / fp8-overflow elements, counted INSIDE the legs —
+                # rides the health rollup.
+                with sync_span(f"bucket_quant_reduce/{b.key}"):
+                    red, st2, qsat = quant_fns[b.key](
+                        vec, local_state_of(b.key))
+                if b.mode == MODE_ALL_REDUCE:
+                    if num_active:
+                        health.add(b.key, red, shard_axes_size=1,
+                                   finite_src=vec,
+                                   sat_count=qsat * sat_norm)
+                    for n, arr in zip(b.names, unpack_bucket(b, red)):
+                        synced[idx_of[n]] = arr
+                        guarded_idx.append(idx_of[n])
+                else:
+                    rs_grad_shards[b.key] = red
+                    if num_active:
+                        health.add(b.key, red, shard_axes_size=d,
+                                   finite_src=vec,
+                                   sat_count=qsat * sat_norm)
             else:
                 comp = get_compressor(b.compressor)
                 sat = guard_mod.wire_saturation(

@@ -17,10 +17,19 @@ the ``overlap=`` knob on :class:`AllReduceSynchronizerConfig` /
    same loop iteration that computes microbatch *k+1*'s backward, so the
    two are data-independent and XLA's latency-hiding scheduler runs them
    concurrently.  Only the LAST microbatch's collective is exposed.
-   Exact (1e-6) for linear reductions: mean-of-means equals the mean, so
-   only ``NoneCompressor`` buckets pipeline; quantizing compressors keep
-   their one-compressed-collective-per-bucket-per-step contract and fall
-   back to the end-of-step reduction (see :func:`overlap_drop_reason`).
+   Under ``"auto"`` only numerics-preserving buckets join: linear
+   (``NoneCompressor``) f32 reductions, where mean-of-means equals the
+   mean exactly (1e-6 vs the sequential loop).  Explicit ``"pipeline"``
+   / ``"full"`` additionally admits quantized-ring compressors
+   (int8/fp8, ``quant_ring.WIRE_FORMATS``) under the relaxed contract:
+   ONE quantized collective per bucket per microbatch slot, with the
+   stage-1 error-feedback residual threaded through the slots (slot
+   *k*'s quantization error corrects slot *k+1*'s input, the last
+   slot's persists to the next step) — the shape the schedule
+   verifier's ``schedule/quantized-pipelined`` rule admits exactly.
+   Cast-based compressors (``HorovodCompressor*``) still keep their
+   one-collective-per-step contract and fall back (see
+   :func:`overlap_drop_reason`).
 2. **Ring decomposition** (``"ring"``): buckets at or above
    :data:`RING_THRESHOLD_BYTES` lower their reduce-scatter/all-gather
    into explicit per-chunk ``ppermute`` ring steps
@@ -57,6 +66,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from autodist_tpu.kernel.synchronization import quant_ring
 from autodist_tpu.kernel.synchronization.bucketing import Bucket
 
 #: overlap-mode vocabulary for AllReduce-family plans.
@@ -122,10 +132,24 @@ def overlap_drop_reason(overlap: str, *, accum_steps: int, compressor: str,
     wants_pipeline = overlap in (OVERLAP_PIPELINE, OVERLAP_FULL) \
         or (overlap == OVERLAP_AUTO and accum_steps > 1)
     if wants_pipeline and not is_linear_compressor(compressor):
-        return (f"{compressor} quantizes once per bucket per step; "
-                "per-microbatch pipelined reduction would change the "
-                "wire numerics, so the bucket keeps the end-of-step "
-                "compressed collective")
+        if quant_ring.is_quant_ring_compressor(compressor):
+            # Quantized-ring compressors own the relaxed contract: one
+            # quantized collective per bucket PER MICROBATCH SLOT, with
+            # error feedback threaded across slots.  Per-slot
+            # quantization adds one rounding per microbatch, so auto
+            # (numerics-preserving) keeps the end-of-step collective
+            # and only an explicit pipeline/full request opts in.
+            if overlap == OVERLAP_AUTO:
+                return (f"{compressor} adds one quantization rounding "
+                        "per microbatch when pipelined; auto keeps the "
+                        "single end-of-step quantized collective (set "
+                        "overlap='pipeline' or 'full' to pipeline one "
+                        "quantized collective per microbatch slot)")
+        else:
+            return (f"{compressor} quantizes once per bucket per step; "
+                    "per-microbatch pipelined reduction would change the "
+                    "wire numerics, so the bucket keeps the end-of-step "
+                    "compressed collective")
     if (overlap == OVERLAP_AUTO and wants_pipeline
             and np.dtype(dtype) != np.float32):
         return (f"{np.dtype(dtype).name} bucket: per-microbatch reduction "
@@ -154,11 +178,12 @@ def pipeline_applies(overlap: str, *, accum_steps: int, compressor: str,
 
 def pipeline_eligible(bucket: Bucket, mode: str, accum_steps: int) -> bool:
     """Does THIS bucket join the software pipeline under ``mode``?
-    Mirrors :func:`overlap_drop_reason`: linear compressor always
-    required; under ``auto`` only f32 buckets pipeline (per-microbatch
-    reduction of a bf16 bucket adds a low-precision rounding per
-    microbatch), while explicit ``pipeline``/``full`` forces any linear
-    bucket."""
+    Mirrors :func:`overlap_drop_reason`: under ``auto`` only linear f32
+    buckets pipeline (per-microbatch reduction of a bf16 bucket adds a
+    low-precision rounding per microbatch, a quantized bucket a
+    quantization rounding), while explicit ``pipeline``/``full``
+    additionally forces bf16 linear buckets and quantized-ring
+    (int8/fp8) buckets — one quantized collective per slot."""
     if accum_steps <= 1:
         return False
     return overlap_drop_reason(
@@ -426,35 +451,49 @@ def pipelined_accumulate(single_vg: Callable, accum: int, has_aux: bool,
                          pipe_buckets: Sequence[Bucket],
                          reduce_fns: Dict[str, Callable],
                          reduced_sizes: Dict[str, int],
-                         params, batch):
+                         params, batch,
+                         quant_fns: Optional[Dict[str, Callable]] = None,
+                         quant_states: Optional[Dict] = None):
     """Software-pipelined gradient accumulation over ``accum``
     microbatches: iteration *k* issues the bucket collectives for
     microbatch *k−1*'s gradients and THEN computes microbatch *k*'s
     backward — the two are data-independent, so the collective overlaps
     the backward and only the final microbatch's reduction is exposed.
 
-    Returns ``(loss, aux, grads, reduced)``:
+    Returns ``(loss, aux, grads, reduced, quant_state, quant_sat)``:
 
     * ``loss`` — the row-weighted mean microbatch loss (== the full
       local-batch mean for row-mean losses);
     * ``aux`` — per-microbatch auxes stacked on a leading [accum] axis
       (the :func:`_accumulate_grads` contract), or None;
     * ``grads`` — the row-weighted mean LOCAL gradient tree (consumed by
-      the per-variable fallback tier and compressed buckets — their
-      single end-of-step collective is unchanged);
+      the per-variable fallback tier and non-pipelined compressed
+      buckets — their single end-of-step collective is unchanged);
     * ``reduced`` — ``{bucket.key: reduced mean vector or shard}`` for
       every bucket in ``pipe_buckets``, already globally averaged by
-      its ``reduce_fns[key]`` leg.
+      its ``reduce_fns[key]`` / ``quant_fns[key]`` leg;
+    * ``quant_state`` — the final error-feedback residual per quantized
+      pipelined bucket (slot *k*'s quantization error corrected slot
+      *k+1* inside the step; the LAST slot's residual persists to the
+      next step's first slot via sync_state);
+    * ``quant_sat`` — ``{key: f32 count}`` of post-quantization
+      saturation events summed over this step's slots (GradHealth).
 
-    Exactness: each ``reduce_fns`` leg is linear (pipelining is gated to
-    uncompressed buckets), so the weighted sum of per-microbatch means
-    equals the mean of the weighted gradient sum — bit-close (summation
-    order) to the sequential accumulate-then-reduce schedule.
+    Exactness: a linear ``reduce_fns`` leg makes the weighted sum of
+    per-microbatch means equal the mean of the weighted gradient sum —
+    bit-close (summation order) to the sequential accumulate-then-reduce
+    schedule.  A quantized ``quant_fns`` leg (``quant_fns[key](vec,
+    state) -> (reduced, new_state, sat)``; int8/fp8 buckets under
+    explicit ``overlap="pipeline"``/``"full"``) issues ONE quantized
+    collective per slot — the relaxed ``schedule/quantized-pipelined``
+    contract — trading one extra quantization rounding per microbatch,
+    error-compensated across slots, for a fully hidden reduce leg.
 
     Equal microbatches run as a ``lax.scan`` whose carries (gradient
-    accumulators and the previous microbatch's packed buckets) are
-    donated by XLA's loop buffer reuse; an uneven tail unrolls the loop
-    (shapes differ per microbatch) with the same weighting.
+    accumulators, the previous microbatch's packed buckets, and the
+    quantized residuals) are donated by XLA's loop buffer reuse; an
+    uneven tail unrolls the loop (shapes differ per microbatch) with
+    the same weighting.
     """
     import jax
     import jax.numpy as jnp
@@ -463,6 +502,8 @@ def pipelined_accumulate(single_vg: Callable, accum: int, has_aux: bool,
     from autodist_tpu.graph_item import path_name
     from autodist_tpu.kernel.synchronization.bucketing import pack_bucket
 
+    quant_fns = quant_fns or {}
+    qstate0 = dict(quant_states or {})
     leaves = jax.tree_util.tree_leaves(batch)
     if not leaves:
         raise ValueError("pipelined accumulation needs a non-empty batch")
@@ -487,8 +528,17 @@ def pipelined_accumulate(single_vg: Callable, accum: int, has_aux: bool,
         return jax.tree_util.tree_map(
             lambda a, x: a + w * x.astype(jnp.float32), acc, tree)
 
-    def reduce_packed(packed):
-        return {k: reduce_fns[k](v) for k, v in packed.items()}
+    def reduce_packed(packed, qstate, sat):
+        red = {}
+        new_q = dict(qstate)
+        new_sat = dict(sat)
+        for k, v in packed.items():
+            if k in quant_fns:
+                red[k], new_q[k], cnt = quant_fns[k](v, qstate.get(k))
+                new_sat[k] = new_sat[k] + cnt
+            else:
+                red[k] = reduce_fns[k](v)
+        return red, new_q, new_sat
 
     off0, rows0 = slices[0]
     mb0 = jax.tree_util.tree_map(
@@ -502,6 +552,7 @@ def pipelined_accumulate(single_vg: Callable, accum: int, has_aux: bool,
         lambda s: jnp.zeros(s.shape, jnp.float32), g_shapes), g0, weights[0])
     red_acc = {b.key: jnp.zeros((reduced_sizes[b.key],), jnp.float32)
                for b in pipe_buckets}
+    sat_acc = {k: jnp.float32(0.0) for k in quant_fns}
     auxes = [aux0] if has_aux else None
 
     if even and accum > 1:
@@ -511,21 +562,23 @@ def pipelined_accumulate(single_vg: Callable, accum: int, has_aux: bool,
             batch)
 
         def body(carry, mb):
-            loss_a, g_a, red_a, prev = carry
+            loss_a, g_a, red_a, prev, qs, sat_a = carry
             # the collective for the PREVIOUS microbatch's buckets: no
             # data dependence on this microbatch's backward below, so
             # the scheduler overlaps them.
-            red = reduce_packed(prev)
+            red, qs, sat_a = reduce_packed(prev, qs, sat_a)
             red_a = {k: red_a[k] + w * red[k].astype(jnp.float32)
                      for k in red_a}
             loss, aux, g, packed = run_vg(mb)
             loss_a = loss_a + w * loss.astype(jnp.float32)
             g_a = add_scaled(g_a, g, w)
-            return (loss_a, g_a, red_a, packed), aux
+            return (loss_a, g_a, red_a, packed, qs, sat_a), aux
 
-        (loss_acc, g_acc, red_acc, prev), scanned = lax.scan(
-            body, (loss_acc, g_acc, red_acc, packed0), mbs)
-        red = reduce_packed(prev)  # the one exposed reduction
+        (loss_acc, g_acc, red_acc, prev, qstate0, sat_acc), scanned = \
+            lax.scan(body, (loss_acc, g_acc, red_acc, packed0, qstate0,
+                            sat_acc), mbs)
+        # the one exposed reduction
+        red, qstate0, sat_acc = reduce_packed(prev, qstate0, sat_acc)
         red_acc = {k: red_acc[k] + w * red[k].astype(jnp.float32)
                    for k in red_acc}
         if has_aux:
@@ -537,7 +590,7 @@ def pipelined_accumulate(single_vg: Callable, accum: int, has_aux: bool,
     else:
         prev, prev_w = packed0, weights[0]
         for k in range(1, accum):
-            red = reduce_packed(prev)
+            red, qstate0, sat_acc = reduce_packed(prev, qstate0, sat_acc)
             red_acc = {key: red_acc[key] + prev_w * red[key].astype(
                 jnp.float32) for key in red_acc}
             off, rows = slices[k]
@@ -549,7 +602,7 @@ def pipelined_accumulate(single_vg: Callable, accum: int, has_aux: bool,
             prev, prev_w = packed, weights[k]
             if has_aux:
                 auxes.append(aux_k)
-        red = reduce_packed(prev)
+        red, qstate0, sat_acc = reduce_packed(prev, qstate0, sat_acc)
         red_acc = {key: red_acc[key] + prev_w * red[key].astype(jnp.float32)
                    for key in red_acc}
         if has_aux:
@@ -562,4 +615,4 @@ def pipelined_accumulate(single_vg: Callable, accum: int, has_aux: bool,
         lambda g, s: g.astype(s.dtype), g_acc, g_shapes)
     reduced = {b.key: red_acc[b.key].astype(np.dtype(b.dtype))
                for b in pipe_buckets}
-    return loss_acc, aux, grads, reduced
+    return loss_acc, aux, grads, reduced, qstate0, sat_acc

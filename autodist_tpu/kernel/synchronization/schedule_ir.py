@@ -37,11 +37,18 @@ make the partition/schedule decision a first-class analyzable artifact):
     consecutive, dep-ordered sequence 1..n-1 (swapped, duplicated,
     missing, or back-edged hops): ranks disagree on which chunk is in
     flight and the ppermute deadlocks.
-  - ``schedule/quantized-pipelined`` (ERROR) — a quantizing
-    compressor's collective carries a microbatch slot, or one bucket
-    schedules two quantized collectives in one step: pipelined
-    accumulation must never interleave quantized collectives for a
-    bucket (the one-quantized-collective-per-bucket-per-step contract).
+  - ``schedule/quantized-pipelined`` (ERROR) — a quantized bucket's
+    collectives violate the pipelining contract.  The ADMITTED shapes
+    are exactly: one quantized collective per bucket at end-of-step, OR
+    — for quantized-ring compressors (int8/fp8,
+    ``quant_ring.WIRE_FORMATS``) under an explicit pipeline request —
+    exactly one quantized collective per microbatch slot ``0..accum-1``
+    (error feedback threaded across slots).  Rejected: two quantized
+    collectives in one slot/step, partial slot coverage, a mix of
+    slotted and end-of-step quantized collectives, a slotted collective
+    for a compressor without the per-slot contract
+    (``HorovodCompressor*``), and a quantized ppermute ring chain for a
+    compressor with no per-hop requantize lowering.
   - ``schedule/read-after-donate`` (ERROR) — a donated sync-state
     buffer has a pure read reachable after a write in the dep graph:
     the donated buffer's old handle is deleted by then (the PR 3
@@ -83,6 +90,7 @@ import numpy as np
 
 from autodist_tpu.const import MESH_AXIS_DATA
 from autodist_tpu.kernel.synchronization import overlap as overlap_mod
+from autodist_tpu.kernel.synchronization import quant_ring
 from autodist_tpu.kernel.synchronization.bucketing import (
     Bucket,
     MODE_REDUCE_SCATTER,
@@ -454,17 +462,25 @@ def _bucket_stage(b: Bucket) -> str:
 
 def _ring_chain(em: _Emitter, *, chain: str, b: Bucket,
                 d: int, axis: str, slot: int, stage: str, deps: Sequence[str],
-                reads: Tuple[str, ...], writes: Tuple[str, ...]) -> Leg:
+                reads: Tuple[str, ...], writes: Tuple[str, ...],
+                per_hop: Optional[int] = None,
+                compressor: Optional[str] = None) -> Leg:
     """Emit a d-1 hop ppermute ring chain; returns the final hop (which
-    carries ``writes``)."""
+    carries ``writes``).  ``per_hop`` overrides the per-hop wire bytes
+    (quantized chains: 1-byte/elem payload + per-chunk scale bytes);
+    ``compressor`` overrides the wire tag (the ZeRO-1 param gather
+    rides full precision regardless of the bucket's gradient wire)."""
     prev: Optional[Leg] = None
-    per_hop = int(b.nbytes // max(d, 1))
+    if per_hop is None:
+        per_hop = int(b.nbytes // max(d, 1))
+    if compressor is None:
+        compressor = b.compressor or "NoneCompressor"
     for h in range(1, d):
         last = h == d - 1
         leg = em.emit(
             id=f"{chain}/hop{h}", kind=LEG_PPERMUTE_HOP, bucket=b.key,
             dtype=b.dtype, nbytes=per_hop, axis=axis, slot=slot,
-            compressor=b.compressor or "NoneCompressor", alg=ALG_RING,
+            compressor=compressor, alg=ALG_RING,
             hop=h, chain=chain, stage=stage, sig=_bucket_sig(b),
             deps=tuple(deps) if prev is None else (prev.id,),
             reads=reads if prev is None else (),
@@ -521,11 +537,16 @@ def build_schedule_ir(*, axes: Dict[str, int], accum_steps: int = 1,
     for b in buckets:
         rs = b.mode == MODE_REDUCE_SCATTER
         linear = overlap_mod.is_linear_compressor(b.compressor)
-        # The reduce lowering — the EXACT rule bucket_reduce_fn applies.
+        qfmt = quant_ring.wire_format_of(b.compressor or "")
+        # The reduce lowering — the EXACT rule bucket_reduce_fn (linear)
+        # / quant_bucket_reduce (quantized wire) applies.
         if linear and plan.ring and d > 1 and b.nbytes >= plan.ring_threshold:
             alg = ALG_RING
         elif linear and plan.one_shot_small and d > 1 and not rs:
             alg = ALG_ONE_SHOT
+        elif qfmt is not None and quant_ring.ring_applies(
+                plan.mode, b.nbytes, d, plan.ring_threshold):
+            alg = ALG_RING
         else:
             alg = ALG_FUSED if per_var_alg != ALG_PSUM_TREE else ALG_PSUM_TREE
         pipelined = bool(
@@ -535,6 +556,17 @@ def build_schedule_ir(*, axes: Dict[str, int], accum_steps: int = 1,
                       and b.nbytes >= plan.ring_threshold else ALG_FUSED) \
             if rs else ""
         stage = _bucket_stage(b)
+        # Quantized wire accounting (docs/schedule-ir.md): a quantized
+        # leg's nbytes is the HONEST transfer — 1-byte/elem payload plus
+        # the per-chunk f32 scales traveling with it — so the IR cost
+        # model prices the compressed wire, not the f32 vector.
+        if qfmt is not None:
+            leg_nbytes = quant_ring.wire_nbytes(b.padded_total, qfmt)
+            hop_nbytes = quant_ring.wire_nbytes(
+                b.padded_total // max(d, 1), qfmt)
+        else:
+            leg_nbytes = int(b.nbytes)
+            hop_nbytes = None
         # Stateful resolution: the runtime passes its exact eval_shape
         # probe results; mesh-free callers fall back to the registry probe.
         is_stateful = (b.key in stateful) if stateful else (
@@ -547,6 +579,12 @@ def build_schedule_ir(*, axes: Dict[str, int], accum_steps: int = 1,
             "total": int(b.total), "padded_total": int(b.padded_total),
             "nbytes": int(b.nbytes), "alg": alg, "pipelined": pipelined,
             "gather_alg": gather_alg, "stage": stage,
+            # quantized-leg metadata (empty/zero for full-precision wire)
+            "wire_dtype": qfmt.name if qfmt else "",
+            "scale_block": quant_ring.QUANT_BLOCK_ELEMS if qfmt else 0,
+            "scale_nbytes": quant_ring.scale_nbytes(b.padded_total)
+            if qfmt else 0,
+            "requantize_per_hop": bool(qfmt is not None and alg == ALG_RING),
             "vars": [{"name": v.name, "shape": list(v.shape)}
                      for v in b.vars],
         })
@@ -559,21 +597,24 @@ def build_schedule_ir(*, axes: Dict[str, int], accum_steps: int = 1,
                     last = _ring_chain(
                         em, chain=f"{b.key}@{slot}/rs", b=b, d=d,
                         axis=MESH_AXIS_DATA, slot=slot, stage=stage,
-                        deps=(), reads=reads, writes=writes)
+                        deps=(), reads=reads, writes=writes,
+                        per_hop=hop_nbytes)
                 else:
                     mid = _ring_chain(
                         em, chain=f"{b.key}@{slot}/rs", b=b, d=d,
                         axis=MESH_AXIS_DATA, slot=slot, stage=stage,
-                        deps=(), reads=reads, writes=())
+                        deps=(), reads=reads, writes=(),
+                        per_hop=hop_nbytes)
                     last = _ring_chain(
                         em, chain=f"{b.key}@{slot}/ag", b=b, d=d,
                         axis=MESH_AXIS_DATA, slot=slot, stage=stage,
-                        deps=(mid.id,), reads=(), writes=writes)
+                        deps=(mid.id,), reads=(), writes=writes,
+                        per_hop=hop_nbytes)
             else:
                 last = em.emit(
                     id=f"{b.key}@{slot}/reduce",
                     kind=LEG_REDUCE_SCATTER if rs else LEG_ALL_REDUCE,
-                    bucket=b.key, dtype=b.dtype, nbytes=int(b.nbytes),
+                    bucket=b.key, dtype=b.dtype, nbytes=leg_nbytes,
                     axis=MESH_AXIS_DATA, slot=slot,
                     compressor=b.compressor or "NoneCompressor", alg=alg,
                     stage=stage, sig=_bucket_sig(b),
@@ -636,11 +677,15 @@ def build_schedule_ir(*, axes: Dict[str, int], accum_steps: int = 1,
             n = by_key[b.key]
             gather_order.append((b.key, n["gather_alg"]))
             if n["gather_alg"] == ALG_RING:
+                # Fresh parameters gather FULL PRECISION whatever the
+                # gradient wire was (ZeRO-1 updates from the dequantized
+                # shard) — tag the chain accordingly.
                 _ring_chain(
                     em, chain=f"{b.key}@gather/ag",
                     b=b, d=d, axis=MESH_AXIS_DATA, slot=END_OF_STEP,
                     stage=n["stage"], deps=(update_of[b.key],),
-                    reads=(f"param:{b.key}",), writes=(f"param:{b.key}",))
+                    reads=(f"param:{b.key}",), writes=(f"param:{b.key}",),
+                    compressor="NoneCompressor")
             else:
                 em.emit(
                     id=f"{b.key}@gather", kind=LEG_ALL_GATHER, bucket=b.key,
@@ -833,33 +878,70 @@ def verify(ir: ScheduleIR) -> List[Violation]:
                 "chunk in flight and the ppermute deadlocks",
                 location=chain))
 
-    # -- quantized collectives: never pipelined, one per bucket per step --
-    quant_count: Dict[str, int] = {}
+    # -- quantized collectives: the per-slot pipelining contract ----------
+    # Admitted shapes per bucket (see module docstring): exactly one
+    # quantized reduce at end-of-step, OR — quantized-ring compressors
+    # only — exactly one per microbatch slot 0..accum-1.  A quantized
+    # all-reduce's stage-2 gather chain counts as its own role (one per
+    # slot too).  Anything else is rejected.
+    accum = max(int(ir.accum_steps), 1)
+    quant_events: Dict[Tuple[str, int, str], int] = {}
+    quant_slots: Dict[str, set] = {}
     for l in legs:
         if l.kind not in COLLECTIVE_KINDS or not is_quantizing(l.compressor):
             continue
+        capable = quant_ring.is_quant_ring_compressor(l.compressor)
         if l.kind == LEG_PPERMUTE_HOP:
-            out.append(Violation(
-                RULE_REDUCTION_ORDER, SEV_WARN,
-                f"quantized bucket {l.bucket!r} ring-decomposes: per-hop "
-                "requantization diverges from the one-scale-grid "
-                "collective contract", leg=l.id))
-            continue
-        if l.slot != END_OF_STEP:
+            if not capable:
+                out.append(Violation(
+                    RULE_QUANTIZED_PIPELINED, SEV_ERROR,
+                    f"{l.compressor} has no per-hop requantize lowering: "
+                    f"a quantized ppermute ring chain for bucket "
+                    f"{l.bucket!r} cannot exist", leg=l.id))
+                continue
+            if l.hop != 1:
+                continue          # hop 1 opens the chain: one event
+            role = "gather" if (l.chain or "").endswith("/ag") else "reduce"
+        else:
+            role = "gather" if l.kind == LEG_ALL_GATHER else "reduce"
+        if l.slot != END_OF_STEP and not capable:
             out.append(Violation(
                 RULE_QUANTIZED_PIPELINED, SEV_ERROR,
                 f"{l.compressor} collective for bucket {l.bucket!r} is "
-                f"scheduled into accumulation slot {l.slot}: quantizing "
-                "per microbatch changes the wire numerics (the bucket "
-                "owes ONE quantized collective per step)", leg=l.id))
-        quant_count[l.bucket] = quant_count.get(l.bucket, 0) + 1
-    for key, n in quant_count.items():
+                f"scheduled into accumulation slot {l.slot}: this "
+                "compressor quantizes once per bucket per step (only "
+                "quantized-ring compressors own the per-slot contract)",
+                leg=l.id))
+        key3 = (l.bucket, l.slot, role)
+        quant_events[key3] = quant_events.get(key3, 0) + 1
+        if role == "reduce":
+            quant_slots.setdefault(l.bucket, set()).add(l.slot)
+    for (key, slot, role), n in sorted(quant_events.items()):
         if n > 1:
+            where = "one step" if slot == END_OF_STEP \
+                else f"microbatch slot {slot}"
             out.append(Violation(
                 RULE_QUANTIZED_PIPELINED, SEV_ERROR,
-                f"bucket {key!r} schedules {n} quantized collectives in "
-                "one step: error-feedback state and the wire scale grid "
-                "assume exactly one", location=key))
+                f"bucket {key!r} schedules {n} quantized {role} "
+                f"collectives in {where}: error-feedback state and the "
+                "per-chunk scale grid assume exactly one", location=key))
+    for key, slots in sorted(quant_slots.items()):
+        slotted = sorted(s for s in slots if s != END_OF_STEP)
+        if not slotted:
+            continue
+        if END_OF_STEP in slots:
+            out.append(Violation(
+                RULE_QUANTIZED_PIPELINED, SEV_ERROR,
+                f"bucket {key!r} mixes slotted and end-of-step quantized "
+                "collectives: the pipelined contract is one quantized "
+                "collective per slot, nothing more", location=key))
+        if slotted != list(range(accum)):
+            out.append(Violation(
+                RULE_QUANTIZED_PIPELINED, SEV_ERROR,
+                f"bucket {key!r} pipelines quantized collectives in "
+                f"slots {slotted}, not one per slot 0..{accum - 1}: "
+                "error feedback threads through EVERY microbatch slot "
+                "or none", location=key))
 
     # -- reduction-order divergence (determinism lint) --------------------
     for node in ir.buckets:

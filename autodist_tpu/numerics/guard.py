@@ -17,6 +17,11 @@ computed as a **byproduct of the existing bucketed pack/reduce**
   partition the vector);
 * compressors with a float wire additionally report pre-quantization
   *saturation* (a finite value that casts to Inf on the wire);
+* quantized-wire buckets (int8/fp8, ``quant_ring``) report
+  POST-quantization saturation counts from inside the ring legs —
+  elements clipped to ±127 / overflowed on the fp8 grid per quantize
+  event — so wire saturation is observed where it happens, not
+  estimated before the collective;
 * everything rolls into ONE small psum piggybacked on the bucket chain
   (a ``[3 × n_keys]`` f32 vector over every mesh axis, each contribution
   divided by its replication factor so nothing is double counted).
@@ -60,11 +65,13 @@ class HealthAccumulator:
 
     def __init__(self, total_devices: int = 1):
         self._n = max(int(total_devices), 1)
-        #: key -> (sq_partial, nonfinite_count, saturated_count, has_sat)
-        self._rows: List[Tuple[str, Any, Any, Any, bool]] = []
+        #: key -> (sq_partial, nonfinite_count, sat_value, sat_kind)
+        #: sat_kind: None | "flag" (pre-quantization 0/1) | "count"
+        #: (post-quantization clipped/overflowed element count)
+        self._rows: List[Tuple[str, Any, Any, Any, Any]] = []
 
     def add(self, key: str, value, *, shard_axes_size: int = 0,
-            finite_src=None, saturation=None) -> None:
+            finite_src=None, saturation=None, sat_count=None) -> None:
         """Record one synced value's contribution.
 
         ``value`` is the REDUCED tensor this key's optimizer update will
@@ -75,8 +82,13 @@ class HealthAccumulator:
         all-axis psum counts every element exactly once.  ``finite_src``
         optionally supplies a different tensor for the finiteness bit
         (the pre-reduce packed vector — the pack-time byproduct);
-        ``saturation`` is an optional extra 0/1 scalar (pre-quantization
-        wire saturation from the compressor)."""
+        ``saturation`` is an optional extra 0/1 scalar (PRE-quantization
+        wire saturation from a float-wire compressor); ``sat_count`` is
+        an optional POST-quantization saturation element count observed
+        inside the quantized ring legs (clipped-to-±127 / fp8-overflow),
+        pre-normalized by the caller so the all-axis psum returns the
+        global count.  Either saturation input trips the step's
+        ``all_finite`` gate when non-zero."""
         import jax.numpy as jnp
 
         repl = self._n / max(int(shard_axes_size) or 1, 1)
@@ -85,9 +97,13 @@ class HealthAccumulator:
         fin_t = value if finite_src is None else finite_src
         nonfinite = (1.0 - jnp.all(jnp.isfinite(fin_t)).astype(
             jnp.float32)) / self._n
-        sat = (saturation.astype(jnp.float32) / self._n
-               if saturation is not None else jnp.float32(0.0))
-        self._rows.append((key, sq, nonfinite, sat, saturation is not None))
+        if sat_count is not None:
+            sat, kind = sat_count.astype(jnp.float32), "count"
+        elif saturation is not None:
+            sat, kind = saturation.astype(jnp.float32) / self._n, "flag"
+        else:
+            sat, kind = jnp.float32(0.0), None
+        self._rows.append((key, sq, nonfinite, sat, kind))
 
     def finalize(self, axis_names: Sequence[str], loss,
                  inv_scale) -> Tuple[Any, Any, Dict[str, Dict[str, Any]]]:
@@ -124,8 +140,13 @@ class HealthAccumulator:
             sq = totals[i, 0] * inv2
             nf, sat = totals[i, 1], totals[i, 2]
             entry = {"finite": nf == 0, "sq_norm": sq}
-            if self._rows[i][4]:
+            kind = self._rows[i][4]
+            if kind is not None:
                 entry["saturated"] = sat > 0
+                if kind == "count":
+                    # post-quantization saturation: the global number of
+                    # elements the ring legs clipped to the wire rail.
+                    entry["sat_count"] = sat
             per_bucket[key] = entry
             bad_count = bad_count + nf + sat
             total_sq = total_sq + sq

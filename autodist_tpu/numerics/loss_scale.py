@@ -103,10 +103,12 @@ def resolve_loss_scale(spec, dtypes: Sequence[str]) -> Optional[LossScale]:
 
 def wire_dtype_of(compressor: str) -> Optional[str]:
     """The float dtype a quantizing compressor puts on the wire, or None
-    when the wire is full-precision / scale-normalized.  Int8Compressor
-    normalizes by the bucket amax before quantizing, so a large loss
-    scale cannot saturate its grid (NaN/Inf scales are caught by the
-    guard's finiteness bits instead)."""
+    when the wire is full-precision / scale-normalized.  The quantized
+    ring compressors (Int8Compressor, Fp8Compressor) normalize by the
+    per-chunk amax before quantizing, so a large loss scale cannot
+    saturate their grids — overflow there is caught by the
+    post-quantization saturation counters inside the ring legs and the
+    guard's finiteness bits, not by this pre-flight rule."""
     if compressor in ("HorovodCompressor", "HorovodCompressorEF"):
         return "bfloat16"
     return None

@@ -108,6 +108,16 @@ class AutoStrategy(StrategyBuilder):
                           PartitionedAR(), RandomAxisPartitionAR(),
                           Parallax(),
                           Zero1(compressor=self._compressor)]
+            # A quantizing compressor is an explicit accuracy opt-in, so
+            # only then does the search also weigh the fully overlapped
+            # quantized plan (one int8/fp8 collective per microbatch
+            # slot + quantized ring + param prefetch, docs/overlap.md) —
+            # on comm-bound programs with accumulation its exposed wire
+            # beats every serial schedule.
+            from autodist_tpu.kernel.synchronization import quant_ring
+            if quant_ring.is_quant_ring_compressor(self._compressor):
+                candidates.append(Zero1(compressor=self._compressor,
+                                        overlap="full"))
         best = None
         pruned = 0
         for builder in candidates:

@@ -74,14 +74,33 @@ COLLECTIVE_ALPHA = 5e-6
 # data-axis size (the arXiv:2004.13336 win beyond state memory).
 HBM_BANDWIDTH = 8.1e11
 
-# Wire-format scale factors per compressor (vs f32 gradients).
+# Wire-format scale factors per compressor (vs f32 gradients).  Every
+# SHIPPED compressor must appear here (or carry a quant_ring wire
+# format) — the unknown-compressor WARN below is reserved for names the
+# registry has never heard of.
 _COMPRESSOR_SCALE = {
     "NoneCompressor": 1.0,
     "HorovodCompressor": 0.5,
     "HorovodCompressorEF": 0.5,
     "PowerSGDCompressor": 0.25,   # rank-r factors; nominal
     "Int8Compressor": 0.25,
+    "Fp8Compressor": 0.25,        # e4m3: 1 byte/elem, like int8
 }
+
+
+def _compressor_scale(name: str) -> Optional[float]:
+    """Wire-byte factor for ``name``, or None for an unknown compressor.
+    Quantized-wire compressors fall back to their registered
+    ``quant_ring`` wire format (1-byte payload) so a newly shipped
+    format is priced without touching this table."""
+    scale = _COMPRESSOR_SCALE.get(name)
+    if scale is not None:
+        return scale
+    from autodist_tpu.kernel.synchronization import quant_ring
+    fmt = quant_ring.wire_format_of(name)
+    if fmt is not None:
+        return fmt.itemsize / 4.0
+    return None
 
 # Adam-family: 2 slot tensors per parameter (m, v) in f32.
 _OPT_SLOTS = 2
@@ -225,7 +244,7 @@ def estimate_cost(strategy: Strategy, graph_item: GraphItem,
         nbytes = info.byte_size
         sync = cfg.synchronizer
         if isinstance(sync, AllReduceSynchronizerConfig):
-            scale = _COMPRESSOR_SCALE.get(sync.compressor)
+            scale = _compressor_scale(sync.compressor)
             if scale is None:
                 logging.warning(
                     "cost model: unknown compressor %r — assuming "
@@ -364,7 +383,11 @@ def estimate_ir_cost(ir, *, ici_bandwidth: float = ICI_BANDWIDTH,
     collective that the plan-level estimate prices neutrally.
     Per-device ring-collective byte algebra: a leg's recorded
     ``nbytes`` is the full vector, scaled here by ``(d-1)/d`` per leg
-    direction (hop legs already carry per-hop bytes)."""
+    direction (hop legs already carry per-hop bytes).  Quantized legs
+    (int8/fp8 buckets) arrive with the HONEST wire size — 1-byte/elem
+    payload plus the per-chunk scale bytes per transfer, per hop for
+    ring chains — stamped by the IR builder, so the compressed wire is
+    priced exactly rather than as the f32 vector."""
     from autodist_tpu.kernel.synchronization import overlap as ov
     from autodist_tpu.kernel.synchronization import schedule_ir as sir
 

@@ -153,16 +153,26 @@ def test_compressor_on_modelonly_mesh_falls_back():
 
 
 def test_int8_compressor_unit_semantics():
-    """Exact on grid values, and the WIRE collectives are int8: the jitted
-    program's all_to_all/all_gather operate on i8 tensors (no int8-typed
-    psum/all-reduce fallback)."""
+    """Exact on per-chunk grid values, and the WIRE collectives are int8:
+    the jitted program's all_to_all/all_gather operate on i8 tensors (no
+    int8-typed psum/all-reduce fallback).
+
+    Grid-exact fixture for the per-chunk scale rule (quant_ring): every
+    device contributes ``c_d * v`` where ``v`` is integer-valued with
+    each scale block's amax pinned at 127 — every quantize event (stage
+    1 on ``c_d * v``, stage 2 on ``sum(c) * v``) then lands exactly on
+    its block grid, so the quantized mean equals the true mean."""
     from jax.sharding import Mesh
     mesh = Mesh(np.array(jax.devices()).reshape(8), ("data",))
     comp = get_compressor("Int8Compressor")
 
-    # Values exactly representable on the shared grid: max=127 -> scale 1,
-    # and the aggregated sums are also grid-exact.
-    g_local = np.tile(np.arange(-127, 127, 2, np.float32)[None], (8, 1))
+    rng = np.random.RandomState(0)
+    n, per_dev = 8, 128
+    chunk = per_dev // n                 # the all_to_all chunk length
+    v = rng.randint(-126, 127, per_dev).astype(np.float32)
+    v[::chunk] = 127.0                   # every block's amax on the rail
+    c = (2.0 ** rng.randint(-2, 3, n)).astype(np.float32)
+    g_local = c[:, None] * v[None, :]
 
     f = jax.jit(jax.shard_map(
         lambda g: comp.reduce(g, jnp.zeros_like(g), "data")[0],
@@ -179,10 +189,12 @@ def test_int8_error_feedback_carries_quantization_error():
     comp = get_compressor("Int8Compressor")
     from jax.sharding import Mesh
     mesh = Mesh(np.array(jax.devices()).reshape(8), ("data",))
-    # Off-grid interior values: max=1.0 sets the grid; 0.3 lies between
-    # steps (scale = 1/127, 0.3*127 = 38.1) -> genuine quantization error.
-    g_local = np.full((8, 8), 0.3, np.float32)
-    g_local[:, 0] = 1.0
+    # Off-grid interior values: a 1.0 in every scale chunk sets that
+    # chunk's grid (the all_to_all chunk is 64/8 = 8 elements, under the
+    # 256-element scale block); 0.3 lies between steps (scale = 1/127,
+    # 0.3*127 = 38.1) -> genuine quantization error.
+    g_local = np.full((8, 64), 0.3, np.float32)
+    g_local[:, ::8] = 1.0
 
     out, st = jax.jit(jax.shard_map(
         lambda g: comp.reduce(g, jnp.zeros_like(g), "data"),
@@ -191,10 +203,12 @@ def test_int8_error_feedback_carries_quantization_error():
                    jax.sharding.PartitionSpec("data")),
         check_vma=False))(g_local)
     st = np.asarray(st)
+    interior = np.ones(64, bool)
+    interior[::8] = False    # the 1.0 grid sentinels quantize exactly
     # residual ~ distance to the nearest grid point (|0.3 - 38/127| ~ 8e-4)
-    assert 1e-4 < np.abs(st[:, 1:]).max() < 1.0 / 127
-    np.testing.assert_allclose(np.asarray(out)[:, 1:], 0.3, rtol=2e-2)
-    np.testing.assert_allclose(np.asarray(out)[:, 0], 1.0, rtol=2e-2)
+    assert 1e-4 < np.abs(st[:, interior]).max() < 1.0 / 127
+    np.testing.assert_allclose(np.asarray(out)[:, interior], 0.3, rtol=2e-2)
+    np.testing.assert_allclose(np.asarray(out)[:, ~interior], 1.0, rtol=2e-2)
 
 
 def test_int8_compressor_converges():
