@@ -1,14 +1,38 @@
-"""Serving-side subsystem: continuous-batching decode engine + HTTP front.
+"""Serving-side subsystem: continuous-batching engines + HTTP front +
+supervised replica routing.
 
 Beyond the reference (training-only — its serving story ends at
 ``SavedModelBuilder`` export, reference ``autodist/checkpoint/
-saved_model_builder.py:24-64``): a slot-based continuous-batching
-engine over the KV-cache decode path of ``models/generate.py``, and a
-stdlib HTTP server (completions + SSE streaming + cancel + stats) in
-front of it.
+saved_model_builder.py:24-64``):
+
+* the slot-based continuous-batching :class:`DecodeEngine` over the
+  KV-cache decode path of ``models/generate.py``;
+* the paged-KV scale-out stack — :mod:`~autodist_tpu.serving.paged_kv`
+  (block pool, refcounted COW prefix trie, paged device programs) and
+  :class:`PagedDecodeEngine` (SLO-class bounded queues, block-budget
+  admission, chunked prefill, immediate slot recycling);
+* a stdlib HTTP server (completions + SSE streaming + cancel + stats +
+  Prometheus ``/metrics``) in front of either engine;
+* the :class:`Router` + :class:`SupervisedReplicaPool` pair: N
+  replicas supervised through the PR 4 resilience machinery, with
+  queue-depth/block-headroom load balancing and re-routing of
+  in-flight requests when a replica dies.
 """
-from autodist_tpu.serving.engine import DecodeEngine, EngineStats, Request
+from autodist_tpu.serving.engine import (AdmissionError, DecodeEngine,
+                                         EngineStats, Request)
+from autodist_tpu.serving.paged_kv import (BlockPool, BlockPoolExhausted,
+                                           PrefixTrie)
+from autodist_tpu.serving.scheduler import (PagedDecodeEngine,
+                                            SLO_CLASSES, SLO_LATENCY,
+                                            SLO_THROUGHPUT)
+from autodist_tpu.serving.router import (Router, RouterBusy, RouterError,
+                                         RouterRequestError,
+                                         SupervisedReplicaPool)
 from autodist_tpu.serving.server import EngineServer, serve
 
-__all__ = ["DecodeEngine", "EngineStats", "Request", "EngineServer",
+__all__ = ["AdmissionError", "DecodeEngine", "EngineStats", "Request",
+           "BlockPool", "BlockPoolExhausted", "PrefixTrie",
+           "PagedDecodeEngine", "SLO_CLASSES", "SLO_LATENCY",
+           "SLO_THROUGHPUT", "Router", "RouterBusy", "RouterError",
+           "RouterRequestError", "SupervisedReplicaPool", "EngineServer",
            "serve"]

@@ -53,6 +53,7 @@ slot (a request's whole ``prompt + max_new`` span must fit inside
 from __future__ import annotations
 
 import functools
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -75,6 +76,19 @@ TEMPERATURE_FLOOR = 1e-6
 scaled logits overflow f32 (|logit|/temp > f32 max) and the softmax
 NaNs, so ``submit`` rejects the range instead of silently clamping —
 ``temperature=0`` is the supported way to ask for greedy."""
+
+
+class AdmissionError(RuntimeError):
+    """Typed backpressure: a submit was rejected because the request
+    queue (or an SLO class's share of it) is full.  Carries a
+    ``retry_after_s`` hint derived from the observed completion rate —
+    the HTTP front surfaces this as 429 with a ``Retry-After`` header
+    (``serving/server.py``), and the router treats it as
+    route-elsewhere, not request-failed."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
 
 
 def _sample_per_slot(logits, key, temp, top_k, top_p):
@@ -315,13 +329,19 @@ class Request:
     """One decode request: ``prompt`` is a 1-D int array; the engine
     appends up to ``max_new_tokens`` (fewer if ``eos_id`` fires).
     ``temperature``/``eos_id`` override the engine defaults per request
-    (traced per-slot values — no recompiles)."""
+    (traced per-slot values — no recompiles).  ``prefix`` PINS the
+    prefix KV generation this request was submitted under —
+    ``(kp, vp, plen)`` — so ``set_prefix``/``clear_prefix`` mid-flight
+    can never swap cached context out from under an admitted request
+    (the pin holds the old arrays alive until the last reader
+    finishes)."""
     prompt: np.ndarray
     max_new_tokens: int
     request_id: int = -1
     temperature: float = 0.0
     eos_id: int = -1
     use_prefix: bool = False
+    prefix: Optional[tuple] = None    # (kp, vp, plen) pinned at submit
 
 
 @dataclass
@@ -379,7 +399,8 @@ class DecodeEngine:
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 0.0, eos_id: Optional[int] = None,
                  rng: Optional[jax.Array] = None, prefill: bool = True,
-                 mesh=None, slot_axis: str = "data"):
+                 mesh=None, slot_axis: str = "data",
+                 max_queue: int = 1024):
         require_lm_spec(spec, "DecodeEngine")
         cfg = spec.config
         if window > cfg["max_len"]:
@@ -416,6 +437,9 @@ class DecodeEngine:
         self._rng = rng if rng is not None else jax.random.PRNGKey(0)
         self._vocab = vocab
         self._prefill = bool(prefill)
+        if max_queue < 1:
+            raise ValueError("max_queue must be >= 1")
+        self._max_queue = int(max_queue)
 
         # Host-side scheduler state.
         self._queue: List[Request] = []
@@ -466,6 +490,15 @@ class DecodeEngine:
         self._kp0 = jnp.zeros((cfg["num_layers"], 1, heads, hd), pdtype)
         self._kp = self._vp = self._kp0
         self._prefix_tokens: Optional[np.ndarray] = None
+        # The prefix generation currently decoded by ACTIVE slots:
+        # admitted prefix requests all pin ONE (kp, vp, plen) tuple at a
+        # time (a request pinning a DIFFERENT generation waits in the
+        # queue until the last reader of the current one finishes), so
+        # the chunk program's single prefix input stays well-defined
+        # while set_prefix/clear_prefix swap freely mid-flight.
+        self._active_prefix: Optional[tuple] = None
+        self._active_prefix_users = 0
+        self._prefix_pin: Optional[tuple] = None
         # Set when a device dispatch raises mid-flight: the state
         # buffers were DONATED to the failed program and may be invalid,
         # so the engine refuses further use instead of decoding garbage.
@@ -539,6 +572,8 @@ class DecodeEngine:
         self._queue.clear()
         self._results.clear()
         self._slot_req = [None] * self._slots
+        self._active_prefix = None
+        self._active_prefix_users = 0
         self._alloc_state()
         self._poisoned = False
 
@@ -556,13 +591,12 @@ class DecodeEngine:
         ``submit(..., use_prefix=True)`` request attends in addition to
         its own ring window — no per-slot storage, no per-admission
         recompute.  Returns the prefix length.  Replaces any previous
-        prefix; requires an idle engine (the prefix length is a static
-        compile dimension of the in-flight programs)."""
+        prefix for FUTURE submits; requests already submitted keep the
+        generation they pinned (``Request.prefix``), so a mid-flight
+        swap can never change the context an admitted request decodes
+        against — new-generation requests simply wait in the queue
+        until the last reader of the old one finishes."""
         self._check_usable()
-        if np.any(self._active) or self._queue:
-            raise RuntimeError(
-                "set_prefix requires an idle engine (drain or reset "
-                "first): in-flight slots reference the current prefix")
         tokens = np.asarray(tokens, np.int32).reshape(-1)
         if tokens.size < 1:
             raise ValueError("prefix must have at least one token")
@@ -587,16 +621,19 @@ class DecodeEngine:
         self._kp, self._vp = kp, vp
         self._prefix_tokens = tokens
         self._knobs = (self._top_k, self._top_p, plen)
+        self._prefix_pin = (kp, vp, plen)
         return plen
 
     def clear_prefix(self) -> None:
-        """Drop the registered prefix (idle engine required)."""
+        """Drop the registered prefix for FUTURE submits.  In-flight
+        and queued requests keep their pinned generation — its K/V stay
+        referenced through the pins and are freed (ordinary array
+        refcounting) when the last reader finishes."""
         self._check_usable()
-        if np.any(self._active) or self._queue:
-            raise RuntimeError("clear_prefix requires an idle engine")
         self._kp = self._vp = self._kp0
         self._prefix_tokens = None
         self._knobs = (self._top_k, self._top_p, 0)
+        self._prefix_pin = None
 
     @property
     def prefix_len(self) -> int:
@@ -612,8 +649,18 @@ class DecodeEngine:
         request only (per-slot traced values — no recompiles); the
         top-k/top-p filters stay engine-wide.  ``use_prefix=True``
         prepends the engine's registered shared prefix (:meth:`set_prefix`)
-        as cached context — the result contains only prompt+generated."""
+        as cached context — the result contains only prompt+generated.
+
+        Raises :class:`AdmissionError` (typed backpressure, carrying a
+        ``retry_after_s`` hint) when the request queue is at
+        ``max_queue`` — the queue is bounded so a traffic spike shows
+        up as explicit rejects, not an unbounded host-memory balloon
+        with minutes-deep latency."""
         self._check_usable()
+        if len(self._queue) >= self._max_queue:
+            raise AdmissionError(
+                f"request queue full ({self._max_queue}); retry later",
+                retry_after_s=self._retry_hint())
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("prompt must have at least one token")
@@ -675,10 +722,22 @@ class DecodeEngine:
                                  f"vocab_size={self._vocab}), got {eos_id}")
         req = Request(prompt, int(max_new_tokens), self._next_id,
                       temperature=temperature, eos_id=eos_id,
-                      use_prefix=bool(use_prefix))
+                      use_prefix=bool(use_prefix),
+                      prefix=(self._prefix_pin if use_prefix else None))
         self._next_id += 1
         self._queue.append(req)
         return req.request_id
+
+    def _retry_hint(self) -> float:
+        """Retry-After estimate for a rejected submit: roughly how long
+        until the queue has drained one request (queue depth x the
+        recent per-request wall time over the slot count), clamped to
+        something a client can act on."""
+        per_req = self._avg_request_s or 1.0
+        est = (len(self._queue) + 1) * per_req / max(self._slots, 1)
+        return float(min(60.0, max(0.1, est)))
+
+    _avg_request_s: float = 0.0
 
     def run(self) -> Dict[int, np.ndarray]:
         """Decode until the queue and all slots drain; returns and
@@ -729,6 +788,7 @@ class DecodeEngine:
                 # invariants.
                 self._active[b] = False
                 self._done[b] = True
+                self._unpin_slot_prefix(b)
                 self._slot_req[b] = None
                 return True
         return False
@@ -825,12 +885,41 @@ class DecodeEngine:
         self._p_end[inactive] = 0
         self._end[inactive] = 0
 
+    def _prefix_compatible(self, req: Request) -> bool:
+        """True when admitting ``req`` now keeps the one-live-prefix
+        invariant: either no prefix generation is active, or ``req``
+        pinned exactly that generation."""
+        return (not req.use_prefix
+                or self._active_prefix is None
+                or req.prefix is self._active_prefix)
+
+    def _pin_active_prefix(self, req: Request) -> None:
+        if req.use_prefix:
+            self._active_prefix = req.prefix
+            self._active_prefix_users += 1
+
+    def _unpin_slot_prefix(self, b: int) -> None:
+        if self._use_prefix[b]:
+            self._use_prefix[b] = False
+            self._active_prefix_users -= 1
+            if self._active_prefix_users <= 0:
+                self._active_prefix_users = 0
+                self._active_prefix = None   # last reader: KV now free
+
     def _admit(self) -> None:
         prefills: List[tuple] = []        # deferred (slot, req) pairs
         for b in range(self._slots):
             if self._active[b] or not self._queue:
                 continue
+            if not self._prefix_compatible(self._queue[0]):
+                # Strict FIFO: the head pinned a different prefix
+                # generation than the active readers'; it (and everyone
+                # behind it) waits until the last old-generation reader
+                # finishes.
+                break
             req = self._queue.pop(0)      # FIFO: head always fits
+            self._pin_active_prefix(req)
+            req.t_admit = time.monotonic()
             p = req.prompt.size
             t0 = self._tick
             if self._prefill:
@@ -976,11 +1065,14 @@ class DecodeEngine:
     def _dispatch_args(self, with_prefix: bool):
         """(knobs, kp, vp) for one compiled-program dispatch — the ONE
         place encoding the compile-cache-key contract: prefix-touching
-        dispatches carry the registered plen + real K/V, all others the
-        plen=0 knobs + dummies so their cache key is independent of any
+        dispatches carry the ACTIVE readers' pinned plen + K/V (which
+        may be an older generation than the currently registered
+        prefix — the mid-flight-swap guarantee), all others the plen=0
+        knobs + dummies so their cache key is independent of any
         registered prefix."""
         if with_prefix:
-            return self._knobs, self._kp, self._vp
+            kp, vp, plen = self._active_prefix
+            return (self._top_k, self._top_p, plen), kp, vp
         return (self._top_k, self._top_p, 0), self._kp0, self._kp0
 
     def _prompt_bucket(self, prompt_size: int) -> int:
@@ -1014,7 +1106,13 @@ class DecodeEngine:
             self.stats.completed += 1
             self._results[req.request_id] = seq
             self._active[b] = False
+            self._unpin_slot_prefix(b)
             self._slot_req[b] = None
+            wall = time.monotonic() - getattr(req, "t_admit", 0.0)
+            if 0.0 < wall < 3600.0:
+                self._avg_request_s = (0.8 * self._avg_request_s
+                                       + 0.2 * wall
+                                       if self._avg_request_s else wall)
 
     def _run_chunk(self) -> None:
         n = self._chunk       # ring: no window clamp (writes wrap)

@@ -1,0 +1,490 @@
+"""Supervised replica pool + request router: replica death is a
+routing event, not an outage.
+
+The PR 4 resilience story for training — a worker kill becomes a
+supervised relaunch with exact resume — applied to serving:
+
+* :class:`SupervisedReplicaPool` runs N serving replicas, each launched
+  through the PR 4 :class:`~autodist_tpu.resilience.supervisor.Supervisor`
+  in its own watch thread: the replica process is health-watched
+  (process exit + heartbeat beacons, so a WEDGED replica — alive but
+  stuck — is treated exactly like a dead one), terminated when bad, and
+  relaunched with jittered backoff under the supervisor's restart
+  budget.  Each attempt binds a fresh port and publishes it through an
+  address file, so the pool's endpoints survive relaunches.
+* :class:`Router` load-balances completions across live replicas by
+  queue depth and block-pool headroom (the scheduler's
+  ``/v1/stats`` surface), and re-routes on failure: a replica that
+  refuses connections, times out, answers 503, or whose beacon verdict
+  goes DEAD/WEDGED has its in-flight requests resubmitted to another
+  live replica.  Re-admission recomputes prefix-cache state on the new
+  replica (the trie warms itself); with greedy decode the re-routed
+  output is token-identical to an uninterrupted run — the live drill
+  in ``tests/test_serving_router.py`` pins it.
+* 429 (:class:`~autodist_tpu.serving.engine.AdmissionError` surfaced by
+  the replica) means route-elsewhere; only when EVERY live replica is
+  at admission capacity does the router surface
+  :class:`RouterBusy` with the largest ``Retry-After`` hint.
+
+The router speaks the replicas' HTTP surface (``serving/server.py``)
+through a tiny stdlib client, but takes any duck-typed endpoint —
+the unit tests drive it with in-process fakes; the drill uses real
+subprocess replicas.
+"""
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from autodist_tpu.telemetry.registry import MetricsRegistry, \
+    render_prometheus
+from autodist_tpu.utils import logging
+
+
+class RouterError(RuntimeError):
+    """No live replica could serve the request."""
+
+
+class RouterBusy(RouterError):
+    """Every live replica rejected with 429; retry after the hint."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
+
+
+class RouterRequestError(RuntimeError):
+    """The request itself is bad (4xx other than 429): re-routing
+    would fail identically, so the error propagates with the replica's
+    status and body."""
+
+    def __init__(self, status: int, body: Dict[str, Any]):
+        super().__init__(f"replica answered {status}: "
+                         f"{body.get('error', body)}")
+        self.status = int(status)
+        self.body = body
+
+
+class HTTPReplicaClient:
+    """Minimal stdlib client for one EngineServer-compatible replica."""
+
+    def __init__(self, host: str, port: int):
+        self.host, self.port = host, int(port)
+
+    def _request(self, method: str, path: str,
+                 body: Optional[dict] = None,
+                 timeout: float = 30.0) -> Tuple[int, Any]:
+        conn = http.client.HTTPConnection(self.host, self.port,
+                                          timeout=timeout)
+        try:
+            payload = json.dumps(body) if body is not None else None
+            conn.request(method, path, payload,
+                         {"Content-Type": "application/json"}
+                         if payload else {})
+            resp = conn.getresponse()
+            raw = resp.read()
+            headers = dict(resp.getheaders())
+            try:
+                data = json.loads(raw) if raw else {}
+            except ValueError:
+                data = {"raw": raw.decode(errors="replace")}
+            if isinstance(data, dict):
+                data["_headers"] = headers
+            return resp.status, data
+        finally:
+            conn.close()
+
+    def post_completion(self, body: dict,
+                        timeout: float = 120.0) -> Tuple[int, dict]:
+        return self._request("POST", "/v1/completions", body, timeout)
+
+    def stats(self, timeout: float = 5.0) -> dict:
+        status, data = self._request("GET", "/v1/stats", timeout=timeout)
+        if status != 200:
+            raise OSError(f"stats answered {status}")
+        return data
+
+    def healthz(self, timeout: float = 2.0) -> bool:
+        try:
+            status, data = self._request("GET", "/healthz",
+                                         timeout=timeout)
+        except OSError:
+            return False
+        return status == 200 and bool(data.get("ok"))
+
+
+@dataclass
+class ReplicaEndpoint:
+    """One replica as the router sees it: a (relaunch-stable) address
+    file plus optional heartbeat beacons.  ``address_file`` holds
+    ``{"host": ..., "port": ...}`` rewritten by every attempt; the
+    endpoint re-reads it when its mtime changes, so a relaunched
+    replica on a fresh port is picked up without router restarts."""
+
+    name: str
+    address_file: str
+    beacon_dir: Optional[str] = None
+    beacon_timeout: float = 10.0
+    _client: Optional[HTTPReplicaClient] = field(default=None, repr=False)
+    _mtime: float = field(default=0.0, repr=False)
+    _monitor: Any = field(default=None, repr=False)
+
+    def client(self) -> Optional[HTTPReplicaClient]:
+        try:
+            mtime = os.stat(self.address_file).st_mtime
+        except OSError:
+            return None
+        if self._client is None or mtime != self._mtime:
+            try:
+                with open(self.address_file, encoding="utf-8") as f:
+                    addr = json.load(f)
+                self._client = HTTPReplicaClient(addr["host"],
+                                                 addr["port"])
+                self._mtime = mtime
+            except (OSError, ValueError, KeyError):
+                return None
+        return self._client
+
+    def beacon_verdict(self) -> Optional[str]:
+        """DEAD/WEDGED verdict from the replica's heartbeat beacons
+        (None = healthy or no beacons configured)."""
+        if self.beacon_dir is None:
+            return None
+        if self._monitor is None:
+            from autodist_tpu.resilience.heartbeat import HeartbeatMonitor
+
+            self._monitor = HeartbeatMonitor(self.beacon_dir,
+                                             timeout=self.beacon_timeout)
+        from autodist_tpu.resilience.heartbeat import DEAD, WEDGED
+
+        for health in self._monitor.status().values():
+            if health.state in (DEAD, WEDGED):
+                return health.state
+        return None
+
+    # -- the duck-typed surface Router consumes ------------------------
+    def probe(self, timeout: float = 2.0) -> bool:
+        if self.beacon_verdict() is not None:
+            return False
+        cli = self.client()
+        return cli is not None and cli.healthz(timeout=timeout)
+
+    def fetch_stats(self) -> Optional[dict]:
+        cli = self.client()
+        if cli is None:
+            return None
+        try:
+            return cli.stats()
+        except OSError:
+            return None
+
+    def post(self, body: dict, timeout: float) -> Tuple[int, dict]:
+        cli = self.client()
+        if cli is None:
+            raise OSError(f"{self.name}: no address published")
+        return cli.post_completion(body, timeout=timeout)
+
+
+class Router:
+    """Load-balancing, re-routing front over a set of endpoints.
+
+    ``endpoints`` need ``name``, ``probe()``, ``fetch_stats()`` and
+    ``post(body, timeout)`` (raising ``OSError`` on transport failure)
+    — :class:`ReplicaEndpoint` for real replicas, fakes in the unit
+    tests.  Load scoring prefers shallow queues and block headroom::
+
+        score = outstanding + queue_depth_total
+                + occupancy_weight * block_occupancy
+
+    Routing policy per request: try live replicas in score order; on
+    transport failure or 5xx mark the replica down (it re-enters
+    rotation when a later probe passes) and try the next; on 429
+    remember the Retry-After hint and try the next; other 4xx raise
+    :class:`RouterRequestError` without re-routing."""
+
+    def __init__(self, endpoints: Sequence[Any], *,
+                 probe_ttl_s: float = 1.0, stats_ttl_s: float = 0.25,
+                 occupancy_weight: float = 4.0,
+                 max_attempts: Optional[int] = None,
+                 retry_wait_s: float = 0.25):
+        if not endpoints:
+            raise ValueError("Router needs at least one endpoint")
+        self._eps = list(endpoints)
+        self._probe_ttl = float(probe_ttl_s)
+        self._stats_ttl = float(stats_ttl_s)
+        self._occ_w = float(occupancy_weight)
+        self._max_attempts = (max_attempts if max_attempts is not None
+                              else 2 * len(self._eps) + 2)
+        self._retry_wait = float(retry_wait_s)
+        self._lock = threading.Lock()
+        self._down_until: Dict[str, float] = {}
+        self._probed: Dict[str, Tuple[float, bool]] = {}
+        self._scores: Dict[str, Tuple[float, float]] = {}
+        self._inflight: Dict[str, int] = {}
+        self.registry = MetricsRegistry()
+        self._m_routed = {}
+        self._m_reroutes = self.registry.counter(
+            "autodist_router_reroutes_total",
+            "requests re-routed after a replica failure")
+        self._m_busy = self.registry.counter(
+            "autodist_router_busy_rejects_total",
+            "requests rejected because every live replica was at "
+            "admission capacity")
+        self._m_live = self.registry.gauge(
+            "autodist_router_live_replicas",
+            "replicas passing their latest health probe")
+
+    # -- health / scoring --------------------------------------------------
+    def _alive(self, ep) -> bool:
+        now = time.monotonic()
+        with self._lock:
+            if self._down_until.get(ep.name, 0.0) > now:
+                return False
+            ts, ok = self._probed.get(ep.name, (0.0, False))
+            if now - ts < self._probe_ttl:
+                return ok
+        ok = bool(ep.probe())
+        with self._lock:
+            self._probed[ep.name] = (time.monotonic(), ok)
+            if ok:
+                self._down_until.pop(ep.name, None)
+        return ok
+
+    def mark_down(self, ep, hold_s: float = 2.0) -> None:
+        with self._lock:
+            self._down_until[ep.name] = time.monotonic() + hold_s
+            self._probed.pop(ep.name, None)
+
+    def _score(self, ep) -> float:
+        now = time.monotonic()
+        with self._lock:
+            ts, score = self._scores.get(ep.name, (0.0, 0.0))
+            inflight = self._inflight.get(ep.name, 0)
+            if now - ts < self._stats_ttl:
+                return score + inflight
+        st = ep.fetch_stats() or {}
+        score = float(st.get("outstanding", 0))
+        score += float(st.get("queue_depth_total", 0))
+        score += self._occ_w * float(st.get("block_occupancy", 0.0))
+        with self._lock:
+            self._scores[ep.name] = (time.monotonic(), score)
+            inflight = self._inflight.get(ep.name, 0)
+        return score + inflight
+
+    def live_replicas(self) -> List[Any]:
+        live = [ep for ep in self._eps if self._alive(ep)]
+        self._m_live.set(len(live))
+        return live
+
+    # -- routing -----------------------------------------------------------
+    def complete(self, body: dict, *, timeout_s: float = 120.0) -> dict:
+        """Route one completion; returns the replica's 200 payload.
+        Blocks its caller like a replica-local request would — the
+        caller's thread IS the in-flight state, which is what makes
+        re-routing safe: a failed attempt leaves nothing behind on the
+        dead replica that the retry could double-serve."""
+        deadline = time.monotonic() + timeout_s
+        tried_busy: Dict[str, float] = {}
+        attempts = 0
+        first = True
+        while attempts < self._max_attempts \
+                and time.monotonic() < deadline:
+            candidates = [ep for ep in self.live_replicas()
+                          if ep.name not in tried_busy]
+            if not candidates and tried_busy:
+                self._m_busy.inc()
+                raise RouterBusy(
+                    "every live replica is at admission capacity",
+                    retry_after_s=max(tried_busy.values()))
+            if not candidates:
+                attempts += 1
+                time.sleep(self._retry_wait)   # a relaunch may be coming
+                continue
+            ep = min(candidates, key=self._score)
+            attempts += 1
+            if not first:
+                self._m_reroutes.inc()
+            first = False
+            with self._lock:
+                self._inflight[ep.name] = \
+                    self._inflight.get(ep.name, 0) + 1
+            try:
+                status, payload = ep.post(
+                    body, timeout=max(deadline - time.monotonic(), 1.0))
+            except OSError as e:
+                logging.warning("router: replica %s failed mid-request "
+                                "(%s) — re-routing", ep.name, e)
+                self.mark_down(ep)
+                continue
+            finally:
+                with self._lock:
+                    self._inflight[ep.name] = \
+                        max(self._inflight.get(ep.name, 1) - 1, 0)
+            if status == 200:
+                self._routed_counter(ep).inc()
+                return payload
+            if status == 429:
+                retry = _retry_after(payload)
+                tried_busy[ep.name] = retry
+                continue
+            if 500 <= status < 600 or status == 503:
+                logging.warning("router: replica %s answered %d — "
+                                "re-routing", ep.name, status)
+                self.mark_down(ep)
+                continue
+            raise RouterRequestError(status, payload)
+        raise RouterError(
+            f"no live replica served the request after {attempts} "
+            f"attempt(s)")
+
+    def _routed_counter(self, ep):
+        c = self._m_routed.get(ep.name)
+        if c is None:
+            c = self.registry.counter(
+                "autodist_router_requests_total",
+                "completions served, by replica",
+                labels={"replica": ep.name})
+            self._m_routed[ep.name] = c
+        return c
+
+    def render_metrics(self) -> str:
+        return render_prometheus(self.registry)
+
+    def merged_replica_stats(self) -> Dict[str, Any]:
+        """Per-replica ``/v1/stats`` snapshots keyed by name (the
+        fleet-level observability roll-up; histograms merge exactly on
+        the replicas' fixed bounds — docs/observability.md)."""
+        return {ep.name: ep.fetch_stats() for ep in self._eps}
+
+
+def _retry_after(payload: dict) -> float:
+    headers = payload.get("_headers") or {}
+    for k, v in headers.items():
+        if k.lower() == "retry-after":
+            try:
+                return float(v)
+            except ValueError:
+                break
+    return float(payload.get("retry_after_s", 1.0))
+
+
+# ---------------------------------------------------------------------------
+# supervised replica pool
+# ---------------------------------------------------------------------------
+
+class SupervisedReplicaPool:
+    """N serving replicas, each under its own PR 4 Supervisor.
+
+    ``launch(replica_index, attempt)`` starts one replica attempt and
+    returns its ``subprocess.Popen`` (launched with
+    ``start_new_session=True`` so straggler process groups die with
+    it).  The replica must write ``{"host":..., "port":...}`` to
+    ``address_file(replica_index)`` once it listens, and should write
+    heartbeat beacons into ``attempt.heartbeat_dir`` — the supervisor
+    then applies the training-side failure taxonomy: process exit,
+    stale-beacon DEAD, fresh-beacon-no-progress WEDGED.
+
+    A healthy serving replica never exits, so each supervisor's
+    ``run()`` blocks in its watch loop for the pool's lifetime — each
+    runs on a daemon thread.  ``stop()`` flips a flag that makes the
+    next relaunch a no-op process exiting 0 (a clean completion ends
+    the supervisor loop), then terminates the current replicas."""
+
+    def __init__(self, n: int, launch, workdir: str, *,
+                 policy=None):
+        from autodist_tpu.resilience.supervisor import SupervisorPolicy
+
+        if n < 1:
+            raise ValueError("need n >= 1 replicas")
+        self._n = n
+        self._launch = launch
+        self._workdir = workdir
+        self._policy = policy or SupervisorPolicy(
+            max_restarts=8, heartbeat_timeout=10.0, poll_interval=0.2)
+        self._stopping = False
+        self._threads: List[threading.Thread] = []
+        self._procs: Dict[int, Any] = {}
+        self._supervisors: List[Any] = []
+        os.makedirs(workdir, exist_ok=True)
+
+    def address_file(self, index: int) -> str:
+        return os.path.join(self._workdir, f"replica_{index}.addr.json")
+
+    def beacon_dir(self, index: int) -> str:
+        return os.path.join(self._workdir, f"replica_{index}_hb")
+
+    def endpoints(self) -> List[ReplicaEndpoint]:
+        return [ReplicaEndpoint(
+                    name=f"replica-{i}",
+                    address_file=self.address_file(i),
+                    beacon_dir=self.beacon_dir(i),
+                    beacon_timeout=(self._policy.heartbeat_timeout
+                                    or 10.0))
+                for i in range(self._n)]
+
+    def current_proc(self, index: int):
+        """The replica's current attempt process (for drills that kill
+        it)."""
+        return self._procs.get(index)
+
+    def start(self) -> "SupervisedReplicaPool":
+        from autodist_tpu.resilience.supervisor import Supervisor
+
+        for i in range(self._n):
+            sup = Supervisor(
+                self._policy, hosts=[f"replica-{i}"],
+                workdir=os.path.join(self._workdir, f"sup_{i}"))
+            self._supervisors.append(sup)
+
+            def run(i=i, sup=sup):
+                def launch_attempt(attempt):
+                    if self._stopping:
+                        import subprocess
+                        import sys
+                        return subprocess.Popen(
+                            [sys.executable, "-c", "pass"])
+                    # beacons live at a pool-stable path (the router's
+                    # monitors watch one directory per replica, across
+                    # attempts)
+                    attempt.heartbeat_dir = self.beacon_dir(i)
+                    os.makedirs(attempt.heartbeat_dir, exist_ok=True)
+                    proc = self._launch(i, attempt)
+                    self._procs[i] = proc
+                    return proc
+
+                report = sup.run(launch_attempt)
+                if not report.ok and not self._stopping:
+                    logging.error(
+                        "replica pool: replica %d exhausted its restart "
+                        "budget (%s)", i, report.gave_up)
+
+            t = threading.Thread(target=run, daemon=True,
+                                 name=f"replica-supervisor-{i}")
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self, timeout: float = 20.0) -> None:
+        import signal
+
+        self._stopping = True
+        for proc in self._procs.values():
+            if proc is not None and proc.poll() is None:
+                try:
+                    os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
+                except (ProcessLookupError, PermissionError, OSError):
+                    proc.terminate()
+        deadline = time.monotonic() + timeout
+        for t in self._threads:
+            t.join(timeout=max(deadline - time.monotonic(), 0.1))
+
+    def __enter__(self) -> "SupervisedReplicaPool":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

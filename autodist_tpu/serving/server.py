@@ -42,7 +42,7 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
-from autodist_tpu.serving.engine import DecodeEngine
+from autodist_tpu.serving.engine import AdmissionError, DecodeEngine
 from autodist_tpu.telemetry.registry import (
     DEPTH_BUCKETS,
     MetricsRegistry,
@@ -140,6 +140,37 @@ class EngineServer:
             "completion requests failed/cancelled/timed out")
         self._m_outstanding = self._registry.gauge(
             "autodist_serving_outstanding", "requests currently in flight")
+        # Scheduler-backed engines (PagedDecodeEngine) report richer
+        # latency + occupancy telemetry: time-to-first-token and
+        # inter-token latency histograms (fixed bounds — multi-replica
+        # scrapes merge exactly) fed from the engine's per-request
+        # timings, plus live block-pool / queue-depth gauges refreshed
+        # by the driver loop.
+        self._paged = hasattr(engine, "scheduler_stats")
+        if self._paged:
+            self._m_ttft = self._registry.histogram(
+                "autodist_serving_ttft_seconds",
+                "submit to first generated token", buckets=TIME_BUCKETS)
+            self._m_itl = self._registry.histogram(
+                "autodist_serving_per_token_seconds",
+                "mean inter-token latency after the first token",
+                buckets=TIME_BUCKETS)
+            self._m_queue_wait = self._registry.histogram(
+                "autodist_serving_queue_wait_seconds",
+                "submit to admission (slot + blocks assigned)",
+                buckets=TIME_BUCKETS)
+            self._m_occupancy = self._registry.gauge(
+                "autodist_serving_block_occupancy",
+                "fraction of the paged KV block pool in use")
+            self._m_prefix_rate = self._registry.gauge(
+                "autodist_serving_prefix_hit_rate",
+                "fraction of prompt tokens served from the prefix cache")
+            self._m_class_depth = {
+                c: self._registry.gauge(
+                    "autodist_serving_queue_depth_class",
+                    "admission queue depth by SLO class",
+                    labels={"slo": c})
+                for c in ("latency", "throughput")}
 
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
@@ -209,6 +240,8 @@ class EngineServer:
                         ev = self._events.pop(rid, None)
                         if ev is not None:
                             ev.set()
+                if self._paged:
+                    self._observe_paged()
                 if self._engine_error is not None:
                     # In-flight work is lost (donated buffers); fail the
                     # waiters loudly rather than hang them to timeout.
@@ -220,6 +253,22 @@ class EngineServer:
             if self._handler_waiters:
                 time.sleep(0.001)   # hand the lock to a waiting handler
 
+    def _observe_paged(self) -> None:
+        """Fold the scheduler's per-request timings and live occupancy
+        into the server registry (driver thread, under the lock)."""
+        for timing in self._engine.pop_timings().values():
+            self._m_ttft.observe(timing["ttft_s"])
+            self._m_queue_wait.observe(timing["queue_wait_s"])
+            if timing.get("per_token_s"):
+                self._m_itl.observe(timing["per_token_s"])
+        sched = self._engine.scheduler_stats()
+        self._m_occupancy.set(sched["block_occupancy"])
+        self._m_prefix_rate.set(sched["prefix_hit_rate"])
+        for c, depth in sched["queue_depth"].items():
+            g = self._m_class_depth.get(c)
+            if g is not None:
+                g.set(depth)
+
     # -- request plumbing (called from handler threads) --------------------
 
     def _locked(self):
@@ -229,15 +278,20 @@ class EngineServer:
 
     def _submit(self, prompt: np.ndarray, max_new: int,
                 temperature=None, eos_id=None,
-                use_prefix: bool = False) -> int:
+                use_prefix: bool = False, slo: Optional[str] = None) -> int:
         with self._locked():
             if self._stop or self._engine_error is not None:
                 raise _Unavailable()
             self._m_queue.observe(float(len(self._outstanding)))
-            rid = self._engine.submit(prompt, max_new,
-                                      temperature=temperature,
-                                      eos_id=eos_id,
-                                      use_prefix=use_prefix)
+            kwargs = dict(temperature=temperature, eos_id=eos_id,
+                          use_prefix=use_prefix)
+            if slo is not None:
+                if not self._paged:
+                    raise ValueError(
+                        "this server's engine has no SLO classes "
+                        "(slot engine); drop the slo field")
+                kwargs["slo"] = slo
+            rid = self._engine.submit(prompt, max_new, **kwargs)
             self._outstanding.add(rid)
             self._m_outstanding.set(len(self._outstanding))
             self._events[rid] = threading.Event()
@@ -325,6 +379,16 @@ class EngineServer:
             if p50 is not None:
                 st["latency_p50_ms"] = round(p50 * 1e3, 3)
                 st["latency_p99_ms"] = round(p99 * 1e3, 3)
+            if self._paged:
+                # scheduler surface: per-class queue depth, block-pool
+                # occupancy, prefix hit rate (the router's load score
+                # reads these)
+                st.update(self._engine.scheduler_stats())
+                p50 = self._m_ttft.percentile(0.5)
+                if p50 is not None:
+                    st["ttft_p50_ms"] = round(p50 * 1e3, 3)
+                    st["ttft_p99_ms"] = round(
+                        self._m_ttft.percentile(0.99) * 1e3, 3)
             return st
 
     def render_metrics(self) -> str:
@@ -399,11 +463,14 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):   # noqa: N802 (stdlib name)
         logging.debug("EngineServer http: " + fmt, *args)
 
-    def _json(self, code: int, payload: Dict[str, Any]) -> None:
+    def _json(self, code: int, payload: Dict[str, Any],
+              headers: Optional[Dict[str, str]] = None) -> None:
         data = json.dumps(payload).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(data)
 
@@ -473,10 +540,24 @@ class _Handler(BaseHTTPRequestHandler):
             use_prefix = body.get("use_prefix", False)
             if type(use_prefix) is not bool:
                 raise ValueError("use_prefix must be a bool")
+            slo = body.get("slo")
+            if slo is not None and not isinstance(slo, str):
+                raise ValueError("slo must be a string")
             rid = srv._submit(prompt, max_new, temperature=temperature,
-                              eos_id=eos_id, use_prefix=use_prefix)
+                              eos_id=eos_id, use_prefix=use_prefix,
+                              slo=slo)
         except _Unavailable:
             self._json(503, {"error": "engine unavailable"})
+            return
+        except AdmissionError as e:
+            # Typed backpressure: the bounded queue rejected the
+            # request.  429 + Retry-After so well-behaved clients (and
+            # the router) back off or route elsewhere instead of
+            # piling on.
+            srv.count_request(served=False)
+            retry = max(round(e.retry_after_s, 3), 0.1)
+            self._json(429, {"error": str(e), "retry_after_s": retry},
+                       headers={"Retry-After": str(int(retry) + 1)})
             return
         except ValueError as e:   # engine/body validation, loud and typed
             srv.count_request(served=False)
@@ -582,10 +663,13 @@ class _Handler(BaseHTTPRequestHandler):
 
 def serve(spec, params, *, host: str = "127.0.0.1", port: int = 8000,
           tokenizer=None, prefix_tokens=None, prefix_text=None,
-          **engine_kwargs) -> EngineServer:
-    """Build a :class:`DecodeEngine` over ``(spec, params)`` and start an
-    :class:`EngineServer` on it.  ``engine_kwargs`` pass through to the
-    engine (slots, window, chunk, sampling knobs, mesh, ...).  A
+          paged: bool = False, **engine_kwargs) -> EngineServer:
+    """Build an engine over ``(spec, params)`` and start an
+    :class:`EngineServer` on it.  ``paged=True`` selects the
+    paged-KV continuous-batching :class:`PagedDecodeEngine`
+    (``serving/scheduler.py``: SLO queues, prefix trie, block pool);
+    the default stays the slot engine.  ``engine_kwargs`` pass through
+    to the engine (slots, window, chunk, sampling knobs, mesh, ...).  A
     tokenizer with a registered ``<eos>`` special token supplies the
     engine's ``eos_id`` automatically (explicit ``eos_id=`` wins).
     ``prefix_tokens`` (ids) or ``prefix_text`` (tokenizer required)
@@ -595,7 +679,12 @@ def serve(spec, params, *, host: str = "127.0.0.1", port: int = 8000,
         eos = getattr(tokenizer, "eos_id", None)
         if eos is not None:
             engine_kwargs["eos_id"] = int(eos)
-    eng = DecodeEngine(spec, params, **engine_kwargs)
+    if paged:
+        from autodist_tpu.serving.scheduler import PagedDecodeEngine
+
+        eng = PagedDecodeEngine(spec, params, **engine_kwargs)
+    else:
+        eng = DecodeEngine(spec, params, **engine_kwargs)
     if prefix_text is not None:
         if tokenizer is None:
             raise ValueError("prefix_text needs a tokenizer; pass "

@@ -1,0 +1,228 @@
+"""Block allocator + prefix trie invariants (host-side, no device).
+
+The paged serving stack's correctness rests on these: alloc/free/
+refcount/COW bookkeeping, pool-exhaustion watermark behavior, and the
+trie's hit/miss/LRU-eviction rules (only full blocks cache, a match
+never covers the whole prompt, eviction only touches blocks no request
+pins).
+"""
+import numpy as np
+import pytest
+
+from autodist_tpu.serving.paged_kv import (SCRATCH_BLOCK, BlockPool,
+                                           BlockPoolExhausted, PrefixTrie)
+
+pytestmark = pytest.mark.serving
+
+
+# ---------------------------------------------------------------------------
+# BlockPool
+# ---------------------------------------------------------------------------
+
+def test_pool_alloc_free_roundtrip():
+    pool = BlockPool(num_blocks=9, block_size=4)
+    assert pool.capacity == 8 and pool.free_count == 8
+    blocks = pool.alloc(5)
+    assert len(blocks) == len(set(blocks)) == 5
+    assert SCRATCH_BLOCK not in blocks
+    assert pool.free_count == 3 and pool.used_count == 5
+    assert pool.occupancy() == pytest.approx(5 / 8)
+    for b in blocks:
+        assert pool.refcount(b) == 1
+        assert pool.release(b)          # last ref -> freed
+    assert pool.free_count == 8
+    pool.verify()
+    assert pool.stats.allocs == 5 and pool.stats.frees == 5
+    assert pool.stats.high_water == 5
+
+
+def test_pool_refcount_sharing():
+    pool = BlockPool(num_blocks=5, block_size=4)
+    (b,) = pool.alloc(1)
+    pool.retain(b)                      # a second reader (trie or request)
+    assert pool.refcount(b) == 2
+    assert not pool.release(b)          # first release keeps it alive
+    assert pool.refcount(b) == 1
+    assert pool.release(b)              # last reader frees
+    pool.verify()
+    with pytest.raises(ValueError, match="double free"):
+        pool.release(b)
+    with pytest.raises(ValueError, match="unallocated"):
+        pool.retain(b)
+
+
+def test_pool_all_or_nothing_exhaustion():
+    pool = BlockPool(num_blocks=4, block_size=2)     # capacity 3
+    held = pool.alloc(2)
+    free_before = pool.free_count
+    with pytest.raises(BlockPoolExhausted, match="need 2 blocks"):
+        pool.alloc(2)
+    # failed alloc leaked nothing
+    assert pool.free_count == free_before
+    assert pool.stats.exhaustions == 1
+    for b in held:
+        pool.release(b)
+    pool.verify()
+
+
+def test_pool_cow_semantics():
+    pool = BlockPool(num_blocks=6, block_size=4)
+    (b,) = pool.alloc(1)
+    # exclusively held: write in place, no copy
+    same, copied = pool.cow(b)
+    assert same == b and not copied
+    # shared: the writer gets a fresh block, the shared one keeps the
+    # other reader's reference
+    pool.retain(b)
+    fresh, copied = pool.cow(b)
+    assert copied and fresh != b
+    assert pool.refcount(b) == 1        # the other reader
+    assert pool.refcount(fresh) == 1    # the writer
+    assert pool.stats.cow_copies == 1
+    pool.release(b)
+    pool.release(fresh)
+    pool.verify()
+
+
+def test_pool_scratch_block_reserved():
+    pool = BlockPool(num_blocks=3, block_size=2)
+    blocks = pool.alloc(2)              # the whole capacity
+    assert SCRATCH_BLOCK not in blocks
+    with pytest.raises(ValueError, match="scratch"):
+        pool.release(SCRATCH_BLOCK)
+    for b in blocks:
+        pool.release(b)
+
+
+def test_pool_verify_catches_leak():
+    pool = BlockPool(num_blocks=4, block_size=2)
+    (b,) = pool.alloc(1)
+    pool._refs[b] = 0                   # corrupt: held but refcount 0
+    with pytest.raises(AssertionError, match="leaked"):
+        pool.verify()
+
+
+# ---------------------------------------------------------------------------
+# PrefixTrie
+# ---------------------------------------------------------------------------
+
+def _tokens(*chunks):
+    return np.concatenate([np.asarray(c, np.int32) for c in chunks])
+
+
+def test_trie_insert_match_roundtrip():
+    pool = BlockPool(num_blocks=20, block_size=4)
+    trie = PrefixTrie(pool)
+    prompt = np.arange(11, dtype=np.int32)          # 2 full blocks + 3
+    table = pool.alloc(pool.blocks_for_tokens(11 + 4))
+    assert trie.insert(prompt, table) == 2          # only full blocks
+    assert len(trie) == 2
+    # the cached blocks now carry the trie's reference too
+    assert pool.refcount(table[0]) == 2
+    assert pool.refcount(table[2]) == 1             # partial tail: not cached
+
+    n, blocks = trie.match(prompt)
+    assert n == 8 and blocks == table[:2]
+    assert pool.refcount(table[0]) == 3             # +1 for the matcher
+    for b in blocks:
+        pool.release(b)
+    # a diverging prompt matches only the shared prefix
+    other = _tokens(np.arange(4), [9, 9, 9, 9], [1, 2])
+    n, blocks = trie.match(other)
+    assert n == 4 and blocks == table[:1]
+    pool.release(blocks[0])
+    assert trie.stats.lookup_hits == 2
+    # miss: nothing cached under a different first block
+    n, blocks = trie.match(np.full(9, 7, np.int32))
+    assert n == 0 and blocks == []
+    for b in table:
+        pool.release(b)
+    pool.verify()
+
+
+def test_trie_match_never_covers_whole_prompt():
+    """A block-aligned fully-cached prompt still leaves >= 1 suffix
+    token to prefill (the program needs a position to sample from, and
+    it keeps every write off shared blocks)."""
+    pool = BlockPool(num_blocks=20, block_size=4)
+    trie = PrefixTrie(pool)
+    prompt = np.arange(8, dtype=np.int32)           # exactly 2 blocks
+    table = pool.alloc(3)
+    trie.insert(prompt, table)
+    assert len(trie) == 1                           # (8-1)//4 = 1 block
+    n, blocks = trie.match(prompt)
+    assert n == 4                                   # never 8
+    pool.release(blocks[0])
+    for b in table:
+        pool.release(b)
+
+
+def test_trie_lru_eviction_skips_pinned():
+    pool = BlockPool(num_blocks=8, block_size=2)    # capacity 7
+    trie = PrefixTrie(pool)
+    # two cached chains of 2 blocks each (prompts of 5 tokens)
+    t1 = pool.alloc(3)
+    trie.insert(np.arange(5, dtype=np.int32), t1)
+    t2 = pool.alloc(3)
+    trie.insert(np.arange(10, 15, dtype=np.int32), t2)
+    for b in t1 + t2:                               # requests finished
+        pool.release(b)
+    assert pool.used_count == 4 and len(trie) == 4
+    # chain 1 is older; pin its blocks as an in-flight reader would
+    n, pinned = trie.match(np.arange(5, dtype=np.int32))
+    assert n == 4
+    # evicting 4 can only take chain 2 (leaf-first) — chain 1 is pinned
+    freed = trie.evict(4)
+    assert freed == 2
+    assert trie.stats.evictions == 2
+    for b in pinned:
+        assert pool.refcount(b) >= 1                # still alive
+        pool.release(b)
+    # unpinned now: leaf-first eviction clears the rest
+    assert trie.evict(4) == 2
+    assert len(trie) == 0
+    pool.verify()
+    assert pool.used_count == 0
+
+
+def test_trie_lru_order():
+    pool = BlockPool(num_blocks=10, block_size=2)
+    trie = PrefixTrie(pool)
+    a = pool.alloc(2)
+    trie.insert(np.arange(3, dtype=np.int32), a)          # chain A
+    b = pool.alloc(2)
+    trie.insert(np.arange(10, 13, dtype=np.int32), b)     # chain B
+    for blk in a + b:
+        pool.release(blk)
+    # touch A so B becomes LRU
+    n, pinned = trie.match(np.arange(3, dtype=np.int32))
+    for blk in pinned:
+        pool.release(blk)
+    assert trie.evict(1) == 1
+    # B's block went; A still matches
+    n, pinned = trie.match(np.arange(3, dtype=np.int32))
+    assert n == 2
+    for blk in pinned:
+        pool.release(blk)
+    n, none = trie.match(np.arange(10, 13, dtype=np.int32))
+    assert n == 0 and none == []
+
+
+def test_trie_duplicate_insert_first_writer_wins():
+    pool = BlockPool(num_blocks=10, block_size=2)
+    trie = PrefixTrie(pool)
+    prompt = np.arange(5, dtype=np.int32)
+    t1 = pool.alloc(3)
+    assert trie.insert(prompt, t1) == 2
+    t2 = pool.alloc(3)
+    assert trie.insert(prompt, t2) == 0             # already cached
+    # t2's blocks stay exclusively owned and free with their request
+    for b in t2:
+        assert pool.refcount(b) == 1
+        pool.release(b)
+    for b in t1:
+        pool.release(b)
+    assert pool.used_count == 2                     # the cached chain
+    trie.clear()
+    pool.verify()
+    assert pool.used_count == 0

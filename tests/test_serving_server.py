@@ -256,3 +256,103 @@ def test_cancel_unknown_and_queued(server):
     assert status == 200 and body["cancelled"] is False
     status, body = _post(addr, "/v1/cancel", {"id": "x"})
     assert status == 400
+
+
+def test_backpressure_answers_429_with_retry_after(lm):
+    """A full engine queue surfaces the typed AdmissionError as HTTP
+    429 with a Retry-After header — the bounded-queue satellite."""
+    import time as _time
+
+    spec, params = lm
+    eng = DecodeEngine(spec, params, slots=1, window=24, chunk=2,
+                       max_queue=1)
+    orig_step = eng.step
+    eng.step = lambda: (_time.sleep(0.2), orig_step())[1]
+    with EngineServer(eng, port=0, request_timeout_s=120) as srv:
+        t1 = threading.Thread(
+            target=_post, args=(srv.address, "/v1/completions",
+                                {"prompt_tokens": [1, 2],
+                                 "max_new_tokens": 8}))
+        t1.start()
+        _time.sleep(0.3)       # in flight: slot busy, queue empty
+        t2 = threading.Thread(
+            target=_post, args=(srv.address, "/v1/completions",
+                                {"prompt_tokens": [3],
+                                 "max_new_tokens": 8}))
+        t2.start()             # queued: queue now full
+        _time.sleep(0.3)
+        conn = http.client.HTTPConnection(*srv.address, timeout=30)
+        conn.request("POST", "/v1/completions",
+                     json.dumps({"prompt_tokens": [4],
+                                 "max_new_tokens": 2}),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        body = json.loads(resp.read())
+        retry_hdr = resp.getheader("Retry-After")
+        conn.close()
+        assert resp.status == 429, body
+        assert "retry" in body["error"].lower() or "full" in body["error"]
+        assert body["retry_after_s"] > 0
+        assert retry_hdr is not None and int(retry_hdr) >= 1
+        eng.step = orig_step
+        t1.join()
+        t2.join()
+    st = srv.stats()
+    assert st["requests_failed"] >= 1        # the 429 counted as failed
+
+
+@pytest.fixture()
+def paged_server(lm):
+    from autodist_tpu.serving import PagedDecodeEngine
+
+    spec, params = lm
+    eng = PagedDecodeEngine(spec, params, slots=2, window=32,
+                            block_size=8, num_blocks=24, chunk=4)
+    srv = EngineServer(eng, port=0, request_timeout_s=120).start()
+    yield srv
+    srv.close()
+
+
+def test_paged_engine_through_http(paged_server, lm):
+    """The paged scheduler behind the HTTP front: oracle-exact
+    completions, SLO class accepted, scheduler surface in /v1/stats,
+    serving gauges + TTFT histogram on /metrics."""
+    spec, params = lm
+    gen = make_generator(spec)
+    addr = paged_server.address
+    status, body = _post(addr, "/v1/completions",
+                         {"prompt_tokens": [3, 5, 7], "max_new_tokens": 5,
+                          "slo": "throughput"})
+    assert status == 200, body
+    want = np.asarray(gen(
+        params, np.asarray([3, 5, 7], np.int32)[None, :], 5))[0]
+    np.testing.assert_array_equal(body["tokens"], want)
+
+    status, body = _post(addr, "/v1/completions",
+                         {"prompt_tokens": [1], "max_new_tokens": 2,
+                          "slo": "gold"})
+    assert status == 400 and "slo" in body["error"]
+
+    status, st = _get(addr, "/v1/stats")
+    assert status == 200
+    assert st["queue_depth"] == {"latency": 0, "throughput": 0}
+    assert st["block_occupancy"] >= 0
+    assert "prefix_hit_rate" in st and "free_blocks" in st
+    assert st["ttft_p50_ms"] > 0
+
+    conn = http.client.HTTPConnection(*addr, timeout=30)
+    conn.request("GET", "/metrics")
+    resp = conn.getresponse()
+    text = resp.read().decode()
+    conn.close()
+    assert "autodist_serving_ttft_seconds_bucket" in text
+    assert "autodist_serving_queue_wait_seconds_bucket" in text
+    assert "autodist_serving_block_occupancy" in text
+    assert 'autodist_serving_queue_depth_class{slo="latency"}' in text
+
+
+def test_slot_engine_rejects_slo_field(server):
+    status, body = _post(server.address, "/v1/completions",
+                         {"prompt_tokens": [1, 2], "max_new_tokens": 2,
+                          "slo": "latency"})
+    assert status == 400 and "SLO" in body["error"]
