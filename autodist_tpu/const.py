@@ -111,6 +111,13 @@ class ENV(enum.Enum):
     # JSONL under this run directory (one writer per process;
     # chief-mergeable — `python -m autodist_tpu.telemetry <dir>`)
     AUTODIST_TELEMETRY_DIR = ("AUTODIST_TELEMETRY_DIR", _str)
+    # leg-calibrated cost-model constants (docs/observability.md): path
+    # to a calibration.json written by telemetry.calibration
+    # .save_calibration / bench.py.  When set (or when
+    # AUTODIST_TELEMETRY_DIR/calibration.json exists), estimate_ir_cost
+    # and AutoStrategy(search=True) load the fitted constants
+    # automatically — no flags.
+    AUTODIST_CALIBRATION = ("AUTODIST_CALIBRATION", _str)
     # dump staged program snapshots (plan table, StableHLO, optimized HLO);
     # parity with the reference's per-stage graph dumps
     # (kernel/graph_transformer.py:62-90)

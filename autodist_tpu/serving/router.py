@@ -38,6 +38,7 @@ import json
 import os
 import threading
 import time
+import uuid
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -78,14 +79,16 @@ class HTTPReplicaClient:
 
     def _request(self, method: str, path: str,
                  body: Optional[dict] = None,
-                 timeout: float = 30.0) -> Tuple[int, Any]:
+                 timeout: float = 30.0,
+                 headers: Optional[dict] = None) -> Tuple[int, Any]:
         conn = http.client.HTTPConnection(self.host, self.port,
                                           timeout=timeout)
         try:
             payload = json.dumps(body) if body is not None else None
-            conn.request(method, path, payload,
-                         {"Content-Type": "application/json"}
-                         if payload else {})
+            hdrs = dict(headers or {})
+            if payload:
+                hdrs.setdefault("Content-Type", "application/json")
+            conn.request(method, path, payload, hdrs)
             resp = conn.getresponse()
             raw = resp.read()
             headers = dict(resp.getheaders())
@@ -99,9 +102,15 @@ class HTTPReplicaClient:
         finally:
             conn.close()
 
-    def post_completion(self, body: dict,
-                        timeout: float = 120.0) -> Tuple[int, dict]:
-        return self._request("POST", "/v1/completions", body, timeout)
+    def post_completion(self, body: dict, timeout: float = 120.0,
+                        trace_id: str = "") -> Tuple[int, dict]:
+        # The trace id travels as an HTTP header (router -> replica ->
+        # scheduler): the replica's request/queue-wait/prefill/decode
+        # spans then carry the router's id, so one request correlates
+        # across hosts in the exported trace (docs/observability.md).
+        headers = {"X-Autodist-Trace": trace_id} if trace_id else None
+        return self._request("POST", "/v1/completions", body, timeout,
+                             headers=headers)
 
     def stats(self, timeout: float = 5.0) -> dict:
         status, data = self._request("GET", "/v1/stats", timeout=timeout)
@@ -183,11 +192,13 @@ class ReplicaEndpoint:
         except OSError:
             return None
 
-    def post(self, body: dict, timeout: float) -> Tuple[int, dict]:
+    def post(self, body: dict, timeout: float,
+             trace_id: str = "") -> Tuple[int, dict]:
         cli = self.client()
         if cli is None:
             raise OSError(f"{self.name}: no address published")
-        return cli.post_completion(body, timeout=timeout)
+        return cli.post_completion(body, timeout=timeout,
+                                   trace_id=trace_id)
 
 
 class Router:
@@ -289,6 +300,10 @@ class Router:
         re-routing safe: a failed attempt leaves nothing behind on the
         dead replica that the retry could double-serve."""
         deadline = time.monotonic() + timeout_s
+        t0_unix = time.time()
+        # One trace id per logical request — re-routes reuse it, so the
+        # exported trace shows every attempt under one id.
+        trace_id = uuid.uuid4().hex[:16]
         tried_busy: Dict[str, float] = {}
         attempts = 0
         first = True
@@ -314,8 +329,18 @@ class Router:
                 self._inflight[ep.name] = \
                     self._inflight.get(ep.name, 0) + 1
             try:
-                status, payload = ep.post(
-                    body, timeout=max(deadline - time.monotonic(), 1.0))
+                try:
+                    status, payload = ep.post(
+                        body,
+                        timeout=max(deadline - time.monotonic(), 1.0),
+                        trace_id=trace_id)
+                except TypeError:
+                    # Duck-typed endpoints predating trace propagation
+                    # (unit-test fakes, user endpoints) keep working;
+                    # their replica spans are simply untagged.
+                    status, payload = ep.post(
+                        body, timeout=max(deadline - time.monotonic(),
+                                          1.0))
             except OSError as e:
                 logging.warning("router: replica %s failed mid-request "
                                 "(%s) — re-routing", ep.name, e)
@@ -327,6 +352,11 @@ class Router:
                         max(self._inflight.get(ep.name, 1) - 1, 0)
             if status == 200:
                 self._routed_counter(ep).inc()
+                from autodist_tpu.telemetry.profiler import record_span
+                record_span("route", start_unix=t0_unix,
+                            dur_s=time.time() - t0_unix,
+                            trace_id=trace_id, replica=ep.name,
+                            attempts=attempts)
                 return payload
             if status == 429:
                 retry = _retry_after(payload)

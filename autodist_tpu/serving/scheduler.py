@@ -77,6 +77,11 @@ class PagedRequest:
     temperature: float
     eos_id: int
     strip: int = 0                 # leading tokens dropped from result
+    # Propagated trace id (router -> replica HTTP header -> here): the
+    # queue-wait/prefill/decode spans recorded at harvest carry it, so
+    # one request's spans correlate across hosts in the exported trace
+    # (docs/observability.md).
+    trace_id: str = ""
     submit_t: float = 0.0
     admit_t: Optional[float] = None
     first_token_t: Optional[float] = None
@@ -317,8 +322,13 @@ class PagedDecodeEngine:
     def submit(self, prompt, max_new_tokens: int, *,
                temperature: Optional[float] = None,
                eos_id: Optional[int] = None, slo: str = SLO_LATENCY,
-               use_prefix: bool = False) -> int:
+               use_prefix: bool = False, trace_id: str = "") -> int:
         """Queue a request into its SLO class; returns its id.
+
+        ``trace_id`` tags this request's queue-wait/prefill/decode
+        spans in the telemetry span stream (propagated from the
+        router's HTTP header by the server; empty = spans recorded
+        untagged).
 
         Raises :class:`AdmissionError` (with ``retry_after_s``) when the
         class's queue is at ``max_queue``; raises ``ValueError`` for a
@@ -361,6 +371,7 @@ class PagedDecodeEngine:
         req = PagedRequest(prompt, int(max_new_tokens), self._next_id,
                            slo=slo, temperature=temperature,
                            eos_id=eos_id, strip=strip,
+                           trace_id=str(trace_id or ""),
                            submit_t=time.monotonic())
         self._next_id += 1
         q.append(req)
@@ -759,6 +770,37 @@ class PagedDecodeEngine:
                 seq = seq[:p + hits[0] + 1]
         return seq[req.strip:]
 
+    def _emit_request_spans(self, req: PagedRequest, gen: int) -> None:
+        """Record the request's lifecycle spans (queue-wait, chunked
+        prefill, decode) into the telemetry span stream at harvest —
+        the request is terminal here, so every boundary timestamp is
+        known and the emission rides a path that already paid a host
+        sync.  Monotonic times anchor to wall clock at 'now'; never
+        raises (record_span's contract)."""
+        from autodist_tpu.telemetry.profiler import record_span
+
+        now_mono = req.done_t or time.monotonic()
+        now_wall = time.time()
+
+        def wall(mono: float) -> float:
+            return now_wall - (now_mono - mono)
+
+        admit = req.admit_t or now_mono
+        record_span("queue_wait", start_unix=wall(req.submit_t),
+                    dur_s=max(admit - req.submit_t, 0.0),
+                    trace_id=req.trace_id,
+                    request_id=req.request_id, slo=req.slo)
+        first = req.first_token_t or admit
+        record_span("prefill", start_unix=wall(admit),
+                    dur_s=max(first - admit, 0.0),
+                    trace_id=req.trace_id, request_id=req.request_id,
+                    prompt_tokens=int(req.prompt.size),
+                    cached_tokens=int(req.n_cached))
+        record_span("decode", start_unix=wall(first),
+                    dur_s=max(now_mono - first, 0.0),
+                    trace_id=req.trace_id, request_id=req.request_id,
+                    generated=int(gen))
+
     def _free_slot(self, b: int, req: PagedRequest) -> None:
         """Return the request's blocks to the pool (shared prefix
         blocks just drop this reader's reference) and clear the block
@@ -789,11 +831,13 @@ class PagedDecodeEngine:
                     if req.first_token_t else wall)
             per_tok = ((req.done_t - req.first_token_t) / max(gen - 1, 1)
                        if req.first_token_t and gen > 1 else 0.0)
+            self._emit_request_spans(req, gen)
             self._timings[req.request_id] = {
                 "queue_wait_s": (req.admit_t or req.done_t) - req.submit_t,
                 "ttft_s": ttft,
                 "per_token_s": per_tok,
                 "generated": float(gen),
                 "cached_tokens": float(req.n_cached),
+                "trace_id": req.trace_id,
                 "slo": req.slo,
             }

@@ -36,6 +36,7 @@ from __future__ import annotations
 import json
 import threading
 import time
+import uuid
 from dataclasses import asdict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
@@ -278,13 +279,19 @@ class EngineServer:
 
     def _submit(self, prompt: np.ndarray, max_new: int,
                 temperature=None, eos_id=None,
-                use_prefix: bool = False, slo: Optional[str] = None) -> int:
+                use_prefix: bool = False, slo: Optional[str] = None,
+                trace_id: str = "") -> int:
         with self._locked():
             if self._stop or self._engine_error is not None:
                 raise _Unavailable()
             self._m_queue.observe(float(len(self._outstanding)))
             kwargs = dict(temperature=temperature, eos_id=eos_id,
                           use_prefix=use_prefix)
+            if trace_id and self._paged:
+                # Only the paged scheduler records per-request spans;
+                # the slot engine ignores trace ids (its submit has no
+                # per-request lifecycle timestamps to span).
+                kwargs["trace_id"] = trace_id
             if slo is not None:
                 if not self._paged:
                     raise ValueError(
@@ -525,6 +532,14 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _completions(self, srv: EngineServer, body: Dict[str, Any]) -> None:
         t0 = time.perf_counter()
+        t0_unix = time.time()
+        # Trace propagation (docs/observability.md): the router stamps
+        # X-Autodist-Trace; a bare client gets a fresh id.  The id rides
+        # to the scheduler (queue-wait/prefill/decode spans) and back in
+        # the response, so one request correlates across hosts in the
+        # exported trace.
+        trace_id = str(self.headers.get("X-Autodist-Trace", "")
+                       or uuid.uuid4().hex[:16])
         try:
             prompt = srv.parse_prompt(body)
             max_new = body.get("max_new_tokens", 16)
@@ -545,7 +560,7 @@ class _Handler(BaseHTTPRequestHandler):
                 raise ValueError("slo must be a string")
             rid = srv._submit(prompt, max_new, temperature=temperature,
                               eos_id=eos_id, use_prefix=use_prefix,
-                              slo=slo)
+                              slo=slo, trace_id=trace_id)
         except _Unavailable:
             self._json(503, {"error": "engine unavailable"})
             return
@@ -587,8 +602,14 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(409, {"error": f"request {rid} was cancelled",
                              "id": rid})
             return
-        srv.count_request(served=True, latency_s=time.perf_counter() - t0)
-        self._json(200, srv.render(rid, tokens, prompt.size))
+        latency = time.perf_counter() - t0
+        srv.count_request(served=True, latency_s=latency)
+        from autodist_tpu.telemetry.profiler import record_span
+        record_span("request", start_unix=t0_unix, dur_s=latency,
+                    trace_id=trace_id, request_id=rid)
+        payload = srv.render(rid, tokens, prompt.size)
+        payload["trace_id"] = trace_id
+        self._json(200, payload, headers={"X-Autodist-Trace": trace_id})
 
     def _stream(self, srv: EngineServer, rid: int, prompt_len: int,
                 t0: Optional[float] = None) -> None:

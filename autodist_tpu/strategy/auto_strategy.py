@@ -118,6 +118,21 @@ class AutoStrategy(StrategyBuilder):
             if quant_ring.is_quant_ring_compressor(self._compressor):
                 candidates.append(Zero1(compressor=self._compressor,
                                         overlap="full"))
+        # Measured calibration (docs/observability.md): when a
+        # calibration.json is discoverable from the environment
+        # (AUTODIST_CALIBRATION or AUTODIST_TELEMETRY_DIR), its fitted
+        # whole-step constants replace the hand-set defaults — the
+        # search ranks candidates with measured numbers, no flags.
+        from autodist_tpu.telemetry.calibration import (
+            load_default_calibration,
+        )
+        calibration = load_default_calibration()
+        cost_kwargs = calibration.as_cost_kwargs() if calibration else {}
+        if calibration is not None:
+            logging.info(
+                "AutoStrategy(search): using calibrated constants "
+                "(bandwidth %.3e B/s, alpha %.3e s) from calibration.json",
+                calibration.ici_bandwidth, calibration.alpha)
         best = None
         pruned = 0
         for builder in candidates:
@@ -136,7 +151,8 @@ class AutoStrategy(StrategyBuilder):
                     "(%s)", type(builder).__name__,
                     report.errors[0].rule)
                 continue
-            cost = estimate_cost(strategy, graph_item, resource_spec)
+            cost = estimate_cost(strategy, graph_item, resource_spec,
+                                 **cost_kwargs)
             if best is None or cost.time_s < best[2].time_s:
                 best = (type(builder).__name__, strategy, cost)
         if best is None:

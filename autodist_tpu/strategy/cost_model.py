@@ -366,9 +366,56 @@ def estimate_cost(strategy: Strategy, graph_item: GraphItem,
     return report
 
 
+def _leg_wire_bytes(leg, d: int) -> float:
+    """One leg's per-device wire bytes under the ring algebra (hop legs
+    already carry per-hop bytes; the guard psum is scalar-sized)."""
+    from autodist_tpu.kernel.synchronization import schedule_ir as sir
+
+    if leg.kind == sir.LEG_PPERMUTE_HOP:
+        return float(leg.nbytes)
+    if leg.kind in (sir.LEG_ALL_REDUCE, sir.LEG_PS_EXCHANGE):
+        return allreduce_bytes(float(leg.nbytes), d)
+    if leg.kind in (sir.LEG_REDUCE_SCATTER, sir.LEG_ALL_GATHER):
+        return reduce_scatter_bytes(float(leg.nbytes), d)
+    return float(leg.nbytes)
+
+
+def leg_cost_s(leg, ir, constants=None, *,
+               ici_bandwidth: float = ICI_BANDWIDTH,
+               alpha: float = COLLECTIVE_ALPHA) -> Optional[float]:
+    """Price ONE schedule-IR leg: wire bytes / bandwidth + a launch
+    alpha, per-kind when ``constants`` (a
+    ``telemetry.calibration.LegCalibration``) is given, the global
+    defaults otherwise.  Update legs price their HBM traffic (the
+    per-kind ``update`` bandwidth, or :data:`HBM_BANDWIDTH`).  Returns
+    None for a leg kind the model does not price.  This is the
+    prediction half of every per-leg measured-vs-predicted pair
+    (``telemetry.profiler.LegSample.predicted_s``)."""
+    from autodist_tpu.kernel.synchronization import schedule_ir as sir
+
+    d = max(int(ir.axes.get(leg.axis, 1)), 1) if leg.axis else 1
+    if leg.kind == sir.LEG_UPDATE:
+        if constants is not None and "update" in constants.bandwidths:
+            return constants.leg_time_s("update", float(leg.nbytes))
+        return float(leg.nbytes) / HBM_BANDWIDTH
+    if leg.kind not in sir.COLLECTIVE_KINDS:
+        return None
+    wire = _leg_wire_bytes(leg, d)
+    launches = 1 if (d > 1 or leg.kind == sir.LEG_PSUM_GUARD) else 0
+    if constants is not None and leg.kind in constants.bandwidths:
+        t = wire / constants.bandwidths[leg.kind]
+        if launches:
+            t += constants.alphas.get(leg.kind, COLLECTIVE_ALPHA)
+        if sir.is_quantizing(leg.compressor):
+            t += constants.quant_overhead_per_byte * wire
+        return t
+    return wire / ici_bandwidth + alpha * launches
+
+
 def estimate_ir_cost(ir, *, ici_bandwidth: float = ICI_BANDWIDTH,
                      alpha: float = COLLECTIVE_ALPHA,
-                     compute_time_s: float = 0.0) -> CostReport:
+                     compute_time_s: float = 0.0,
+                     constants=None) -> CostReport:
     """Price a sync-schedule IR (docs/schedule-ir.md) leg by leg.
 
     Where :func:`estimate_cost` prices the *plan projection* (it must
@@ -387,26 +434,40 @@ def estimate_ir_cost(ir, *, ici_bandwidth: float = ICI_BANDWIDTH,
     (int8/fp8 buckets) arrive with the HONEST wire size — 1-byte/elem
     payload plus the per-chunk scale bytes per transfer, per hop for
     ring chains — stamped by the IR builder, so the compressed wire is
-    priced exactly rather than as the f32 vector."""
+    priced exactly rather than as the f32 vector.
+
+    ``constants`` takes a measured ``telemetry.calibration.
+    LegCalibration``: each leg kind is then priced with ITS OWN fitted
+    launch alpha and bandwidth (ring-hop alpha vs one-shot alpha,
+    RS/AG/AR bandwidths, quantize overhead, update cost), and update
+    legs join the estimate through the fitted update bandwidth.  When
+    ``constants`` is None the default calibration discovered from the
+    environment (``AUTODIST_CALIBRATION`` /
+    ``AUTODIST_TELEMETRY_DIR/calibration.json`` — see
+    ``load_default_calibration``) applies automatically; without one
+    the uncalibrated single-bandwidth model below is unchanged."""
     from autodist_tpu.kernel.synchronization import overlap as ov
     from autodist_tpu.kernel.synchronization import schedule_ir as sir
 
+    if constants is None:
+        from autodist_tpu.telemetry.calibration import (
+            load_default_calibration,
+        )
+        constants = load_default_calibration()
+
     report = CostReport()
     accum = max(int(ir.accum_steps), 1)
+    calibrated_comm_s = 0.0
+    update_s = 0.0
     for leg in ir.legs:
+        if leg.kind == sir.LEG_UPDATE and constants is not None \
+                and "update" in constants.bandwidths:
+            update_s += constants.leg_time_s("update", float(leg.nbytes))
+            continue
         if leg.kind not in sir.COLLECTIVE_KINDS:
             continue
         d = max(int(ir.axes.get(leg.axis, 1)), 1) if leg.axis else 1
-        if leg.kind == sir.LEG_PPERMUTE_HOP:
-            wire = float(leg.nbytes)          # already per-hop bytes
-        elif leg.kind == sir.LEG_ALL_REDUCE:
-            wire = allreduce_bytes(float(leg.nbytes), d)
-        elif leg.kind in (sir.LEG_REDUCE_SCATTER, sir.LEG_ALL_GATHER):
-            wire = reduce_scatter_bytes(float(leg.nbytes), d)
-        elif leg.kind == sir.LEG_PS_EXCHANGE:
-            wire = allreduce_bytes(float(leg.nbytes), d)
-        else:                                 # guard psum: scalar-sized
-            wire = float(leg.nbytes)
+        wire = _leg_wire_bytes(leg, d)
         hidden = 0.0
         if leg.slot != sir.END_OF_STEP and leg.slot < accum - 1:
             hidden = wire                     # rides behind backward k+1
@@ -416,9 +477,18 @@ def estimate_ir_cost(ir, *, ici_bandwidth: float = ICI_BANDWIDTH,
         report.exposed_wire_bytes += wire - hidden
         if d > 1 or leg.kind == sir.LEG_PSUM_GUARD:
             report.num_collectives += 1
-    comm_s = (report.exposed_wire_bytes / ici_bandwidth
-              + alpha * report.num_collectives)
-    report.time_s = max(compute_time_s, comm_s)
+        if constants is not None:
+            exposed_fraction = (wire - hidden) / wire if wire > 0 \
+                else (0.0 if hidden else 1.0)
+            t = leg_cost_s(leg, ir, constants)
+            if t is not None:
+                calibrated_comm_s += t * exposed_fraction
+    if constants is not None:
+        comm_s = constants.scale * calibrated_comm_s
+    else:
+        comm_s = (report.exposed_wire_bytes / ici_bandwidth
+                  + alpha * report.num_collectives)
+    report.time_s = max(compute_time_s, comm_s) + update_s
     return report
 
 

@@ -28,13 +28,28 @@ on); JSONL output lands under ``AUTODIST_TELEMETRY_DIR`` when set.
 This ``__init__`` (and everything except ``timeline``'s span helpers)
 imports without jax, so the CLI runs on accelerator-free hosts.
 """
+from autodist_tpu.telemetry.aggregate import (
+    aggregate_run,
+    merge_registry_snapshots,
+    per_host_step_stats,
+    write_registry_snapshot,
+)
 from autodist_tpu.telemetry.calibration import (
     CalibratedConstants,
     DRIFT_THRESHOLD,
+    LEG_DRIFT_THRESHOLD,
+    LegCalibration,
+    STRAGGLER_THRESHOLD,
     fit_constants,
+    fit_leg_constants,
+    leg_drift_reason,
+    load_calibration,
+    load_default_calibration,
     model_drift_reason,
     predicted_vs_measured,
     prediction_error,
+    save_calibration,
+    straggler_reason,
 )
 from autodist_tpu.telemetry.events import (
     EventJournal,
@@ -53,6 +68,15 @@ from autodist_tpu.telemetry.registry import (
     render_prometheus,
     telemetry_enabled,
 )
+from autodist_tpu.telemetry.profiler import (
+    LegProfiler,
+    LegSample,
+    configure_spans,
+    load_leg_samples,
+    load_spans,
+    record_span,
+    write_leg_samples,
+)
 from autodist_tpu.telemetry.timeline import (
     StepRecord,
     StepRecorder,
@@ -60,30 +84,56 @@ from autodist_tpu.telemetry.timeline import (
     load_step_records,
     sync_span,
 )
+from autodist_tpu.telemetry.trace_export import (
+    chrome_trace_events,
+    export_trace,
+)
 
 __all__ = [
     "CalibratedConstants",
     "DRIFT_THRESHOLD",
     "DEFAULT_REGISTRY",
     "EventJournal",
+    "LEG_DRIFT_THRESHOLD",
+    "LegCalibration",
+    "LegProfiler",
+    "LegSample",
     "MetricsRegistry",
+    "STRAGGLER_THRESHOLD",
     "StepRecord",
     "StepRecorder",
+    "aggregate_run",
+    "chrome_trace_events",
     "configure_events",
+    "configure_spans",
     "counter",
     "emit_event",
+    "export_trace",
     "fit_constants",
+    "fit_leg_constants",
     "gauge",
     "get_journal",
     "histogram",
     "host_span",
+    "leg_drift_reason",
+    "load_calibration",
+    "load_default_calibration",
+    "load_leg_samples",
     "load_run_events",
+    "load_spans",
     "load_step_records",
+    "merge_registry_snapshots",
     "model_drift_reason",
+    "per_host_step_stats",
     "predicted_vs_measured",
     "prediction_error",
     "read_events",
+    "record_span",
     "render_prometheus",
+    "save_calibration",
+    "straggler_reason",
     "sync_span",
     "telemetry_enabled",
+    "write_leg_samples",
+    "write_registry_snapshot",
 ]

@@ -11,7 +11,20 @@ next to its BENCH_*.json artifacts:
   checkpoint, numerics events),
 * the predicted-vs-measured table with the ``telemetry/model-drift``
   verdict, and — with ``--fit`` — calibrated cost-model constants
-  (:func:`~autodist_tpu.telemetry.calibration.fit_constants`).
+  (:func:`~autodist_tpu.telemetry.calibration.fit_constants`, plus the
+  per-leg-kind :func:`fit_leg_constants` when the run holds leg
+  samples; ``--save-calibration`` persists the result as
+  ``calibration.json`` where ``estimate_ir_cost`` and
+  ``AutoStrategy(search=True)`` discover it),
+* cross-host aggregation (per-host step-time skew + the
+  ``telemetry/straggler`` verdict) whenever records carry more than
+  one host,
+* ``--export-trace`` — merge StepRecords, leg samples, the event
+  journal and serving request spans into ONE Chrome-trace/Perfetto
+  JSON with per-host tracks (``trace_export.py``),
+* ``--compare <run_b>`` — the two-run regression report: step-time
+  percentile deltas, per-phase and per-leg-kind regressions, drift
+  verdicts.
 
 Deliberately jax-free (numpy + stdlib): runs on any host that can read
 the files.  Exits 0 on success, 2 when the directory holds no telemetry.
@@ -21,6 +34,8 @@ Examples::
     python -m autodist_tpu.telemetry /tmp/autodist_tpu/telemetry/run1
     python -m autodist_tpu.telemetry ./telemetry_run --fit --json
     python -m autodist_tpu.telemetry ./run --events 50
+    python -m autodist_tpu.telemetry ./run --export-trace
+    python -m autodist_tpu.telemetry ./run_a --compare ./run_b
 """
 from __future__ import annotations
 
@@ -34,9 +49,13 @@ import numpy as np
 
 from autodist_tpu.telemetry.calibration import (
     fit_constants,
+    fit_leg_constants,
+    leg_drift_reason,
     predicted_vs_measured,
+    save_calibration,
 )
 from autodist_tpu.telemetry.events import load_run_events
+from autodist_tpu.telemetry.profiler import load_leg_samples
 from autodist_tpu.telemetry.timeline import StepRecord, load_step_records
 
 
@@ -96,6 +115,145 @@ def summarize_steps(records: List[StepRecord]) -> Optional[dict]:
     return out
 
 
+def leg_kind_totals(samples) -> dict:
+    """Per-leg-kind measured/predicted second totals over profiler
+    samples — the ``leg_kinds`` analysis provenance and the compare
+    report's per-kind rows."""
+    out: dict = {}
+    for s in samples:
+        kind = getattr(s, "kind", None)
+        t = getattr(s, "measured_s", None)
+        if not kind or not t or t <= 0:
+            continue
+        row = out.setdefault(kind, {"measured_s": 0.0, "predicted_s": 0.0,
+                                    "n": 0, "_pred_n": 0})
+        row["measured_s"] += float(t)
+        row["n"] += 1
+        pred = getattr(s, "predicted_s", None)
+        if pred:
+            row["predicted_s"] += float(pred)
+            row["_pred_n"] += 1
+    for row in out.values():
+        if row.pop("_pred_n") == 0:
+            row["predicted_s"] = None
+    return out
+
+
+#: fractional step-time/phase/leg growth that counts as a regression in
+#: the two-run compare report.
+REGRESSION_THRESHOLD = 0.10
+
+
+def _pct(a: Optional[float], b: Optional[float]) -> Optional[float]:
+    if not a or b is None:
+        return None
+    return round((b - a) / a, 4)
+
+
+def compare_runs(dir_a: str, dir_b: str) -> Optional[dict]:
+    """The two-run regression report (``--compare``): step-time
+    percentile deltas, per-phase and per-leg-kind deltas, drift
+    verdicts, and a ``regressions`` list of everything that grew past
+    :data:`REGRESSION_THRESHOLD`.  ``dir_a`` is the baseline.  None
+    when either run holds no step records."""
+    rec_a = load_step_records(dir_a)
+    rec_b = load_step_records(dir_b)
+    sum_a = summarize_steps(rec_a)
+    sum_b = summarize_steps(rec_b)
+    if not sum_a or not sum_b:
+        return None
+    out: dict = {"run_a": dir_a, "run_b": dir_b,
+                 "steps": [sum_a.get("steps"), sum_b.get("steps")]}
+    regressions: List[str] = []
+    st_a, st_b = sum_a.get("step_time") or {}, sum_b.get("step_time") or {}
+    steps: dict = {}
+    for key in ("p50_ms", "p90_ms", "p99_ms", "mean_ms"):
+        a, b = st_a.get(key), st_b.get(key)
+        delta = _pct(a, b)
+        steps[key] = {"a": a, "b": b, "delta_pct": delta}
+        if delta is not None and delta > REGRESSION_THRESHOLD:
+            regressions.append(
+                f"step time {key} regressed {delta:+.1%}: "
+                f"{a} ms -> {b} ms")
+    out["step_time"] = steps
+    phases: dict = {}
+    ph_a, ph_b = sum_a.get("phases") or {}, sum_b.get("phases") or {}
+    for name in sorted(set(ph_a) | set(ph_b)):
+        a = (ph_a.get(name) or {}).get("mean_ms")
+        b = (ph_b.get(name) or {}).get("mean_ms")
+        delta = _pct(a, b)
+        phases[name] = {"a_mean_ms": a, "b_mean_ms": b,
+                        "delta_pct": delta}
+        if delta is not None and delta > REGRESSION_THRESHOLD:
+            regressions.append(
+                f"phase {name} regressed {delta:+.1%}: "
+                f"{a} ms -> {b} ms per step")
+    if phases:
+        out["phases"] = phases
+    legs_a = leg_kind_totals(load_leg_samples(dir_a))
+    legs_b = leg_kind_totals(load_leg_samples(dir_b))
+    if legs_a or legs_b:
+        kinds: dict = {}
+        for kind in sorted(set(legs_a) | set(legs_b)):
+            a = (legs_a.get(kind) or {}).get("measured_s")
+            b = (legs_b.get(kind) or {}).get("measured_s")
+            delta = _pct(a, b)
+            kinds[kind] = {
+                "a_measured_ms": round(a * 1e3, 4) if a else None,
+                "b_measured_ms": round(b * 1e3, 4) if b else None,
+                "delta_pct": delta}
+            if delta is not None and delta > REGRESSION_THRESHOLD:
+                regressions.append(
+                    f"leg kind {kind} regressed {delta:+.1%}: "
+                    f"{a * 1e3:.3f} ms -> {b * 1e3:.3f} ms measured")
+            drift = leg_drift_reason(
+                kind, b, (legs_b.get(kind) or {}).get("predicted_s"))
+            if drift:
+                kinds[kind]["drift"] = drift
+        out["leg_kinds"] = kinds
+    for tag, summary in (("a", sum_a), ("b", sum_b)):
+        pm = summary.get("predicted_vs_measured") or {}
+        if pm.get("drift"):
+            out[f"drift_{tag}"] = pm["drift"]
+    out["regressions"] = regressions
+    return out
+
+
+def _print_compare(cmp: dict) -> None:
+    print(f"compare: {cmp['run_a']} (baseline) vs {cmp['run_b']}")
+    for key, row in cmp["step_time"].items():
+        if row["a"] is None or row["b"] is None:
+            continue
+        delta = row["delta_pct"]
+        print(f"  step {key:8s} {row['a']:10.3f} -> {row['b']:10.3f} ms"
+              + (f"  ({delta:+.1%})" if delta is not None else ""))
+    for name, row in (cmp.get("phases") or {}).items():
+        if row["a_mean_ms"] is None or row["b_mean_ms"] is None:
+            continue
+        delta = row["delta_pct"]
+        print(f"  phase {name:16s} {row['a_mean_ms']:9.3f} -> "
+              f"{row['b_mean_ms']:9.3f} ms"
+              + (f"  ({delta:+.1%})" if delta is not None else ""))
+    for kind, row in (cmp.get("leg_kinds") or {}).items():
+        a, b = row.get("a_measured_ms"), row.get("b_measured_ms")
+        if a is None or b is None:
+            continue
+        delta = row["delta_pct"]
+        print(f"  legs  {kind:16s} {a:9.3f} -> {b:9.3f} ms"
+              + (f"  ({delta:+.1%})" if delta is not None else ""))
+    for tag in ("a", "b"):
+        if cmp.get(f"drift_{tag}"):
+            print(f"  WARN telemetry/model-drift [{tag}]: "
+                  f"{cmp[f'drift_{tag}']}")
+    if cmp["regressions"]:
+        print(f"  REGRESSIONS ({len(cmp['regressions'])}):")
+        for r in cmp["regressions"]:
+            print(f"    - {r}")
+    else:
+        print("  no regressions past "
+              f"{REGRESSION_THRESHOLD:.0%}")
+
+
 def _fmt_event(rec: dict, t0: float) -> str:
     extras = {k: v for k, v in rec.items()
               if k not in ("time", "kind", "host", "pid")}
@@ -115,11 +273,47 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="show at most N timeline events (default 20)")
     p.add_argument("--fit", action="store_true",
                    help="fit cost-model constants from the records "
-                        "(telemetry.calibration.fit_constants)")
+                        "(telemetry.calibration.fit_constants; with leg "
+                        "samples also fit_leg_constants)")
+    p.add_argument("--save-calibration", metavar="PATH", default=None,
+                   help="with --fit: persist the leg calibration as "
+                        "calibration.json at PATH (or '-' for "
+                        "<run_dir>/calibration.json)")
+    p.add_argument("--export-trace", nargs="?", const="-", default=None,
+                   metavar="PATH",
+                   help="merge steps/legs/events/spans into one Chrome-"
+                        "trace JSON (default <run_dir>/trace.json)")
+    p.add_argument("--compare", metavar="RUN_B", default=None,
+                   help="two-run regression report: RUN_DIR is the "
+                        "baseline, RUN_B the candidate")
     p.add_argument("--json", action="store_true",
                    help="emit one machine-readable JSON object instead "
                         "of the human report")
     args = p.parse_args(argv)
+
+    if args.compare:
+        cmp = compare_runs(args.run_dir, args.compare)
+        if cmp is None:
+            print(f"compare: no step records under {args.run_dir} and/or "
+                  f"{args.compare}", file=sys.stderr)
+            return 2
+        if args.json:
+            print(json.dumps(cmp, default=str))
+        else:
+            _print_compare(cmp)
+        return 0
+
+    if args.export_trace is not None:
+        from autodist_tpu.telemetry.trace_export import export_trace
+
+        out_path = None if args.export_trace == "-" else args.export_trace
+        path = export_trace(args.run_dir, out_path)
+        if path is None:
+            print(f"no telemetry under {args.run_dir} — nothing to "
+                  "export", file=sys.stderr)
+            return 2
+        print(f"wrote {path}")
+        return 0
 
     records = load_step_records(args.run_dir)
     events = load_run_events(args.run_dir)
@@ -130,6 +324,25 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 2
 
     summary = summarize_steps(records) or {}
+    leg_samples = load_leg_samples(args.run_dir)
+    if leg_samples:
+        summary["leg_kinds"] = {
+            k: {kk: (round(vv, 6) if isinstance(vv, float) else vv)
+                for kk, vv in row.items()}
+            for k, row in leg_kind_totals(leg_samples).items()}
+    # Cross-host section whenever records carry more than one host.
+    from autodist_tpu.telemetry.aggregate import per_host_step_stats
+    from autodist_tpu.telemetry.calibration import straggler_reason
+
+    hosts = per_host_step_stats(records)
+    if len(hosts) > 1:
+        medians = {h: s["median_s"] for h, s in hosts.items()}
+        summary["hosts"] = hosts
+        summary["step_skew_ratio"] = round(
+            max(medians.values()) / min(medians.values()), 4)
+        straggler = straggler_reason(medians)
+        if straggler:
+            summary["straggler"] = straggler
     fit = fit_constants(records) if args.fit and records else None
     if fit is not None:
         summary["calibration"] = {
@@ -141,6 +354,34 @@ def main(argv: Optional[List[str]] = None) -> int:
                 fit.baseline_mean_abs_error_s * 1e3, 4),
             "improved": fit.improved,
         }
+    if args.fit and leg_samples:
+        leg_cal = fit_leg_constants(leg_samples, records)
+        if leg_cal is not None:
+            summary["leg_calibration"] = {
+                "alphas": leg_cal.alphas,
+                "bandwidths": leg_cal.bandwidths,
+                "quant_overhead_per_byte":
+                    leg_cal.quant_overhead_per_byte,
+                "scale": leg_cal.scale,
+                "n_samples": leg_cal.n_samples,
+                "n_records": leg_cal.n_records,
+                "mean_abs_error_ms": round(
+                    leg_cal.mean_abs_error_s * 1e3, 4)
+                if leg_cal.mean_abs_error_s is not None else None,
+                "step_fit_mean_abs_error_ms": round(
+                    leg_cal.step_fit_mean_abs_error_s * 1e3, 4)
+                if leg_cal.step_fit_mean_abs_error_s is not None
+                else None,
+                "improved": leg_cal.improved,
+            }
+            if args.save_calibration:
+                import os as _os
+
+                dest = args.save_calibration
+                if dest == "-":
+                    dest = _os.path.join(args.run_dir, "calibration.json")
+                save_calibration(leg_cal, dest)
+                summary["leg_calibration"]["path"] = dest
 
     if args.json:
         payload = dict(summary)
@@ -176,6 +417,21 @@ def main(argv: Optional[List[str]] = None) -> int:
                   f"(x{pm['ratio']:.2f})")
             if pm.get("drift"):
                 print(f"  WARN telemetry/model-drift: {pm['drift']}")
+        for kind, row in (summary.get("leg_kinds") or {}).items():
+            pred = row.get("predicted_s")
+            print(f"  leg {kind:18s} measured "
+                  f"{row['measured_s'] * 1e3:9.3f} ms over {row['n']} "
+                  "sample(s)"
+                  + (f"  (predicted {pred * 1e3:.3f} ms)"
+                     if pred else ""))
+        for host, st in (summary.get("hosts") or {}).items():
+            print(f"  host {host:20s} median "
+                  f"{st['median_s'] * 1e3:9.3f} ms over {st['n']} step(s)")
+        if summary.get("step_skew_ratio"):
+            print(f"  cross-host step skew: "
+                  f"x{summary['step_skew_ratio']:.2f}")
+        if summary.get("straggler"):
+            print(f"  WARN telemetry/straggler: {summary['straggler']}")
     cal = summary.get("calibration")
     if cal:
         print(f"  calibrated: bandwidth {cal['ici_bandwidth']:.3e} B/s, "
@@ -183,6 +439,17 @@ def main(argv: Optional[List[str]] = None) -> int:
               f"({cal['n_records']} records; mean abs error "
               f"{cal['mean_abs_error_ms']} ms vs "
               f"{cal['baseline_mean_abs_error_ms']} ms uncalibrated)")
+    leg_cal = summary.get("leg_calibration")
+    if leg_cal:
+        kinds = ", ".join(sorted(leg_cal["bandwidths"]))
+        print(f"  leg-calibrated: {len(leg_cal['bandwidths'])} kind(s) "
+              f"[{kinds}] from {leg_cal['n_samples']} sample(s)"
+              + (f"; record mean abs error {leg_cal['mean_abs_error_ms']}"
+                 f" ms vs {leg_cal['step_fit_mean_abs_error_ms']} ms "
+                 "whole-step fit"
+                 if leg_cal.get("mean_abs_error_ms") is not None else ""))
+        if leg_cal.get("path"):
+            print(f"  wrote {leg_cal['path']}")
     if events:
         t0 = events[0].get("time", time.time())
         shown = events[:max(args.events, 0)]

@@ -85,6 +85,11 @@ class StepRecord:
     # records stamped with a different fingerprint than the checkpoint
     # they resumed from executed a DIFFERENT schedule than planned.
     schedule_fingerprint: Optional[str] = None
+    # Emitting host (stamped once per recorder) — the cross-host
+    # aggregator keys per-host step-time skew and the trace exporter's
+    # per-host tracks on it; None in records written before this field
+    # existed.
+    host: Optional[str] = None
 
     def to_json(self) -> str:
         d = {k: v for k, v in asdict(self).items() if v not in (None, {})}
@@ -109,7 +114,10 @@ class StepRecorder:
                  ring: int = RING_RECORDS, flush_every: int = FLUSH_EVERY,
                  rotate_records: int = ROTATE_RECORDS,
                  predictor: Optional[Callable[[], Optional[dict]]] = None):
+        import socket
+
         self.run_id = run_id
+        self._host = socket.gethostname()
         self._dir = directory
         self._ring: deque = deque(maxlen=max(ring, 1))
         self._unflushed: List[StepRecord] = []
@@ -189,7 +197,8 @@ class StepRecorder:
             exposed_bytes=pred.get("exposed_wire_bytes"),
             num_collectives=pred.get("num_collectives"),
             predicted_step_time_s=pred.get("time_s"),
-            schedule_fingerprint=pred.get("schedule_fingerprint"))
+            schedule_fingerprint=pred.get("schedule_fingerprint"),
+            host=self._host)
         self._pending_phases = {}
         self._ring.append(rec)
         self._m_steps.inc()
@@ -246,9 +255,13 @@ class StepRecorder:
 
     # -- persistence -------------------------------------------------------
     def _segment_path(self) -> str:
+        # Host in the filename (like events-*.jsonl): multi-host runs
+        # share one directory over network FS, and two hosts can share
+        # a pid.  The loader's steps-*.jsonl glob matches both formats.
         pid = os.getpid()
+        safe = self._host.replace("/", "_").replace(":", "_")
         suffix = "" if self._file_index == 0 else f".{self._file_index}"
-        return os.path.join(self._dir, f"steps-{pid}{suffix}.jsonl")
+        return os.path.join(self._dir, f"steps-{safe}-{pid}{suffix}.jsonl")
 
     def flush(self) -> Optional[str]:
         """Append unflushed records as JSONL; rotates to a new segment
