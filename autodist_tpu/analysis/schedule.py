@@ -24,6 +24,13 @@ verifier's own ids (docs/schedule-ir.md):
 * ``schedule/reduction-order-divergence`` (WARN) — a low-precision or
   compressed bucket whose ring order diverges from the GSPMD psum
   tree.
+* ``schedule/fused-inconsistent`` (ERROR) — fused-kernel legs
+  (docs/kernels.md) that disagree with the IR's ``fused_kernels``
+  record.
+* ``schedule/fused-fallback`` (WARN) — a kernel requested via
+  ``AUTODIST_FUSED_KERNELS`` that this program must lower unfused,
+  with the runtime's exact drop-reason string
+  (``ops.fused_kernels.fused_drop_reason``).
 * ``schedule/elastic-resize`` (INFO) — under elastic provenance
   (``--elastic-from`` / ``preflight_elastic``): the exact leg-level
   delta of the resize (ring hop counts, leg totals), emitted after the
@@ -76,8 +83,46 @@ def _build_ir(ctx: AnalysisContext, axes) -> Optional[object]:
     if not facts:
         return None
     accum = int(getattr(ctx.graph_item, "accum_steps", 1) or 1)
+    active, drops = _resolve_fused(ctx, facts, guard)
+    ctx.fused_drops = drops
     return sir.ir_from_facts(facts, axes=dict(axes), accum_steps=accum,
-                             guard=guard)
+                             guard=guard, fused_kernels=active)
+
+
+def _resolve_fused(ctx: AnalysisContext, facts, guard: bool):
+    """The SAME fused-kernel resolution the runtime applies
+    (``ops.fused_kernels.resolve_fused``) so the analysis IR — and its
+    fingerprint — matches what ``make_explicit_step`` lowers, and the
+    drop reasons surface here as ``schedule/fused-fallback`` WARNs with
+    the runtime's exact strings."""
+    from autodist_tpu.kernel.synchronization import quant_ring
+    from autodist_tpu.ops import fused_kernels as fk
+
+    if not fk.requested_kernels():
+        return (), []
+    optimizer = getattr(ctx.graph_item, "optimizer", None)
+    opt_fusable = getattr(optimizer, "fused_spec", None) is not None
+    adam_shaped = True
+    has_rs = any(f.sync_mode == "reduce_scatter" for f in facts)
+    if opt_fusable and has_rs:
+        try:
+            import jax
+
+            import jax.numpy as jnp
+            probe = jax.eval_shape(
+                optimizer.init,
+                {"x": jax.ShapeDtypeStruct((8,), jnp.float32)})
+            adam_shaped = fk.find_adam_state(probe) is not None
+        except Exception:  # pragma: no cover - defensive
+            adam_shaped = False
+    return fk.resolve_fused(
+        guard=guard, has_rs=has_rs,
+        has_quant_ring=any(
+            quant_ring.wire_format_of(f.compressor) is not None
+            for f in facts),
+        optimizer_fusable=opt_fusable, adam_state_shaped=adam_shaped,
+        f32_buckets=all(str(f.dtype) == "float32" for f in facts
+                        if f.sync_mode == "reduce_scatter"))
 
 
 _SEVERITY = {"error": Severity.ERROR, "warn": Severity.WARN}
@@ -99,6 +144,9 @@ _FIXES = {
     "schedule/reduction-order-divergence":
         "expect >1e-6 explicit-vs-GSPMD divergence for this bucket, or "
         "keep it f32/uncompressed",
+    "schedule/fused-inconsistent":
+        "rebuild the IR through build_schedule_ir(fused_kernels=...) so "
+        "the fused legs and the program record agree",
 }
 
 
@@ -116,6 +164,13 @@ def run(ctx: AnalysisContext) -> List[Diagnostic]:
         diags.append(diag(
             v.rule, _SEVERITY.get(v.severity, Severity.WARN), v.message,
             location=v.location or v.leg, fix=_FIXES.get(v.rule)))
+    for kernel, why in getattr(ctx, "fused_drops", ()) or ():
+        diags.append(diag(
+            "schedule/fused-fallback", Severity.WARN,
+            f"requested fused kernel {kernel!r} falls back to the "
+            f"unfused lowering: {why}",
+            fix="fix the blocking config, or drop the kernel from "
+                "AUTODIST_FUSED_KERNELS"))
     diags.extend(_elastic_recheck(ctx, ir))
     return diags
 

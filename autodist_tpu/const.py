@@ -118,6 +118,15 @@ class ENV(enum.Enum):
     # and AutoStrategy(search=True) load the fitted constants
     # automatically — no flags.
     AUTODIST_CALIBRATION = ("AUTODIST_CALIBRATION", _str)
+    # fused Pallas kernel opt-in (docs/kernels.md): "all" or a comma
+    # list of guard,update,quant_hop,paged_attention.  Unset = every
+    # path keeps its unfused lowering; requested-but-unsupported
+    # configs fall back with a shared drop-reason WARN
+    # (ops.fused_kernels.fused_drop_reason).
+    AUTODIST_FUSED_KERNELS = ("AUTODIST_FUSED_KERNELS", _str)
+    # force Pallas interpret mode off-TPU for the fused kernels —
+    # the CPU test/bench escape hatch (slower than XLA; never default)
+    AUTODIST_FUSED_INTERPRET = ("AUTODIST_FUSED_INTERPRET", _bool)
     # dump staged program snapshots (plan table, StableHLO, optimized HLO);
     # parity with the reference's per-stage graph dumps
     # (kernel/graph_transformer.py:62-90)

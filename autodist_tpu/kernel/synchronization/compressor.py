@@ -236,21 +236,26 @@ class QuantizedRingCompressor(Compressor):
         return shard, new_state
 
     # -- bucket-level entry points (explicit path; docs/overlap.md) -------
-    def bucket_reduce(self, vec, state, axis_name, n, alg="fused"):
+    def bucket_reduce(self, vec, state, axis_name, n, alg="fused",
+                      hop_fused=False):
         """Full mean of flat ``vec`` through the quantized wire under
         the IR-resolved ``alg``; returns ``(mean, new_state,
-        sat_count)`` — the saturation counter feeds GradHealth."""
+        sat_count)`` — the saturation counter feeds GradHealth.
+        ``hop_fused`` selects the fused Pallas hop boundary for ring
+        chains (the IR bucket node's ``hop_fused`` flag,
+        docs/kernels.md)."""
         return quant_ring.quant_bucket_reduce(
             vec, state, axis_name, n, self.wire,
-            mode="all_reduce", alg=alg)
+            mode="all_reduce", alg=alg, fused=hop_fused)
 
-    def bucket_reduce_scatter(self, vec, state, axis_name, n, alg="fused"):
+    def bucket_reduce_scatter(self, vec, state, axis_name, n, alg="fused",
+                              hop_fused=False):
         """This device's 1/n mean shard (ZeRO-1 leg) — the update runs
         on the f32-dequantized shard; returns ``(shard, new_state,
         sat_count)``."""
         return quant_ring.quant_bucket_reduce(
             vec, state, axis_name, n, self.wire,
-            mode="reduce_scatter", alg=alg)
+            mode="reduce_scatter", alg=alg, fused=hop_fused)
 
 
 class Int8Compressor(QuantizedRingCompressor):

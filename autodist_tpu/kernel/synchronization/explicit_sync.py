@@ -426,6 +426,43 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
     donate_sync = all(kind in ("bucket", "numerics")
                       for kind, _ in sync_builders.values())
 
+    # -- fused Pallas kernels (docs/kernels.md) ----------------------------
+    # Opt-in via AUTODIST_FUSED_KERNELS; every requested kernel this
+    # program cannot lower falls back to the unfused path with the
+    # SHARED drop-reason string (ops.fused_kernels.fused_drop_reason —
+    # the analysis schedule pass surfaces the same rule).  The active
+    # set is recorded in the schedule IR below, so the fingerprint, the
+    # verifier, and the cost model all see the fused program.
+    from autodist_tpu.ops import fused_kernels as fk
+
+    opt_fusable = getattr(gi.optimizer, "fused_spec", None) is not None
+    adam_shaped = True
+    if opt_fusable and rs_buckets:
+        opt_probe = jax.eval_shape(
+            gi.optimizer.init,
+            {"x": jax.ShapeDtypeStruct((8,), jnp.float32)})
+        adam_shaped = fk.find_adam_state(opt_probe) is not None
+    active_fused, fused_drops = fk.resolve_fused(
+        guard=num_active, has_rs=bool(rs_buckets),
+        has_quant_ring=any(quant_ring.wire_format_of(b.compressor)
+                           is not None for b in buckets),
+        optimizer_fusable=opt_fusable, adam_state_shaped=adam_shaped,
+        f32_buckets=all(b.dtype == "float32" for b in rs_buckets))
+    for kernel, why in fused_drops:
+        logging.warning(
+            "explicit sync path: fused kernel %s falls back to the "
+            "unfused lowering (%s)", kernel, why)
+    # Interpret-mode decision resolved HERE, at build — not at trace —
+    # the ops/flash_attention.py convention (off-TPU is only reachable
+    # under the AUTODIST_FUSED_INTERPRET escape hatch).
+    fused_interpret = not fk.kernels_runnable()[0]
+    guard_fused = fk.KERNEL_GUARD in active_fused
+    update_fused = fk.KERNEL_UPDATE in active_fused
+    if active_fused:
+        logging.info("explicit sync path: fused Pallas kernels active: "
+                     "%s%s", ",".join(active_fused),
+                     " (interpret mode)" if fused_interpret else "")
+
     # -- schedule IR (docs/schedule-ir.md) ---------------------------------
     # The sync program as a first-class artifact: one IR instance built
     # from the planner + overlap + guard + donation facts above; this
@@ -455,7 +492,8 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
         donated=tuple(f"sync:{k}" for k in sync_builders) if donate_sync
         else (),
         stateful_keys={k for k, (kind, _) in sync_builders.items()
-                       if kind == "bucket"})
+                       if kind == "bucket"},
+        fused_kernels=active_fused)
     schedule_ir.assert_verified(ir, "explicit sync build")
     logging.info(
         "explicit sync path: schedule IR %s (%d bucket(s), %d leg(s), "
@@ -481,14 +519,20 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
         if quant_ring.wire_format_of(b.compressor) is None:
             continue
         comp = get_compressor(b.compressor)
+        node = ir.bucket_node(b.key) or {}
+        hop_fused = bool(node.get("hop_fused", False))
         if b.mode == MODE_REDUCE_SCATTER:
             quant_fns[b.key] = (
-                lambda v, s, comp=comp, alg=ir.reduce_alg(b.key):
-                comp.bucket_reduce_scatter(v, s, MESH_AXIS_DATA, d, alg=alg))
+                lambda v, s, comp=comp, alg=ir.reduce_alg(b.key),
+                hf=hop_fused:
+                comp.bucket_reduce_scatter(v, s, MESH_AXIS_DATA, d,
+                                           alg=alg, hop_fused=hf))
         else:
             quant_fns[b.key] = (
-                lambda v, s, comp=comp, alg=ir.reduce_alg(b.key):
-                comp.bucket_reduce(v, s, MESH_AXIS_DATA, d, alg=alg))
+                lambda v, s, comp=comp, alg=ir.reduce_alg(b.key),
+                hf=hop_fused:
+                comp.bucket_reduce(v, s, MESH_AXIS_DATA, d, alg=alg,
+                                   hop_fused=hf))
     pipe_quant_fns = {k: f for k, f in quant_fns.items() if k in pipe_keys}
     # Saturation counters are per-data-rank events replicated across the
     # other mesh axes; this factor makes the guard's all-axis psum
@@ -659,7 +703,9 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
         if num_active:
             ns = sync_state[NUMERICS_KEY]
             scale = ns["scale"] if num_ls is not None else None
-            health = guard_mod.HealthAccumulator(n_devices)
+            health = guard_mod.HealthAccumulator(
+                n_devices, fused=guard_fused,
+                interpret=fused_interpret if guard_fused else None)
             if scale is None:
                 vg_local = vg
             else:
@@ -872,6 +918,7 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
         # computed from the psum of shard norms, identical on every
         # device).
         all_finite = gnorm = per_bucket = new_ns = None
+        fused_mult = None
         if num_active:
             inv_scale = jnp.float32(1.0) if scale is None \
                 else jnp.float32(1.0) / scale
@@ -887,9 +934,16 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
                     g_i = synced[i]
                     synced[i] = (g_i.astype(jnp.float32)
                                  * mult).astype(g_i.dtype)
-                rs_grad_shards = {
-                    k: (v.astype(jnp.float32) * mult).astype(v.dtype)
-                    for k, v in rs_grad_shards.items()}
+                if update_fused:
+                    # The fused unscale/clip/update kernel folds the
+                    # multiplier into the shard update itself — the
+                    # gradient shards stay untouched here (one fewer
+                    # full pass over every ZeRO-1 bucket).
+                    fused_mult = mult
+                else:
+                    rs_grad_shards = {
+                        k: (v.astype(jnp.float32) * mult).astype(v.dtype)
+                        for k, v in rs_grad_shards.items()}
         grads = jax.tree_util.tree_unflatten(treedef, synced)
 
         # Shard-local update: grads, params, and opt state all carry the
@@ -911,10 +965,32 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
                 sz = b.padded_total // d
                 p_shards[b.key] = lax.dynamic_slice_in_dim(
                     vec, shard_idx * sz, sz, 0)
-            with sync_span("zero1_shard_update"):
-                z_updates, z_state = bucket_optimizer.update(
-                    rs_grad_shards, opt_state["zero1"], p_shards)
-                new_shards = optax.apply_updates(p_shards, z_updates)
+            if update_fused:
+                # Fused unscale/clip/Adam update (docs/kernels.md): one
+                # kernel per bucket shard over (p, g, m, v) — exact vs
+                # the optax chain (fusable_adam pins the hyperparams);
+                # the shared step counter increments once, like optax.
+                spec = gi.optimizer.fused_spec
+                with sync_span("fused_shard_update"):
+                    adam_st = fk.find_adam_state(opt_state["zero1"])
+                    new_shards, new_mu, new_nu = {}, {}, {}
+                    for b in rs_buckets:
+                        key = b.key
+                        (new_shards[key], new_mu[key],
+                         new_nu[key]) = fk.fused_adam_update(
+                            p_shards[key], rs_grad_shards[key],
+                            adam_st.mu[key], adam_st.nu[key],
+                            adam_st.count, spec, mult=fused_mult,
+                            interpret=fused_interpret)
+                    z_state = fk.replace_adam_state(
+                        opt_state["zero1"],
+                        adam_st._replace(count=adam_st.count + 1,
+                                         mu=new_mu, nu=new_nu))
+            else:
+                with sync_span("zero1_shard_update"):
+                    z_updates, z_state = bucket_optimizer.update(
+                        rs_grad_shards, opt_state["zero1"], p_shards)
+                    new_shards = optax.apply_updates(p_shards, z_updates)
 
             with sync_span("tree_update"):
                 t_updates, t_state = tree_optimizer.update(

@@ -133,6 +133,8 @@ def quantize_blocks(x, fmt: WireFormat, block: int = QUANT_BLOCK_ELEMS
     guard rolls up."""
     import jax.numpy as jnp
 
+    from autodist_tpu.ops import quant_scale
+
     length = x.shape[0]
     nb = scale_count(length, block)
     pad = nb * block - length
@@ -141,17 +143,18 @@ def quantize_blocks(x, fmt: WireFormat, block: int = QUANT_BLOCK_ELEMS
     finite = jnp.isfinite(xb)
     # The grid is set by the block's FINITE amax: a stray Inf/NaN lands
     # in the saturation counter instead of flattening its neighbors'
-    # scale to zero resolution.
+    # scale to zero resolution.  Scale + clip arithmetic is the shared
+    # rule in ops/quant_scale.py — the fused hop kernel
+    # (ops/fused_kernels.py) calls the same helpers, so the two wire
+    # formats cannot drift.
     amax = jnp.max(jnp.where(finite, jnp.abs(xb), 0.0), axis=1)
-    scales = jnp.maximum(amax / fmt.qmax, 1e-30)
+    scales = quant_scale.chunk_scale(amax, fmt.qmax)
     y = xb / scales[:, None]
-    if fmt.name == "int8":
-        qf = jnp.round(y)
-        sat = jnp.sum((~finite) | (finite & (jnp.abs(qf) > fmt.qmax)))
-        q = jnp.clip(qf, -fmt.qmax, fmt.qmax).astype(_wire_dtype(fmt))
-    else:
-        sat = jnp.sum((~finite) | (finite & (jnp.abs(y) > fmt.qmax)))
-        q = jnp.clip(y, -fmt.qmax, fmt.qmax).astype(_wire_dtype(fmt))
+    rounded = fmt.name == "int8"
+    sat = quant_scale.saturation_count(y, finite, fmt.qmax,
+                                       rounded=rounded)
+    q = quant_scale.quantize_values(y, fmt.qmax, _wire_dtype(fmt),
+                                    rounded=rounded)
     if pad:
         # padded tail is zero: quantizes exactly, never counts.
         q = q.reshape(-1)[:length]
@@ -178,7 +181,8 @@ def dequantize_blocks(q, scales, block: int = QUANT_BLOCK_ELEMS):
 
 def quantized_ring_reduce_scatter(vec, axis_name: str, n: int,
                                   fmt: WireFormat,
-                                  block: int = QUANT_BLOCK_ELEMS):
+                                  block: int = QUANT_BLOCK_ELEMS,
+                                  fused: bool = False):
     """Sum-reduce-scatter of flat ``vec`` (length divisible by ``n``) as
     n−1 quantized ppermute ring hops.
 
@@ -189,7 +193,17 @@ def quantized_ring_reduce_scatter(vec, axis_name: str, n: int,
     ``err`` is THIS device's injected stage-1 quantization error,
     vector-shaped with each hop's error at the chunk position it was
     quantizing (the error-feedback contract: feed it back into the next
-    round's input and the bias cancels, Karimireddy et al., 2019)."""
+    round's input and the bias cancels, Karimireddy et al., 2019).
+
+    ``fused=True`` lowers each hop BOUNDARY through the fused Pallas
+    kernels (``ops/fused_kernels.py``, docs/kernels.md): dequantize the
+    received payload, add the local chunk, and requantize for the next
+    send in one kernel — the f32 partial stays in VMEM between wire
+    formats instead of round-tripping HBM, and the error + saturation
+    count come out of the same pass.  Same scale rule
+    (``ops/quant_scale.py``), same hop order, same wire bytes — the
+    fused and unfused paths agree to float round-off (the wire payloads
+    bit-equal)."""
     import jax.numpy as jnp
     from jax import lax
 
@@ -200,6 +214,31 @@ def quantized_ring_reduce_scatter(vec, axis_name: str, n: int,
     chunks = jnp.reshape(vec, (n, -1))
     idx = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
+    if fused:
+        from autodist_tpu.ops import fused_kernels as fk
+
+        # Hop s's receive side (dequantize + accumulate) and hop s+1's
+        # send side (requantize) are one fused boundary; the first send
+        # and the final owned-shard receive are the half-boundaries.
+        acc0 = jnp.take(chunks, (idx - 1) % n, axis=0)
+        err = jnp.zeros_like(chunks)
+        with sync_span("quant_ring_fused/leg1"):
+            q, scales, err_h, sat = fk.fused_quantize(acc0, fmt, block)
+            err = err.at[(idx - 1) % n].set(err_h)
+        for s in range(1, n):
+            with sync_span(f"quant_ring_fused/leg{s}"):
+                q = lax.ppermute(q, axis_name, perm)
+                scales = lax.ppermute(scales, axis_name, perm)
+                chunk = jnp.take(chunks, (idx - 1 - s) % n, axis=0)
+                if s < n - 1:
+                    q, scales, err_h, s_cnt = fk.fused_hop_accumulate(
+                        q, scales, chunk, fmt, block)
+                    err = err.at[(idx - 1 - s) % n].set(err_h)
+                    sat = sat + s_cnt
+                else:
+                    acc = fk.fused_dequant_add(q, scales, chunk, fmt,
+                                               block)
+        return acc, jnp.reshape(err, vec.shape), sat
     acc = jnp.take(chunks, (idx - 1) % n, axis=0)
     err = jnp.zeros_like(chunks)
     sat = jnp.float32(0.0)
@@ -312,7 +351,8 @@ def quantized_all_gather(shard, axis_name: str, n: int, fmt: WireFormat,
 
 def quant_bucket_reduce(vec, state, axis_name: str, n: int,
                         fmt: WireFormat, *, mode: str, alg: str,
-                        block: int = QUANT_BLOCK_ELEMS):
+                        block: int = QUANT_BLOCK_ELEMS,
+                        fused: bool = False):
     """Reduce one flat bucket through the quantized wire.
 
     ``mode`` is the bucket sync mode (``all_reduce`` returns the full
@@ -323,6 +363,9 @@ def quant_bucket_reduce(vec, state, axis_name: str, n: int,
     collective).  Error feedback: ``state`` (vector-shaped stage-1
     residual) is added before quantization and the new residual is
     returned; stage-2 (the ``all_reduce`` gather leg) is uncompensated.
+    ``fused`` lowers ring hop boundaries through the fused Pallas
+    kernels (docs/kernels.md; ring algorithm only — the one-shot and
+    gather lowerings have no per-hop boundary to fuse).
     Returns ``(reduced, new_state, sat_count)``."""
     import jax.numpy as jnp
 
@@ -340,7 +383,7 @@ def quant_bucket_reduce(vec, state, axis_name: str, n: int,
         return out.astype(orig_dtype), new_state, jnp.float32(0.0)
     if alg == "ring":
         shard_sum, err, sat = quantized_ring_reduce_scatter(
-            corrected, axis_name, n, fmt, block)
+            corrected, axis_name, n, fmt, block, fused=fused)
     else:
         shard_sum, err, sat = quantized_all_to_all_reduce_scatter(
             corrected, axis_name, n, fmt, block)

@@ -62,6 +62,13 @@ make the partition/schedule decision a first-class analyzable artifact):
     or compressed bucket whose reduce ring-decomposes: the explicit
     ring order and the GSPMD psum-tree order round differently, so the
     two lowerings of this IR are not bit-identical for it.
+  - ``schedule/fused-inconsistent`` (ERROR) — a fused-kernel leg
+    (``fused_detect``/``fused_update``/``fused_hop``, docs/kernels.md)
+    in a program whose ``fused_kernels`` record does not claim that
+    kernel, a ``hop_fused`` bucket node without the ``quant_hop``
+    record, or a fused hop for a compressor with no per-hop requantize
+    lowering: the fused and unfused halves of the lowering disagree
+    about what runs.
 
 Everything here is mesh-free and jax-free at module import (numpy
 only), so the analyzer's sub-second verdict survives, and the verifier
@@ -106,11 +113,33 @@ LEG_PPERMUTE_HOP = "ppermute_hop"
 LEG_PSUM_GUARD = "psum_guard"
 LEG_PS_EXCHANGE = "ps_exchange"
 LEG_UPDATE = "update"
+#: fused-kernel leg kinds (docs/kernels.md): the Pallas lowerings the
+#: ``AUTODIST_FUSED_KERNELS`` knob selects.  ``fused_hop`` is a
+#: ppermute ring hop whose dequantize→accumulate→requantize boundary
+#: runs as one kernel (same wire, same hop-order rules);
+#: ``fused_detect`` is the single-pass guard statistics pass over a
+#: bucket; ``fused_update`` the one-kernel unscale/clip/Adam ZeRO-1
+#: shard update.  Distinct kinds so ``fit_leg_constants`` prices
+#: fused-vs-unfused as separate calibrated alternatives.
+LEG_FUSED_HOP = "fused_hop"
+LEG_FUSED_DETECT = "fused_detect"
+LEG_FUSED_UPDATE = "fused_update"
 LEG_KINDS = (LEG_REDUCE_SCATTER, LEG_ALL_GATHER, LEG_ALL_REDUCE,
-             LEG_PPERMUTE_HOP, LEG_PSUM_GUARD, LEG_PS_EXCHANGE, LEG_UPDATE)
+             LEG_PPERMUTE_HOP, LEG_PSUM_GUARD, LEG_PS_EXCHANGE, LEG_UPDATE,
+             LEG_FUSED_HOP, LEG_FUSED_DETECT, LEG_FUSED_UPDATE)
 #: kinds that issue wire traffic (every rank must agree on these).
 COLLECTIVE_KINDS = (LEG_REDUCE_SCATTER, LEG_ALL_GATHER, LEG_ALL_REDUCE,
-                    LEG_PPERMUTE_HOP, LEG_PSUM_GUARD, LEG_PS_EXCHANGE)
+                    LEG_PPERMUTE_HOP, LEG_PSUM_GUARD, LEG_PS_EXCHANGE,
+                    LEG_FUSED_HOP)
+#: ppermute ring-hop kinds — one chain grammar, fused or not.
+RING_HOP_KINDS = (LEG_PPERMUTE_HOP, LEG_FUSED_HOP)
+#: leg kind each fused kernel name lowers to (the consistency contract
+#: schedule/fused-inconsistent checks).
+FUSED_KERNEL_KINDS = {
+    "guard": LEG_FUSED_DETECT,
+    "update": LEG_FUSED_UPDATE,
+    "quant_hop": LEG_FUSED_HOP,
+}
 
 #: reduce-lowering algorithms a bucket node resolves to.
 ALG_RING = "ring"            # explicit ppermute hop chain (overlap.py)
@@ -221,6 +250,10 @@ class ScheduleIR:
     legs: List[Leg] = field(default_factory=list)
     gather_order: List[Tuple[str, str]] = field(default_factory=list)
     donated: Tuple[str, ...] = ()
+    #: fused Pallas kernels this program lowers through (docs/kernels.md)
+    #: — already drop-filtered by the builder's caller, so the record is
+    #: what actually runs, not what was requested.
+    fused_kernels: Tuple[str, ...] = ()
     version: int = IR_VERSION
 
     # -- decision surface (what the lowerings consume) --------------------
@@ -255,6 +288,11 @@ class ScheduleIR:
             "legs": [asdict(l) for l in self.legs],
             "gather_order": [list(kv) for kv in self.gather_order],
             "donated": list(self.donated),
+            # Omitted when empty so every pre-fusion program keeps its
+            # recorded fingerprint (checkpoints, BENCH_leg_samples.jsonl,
+            # calibration.json all key on it).
+            **({"fused_kernels": list(self.fused_kernels)}
+               if self.fused_kernels else {}),
         }
 
     @classmethod
@@ -276,6 +314,7 @@ class ScheduleIR:
             legs=legs,
             gather_order=[tuple(kv) for kv in d.get("gather_order", ())],
             donated=tuple(d.get("donated", ())),
+            fused_kernels=tuple(d.get("fused_kernels", ())),
             version=int(d.get("version", IR_VERSION)))
 
     def to_json(self, indent: Optional[int] = None) -> str:
@@ -464,12 +503,16 @@ def _ring_chain(em: _Emitter, *, chain: str, b: Bucket,
                 d: int, axis: str, slot: int, stage: str, deps: Sequence[str],
                 reads: Tuple[str, ...], writes: Tuple[str, ...],
                 per_hop: Optional[int] = None,
-                compressor: Optional[str] = None) -> Leg:
+                compressor: Optional[str] = None,
+                hop_kind: str = LEG_PPERMUTE_HOP) -> Leg:
     """Emit a d-1 hop ppermute ring chain; returns the final hop (which
     carries ``writes``).  ``per_hop`` overrides the per-hop wire bytes
     (quantized chains: 1-byte/elem payload + per-chunk scale bytes);
     ``compressor`` overrides the wire tag (the ZeRO-1 param gather
-    rides full precision regardless of the bucket's gradient wire)."""
+    rides full precision regardless of the bucket's gradient wire);
+    ``hop_kind`` selects the fused-boundary variant
+    (:data:`LEG_FUSED_HOP`) — same chain grammar, distinct calibration
+    kind."""
     prev: Optional[Leg] = None
     if per_hop is None:
         per_hop = int(b.nbytes // max(d, 1))
@@ -478,7 +521,7 @@ def _ring_chain(em: _Emitter, *, chain: str, b: Bucket,
     for h in range(1, d):
         last = h == d - 1
         leg = em.emit(
-            id=f"{chain}/hop{h}", kind=LEG_PPERMUTE_HOP, bucket=b.key,
+            id=f"{chain}/hop{h}", kind=hop_kind, bucket=b.key,
             dtype=b.dtype, nbytes=per_hop, axis=axis, slot=slot,
             compressor=compressor, alg=ALG_RING,
             hop=h, chain=chain, stage=stage, sig=_bucket_sig(b),
@@ -496,7 +539,8 @@ def build_schedule_ir(*, axes: Dict[str, int], accum_steps: int = 1,
                       guard: bool = False,
                       donated: Sequence[str] = (),
                       stateful_keys: Iterable[str] = (),
-                      per_var_alg: str = ALG_FUSED) -> ScheduleIR:
+                      per_var_alg: str = ALG_FUSED,
+                      fused_kernels: Sequence[str] = ()) -> ScheduleIR:
     """Build the schedule program for one step.
 
     Pure: consumes exactly the planner's outputs (``buckets`` from
@@ -506,7 +550,10 @@ def build_schedule_ir(*, axes: Dict[str, int], accum_steps: int = 1,
     IR and can never drift.  ``stateful_keys`` names buckets whose
     compressor carries sync state (probed by the runtime, mirrored by
     :func:`compressor_stateful` for mesh-free callers); ``donated``
-    lists the donated sync-state buffer names (``sync:<key>``)."""
+    lists the donated sync-state buffer names (``sync:<key>``);
+    ``fused_kernels`` the ACTIVE fused Pallas kernels (already
+    drop-filtered — ``ops.fused_kernels.resolve_fused``), which switch
+    the affected legs to their fused kinds (docs/kernels.md)."""
     axes = {str(k): int(v) for k, v in axes.items()}
     d = max(int(axes.get(MESH_AXIS_DATA, 1)), 1)
     accum = max(int(accum_steps), 1)
@@ -516,8 +563,10 @@ def build_schedule_ir(*, axes: Dict[str, int], accum_steps: int = 1,
             [], accum_steps=accum, buckets=buckets, d=d,
             has_rs=any(b.mode == MODE_REDUCE_SCATTER for b in buckets))
     stateful = set(stateful_keys)
+    fused = tuple(fused_kernels)
     em = _Emitter()
     reduce_final: Dict[str, str] = {}
+    detect_bytes: Dict[str, int] = {}   # f32 bytes the guard pass touches
     bucket_nodes: List[dict] = []
 
     # Per-variable fallback tier first — the explicit path's tier-3 loop
@@ -533,6 +582,7 @@ def build_schedule_ir(*, axes: Dict[str, int], accum_steps: int = 1,
             reads=(f"grad:{e.name}",) + state,
             writes=(f"red:{e.name}",) + state)
         reduce_final[e.name] = leg.id
+        detect_bytes[e.name] = int(e.nbytes)
 
     for b in buckets:
         rs = b.mode == MODE_REDUCE_SCATTER
@@ -572,6 +622,12 @@ def build_schedule_ir(*, axes: Dict[str, int], accum_steps: int = 1,
         is_stateful = (b.key in stateful) if stateful else (
             not linear and compressor_stateful(b.compressor))
         state = (f"sync:{b.key}",) if is_stateful else ()
+        # Fused hop boundaries (docs/kernels.md): only a quantized ring
+        # chain has per-hop dequantize/requantize arithmetic to fuse.
+        hop_fused = ("quant_hop" in fused and qfmt is not None
+                     and alg == ALG_RING)
+        hop_kind = LEG_FUSED_HOP if hop_fused else LEG_PPERMUTE_HOP
+        detect_bytes[b.key] = int(b.padded_total) * 4
         bucket_nodes.append({
             "key": b.key, "mode": b.mode, "dtype": b.dtype,
             "compressor": b.compressor or "NoneCompressor",
@@ -587,6 +643,9 @@ def build_schedule_ir(*, axes: Dict[str, int], accum_steps: int = 1,
             "requantize_per_hop": bool(qfmt is not None and alg == ALG_RING),
             "vars": [{"name": v.name, "shape": list(v.shape)}
                      for v in b.vars],
+            # fused-kernel hop boundary (omitted when off so every
+            # pre-fusion bucket node — and fingerprint — is unchanged)
+            **({"hop_fused": True} if hop_fused else {}),
         })
         slots = list(range(accum)) if pipelined else [END_OF_STEP]
         for slot in slots:
@@ -598,13 +657,17 @@ def build_schedule_ir(*, axes: Dict[str, int], accum_steps: int = 1,
                         em, chain=f"{b.key}@{slot}/rs", b=b, d=d,
                         axis=MESH_AXIS_DATA, slot=slot, stage=stage,
                         deps=(), reads=reads, writes=writes,
-                        per_hop=hop_nbytes)
+                        per_hop=hop_nbytes, hop_kind=hop_kind)
                 else:
                     mid = _ring_chain(
                         em, chain=f"{b.key}@{slot}/rs", b=b, d=d,
                         axis=MESH_AXIS_DATA, slot=slot, stage=stage,
                         deps=(), reads=reads, writes=(),
-                        per_hop=hop_nbytes)
+                        per_hop=hop_nbytes, hop_kind=hop_kind)
+                    # The gather stage's per-hop work is a plain
+                    # dequantize-into-place (EQuARX stage 2) — no
+                    # accumulate/requantize boundary to fuse, so its
+                    # hops keep the unfused kind.
                     last = _ring_chain(
                         em, chain=f"{b.key}@{slot}/ag", b=b, d=d,
                         axis=MESH_AXIS_DATA, slot=slot, stage=stage,
@@ -622,15 +685,30 @@ def build_schedule_ir(*, axes: Dict[str, int], accum_steps: int = 1,
             reduce_final[b.key] = last.id
 
     # Guard roll-up: ONE small all-axis psum over every bucket/var
-    # partial (docs/numerics.md) — depends on every reduce final.
+    # partial (docs/numerics.md) — depends on every reduce final.  With
+    # the fused guard kernel the per-key detection arithmetic (the
+    # measured 5-7% of BENCH_guard.json — not the psum) becomes an
+    # explicit fused_detect leg per key: one Pallas pass producing the
+    # finite-count and sq-norm partials together, priced by its own
+    # calibration kind.
     guard_id = None
     if guard:
+        rollup_deps = list(reduce_final.values())
+        if "guard" in fused:
+            for key, lid in sorted(reduce_final.items()):
+                leg = em.emit(
+                    chainable=False, id=f"detect/{key}",
+                    kind=LEG_FUSED_DETECT, bucket=key, dtype="float32",
+                    nbytes=int(detect_bytes.get(key, 0)),
+                    slot=END_OF_STEP, alg=ALG_FUSED, sig="detect",
+                    deps=(lid,), reads=(f"red:{key}",))
+                rollup_deps.append(leg.id)
         leg = em.emit(
             id="guard/rollup", kind=LEG_PSUM_GUARD, bucket="~numerics",
             dtype="float32",
             nbytes=4 * (len(reduce_final) + 2), axis="", slot=END_OF_STEP,
             alg=ALG_FUSED, sig="guard",
-            deps=tuple(reduce_final.values()),
+            deps=tuple(rollup_deps),
             reads=tuple(f"red:{k}" for k in reduce_final)
             + ("sync:~numerics",),
             writes=("sync:~numerics",))
@@ -641,11 +719,15 @@ def build_schedule_ir(*, axes: Dict[str, int], accum_steps: int = 1,
     # the issue chain, ordered purely by data deps.
     rs_nodes = [n for n in bucket_nodes if n["mode"] == MODE_REDUCE_SCATTER]
     update_of: Dict[str, str] = {}
+    # Fused unscale/clip/update (docs/kernels.md): only the ZeRO-1 flat
+    # bucket-major shard update fuses — the tree update stays the optax
+    # chain regardless.
+    rs_update_kind = LEG_FUSED_UPDATE if "update" in fused else LEG_UPDATE
     for n in rs_nodes:
         key = n["key"]
         deps = [reduce_final[key]] + ([guard_id] if guard_id else [])
         leg = em.emit(
-            chainable=False, id=f"update/{key}", kind=LEG_UPDATE,
+            chainable=False, id=f"update/{key}", kind=rs_update_kind,
             bucket=key, dtype=n["dtype"],
             nbytes=int(n["padded_total"]
                        * np.dtype(n["dtype"]).itemsize // d),
@@ -698,11 +780,13 @@ def build_schedule_ir(*, axes: Dict[str, int], accum_steps: int = 1,
     return ScheduleIR(
         axes=axes, accum_steps=accum, overlap_mode=plan.mode, guard=guard,
         prefetch=bool(plan.prefetch), buckets=bucket_nodes, legs=em.legs,
-        gather_order=gather_order, donated=tuple(donated))
+        gather_order=gather_order, donated=tuple(donated),
+        fused_kernels=fused)
 
 
 def ir_from_facts(facts: Sequence[PlanFact], *, axes: Dict[str, int],
-                  accum_steps: int = 1, guard: bool = False) -> ScheduleIR:
+                  accum_steps: int = 1, guard: bool = False,
+                  fused_kernels: Sequence[str] = ()) -> ScheduleIR:
     """Mesh-free IR construction from per-variable plan facts — the
     analyzer's and the GSPMD transform's entry point.  Routing mirrors
     the runtime exactly: when any plan implies the explicit path
@@ -753,7 +837,8 @@ def ir_from_facts(facts: Sequence[PlanFact], *, axes: Dict[str, int],
         axes=axes, accum_steps=accum_steps, buckets=buckets, plan=plan,
         per_var=per_var, guard=guard, donated=donated,
         stateful_keys=stateful_buckets,
-        per_var_alg=ALG_FUSED if explicit else ALG_PSUM_TREE)
+        per_var_alg=ALG_FUSED if explicit else ALG_PSUM_TREE,
+        fused_kernels=fused_kernels)
 
 
 # -- the static schedule verifier --------------------------------------------
@@ -769,6 +854,7 @@ RULE_QUANTIZED_PIPELINED = "schedule/quantized-pipelined"
 RULE_READ_AFTER_DONATE = "schedule/read-after-donate"
 RULE_COLLECTIVE_MISMATCH = "schedule/collective-mismatch"
 RULE_REDUCTION_ORDER = "schedule/reduction-order-divergence"
+RULE_FUSED_INCONSISTENT = "schedule/fused-inconsistent"
 
 
 @dataclass(frozen=True)
@@ -840,9 +926,11 @@ def verify(ir: ScheduleIR) -> List[Violation]:
     by_id = {l.id: l for l in legs}
 
     # -- ring chains: degenerate axes + exact hop order -------------------
+    # (fused_hop legs are ppermute hops with a fused compute boundary —
+    # one chain grammar, so the order/degeneracy rules cover both.)
     chains: Dict[str, List[Leg]] = {}
     for l in legs:
-        if l.kind == LEG_PPERMUTE_HOP:
+        if l.kind in RING_HOP_KINDS:
             chains.setdefault(l.chain or l.id, []).append(l)
     for chain, hops in chains.items():
         axis = hops[0].axis
@@ -891,7 +979,7 @@ def verify(ir: ScheduleIR) -> List[Violation]:
         if l.kind not in COLLECTIVE_KINDS or not is_quantizing(l.compressor):
             continue
         capable = quant_ring.is_quant_ring_compressor(l.compressor)
-        if l.kind == LEG_PPERMUTE_HOP:
+        if l.kind in RING_HOP_KINDS:
             if not capable:
                 out.append(Violation(
                     RULE_QUANTIZED_PIPELINED, SEV_ERROR,
@@ -955,6 +1043,39 @@ def verify(ir: ScheduleIR) -> List[Violation]:
                 "reduces in ring order on the explicit lowering but psum "
                 "tree order on GSPMD: low-precision rounding makes the "
                 "two lowerings diverge beyond reordering tolerance",
+                location=node["key"]))
+
+    # -- fused-kernel consistency: legs vs the IR's fused record ----------
+    # A fused-kind leg in a program whose ``fused_kernels`` record does
+    # not claim that kernel (or a fused hop for a compressor with no
+    # per-hop requantize lowering) means the two halves of the lowering
+    # disagree about what runs — the fused kernel would read state the
+    # unfused path owns, or vice versa.
+    claimed = set(ir.fused_kernels)
+    _kind_kernel = {kind: k for k, kind in FUSED_KERNEL_KINDS.items()}
+    for l in legs:
+        kernel = _kind_kernel.get(l.kind)
+        if kernel is None:
+            continue
+        if kernel not in claimed:
+            out.append(Violation(
+                RULE_FUSED_INCONSISTENT, SEV_ERROR,
+                f"leg {l.id!r} has fused kind {l.kind!r} but the program "
+                f"does not record fused kernel {kernel!r}: the fused and "
+                "unfused halves of the lowering disagree", leg=l.id))
+        if l.kind == LEG_FUSED_HOP \
+                and not quant_ring.is_quant_ring_compressor(l.compressor):
+            out.append(Violation(
+                RULE_FUSED_INCONSISTENT, SEV_ERROR,
+                f"fused ring hop {l.id!r} carries compressor "
+                f"{l.compressor!r}, which has no per-hop requantize "
+                "lowering to fuse", leg=l.id))
+    for node in ir.buckets:
+        if node.get("hop_fused") and "quant_hop" not in claimed:
+            out.append(Violation(
+                RULE_FUSED_INCONSISTENT, SEV_ERROR,
+                f"bucket {node['key']!r} is marked hop_fused but the "
+                "program does not record fused kernel 'quant_hop'",
                 location=node["key"]))
 
     # -- donation race: no read reachable after a donated buffer's write --

@@ -63,8 +63,17 @@ class HealthAccumulator:
     finalizes them with one psum (or locally, on the GSPMD path where
     values are already global)."""
 
-    def __init__(self, total_devices: int = 1):
+    def __init__(self, total_devices: int = 1, *, fused: bool = False,
+                 interpret: Optional[bool] = None):
         self._n = max(int(total_devices), 1)
+        #: fused detection (docs/kernels.md): per-key statistics come
+        #: from ONE Pallas pass producing the non-finite count and the
+        #: squared-norm partial together, instead of two separate
+        #: full-vector reductions.  The finite BIT (count > 0) and
+        #: therefore the skip decision are bit-identical to the unfused
+        #: arithmetic; the sq partial matches to f32 summation order.
+        self._fused = bool(fused)
+        self._interpret = interpret
         #: key -> (sq_partial, nonfinite_count, sat_value, sat_kind)
         #: sat_kind: None | "flag" (pre-quantization 0/1) | "count"
         #: (post-quantization clipped/overflowed element count)
@@ -92,11 +101,30 @@ class HealthAccumulator:
         import jax.numpy as jnp
 
         repl = self._n / max(int(shard_axes_size) or 1, 1)
-        v32 = value.astype(jnp.float32)
-        sq = jnp.sum(v32 * v32) / repl
-        fin_t = value if finite_src is None else finite_src
-        nonfinite = (1.0 - jnp.all(jnp.isfinite(fin_t)).astype(
-            jnp.float32)) / self._n
+        if self._fused:
+            from autodist_tpu.ops.fused_kernels import fused_detect_stats
+            from autodist_tpu.telemetry.timeline import sync_span
+
+            # One kernel pass per tensor yields BOTH statistics; when
+            # the finite bit comes from a different tensor than the
+            # norm (the pre-pack vector vs the reduced shard) each
+            # tensor still pays exactly one pass.
+            with sync_span(f"fused_pack_detect/{key}"):
+                nf_value, sq_raw = fused_detect_stats(
+                    value, interpret=self._interpret)
+                if finite_src is None:
+                    nf = nf_value
+                else:
+                    nf, _ = fused_detect_stats(
+                        finite_src, interpret=self._interpret)
+            sq = sq_raw / repl
+            nonfinite = (nf > 0).astype(jnp.float32) / self._n
+        else:
+            v32 = value.astype(jnp.float32)
+            sq = jnp.sum(v32 * v32) / repl
+            fin_t = value if finite_src is None else finite_src
+            nonfinite = (1.0 - jnp.all(jnp.isfinite(fin_t)).astype(
+                jnp.float32)) / self._n
         if sat_count is not None:
             sat, kind = sat_count.astype(jnp.float32), "count"
         elif saturation is not None:

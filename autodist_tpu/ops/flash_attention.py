@@ -32,46 +32,22 @@ from jax.experimental import pallas as pl
 from jax.sharding import Mesh, PartitionSpec as P
 
 from autodist_tpu.const import MESH_AXIS_DATA, MESH_AXIS_MODEL
+from autodist_tpu.ops import pallas_utils
 from autodist_tpu.utils import compat
 
 _NEG_INF = -1e30  # finite -inf: keeps exp()/max() NaN-free (masked rows)
-_TILE = 128           # MXU lane quantum: pad unit and block alignment
+# Tiling policy lives in ops/pallas_utils.py (shared by every Pallas
+# kernel in the repo); these aliases keep this module's historical
+# private names importable (tests pin the padding policy through them).
+_TILE = pallas_utils.TILE
+_pick_block = pallas_utils.pick_block
+_pad_len = pallas_utils.pad_len
+_use_interpret = pallas_utils.use_interpret
 # Default q/k block edge.  Measured on TPU v5e (B=2,H=8,D=64, causal,
 # fwd+bwd, vs XLA dense attention): 512 gives ~1.0x at T=2048, ~1.8x at
 # T=4096, ~3.2x at T=8192; 128 loses to dense.  _pick_block degrades
 # gracefully for sequences 512 doesn't divide.
 _DEFAULT_BLOCK = 512
-
-
-def _pick_block(t: int, target: int) -> int:
-    """Largest block ≤ ``target`` dividing ``t``, preferring multiples of
-    the MXU tile (``_pad_len`` guarantees a 128-multiple divisor exists on
-    the compiled path; tiny interpret-mode sequences fall back to any
-    divisor)."""
-    b = min(t, target)
-    for cand in range(b - b % _TILE, 0, -_TILE):
-        if t % cand == 0:
-            return cand
-    while t % b:
-        b -= 1
-    return b
-
-
-def _pad_len(t: int, interpret: bool) -> int:
-    """Sequence length after padding to an MXU-tileable length.  Compiled
-    Pallas requires (8,128)-aligned tiles; interpret mode has no such
-    constraint.  ≤128 → next multiple of 8 (the whole sequence is one
-    block); >128 → next multiple of 128 (a 128-multiple block always
-    divides)."""
-    if interpret:
-        return t
-    if t <= _TILE:
-        return -(-t // 8) * 8
-    return -(-t // _TILE) * _TILE
-
-
-def _use_interpret() -> bool:
-    return jax.devices()[0].platform != "tpu"
 
 
 # ---------------------------------------------------------------------------

@@ -29,7 +29,9 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-_TILE = 128          # MXU lane quantum
+from autodist_tpu.ops import pallas_utils, quant_scale
+
+_TILE = pallas_utils.TILE          # MXU lane quantum
 _DEFAULT_BLOCK_N = 512
 
 
@@ -58,13 +60,15 @@ def quantize_weight(w: jax.Array) -> Quantized:
                          f"shape {w.shape}")
     w = w.astype(jnp.float32)
     amax = jnp.max(jnp.abs(w), axis=0, keepdims=True)       # [1, N]
-    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
-    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    # Shared scale rule (ops/quant_scale.py): per-channel amax/127 with
+    # all-zero columns pinned at the identity scale.
+    scale = quant_scale.channel_scale(amax, 127.0)
+    q = quant_scale.quantize_values(w / scale, 127.0, jnp.int8,
+                                    rounded=True)
     return Quantized(q=q, scale=scale)
 
 
-def _use_interpret() -> bool:
-    return jax.devices()[0].platform != "tpu"
+_use_interpret = pallas_utils.use_interpret
 
 
 def _kernel(x_ref, q_ref, s_ref, o_ref):
@@ -80,8 +84,7 @@ def _kernel(x_ref, q_ref, s_ref, o_ref):
     o_ref[...] = (acc * s_ref[...]).astype(o_ref.dtype)
 
 
-def _pad_to(n: int, m: int) -> int:
-    return -(-n // m) * m
+_pad_to = pallas_utils.pad_to
 
 
 @functools.partial(jax.jit, static_argnames=("block_n", "interpret"))

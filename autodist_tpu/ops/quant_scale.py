@@ -1,0 +1,70 @@
+"""The one symmetric-quantization scale rule (shared, drift-proof).
+
+Two quantizers grew up independently: the weight-only serving kernel
+(``ops/quant.py``, per-output-channel scales) and the quantized ring
+collectives (``kernel/synchronization/quant_ring.py``, per-chunk scale
+grid).  Both compute ``scale = amax / qmax`` with a zero-amax guard and
+``q = clip(round(x / scale), ±qmax)`` — but each spelled it locally, so
+the fused hop kernel (``ops/fused_kernels.py``) would have been a THIRD
+spelling of the same arithmetic, free to drift from the compressors it
+must match bit-for-bit.  This module is the single definition all three
+call; it is jax-lazy (imports ``jax.numpy`` inside each function) so the
+pure planning modules that import ``quant_ring`` stay jax-free, and the
+helpers work unchanged INSIDE a Pallas kernel body (jnp ops on loaded
+blocks lower fine there).
+
+Two zero-amax conventions exist on purpose and are kept distinct:
+
+* :func:`chunk_scale` (collectives): floor the scale away from zero
+  (``max(amax/qmax, 1e-30)``) — an all-zero gradient chunk quantizes
+  exactly to zeros and dequantizes exactly back, and the scale stays a
+  well-defined positive number the wire can carry;
+* :func:`channel_scale` (stored weights): an all-zero weight column
+  keeps ``scale = 1.0`` — the stored scale array is long-lived model
+  state and an identity scale is the honest "nothing here" marker.
+"""
+from __future__ import annotations
+
+#: positive floor keeping all-zero-block scales finite and exact.
+SCALE_FLOOR = 1e-30
+
+
+def chunk_scale(amax, qmax: float):
+    """Per-chunk collective-wire scale: ``max(amax / qmax,
+    SCALE_FLOOR)``.  ``amax`` is the chunk's FINITE absolute max (the
+    caller masks non-finite entries — they land in the saturation
+    counter instead of flattening the grid)."""
+    import jax.numpy as jnp
+
+    return jnp.maximum(amax / qmax, SCALE_FLOOR)
+
+
+def channel_scale(amax, qmax: float):
+    """Per-output-channel stored-weight scale: ``amax / qmax`` with
+    all-zero channels pinned at the identity scale 1.0."""
+    import jax.numpy as jnp
+
+    return jnp.where(amax > 0, amax / qmax, 1.0)
+
+
+def quantize_values(y, qmax: float, wire_dtype, *, rounded: bool):
+    """Clip ``y`` (already divided by its scale) to the wire rail and
+    cast.  ``rounded=True`` is the integer grid (round-to-nearest before
+    the clip, the int8 rule); ``rounded=False`` lets the float wire
+    (fp8) do its own rounding in the cast."""
+    import jax.numpy as jnp
+
+    if rounded:
+        y = jnp.round(y)
+    return jnp.clip(y, -qmax, qmax).astype(wire_dtype)
+
+
+def saturation_count(y, finite, qmax: float, *, rounded: bool):
+    """Elements this quantize event clips to the rail or received
+    non-finite — the post-quantization saturation counter the numerics
+    guard rolls up.  ``y`` is the scaled (pre-clip) value, ``finite``
+    the per-element finiteness mask of the source."""
+    import jax.numpy as jnp
+
+    mag = jnp.abs(jnp.round(y)) if rounded else jnp.abs(y)
+    return jnp.sum((~finite) | (finite & (mag > qmax)))

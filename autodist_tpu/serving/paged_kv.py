@@ -37,7 +37,13 @@ static-shape TPU rules):
   paging gives up — on real TPUs this is where a paged-attention
   kernel goes); what it buys is admission decoupled from memory shape:
   any free slot plus enough free blocks admits any request, and block
-  tables never force a recompile (they are data, not shape).
+  tables never force a recompile (they are data, not shape).  With
+  ``AUTODIST_FUSED_KERNELS=paged_attention`` the decode program drops
+  the gather entirely: the fused Pallas kernel
+  (``ops/fused_kernels.py``, docs/kernels.md) reads K/V straight
+  through the block table via scalar-prefetch index maps with the
+  flash-attention online-softmax structure; off-TPU the gather path
+  stays, with a shared drop-reason WARN.
 
 Numerics are the same single-definition ``TransformerLayer`` math as
 training/decode (the ``attn_fn`` seam), so greedy paged output equals
@@ -68,6 +74,29 @@ from autodist_tpu.serving.engine import _sample_per_slot
 #: freed block can be handed to a new owner between dispatches without
 #: any risk of a stale slot scribbling on it.
 SCRATCH_BLOCK = 0
+
+_paged_kernel_warned = False
+
+
+def _use_fused_paged_attention() -> bool:
+    """Does this trace lower decode attention through the fused Pallas
+    paged-attention kernel (``ops/fused_kernels.py``, opted in via
+    ``AUTODIST_FUSED_KERNELS=paged_attention``)?  Resolved at TRACE
+    time — the jit cache pins the decision per program, like every
+    other static knob of ``_paged_chunk_program``.  A requested kernel
+    this platform cannot run falls back to the gather-per-layer path
+    with one shared drop-reason WARN."""
+    global _paged_kernel_warned
+    from autodist_tpu.ops import fused_kernels as fk
+    from autodist_tpu.utils import logging
+
+    active, why = fk.paged_attention_status()
+    if why is not None and not _paged_kernel_warned:
+        _paged_kernel_warned = True
+        logging.warning(
+            "paged decode: fused paged-attention kernel falls back to "
+            "the gather-per-layer program (%s)", why)
+    return active
 
 
 class BlockPoolExhausted(RuntimeError):
@@ -387,6 +416,7 @@ def _paged_token_step(layer_params, ln_final_scale, embed, x, kc, vc,
                            Quantized)
     x = x[:, None, :]                                   # [B, 1, D]
     mask = jnp.arange(w)[None, None, :] <= rel[:, None, None]  # [B,1,W]
+    fused_attn = _use_fused_paged_attention()
     for i, lp in enumerate(layer_params):
         cache_out = {}
 
@@ -394,6 +424,15 @@ def _paged_token_step(layer_params, ln_final_scale, embed, x, kc, vc,
             kcn = kc.at[_i, blk, off].set(k[:, 0].astype(kc.dtype))
             vcn = vc.at[_i, blk, off].set(v[:, 0].astype(vc.dtype))
             _out["k"], _out["v"] = kcn, vcn
+            if fused_attn:
+                # Fused paged-attention kernel (docs/kernels.md): the
+                # block table drives scalar-prefetch index maps, so the
+                # kernel DMAs exactly the physical blocks each slot's
+                # window names — no [B, W, H, Dh] gather materialized
+                # per layer per tick.
+                from autodist_tpu.ops.fused_kernels import paged_attention
+                return paged_attention(q[:, 0], kcn[_i], vcn[_i], bt,
+                                       rel)[:, None]
             # each slot's logical window, gathered from the pool
             kb = jnp.take(kcn[_i], bt, axis=0).reshape(b, w, heads, hd)
             vb = jnp.take(vcn[_i], bt, axis=0).reshape(b, w, heads, hd)

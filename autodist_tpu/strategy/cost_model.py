@@ -371,7 +371,7 @@ def _leg_wire_bytes(leg, d: int) -> float:
     already carry per-hop bytes; the guard psum is scalar-sized)."""
     from autodist_tpu.kernel.synchronization import schedule_ir as sir
 
-    if leg.kind == sir.LEG_PPERMUTE_HOP:
+    if leg.kind in sir.RING_HOP_KINDS:
         return float(leg.nbytes)
     if leg.kind in (sir.LEG_ALL_REDUCE, sir.LEG_PS_EXCHANGE):
         return allreduce_bytes(float(leg.nbytes), d)
@@ -394,18 +394,36 @@ def leg_cost_s(leg, ir, constants=None, *,
     from autodist_tpu.kernel.synchronization import schedule_ir as sir
 
     d = max(int(ir.axes.get(leg.axis, 1)), 1) if leg.axis else 1
-    if leg.kind == sir.LEG_UPDATE:
-        if constants is not None and "update" in constants.bandwidths:
-            return constants.leg_time_s("update", float(leg.nbytes))
+    if leg.kind in (sir.LEG_UPDATE, sir.LEG_FUSED_UPDATE,
+                    sir.LEG_FUSED_DETECT):
+        # HBM-bound local passes.  Fused kinds price through their OWN
+        # calibration constants when fitted (fused-vs-unfused must rank
+        # as distinct alternatives); an unfitted fused_update falls back
+        # to the unfused update constant, and everything degrades to the
+        # raw HBM clock.
+        if constants is not None:
+            if leg.kind in constants.bandwidths:
+                return constants.leg_time_s(leg.kind, float(leg.nbytes))
+            if leg.kind == sir.LEG_FUSED_UPDATE \
+                    and "update" in constants.bandwidths:
+                return constants.leg_time_s("update", float(leg.nbytes))
         return float(leg.nbytes) / HBM_BANDWIDTH
     if leg.kind not in sir.COLLECTIVE_KINDS:
         return None
     wire = _leg_wire_bytes(leg, d)
     launches = 1 if (d > 1 or leg.kind == sir.LEG_PSUM_GUARD) else 0
-    if constants is not None and leg.kind in constants.bandwidths:
-        t = wire / constants.bandwidths[leg.kind]
+    kind = leg.kind
+    if constants is not None and kind not in constants.bandwidths \
+            and kind == sir.LEG_FUSED_HOP \
+            and sir.LEG_PPERMUTE_HOP in constants.bandwidths:
+        # Unfitted fused hops borrow the unfused hop constants — a
+        # calibration run that never measured the fused wire should
+        # not make it look free (or infinitely slow).
+        kind = sir.LEG_PPERMUTE_HOP
+    if constants is not None and kind in constants.bandwidths:
+        t = wire / constants.bandwidths[kind]
         if launches:
-            t += constants.alphas.get(leg.kind, COLLECTIVE_ALPHA)
+            t += constants.alphas.get(kind, COLLECTIVE_ALPHA)
         if sir.is_quantizing(leg.compressor):
             t += constants.quant_overhead_per_byte * wire
         return t
@@ -460,9 +478,21 @@ def estimate_ir_cost(ir, *, ici_bandwidth: float = ICI_BANDWIDTH,
     calibrated_comm_s = 0.0
     update_s = 0.0
     for leg in ir.legs:
-        if leg.kind == sir.LEG_UPDATE and constants is not None \
-                and "update" in constants.bandwidths:
-            update_s += constants.leg_time_s("update", float(leg.nbytes))
+        if leg.kind in (sir.LEG_UPDATE, sir.LEG_FUSED_UPDATE,
+                        sir.LEG_FUSED_DETECT):
+            # Local HBM-bound legs join the estimate once calibration
+            # knows their cost (fused kinds carry their own constants so
+            # fused-vs-unfused price as distinct alternatives; an
+            # unfitted fused_update borrows the unfused update constant
+            # inside leg_cost_s).
+            fitted = constants is not None and (
+                leg.kind in constants.bandwidths
+                or (leg.kind in (sir.LEG_UPDATE, sir.LEG_FUSED_UPDATE)
+                    and "update" in constants.bandwidths))
+            if fitted:
+                t = leg_cost_s(leg, ir, constants)
+                if t is not None:
+                    update_s += t
             continue
         if leg.kind not in sir.COLLECTIVE_KINDS:
             continue

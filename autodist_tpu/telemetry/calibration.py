@@ -271,9 +271,14 @@ def straggler_reason(per_host_step_time_s: Optional[Dict[str, float]],
 
 #: leg kinds the per-kind regression fits (the schedule-IR vocabulary,
 #: mirrored here as strings so this module stays jax-free and
-#: import-light).
+#: import-light).  The fused kinds (docs/kernels.md) are first-class:
+#: a fused_hop / fused_detect / fused_update sample fits ITS OWN
+#: constants, so ``estimate_ir_cost`` and ``AutoStrategy(search=True)``
+#: see fused-vs-unfused as distinct priced alternatives and
+#: ``telemetry/leg-drift`` watches each independently.
 LEG_KINDS = ("reduce_scatter", "all_gather", "all_reduce",
-             "ppermute_hop", "psum_guard", "ps_exchange", "update")
+             "ppermute_hop", "psum_guard", "ps_exchange", "update",
+             "fused_hop", "fused_detect", "fused_update")
 
 #: compressor names whose wire is full-precision: any other compressor
 #: tag on a sample marks it quantized for the quantize-overhead term.

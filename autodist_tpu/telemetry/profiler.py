@@ -158,6 +158,9 @@ SYNC_SCOPE_PREFIX = "autodist_sync/"
 #: first match wins; fragments mirror the sync_span call sites in
 #: explicit_sync.py / overlap.py / quant_ring.py.
 _SPAN_KIND_RULES: Tuple[Tuple[str, str], ...] = (
+    ("quant_ring_fused/", "fused_hop"),
+    ("fused_pack_detect", "fused_detect"),
+    ("fused_shard_update", "fused_update"),
     ("ring_reduce_scatter/", "ppermute_hop"),
     ("ring_all_gather/", "ppermute_hop"),
     ("quant_ring_reduce_scatter/", "ppermute_hop"),
@@ -217,7 +220,7 @@ class LegProfiler:
         fingerprint = ir.fingerprint()
         groups: Dict[Tuple, List[Any]] = {}
         for leg in ir.legs:
-            if leg.kind == "update" and not include_update:
+            if leg.kind in ("update", "fused_update") and not include_update:
                 continue
             key = (leg.kind, leg.alg, leg.dtype, leg.compressor,
                    leg.axis, int(leg.nbytes))
@@ -277,7 +280,8 @@ class LegProfiler:
         n = max(int(nbytes) // dt.itemsize, 1)
         mesh = self._mesh
         collective = kind in ("reduce_scatter", "all_gather", "all_reduce",
-                              "ppermute_hop", "psum_guard", "ps_exchange")
+                              "ppermute_hop", "fused_hop", "psum_guard",
+                              "ps_exchange")
         if collective and mesh is not None and axis \
                 and int(dict(mesh.shape).get(axis, 1)) > 1:
             from jax.sharding import PartitionSpec as P
@@ -295,7 +299,10 @@ class LegProfiler:
                 body = lambda x: jax.lax.all_gather(  # noqa: E731
                     x, axis, tiled=True)
                 out_spec = P()
-            elif kind == "ppermute_hop":
+            elif kind in ("ppermute_hop", "fused_hop"):
+                # A fused hop is still one ppermute on the wire; its
+                # compute boundary rides the kernel, so the micro-run's
+                # wire cost is the honest shared part.
                 perm = [(i, (i + 1) % d) for i in range(d)]
                 body = lambda x: jax.lax.ppermute(  # noqa: E731
                     x, axis, perm)
@@ -308,13 +315,22 @@ class LegProfiler:
                 check_vma=False))
             arg = jnp.zeros((n,), dt)
             return fn, arg
-        if kind == "update":
+        if kind in ("update", "fused_update"):
             # Adam-shaped: read param+2 slots, write param+2 slots — the
-            # HBM-bound memory traffic the update leg models.
+            # HBM-bound memory traffic the update leg models.  The
+            # fused_update micro-run times the same arithmetic XLA-fused
+            # (the kernel's one-pass cost on real TPU shows up in its
+            # own fitted constant instead).
             def body(p):
                 m = p * 0.9
                 v = p * p * 0.999
                 return p - 1e-3 * m / (jnp.sqrt(v) + 1e-8)
+        elif kind == "fused_detect":
+            # The guard statistics pass: one read of the bucket
+            # producing both the finite count and the squared sum.
+            def body(p):
+                return (jnp.sum(p * p),
+                        jnp.sum(1.0 - jnp.isfinite(p).astype(jnp.float32)))
         else:
             # Degenerate-axis collective: the data movement collapses;
             # time the local touch of the buffer (honest lower bound).
