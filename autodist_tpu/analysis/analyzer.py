@@ -124,6 +124,10 @@ class AnalysisContext:
     # "predicted_step_time_s": ...} — predicted_vs_measured() output)
     # — enables the telemetry/* rules; None without a recorded run.
     telemetry: Optional[dict] = None
+    # Resilience provenance ({"checkpoint_interval_steps": ...,
+    # "step_time_s": ..., "snapshot_every": ...}) — enables the
+    # resilience/* rules (recovery-gap); None without a recovery config.
+    resilience: Optional[dict] = None
     # Sync-schedule IR cache (built once by analysis.schedule.ir_for;
     # shared with the collectives pass and the CLI --dump-ir).
     schedule_ir: Any = None
@@ -182,6 +186,7 @@ def _load_passes() -> None:
         legality,
         memory,
         precision,
+        resilience,
         schedule,
         sync_coverage,
         telemetry,
@@ -195,14 +200,15 @@ def _load_passes() -> None:
 #: elastic-resume and telemetry rules (each inert without its
 #: provenance).
 PASS_ORDER = ("legality", "sync", "memory", "collectives", "schedule",
-              "precision", "elastic", "telemetry")
+              "precision", "elastic", "telemetry", "resilience")
 
 
 def analyze(strategy_or_compiled, graph_item: GraphItem, *,
             mesh=None, resource_spec=None, budget_bytes: Optional[int] = None,
             batch=None, passes: Optional[Tuple[str, ...]] = None,
             elastic: Optional[dict] = None,
-            telemetry: Optional[dict] = None
+            telemetry: Optional[dict] = None,
+            resilience: Optional[dict] = None
             ) -> AnalysisReport:
     """Run the static pass pipeline and return an :class:`AnalysisReport`.
 
@@ -232,6 +238,12 @@ def analyze(strategy_or_compiled, graph_item: GraphItem, *,
         ``telemetry.calibration.predicted_vs_measured()`` summary of a
         recorded run — enabling the ``telemetry/*`` rules
         (``telemetry/model-drift``); inert when None.
+      resilience: recovery-config provenance —
+        ``{"checkpoint_interval_steps": ..., "step_time_s": ...[,
+        "snapshot_every": ..., "recovery_budget_s": ...]}`` (e.g.
+        ``telemetry.goodput.checkpoint_cadence`` over a recorded run)
+        — enabling the ``resilience/*`` rules
+        (``resilience/recovery-gap``); inert when None.
     """
     _load_passes()
     strategy, compiled, axes = _resolve_axes(
@@ -242,7 +254,8 @@ def analyze(strategy_or_compiled, graph_item: GraphItem, *,
                           axes=axes, compiled=compiled,
                           resource_spec=resource_spec,
                           budget_bytes=budget_bytes, batch=batch,
-                          elastic=elastic, telemetry=telemetry)
+                          elastic=elastic, telemetry=telemetry,
+                          resilience=resilience)
     report = AnalysisReport()
     selected = PASS_ORDER if passes is None else tuple(passes)
     for name in selected:

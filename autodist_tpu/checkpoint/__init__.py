@@ -1,2 +1,15 @@
 from autodist_tpu.checkpoint.saver import Saver  # noqa: F401
 from autodist_tpu.checkpoint.saved_model_builder import SavedModelBuilder  # noqa: F401
+from autodist_tpu.checkpoint.tiers import (  # noqa: F401
+    CheckpointTiers,
+    PeerMirror,
+    RamSnapshot,
+    SnapshotError,
+    SnapshotRing,
+    buddy_of,
+    capture_snapshot,
+    load_snapshot,
+    route_restore,
+    snapshot_from_bytes,
+    snapshot_to_bytes,
+)

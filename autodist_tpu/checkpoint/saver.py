@@ -64,6 +64,40 @@ GOOD_MARKER = "VERIFIED_GOOD.json"
 #: autodist_meta schema version (1 = step/has_sync_state only).
 META_FORMAT = 2
 
+# -- chaos seams (resilience/chaos.py) ---------------------------------------
+# ``storage_stall`` injects slow/blocking checkpoint writes; registered
+# pre-save hooks fire at the top of every Saver.save (the
+# ``kill@...,during=save`` drill arms one that os._exits there, so the
+# stranded-partial-save recovery path is exercisable on demand).
+_storage_stall_s: float = 0.0
+_pre_save_hooks: list = []
+
+
+def set_storage_stall(seconds: float) -> None:
+    """Make every subsequent save/wait sleep ``seconds`` first — the
+    deterministic slow-storage drill (0 clears it)."""
+    global _storage_stall_s
+    _storage_stall_s = max(float(seconds), 0.0)
+
+
+def add_pre_save_hook(fn) -> None:
+    """Register ``fn(path)`` to run at the top of every save (chaos:
+    kill-during-save).  Test/drill seam — not a public extension point."""
+    _pre_save_hooks.append(fn)
+
+
+def clear_save_hooks() -> None:
+    global _storage_stall_s
+    _storage_stall_s = 0.0
+    _pre_save_hooks.clear()
+
+
+def _maybe_stall(where: str) -> None:
+    if _storage_stall_s > 0:
+        logging.warning("CHAOS storage_stall: %s blocked %.3fs", where,
+                        _storage_stall_s)
+        time.sleep(_storage_stall_s)
+
 
 class Saver:
     """Save/restore a :class:`DistributedSession`'s state.
@@ -102,11 +136,26 @@ class Saver:
         self._gc_dir: Optional[str] = None
         self._pending_mark: Optional[str] = None
         self._ckptr = ocp.AsyncCheckpointer(ocp.CompositeCheckpointHandler())
+        #: wall seconds the last PERSISTENT save took to become durable
+        #: (sync saves: the whole save; async: measured at the next
+        #: wait/save boundary) — what the preemption deadline decision
+        #: compares against AUTODIST_PREEMPT_GRACE_S.
+        self.last_persist_s: Optional[float] = None
+        self._async_t0: Optional[float] = None
 
     def wait(self) -> None:
         """Block until any in-flight async save is durable on disk, then
-        apply any deferred good-mark and retention."""
-        self._ckptr.wait_until_finished()
+        apply any deferred good-mark and retention.  The wait is
+        phase-tagged on the heartbeat beacon: a long storage stall here
+        must read as a checkpoint wait, not a wedge."""
+        from autodist_tpu.resilience.heartbeat import heartbeat_phase
+
+        with heartbeat_phase("checkpoint/wait"):
+            _maybe_stall("Saver.wait")
+            self._ckptr.wait_until_finished()
+        if self._async_t0 is not None:
+            self.last_persist_s = time.perf_counter() - self._async_t0
+            self._async_t0 = None
         self._apply_pending_mark()
         self._maybe_gc()
 
@@ -346,11 +395,19 @@ class Saver:
         if session is None:
             raise ValueError("Saver has no bound session")
         t_save = time.perf_counter()
-        self._ckptr.wait_until_finished()   # one async save in flight max
-        self._apply_pending_mark()
-        self._maybe_gc()                    # previous save is durable now
         step = session.step_count if step is None else step
         path = self._step_dir(directory, step)
+        for hook in list(_pre_save_hooks):   # chaos: kill-during-save
+            hook(path)
+        from autodist_tpu.resilience.heartbeat import heartbeat_phase
+        with heartbeat_phase("checkpoint/save"):
+            _maybe_stall("Saver.save")
+            self._ckptr.wait_until_finished()  # one async save in flight max
+        if self._async_t0 is not None:      # the PREVIOUS async save
+            self.last_persist_s = time.perf_counter() - self._async_t0
+            self._async_t0 = None
+        self._apply_pending_mark()
+        self._maybe_gc()                    # previous save is durable now
         # LOGICAL layout (pad-to-divisible sharding stripped): checkpoints
         # stay interchangeable with single-device programs and across
         # mesh topologies regardless of physical padding.
@@ -398,15 +455,19 @@ class Saver:
         )
         if has_sync:
             items["sync_state"] = ocp.args.StandardSave(session.sync_state)
-        self._ckptr.save(os.path.abspath(path),
-                         args=ocp.args.Composite(**items), force=True)
-        self._gc_dir = directory
-        if mark_good:
-            self._pending_mark = path
-        if not self._async:
-            self._ckptr.wait_until_finished()
-            self._apply_pending_mark()
-            self._maybe_gc()
+        with heartbeat_phase("checkpoint/save"):
+            self._ckptr.save(os.path.abspath(path),
+                             args=ocp.args.Composite(**items), force=True)
+            self._gc_dir = directory
+            if mark_good:
+                self._pending_mark = path
+            if not self._async:
+                self._ckptr.wait_until_finished()
+                self.last_persist_s = time.perf_counter() - t_save
+                self._apply_pending_mark()
+                self._maybe_gc()
+            else:
+                self._async_t0 = t_save
         logging.info("checkpoint %s: %s (step %d)",
                      "saving in background" if self._async else "saved",
                      path, step)
@@ -432,6 +493,11 @@ class Saver:
         if session is None:
             raise ValueError("Saver has no bound session")
         t_restore = time.perf_counter()
+        from autodist_tpu.resilience.heartbeat import heartbeat_phase
+        with heartbeat_phase("checkpoint/restore"):
+            return self._restore_inner(path, session, t_restore)
+
+    def _restore_inner(self, path: str, session, t_restore: float) -> int:
         self._ckptr.wait_until_finished()   # don't read an in-flight save
         self._apply_pending_mark()
         path = os.path.abspath(path)

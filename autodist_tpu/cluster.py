@@ -249,6 +249,41 @@ class Cluster:
             _copy, retryable=(subprocess.CalledProcessError, OSError),
             label=f"remote_copy {local_path} -> {address}:{remote_path}")
 
+    def remote_fetch(self, remote_path: str, local_path: str,
+                     address: str) -> None:
+        """Copy a file FROM ``address`` — the inverse of
+        :meth:`remote_copy`, added for the peer checkpoint tier: a
+        replaced host pulls its mirrored RAM snapshot from the buddy
+        that survived (``checkpoint/tiers.py``).  Same retry schedule
+        as the push side."""
+        if ENV.AUTODIST_DEBUG_REMOTE.val:
+            logging.info("DEBUG_REMOTE fetch %s:%s -> %s", address,
+                         remote_path, local_path)
+            return
+        if is_local_address(address):
+            if os.path.abspath(local_path) != os.path.abspath(remote_path):
+                os.makedirs(os.path.dirname(local_path) or ".",
+                            exist_ok=True)
+                import shutil
+
+                shutil.copy(remote_path, local_path)
+            return
+        conf = self._spec.ssh_config_for(address) or SSHConfig()
+        cmd = ["scp", "-o", "StrictHostKeyChecking=no",
+               "-o", "BatchMode=yes", "-P", str(conf.port)]
+        if conf.key_file:
+            cmd += ["-i", os.path.expanduser(conf.key_file)]
+        target = (f"{conf.username}@{address}" if conf.username else address)
+        scp = cmd + [f"{target}:{remote_path}", local_path]
+
+        def _fetch():
+            os.makedirs(os.path.dirname(local_path) or ".", exist_ok=True)
+            subprocess.run(scp, check=True)
+
+        self._retry.retry(
+            _fetch, retryable=(subprocess.CalledProcessError, OSError),
+            label=f"remote_fetch {address}:{remote_path} -> {local_path}")
+
     def remote_file_write(self, remote_path: str, data: str,
                           address: str) -> None:
         """Write ``data`` into a file on ``address``

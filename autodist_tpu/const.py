@@ -70,6 +70,14 @@ def _bool_default_true(v):
     return v not in ("False", "false", "0")
 
 
+def _float0(v):
+    return float(v) if v else 0.0
+
+
+def _int2(v):
+    return int(v) if v else 2
+
+
 class ENV(enum.Enum):
     """Typed environment-variable registry.
 
@@ -151,6 +159,22 @@ class ENV(enum.Enum):
     AUTODIST_SUPERVISOR_DIR = ("AUTODIST_SUPERVISOR_DIR", _str)
     # deterministic fault-injection spec (resilience.chaos grammar)
     AUTODIST_CHAOS = ("AUTODIST_CHAOS", _str)
+    # preemption grace window in seconds (docs/resilience.md): at a
+    # preemption notice, fit compares the last measured persistent-save
+    # time against this deadline and routes the emergency state to the
+    # peer RAM tier when a durable save cannot finish.  0 = no deadline
+    # (always attempt the persistent save — the pre-tier behavior)
+    AUTODIST_PREEMPT_GRACE_S = ("AUTODIST_PREEMPT_GRACE_S", _float0)
+    # RAM checkpoint tier (checkpoint/tiers.py): device→host snapshot
+    # cadence in steps (0 = tier off), ring depth, and the peer-mirror
+    # directory (a tmpfs path like /dev/shm/... in production; any
+    # shared dir in tests).  fit() arguments override all three.
+    AUTODIST_SNAPSHOT_EVERY = ("AUTODIST_SNAPSHOT_EVERY", _int0)
+    AUTODIST_SNAPSHOT_KEEP = ("AUTODIST_SNAPSHOT_KEEP", _int2)
+    AUTODIST_SNAPSHOT_DIR = ("AUTODIST_SNAPSHOT_DIR", _str)
+    # buddy host address RAM snapshots mirror to (default: the next
+    # host in the ResourceSpec ring — checkpoint.tiers.buddy_of)
+    AUTODIST_BUDDY = ("AUTODIST_BUDDY", _str)
     # which supervisor attempt this process belongs to (chaos/test filters)
     AUTODIST_ATTEMPT = ("AUTODIST_ATTEMPT", _int0)
     # jax.distributed coordinator (host:port)

@@ -134,7 +134,14 @@ class Coordinator:
                             "AUTODIST_TPU_WORKDIR",
                             ENV.AUTODIST_CHAOS.name,
                             ENV.AUTODIST_ATTEMPT.name,
-                            ENV.AUTODIST_SUPERVISOR_DIR.name):
+                            ENV.AUTODIST_SUPERVISOR_DIR.name,
+                            # recovery-tier knobs (checkpoint/tiers.py):
+                            # every worker snapshots on the chief's
+                            # cadence into the shared mirror layout
+                            ENV.AUTODIST_SNAPSHOT_EVERY.name,
+                            ENV.AUTODIST_SNAPSHOT_KEEP.name,
+                            ENV.AUTODIST_SNAPSHOT_DIR.name,
+                            ENV.AUTODIST_PREEMPT_GRACE_S.name):
             if os.environ.get(passthrough):
                 env[passthrough] = os.environ[passthrough]
         return self._cluster.remote_exec(

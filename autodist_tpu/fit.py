@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import contextlib
 import itertools
+import os
 import signal as _signal
 import time
 from typing import Any, Callable, Dict, Iterable, Optional, Sequence, Union
@@ -95,6 +96,18 @@ class History:
         #: True when ``fit`` stopped early on a preemption signal (the
         #: partial epoch is NOT counted in ``epochs_run``).
         self.preempted = False
+        #: which checkpoint tier took the emergency preemption state
+        #: ("persistent" | "peer" | None) — the deadline decision's
+        #: outcome (docs/resilience.md); callers exiting on preemption
+        #: should use resilience.PREEMPTED_EXIT_CODE so the supervisor
+        #: relaunches without consuming the restart budget.
+        self.preempt_tier: Optional[str] = None
+        #: which tier the resume came from ("ram" | "peer" |
+        #: "persistent" | None when fit started fresh).
+        self.resume_tier: Optional[str] = None
+        #: this attempt's goodput summary (telemetry.goodput
+        #: .attempt_goodput output), or None when fit ran no steps.
+        self.goodput: Optional[dict] = None
 
     def _sample(self, step: int, loss: float) -> None:
         self.history["loss"].append(loss)
@@ -174,7 +187,11 @@ def fit(session, data: DataArg, epochs: int = 1,
         prefetch_depth: int = 2,
         preemption_signals: Sequence = (),
         on_nonfinite: Optional[str] = None,
-        validate: bool = False) -> History:
+        validate: bool = False,
+        snapshot_every: int = 0,
+        snapshot_keep: Optional[int] = None,
+        snapshot_dir: Optional[str] = None,
+        tiers=None) -> History:
     """Train ``epochs`` × (``steps_per_epoch`` or len(data)) steps.
 
     ``epochs`` is the TOTAL target, Keras-style: resuming an interrupted
@@ -245,6 +262,29 @@ def fit(session, data: DataArg, epochs: int = 1,
         ``NumericsConfig.max_rollbacks``).  Requires the numerics guard;
         ``raise``/``rollback`` cost one host sync per step.
 
+      snapshot_every: enable the RAM checkpoint tier
+        (``checkpoint/tiers.py``, docs/resilience.md): every N steps a
+        device→host snapshot of the training state lands in an
+        in-process ring and mirrors to the peer directory — recovery in
+        seconds with at most N steps lost, independent of the
+        persistent ``checkpoint_every`` cadence.  0 (default) defers to
+        ``AUTODIST_SNAPSHOT_EVERY``.
+      snapshot_keep: RAM/peer ring depth (default
+        ``AUTODIST_SNAPSHOT_KEEP``, 2).
+      snapshot_dir: the peer-mirror directory (RAM-backed in
+        production, e.g. under /dev/shm); defaults to
+        ``AUTODIST_SNAPSHOT_DIR`` or ``<checkpoint_dir>/peer_tier``.
+      tiers: a pre-built :class:`~autodist_tpu.checkpoint.tiers
+        .CheckpointTiers` (e.g. with a Cluster-backed buddy transport);
+        overrides the three knobs above.  With any tier configured,
+        ``resume`` routes RAM-local → peer-fetch → persistent (newest
+        usable step wins), so a replaced host rejoins from a
+        survivor's mirror without touching persistent storage.  At a
+        preemption notice, ``AUTODIST_PREEMPT_GRACE_S`` decides whether
+        the persistent save can finish inside the grace window or the
+        emergency snapshot goes to the peer tier instead
+        (``history.preempt_tier`` records the outcome).
+
       validate: run the static pre-flight analyzer
         (:mod:`autodist_tpu.analysis`) on the session's compiled
         strategy before anything else — before the checkpoint restore,
@@ -292,31 +332,52 @@ def fit(session, data: DataArg, epochs: int = 1,
     saver = None
     resumed_step = None
     data_resume = None
+    resume_tier = None
     track_data = hasattr(data, "state") and hasattr(data, "load_state")
     if checkpoint_dir is not None:
         from autodist_tpu.checkpoint import Saver
 
         saver = Saver(session, async_save=async_checkpoints,
                       keep=checkpoint_keep)
-        if resume:
-            latest = Saver.latest_checkpoint(checkpoint_dir)
-            if latest is not None:
-                resumed_step = saver.restore(latest)
-                logging.info("fit: resumed from %s at step %d",
-                             latest, resumed_step)
-                if track_data:
-                    ds = Saver.read_meta(latest).get("data_state")
-                    if ds:
-                        try:
-                            data_resume = data.load_state(ds)
-                            logging.info(
-                                "fit: exact data resume — continuing at "
-                                "epoch %d batch %d", data_resume["epoch"],
-                                data_resume["offset"])
-                        except (ValueError, KeyError) as e:
-                            logging.warning(
-                                "fit: checkpoint data state unusable (%s); "
-                                "resuming at epoch granularity", e)
+    # RAM/peer checkpoint tiers (docs/resilience.md): explicit object,
+    # fit knobs, or the AUTODIST_SNAPSHOT_* env config, in that order.
+    if tiers is None:
+        from autodist_tpu.checkpoint.tiers import CheckpointTiers
+        from autodist_tpu.const import ENV
+
+        every = snapshot_every or ENV.AUTODIST_SNAPSHOT_EVERY.val
+        if every:
+            peer_dir = snapshot_dir or ENV.AUTODIST_SNAPSHOT_DIR.val or (
+                os.path.join(checkpoint_dir, "peer_tier")
+                if checkpoint_dir else None)
+            keep = snapshot_keep if snapshot_keep is not None \
+                else ENV.AUTODIST_SNAPSHOT_KEEP.val
+            tiers = CheckpointTiers(session, snapshot_every=every,
+                                    keep=keep, peer_dir=peer_dir,
+                                    buddy=ENV.AUTODIST_BUDDY.val or None)
+    elif tiers._session is None:
+        tiers._session = session
+    if resume and (checkpoint_dir is not None or tiers is not None):
+        from autodist_tpu.checkpoint.tiers import route_restore
+
+        routed = route_restore(session, checkpoint_dir, tiers=tiers)
+        if routed is not None:
+            resumed_step, resume_tier, resume_meta = routed
+            logging.info("fit: resumed at step %d from the %s tier",
+                         resumed_step, resume_tier)
+            if track_data:
+                ds = resume_meta.get("data_state")
+                if ds:
+                    try:
+                        data_resume = data.load_state(ds)
+                        logging.info(
+                            "fit: exact data resume — continuing at "
+                            "epoch %d batch %d", data_resume["epoch"],
+                            data_resume["offset"])
+                    except (ValueError, KeyError) as e:
+                        logging.warning(
+                            "fit: checkpoint data state unusable (%s); "
+                            "resuming at epoch granularity", e)
 
     if initial_epoch is None:
         if data_resume is not None:
@@ -377,52 +438,159 @@ def fit(session, data: DataArg, epochs: int = 1,
 
     preempt = {"signum": None}
     hist = History()
+    hist.resume_tier = resume_tier
     guard_state = {"last_finite": None, "last_skipped": None}
-    with _preemption_handlers(handler_nums, preempt):
-        # on_train_begin runs INSIDE the handler scope: a SIGTERM during
-        # a slow user callback must still flag (and checkpoint at the
-        # first step boundary), not kill the process.
-        for cb in callbacks:
-            cb.on_train_begin(session)
-        rollbacks = 0
-        while True:
-            try:
-                last_saved_step = _fit_epochs(
-                    session=session, data=data, epochs=epochs,
-                    steps_per_epoch=steps_per_epoch,
-                    validation_data=validation_data,
-                    validation_steps=validation_steps, callbacks=callbacks,
-                    log_every=log_every, checkpoint_dir=checkpoint_dir,
-                    checkpoint_every=checkpoint_every,
-                    prefetch_depth=prefetch_depth,
-                    initial_epoch=initial_epoch,
-                    saver=saver, hist=hist, preempt=preempt,
-                    data_track=data_track, monitor=monitor,
-                    guard_state=guard_state)
-                break
-            except _RollbackRequest as rb:
-                rollbacks += 1
-                initial_epoch = _handle_rollback(
-                    session=session, saver=saver,
-                    checkpoint_dir=checkpoint_dir, data=data, rb=rb,
-                    rollbacks=rollbacks, num_cfg=num_cfg, epochs=epochs,
-                    steps_per_epoch=steps_per_epoch,
-                    data_track=data_track, hist=hist, monitor=monitor)
-                guard_state["last_finite"] = None
-                guard_state["last_skipped"] = None
+    # Goodput accounting (docs/observability.md): wall clock from here,
+    # checkpoint stalls and rollback re-run loss accumulated as they
+    # happen, the summary emitted/gauged before fit returns.
+    t_fit0 = time.perf_counter()
+    goodput = {"ckpt_stall_s": 0.0, "rollback_s": 0.0}
+    try:
+        with _preemption_handlers(handler_nums, preempt):
+            # on_train_begin runs INSIDE the handler scope: a SIGTERM
+            # during a slow user callback must still flag (and
+            # checkpoint at the first step boundary), not kill the
+            # process.
+            for cb in callbacks:
+                cb.on_train_begin(session)
+            rollbacks = 0
+            while True:
+                try:
+                    last_saved_step = _fit_epochs(
+                        session=session, data=data, epochs=epochs,
+                        steps_per_epoch=steps_per_epoch,
+                        validation_data=validation_data,
+                        validation_steps=validation_steps,
+                        callbacks=callbacks,
+                        log_every=log_every, checkpoint_dir=checkpoint_dir,
+                        checkpoint_every=checkpoint_every,
+                        prefetch_depth=prefetch_depth,
+                        initial_epoch=initial_epoch,
+                        saver=saver, hist=hist, preempt=preempt,
+                        data_track=data_track, monitor=monitor,
+                        guard_state=guard_state, tiers=tiers,
+                        goodput=goodput)
+                    break
+                except _RollbackRequest as rb:
+                    rollbacks += 1
+                    initial_epoch = _handle_rollback(
+                        session=session, saver=saver,
+                        checkpoint_dir=checkpoint_dir, data=data, rb=rb,
+                        rollbacks=rollbacks, num_cfg=num_cfg, epochs=epochs,
+                        steps_per_epoch=steps_per_epoch,
+                        data_track=data_track, hist=hist, monitor=monitor,
+                        goodput=goodput)
+                    guard_state["last_finite"] = None
+                    guard_state["last_skipped"] = None
 
-    if (saver is not None and hist.steps_run
-            and last_saved_step != session.step_count):
-        # Never lose the tail epochs to the checkpoint_every stride.
-        saver.save(checkpoint_dir, step=session.step_count,
-                   extra_meta=_data_state_meta(data_track),
-                   mark_good=_guard_clean(guard_state, monitor))
-    if saver is not None:
-        saver.wait()   # async saves must be durable before fit returns
+        if (saver is not None and hist.steps_run and not hist.preempted
+                and last_saved_step != session.step_count):
+            # Never lose the tail epochs to the checkpoint_every stride.
+            # (A preempted fit already routed its emergency state.)
+            t0 = time.perf_counter()
+            saver.save(checkpoint_dir, step=session.step_count,
+                       extra_meta=_data_state_meta(data_track),
+                       mark_good=_guard_clean(guard_state, monitor))
+            goodput["ckpt_stall_s"] += time.perf_counter() - t0
+    finally:
+        # ALWAYS in a finally: a SIGTERM-raised exception (or any crash)
+        # racing an async save must not strand a partial step dir — the
+        # in-flight save becomes durable before the process exits.
+        if saver is not None:
+            t0 = time.perf_counter()
+            saver.wait()
+            goodput["ckpt_stall_s"] += time.perf_counter() - t0
 
+    hist.goodput = _finish_goodput(session, hist, goodput,
+                                   time.perf_counter() - t_fit0)
     for cb in callbacks:
         cb.on_train_end(hist)
     return hist
+
+
+def _attempt_useful_s(session, steps_run: int) -> Optional[float]:
+    """Useful (forward-progress) seconds this attempt: mean measured
+    step time × steps run.  None when telemetry recorded nothing — the
+    goodput ratio is then reported unknown instead of flattered."""
+    rec = getattr(session, "telemetry", None)
+    if rec is None or not steps_run:
+        return None
+    times = [r.step_time_s for r in rec.records if r.step_time_s]
+    if not times:
+        return None
+    return float(np.mean(times)) * steps_run
+
+
+def _finish_goodput(session, hist, goodput: dict,
+                    wall_s: float) -> Optional[dict]:
+    """Per-attempt goodput summary: gauge + journal + History field."""
+    if not hist.steps_run:
+        return None
+    from autodist_tpu.const import ENV
+    from autodist_tpu.telemetry import attempt_goodput, emit_event, gauge
+
+    gp = attempt_goodput(wall_s, _attempt_useful_s(session, hist.steps_run),
+                         ckpt_stall_s=goodput["ckpt_stall_s"],
+                         rollback_s=goodput["rollback_s"],
+                         steps=hist.steps_run)
+    if gp.get("goodput_ratio") is not None:
+        gauge("autodist_goodput_ratio",
+              "useful step time / wall time of the last fit attempt"
+              ).set(gp["goodput_ratio"])
+    emit_event("goodput/attempt", attempt=ENV.AUTODIST_ATTEMPT.val,
+               preempted=hist.preempted, resume_tier=hist.resume_tier,
+               **gp)
+    return gp
+
+
+def _preempt_save(*, session, saver, tiers, checkpoint_dir, data_track,
+                  guard_state, monitor, goodput) -> Optional[str]:
+    """The deadline-aware preemption decision (docs/resilience.md): can
+    the persistent save finish inside ``AUTODIST_PREEMPT_GRACE_S``, or
+    does the emergency state go to the peer RAM tier instead?
+
+    The estimate is the last MEASURED persistent-save duration
+    (``Saver.last_persist_s``) with a 1.25x safety margin; with a grace
+    deadline set and no measurement yet, the peer tier wins (seconds,
+    bounded) over gambling the whole grace window on unknown storage.
+    No deadline (grace 0/unset) keeps the legacy always-persist path.
+    Returns the tier that took the state, None when nothing could."""
+    from autodist_tpu.const import ENV
+    from autodist_tpu.resilience.heartbeat import heartbeat_phase
+    from autodist_tpu.telemetry import emit_event
+
+    grace = ENV.AUTODIST_PREEMPT_GRACE_S.val
+    est = saver.last_persist_s if saver is not None else None
+    can_peer = tiers is not None and tiers.enabled \
+        and tiers.mirror is not None
+    if saver is None:
+        use_peer = can_peer
+    elif grace > 0:
+        use_peer = can_peer and (est is None or est * 1.25 >= grace)
+    else:
+        use_peer = False
+    emit_event("checkpoint/preempt_decision", step=session.step_count,
+               grace_s=grace or None, est_persist_s=est,
+               tier="peer" if use_peer else
+               ("persistent" if saver is not None else None))
+    t0 = time.perf_counter()
+    # The drain is phase-tagged on the heartbeat beacon: the monitor
+    # reports DRAINING, not WEDGED, while the grace window runs.
+    with heartbeat_phase("draining"):
+        if use_peer:
+            snap = tiers.snapshot(session.step_count,
+                                  extra_meta=_data_state_meta(data_track),
+                                  emergency=True)
+            goodput["ckpt_stall_s"] += time.perf_counter() - t0
+            return "peer" if snap is not None else None
+        if saver is not None:
+            saver.save(checkpoint_dir, step=session.step_count,
+                       extra_meta=_data_state_meta(data_track),
+                       mark_good=_guard_clean(guard_state, monitor))
+            saver.wait()   # the process exits right after: must be durable
+            goodput["ckpt_stall_s"] += time.perf_counter() - t0
+            return "persistent"
+    return None
 
 
 def _data_state_meta(data_track) -> Optional[dict]:
@@ -508,7 +676,7 @@ def _timed_batches(it, rec):
 
 def _handle_rollback(*, session, saver, checkpoint_dir, data, rb,
                      rollbacks, num_cfg, epochs, steps_per_epoch,
-                     data_track, hist, monitor) -> int:
+                     data_track, hist, monitor, goodput=None) -> int:
     """Anomaly rollback (docs/numerics.md): restore the last
     verified-good checkpoint, reposition (and optionally re-seed) the
     data, emit a supervisor failure marker, and return the epoch to
@@ -533,6 +701,13 @@ def _handle_rollback(*, session, saver, checkpoint_dir, data, rb,
     hist.history.setdefault("rollbacks", []).append(
         {"at_step": rb.step, "restored_step": restored,
          "reason": rb.reason})
+    if goodput is not None:
+        # Rollback loss: the discarded steps between the anchor and the
+        # failure, priced at the measured mean step time.
+        lost = max(int(rb.step) - int(restored), 0)
+        per_step = _attempt_useful_s(session, 1)
+        if lost and per_step:
+            goodput["rollback_s"] += lost * per_step
     from autodist_tpu.telemetry import emit_event
     emit_event("numerics/rollback", step=rb.step, reason=rb.reason,
                restored_step=restored, rollback_index=rollbacks,
@@ -592,12 +767,14 @@ def _fit_epochs(*, session, data, epochs, steps_per_epoch,
                 validation_data, validation_steps, callbacks, log_every,
                 checkpoint_dir, checkpoint_every, prefetch_depth,
                 initial_epoch, saver, hist, preempt, data_track,
-                monitor=None, guard_state=None):
+                monitor=None, guard_state=None, tiers=None, goodput=None):
     """The epoch loop (split out so ``fit`` can wrap it in the
     signal-handler scope; keyword-only — no positional-order hazard).
     Returns ``last_saved_step``."""
     if guard_state is None:
         guard_state = {"last_finite": None, "last_skipped": None}
+    if goodput is None:
+        goodput = {"ckpt_stall_s": 0.0, "rollback_s": 0.0}
     last_saved_step = None
     for epoch in range(initial_epoch, epochs):
         # The resumed epoch starts at the restored offset; every later
@@ -622,6 +799,20 @@ def _fit_epochs(*, session, data, epochs, steps_per_epoch,
             hist.steps_run += 1
             for cb in callbacks:
                 cb.on_step_end(session.step_count, out)
+            if tiers is not None:
+                # RAM tier cadence: one modulo check when idle; on a
+                # snapshot step the device→host copy is synchronous
+                # (counted as checkpoint stall) and carries the exact
+                # data position so a tier resume is mid-epoch exact.
+                extra = None
+                if data_track["enabled"]:
+                    extra = {"data_state": {
+                        "epoch": epoch,
+                        "offset": epoch_base + epoch_steps,
+                        "seed": data_track["seed"]}}
+                if tiers.on_step(session.step_count,
+                                 extra_meta=extra) is not None:
+                    goodput["ckpt_stall_s"] += tiers.last_snapshot_s or 0.0
             if monitor is not None:
                 # raise/rollback/spike policies: one host sync per step
                 # (documented cost of the active policies).
@@ -671,13 +862,16 @@ def _fit_epochs(*, session, data, epochs, steps_per_epoch,
                 data_track["pos"] = {"epoch": epoch,
                                      "offset": epoch_base + epoch_steps,
                                      "seed": data_track["seed"]}
-            if saver is not None and hist.steps_run:
+            if (saver is not None or tiers is not None) and hist.steps_run:
                 if out is not None:
                     _observe_health(out, hist, guard_state, session)
-                saver.save(checkpoint_dir, step=session.step_count,
-                           extra_meta=_data_state_meta(data_track),
-                           mark_good=_guard_clean(guard_state, monitor))
-                last_saved_step = session.step_count
+                hist.preempt_tier = _preempt_save(
+                    session=session, saver=saver, tiers=tiers,
+                    checkpoint_dir=checkpoint_dir, data_track=data_track,
+                    guard_state=guard_state, monitor=monitor,
+                    goodput=goodput)
+                if hist.preempt_tier == "persistent":
+                    last_saved_step = session.step_count
             for cb in callbacks:
                 cb.on_epoch_end(epoch, {
                     "loss": loss, "epoch_steps": epoch_steps,
@@ -685,8 +879,8 @@ def _fit_epochs(*, session, data, epochs, steps_per_epoch,
             logging.warning(
                 "fit: preempted (signal %d) at step %d%s",
                 preempt["signum"], session.step_count,
-                " — checkpoint saved" if last_saved_step is not None
-                else "")
+                f" — emergency state took the {hist.preempt_tier} tier"
+                if hist.preempt_tier else "")
             break
         if out is None:
             # on_epoch_end still fires so begin/end-paired callbacks stay
@@ -749,9 +943,11 @@ def _fit_epochs(*, session, data, epochs, steps_per_epoch,
         for cb in callbacks:
             cb.on_epoch_end(epoch, logs)
         if saver is not None and (epoch + 1) % checkpoint_every == 0:
+            t0 = time.perf_counter()
             saver.save(checkpoint_dir, step=session.step_count,
                        extra_meta=_data_state_meta(data_track),
                        mark_good=_guard_clean(guard_state, monitor))
+            goodput["ckpt_stall_s"] += time.perf_counter() - t0
             last_saved_step = session.step_count
 
     return last_saved_step

@@ -25,6 +25,10 @@ _EXPORTS = {
     "HeartbeatCallback": "autodist_tpu.resilience.heartbeat",
     "HeartbeatMonitor": "autodist_tpu.resilience.heartbeat",
     "HeartbeatWriter": "autodist_tpu.resilience.heartbeat",
+    "heartbeat_phase": "autodist_tpu.resilience.heartbeat",
+    "set_active_writer": "autodist_tpu.resilience.heartbeat",
+    "PREEMPTED_EXIT_CODE": "autodist_tpu.resilience.supervisor",
+    "SUPERVISED_ABORT_CODE": "autodist_tpu.resilience.supervisor",
     "ChaosCallback": "autodist_tpu.resilience.chaos",
     "ChaosMonkey": "autodist_tpu.resilience.chaos",
     "corrupt_checkpoint": "autodist_tpu.resilience.chaos",

@@ -13,12 +13,26 @@ any other coordinator env) as ``;``-separated events::
 
     kill@step=6,proc=1,attempt=0            # worker 1 exits 43 at step 6
     kill@step=6,proc=1,attempt=0,code=9     # ... with exit code 9
+    kill@step=6,during=save                 # die INSIDE the next Saver.save
     preempt@step=5,signal=SIGTERM           # deliver a preemption notice
+    preempt@step=5,grace=2.5                # ... with a 2.5s grace deadline
+    storage_stall@step=4,seconds=3          # checkpoint writes block 3s
     drop_heartbeats@step=3,proc=2           # beacons stop (wedge drill)
     corrupt_ckpt@step=4,item=params,path=/ckpt/dir   # truncate a step dir
     nan_grad@step=3,bucket=all_reduce:float32:g0:0   # NaN into a bucket
     inf_grad@step=3,var=l0/w                # Inf into one grad leaf
     loss_spike@step=9,factor=1e6            # spike the MONITORED loss
+
+Recovery-tier drills (docs/resilience.md): ``preempt@...,grace=<s>``
+stamps ``AUTODIST_PREEMPT_GRACE_S`` before delivering the signal, so
+``fit``'s deadline decision (persistent save vs peer-tier emergency
+snapshot) runs under the injected budget.  ``storage_stall`` makes
+every subsequent ``Saver.save``/``wait`` block first (the slow-disk
+drill the deadline decision exists for).  ``kill@...,during=save`` arms
+a pre-save hook instead of dying at the step boundary: the process
+os._exits INSIDE the next save, leaving the partial step dir the
+verify/latest_step machinery must skip.  Per the "kills leave
+evidence" rule, every injection is journaled BEFORE it executes.
 
 Filters (``step``/``proc``/``attempt``) all default to "any"; an event
 fires at most once per process.  ``proc`` matches the JAX process index
@@ -50,7 +64,7 @@ from typing import Dict, List, Optional
 from autodist_tpu.utils import logging
 
 ACTIONS = ("kill", "preempt", "drop_heartbeats", "corrupt_ckpt",
-           "nan_grad", "inf_grad", "loss_spike")
+           "storage_stall", "nan_grad", "inf_grad", "loss_spike")
 
 #: events NOT executed by ChaosMonkey.on_step: grad injections compile
 #: into the step (numerics guard), loss_spike rides the health monitor.
@@ -187,12 +201,36 @@ class ChaosMonkey:
                    args=dict(ev.args))
         if ev.action == "kill":
             code = int(ev.args.get("code", DEFAULT_KILL_CODE))
-            # os._exit: no atexit, no orbax flush — a real SIGKILL-grade
-            # death, which is the point.
-            self._exit(code)
+            if ev.args.get("during") == "save":
+                # Die INSIDE the next Saver.save instead of here: the
+                # stranded-partial-save drill.  The injection was
+                # journaled above (arming), so the evidence exists even
+                # though the hook itself cannot journal after os._exit.
+                from autodist_tpu.checkpoint import saver as saver_mod
+
+                saver_mod.add_pre_save_hook(
+                    lambda path, _exit=self._exit, _code=code: (
+                        logging.warning(
+                            "CHAOS: kill during=save firing inside "
+                            "save of %s", path),
+                        _exit(_code)))
+            else:
+                # os._exit: no atexit, no orbax flush — a real
+                # SIGKILL-grade death, which is the point.
+                self._exit(code)
         elif ev.action == "preempt":
+            grace = ev.args.get("grace")
+            if grace is not None:
+                # The deadline drill: fit's preemption decision reads
+                # the grace budget from env at notice time.
+                os.environ["AUTODIST_PREEMPT_GRACE_S"] = str(float(grace))
             sig = getattr(_signal, ev.args.get("signal", "SIGTERM"))
             os.kill(os.getpid(), sig)
+        elif ev.action == "storage_stall":
+            from autodist_tpu.checkpoint import saver as saver_mod
+
+            saver_mod.set_storage_stall(
+                float(ev.args.get("seconds", 1.0)))
         elif ev.action == "drop_heartbeats":
             self._heartbeats = False
         elif ev.action == "corrupt_ckpt":

@@ -52,6 +52,15 @@ RELAUNCH = "relaunch"
 #: distinguishable from ordinary crashes (1) and chaos kills (43).
 SUPERVISED_ABORT_CODE = 73
 
+#: exit code a job uses after a GRACEFUL preemption exit (fit saved its
+#: emergency state inside the grace window and returned with
+#: ``history.preempted``).  The supervisor relaunches it WITHOUT
+#: consuming the restart budget: a preempted host is the platform
+#: reclaiming capacity, not the job failing — burning retry budget on
+#: it would let routine preemptions exhaust the budget real failures
+#: need (docs/resilience.md preemption playbook).
+PREEMPTED_EXIT_CODE = 75
+
 _MARKER_PREFIX = "failure_"
 
 
@@ -191,6 +200,10 @@ class SupervisorPolicy:
     """Knobs of the restart loop (documented in docs/resilience.md)."""
 
     max_restarts: int = 3               # relaunches after the first attempt
+    #: bound on budget-FREE preemption relaunches (exit 75) — a backstop
+    #: against a pathological platform preempting every attempt forever,
+    #: not a recovery budget.
+    max_preemptions: int = 16
     backoff: Backoff = field(
         default_factory=lambda: Backoff(max_tries=8, base=1.0, cap=60.0))
     host_failure_budget: int = 2        # failures before a host is "gone"
@@ -226,7 +239,7 @@ class Attempt:
 @dataclass
 class AttemptFailure:
     attempt: int
-    kind: str                  # "exit" | "heartbeat"
+    kind: str                  # "exit" | "heartbeat" | "preempt"
     culprit: Optional[str]     # host/worker the failure is attributed to
     detail: str = ""
 
@@ -238,6 +251,9 @@ class SupervisorReport:
     hosts: List[str]                     # surviving hosts after the run
     failures: List[AttemptFailure] = field(default_factory=list)
     gave_up: str = ""
+    #: graceful preemption relaunches (exit 75) — informational; they
+    #: did NOT consume the restart budget.
+    preemptions: int = 0
 
 
 LaunchFn = Callable[[Attempt], Union[subprocess.Popen,
@@ -287,7 +303,9 @@ class Supervisor:
     def run(self, launch: LaunchFn) -> SupervisorReport:
         report = SupervisorReport(ok=False, attempts=0,
                                   hosts=list(self._hosts))
-        for index in range(self._policy.max_restarts + 1):
+        index = 0          # launch counter (== report.attempts - 1)
+        restarts = 0       # budget-consuming (non-preemption) relaunches
+        while True:
             report.attempts = index + 1
             att = Attempt(
                 index=index, hosts=list(self._hosts),
@@ -345,17 +363,39 @@ class Supervisor:
                             "budget (%d); policy is not elastic, so "
                             "relaunch keeps targeting it",
                             failure.culprit, n)
-            if index >= self._policy.max_restarts:
+            if failure.kind == "preempt":
+                # Graceful preemption (exit 75): the job checkpointed
+                # inside its grace window and asked to be relaunched.
+                # Relaunch promptly and WITHOUT consuming the restart
+                # budget — bounded only by the max_preemptions backstop.
+                report.preemptions += 1
+                if report.preemptions > self._policy.max_preemptions:
+                    report.gave_up = (
+                        f"preemption backstop exhausted after "
+                        f"{report.preemptions} preemption(s)")
+                    break
+                logging.info(
+                    "supervisor: attempt %d exited on a preemption "
+                    "notice — relaunching without consuming the restart "
+                    "budget (%d/%d preemptions)", index + 1,
+                    report.preemptions, self._policy.max_preemptions)
+                emit_event("supervisor/preempt_relaunch", attempt=index,
+                           preemptions=report.preemptions)
+                index += 1
+                continue
+            restarts += 1
+            if restarts > self._policy.max_restarts:
+                report.gave_up = (f"retry budget exhausted after "
+                                  f"{report.attempts} attempt(s)")
                 break
-            pause = self._policy.backoff.delay(index + 1)
+            pause = self._policy.backoff.delay(restarts)
             logging.info("supervisor: backing off %.2fs before relaunch",
                          pause)
             emit_event("supervisor/backoff", attempt=index,
                        pause_s=round(pause, 3))
             time.sleep(pause)
+            index += 1
         report.hosts = list(self._hosts)
-        report.gave_up = (f"retry budget exhausted after "
-                          f"{report.attempts} attempt(s)")
         logging.error("supervisor: %s", report.gave_up)
         emit_event("supervisor/gave_up", attempts=report.attempts,
                    reason=report.gave_up)
@@ -379,6 +419,11 @@ class Supervisor:
                 code = proc.poll()
                 if code is None:
                     running = True
+                elif code == PREEMPTED_EXIT_CODE:
+                    return AttemptFailure(
+                        att.index, "preempt", None,
+                        f"{name} exited with the preemption code "
+                        f"{code} (graceful drain)")
                 elif code != 0:
                     culprit = self._culprit(att) or name
                     return AttemptFailure(

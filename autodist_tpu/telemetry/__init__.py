@@ -51,6 +51,13 @@ from autodist_tpu.telemetry.calibration import (
     save_calibration,
     straggler_reason,
 )
+from autodist_tpu.telemetry.goodput import (
+    RECOVERY_BUDGET_S,
+    attempt_goodput,
+    checkpoint_cadence,
+    goodput_from_run,
+    recovery_gap_reason,
+)
 from autodist_tpu.telemetry.events import (
     EventJournal,
     configure as configure_events,
@@ -99,10 +106,13 @@ __all__ = [
     "LegProfiler",
     "LegSample",
     "MetricsRegistry",
+    "RECOVERY_BUDGET_S",
     "STRAGGLER_THRESHOLD",
     "StepRecord",
     "StepRecorder",
     "aggregate_run",
+    "attempt_goodput",
+    "checkpoint_cadence",
     "chrome_trace_events",
     "configure_events",
     "configure_spans",
@@ -113,6 +123,7 @@ __all__ = [
     "fit_leg_constants",
     "gauge",
     "get_journal",
+    "goodput_from_run",
     "histogram",
     "host_span",
     "leg_drift_reason",
@@ -129,6 +140,7 @@ __all__ = [
     "prediction_error",
     "read_events",
     "record_span",
+    "recovery_gap_reason",
     "render_prometheus",
     "save_calibration",
     "straggler_reason",

@@ -330,6 +330,29 @@ def main(argv: Optional[List[str]] = None) -> int:
             k: {kk: (round(vv, 6) if isinstance(vv, float) else vv)
                 for kk, vv in row.items()}
             for k, row in leg_kind_totals(leg_samples).items()}
+    # Goodput section (docs/observability.md): useful step time vs wall
+    # time with the restart / checkpoint-stall / rollback decomposition,
+    # plus the recovery-gap verdict over the observed checkpoint cadence
+    # (the same pure rule the resilience/recovery-gap analysis fires).
+    from autodist_tpu.telemetry.goodput import (
+        checkpoint_cadence,
+        goodput_from_run,
+        recovery_gap_reason,
+    )
+
+    gp = goodput_from_run(records, events)
+    if gp:
+        cadence = checkpoint_cadence(records, events)
+        if cadence:
+            gp["cadence"] = cadence
+            gap = recovery_gap_reason(
+                cadence["checkpoint_interval_steps"],
+                cadence["step_time_s"],
+                snapshot_every=cadence.get("snapshot_every"))
+            if gap:
+                gp["recovery_gap"] = gap
+        summary["goodput"] = gp
+
     # Cross-host section whenever records carry more than one host.
     from autodist_tpu.telemetry.aggregate import per_host_step_stats
     from autodist_tpu.telemetry.calibration import straggler_reason
@@ -432,6 +455,27 @@ def main(argv: Optional[List[str]] = None) -> int:
                   f"x{summary['step_skew_ratio']:.2f}")
         if summary.get("straggler"):
             print(f"  WARN telemetry/straggler: {summary['straggler']}")
+    gp = summary.get("goodput")
+    if gp:
+        # Printed even for an events-only directory: the decomposition
+        # (restart gaps, checkpoint stalls) lives in the journal.
+        ratio = gp.get("goodput_ratio")
+        print("  goodput: "
+              + (f"{ratio:.1%}" if ratio is not None else "n/a")
+              + f"  ({gp['useful_step_s']:.3f}s useful"
+              + (f" / {gp['wall_s']:.3f}s wall" if gp.get("wall_s")
+                 else "")
+              + (f", {gp['attempts']} attempt(s)"
+                 if gp.get("attempts") else "") + ")")
+        losses = gp.get("losses") or {}
+        for name in ("restart_s", "checkpoint_stall_s", "rollback_s",
+                     "other_s"):
+            v = losses.get(name)
+            if v:
+                print(f"    loss {name[:-2]:18s} {v:9.3f} s")
+        if gp.get("recovery_gap"):
+            print("  WARN resilience/recovery-gap: "
+                  f"{gp['recovery_gap']}")
     cal = summary.get("calibration")
     if cal:
         print(f"  calibrated: bandwidth {cal['ici_bandwidth']:.3e} B/s, "

@@ -205,6 +205,10 @@ def run_child(platform: str) -> None:
     # child; the numbers compare scheduler modes against each other.
     _fill_serving(result)
     mark("serving")
+    # Fast-recovery checkpoint tiers: its own CPU child (host-side
+    # mechanics); per-tier time-to-recover + goodput under preemption.
+    _fill_recovery(result)
+    mark("recovery")
     _fill_mfu(result, dev, on_tpu, dt, sess, batch)
     if on_tpu:
         # TPU-only like the other enrichments: a projection built on a
@@ -1501,6 +1505,205 @@ def _fill_kernels(result) -> None:
               file=sys.stderr, flush=True)
 
 
+def _fill_recovery(result) -> None:
+    """Fast-recovery checkpoint tiers (docs/resilience.md,
+    BENCH_recovery.json): time-to-recover per tier (RAM-local ring /
+    peer mirror fetch / persistent Orbax), the sync-vs-async checkpoint
+    stall a training loop actually pays, and end-to-end goodput under
+    an injected preemption schedule — gated on the no-litter invariant
+    (no drill may leave snapshot/marker files behind).  Runs in its own
+    CPU child; committed standalone as BENCH_recovery.json."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    cmd = [sys.executable, "-u", os.path.abspath(__file__),
+           "--recovery-child"]
+    try:
+        proc = subprocess.run(cmd, stdout=subprocess.PIPE, env=env,
+                              timeout=600)
+        payload = _extract_json(proc.stdout.decode())
+        if payload is None or proc.returncode != 0:
+            raise RuntimeError(f"no JSON from recovery child "
+                               f"(rc={proc.returncode})")
+        result["recovery"] = payload
+        with open(os.path.join(REPO, "BENCH_recovery.json"), "w",
+                  encoding="utf-8") as f:
+            json.dump(payload, f, indent=2, sort_keys=True)
+            f.write("\n")
+    except Exception as e:  # pragma: no cover - best-effort enrichment
+        print(f"bench: recovery section unavailable ({e!r})",
+              file=sys.stderr, flush=True)
+
+
+def run_recovery_child() -> None:
+    """The recovery-tier measurement (CPU child — recovery mechanics
+    are host-side: device→host snapshot, file mirror, Orbax I/O; tier
+    ratios mean the same thing on any backend).
+
+    Sections: (1) time-to-recover per tier on the same trained state —
+    RAM ring restore vs peer-mirror fetch+restore vs persistent Orbax
+    restore; (2) checkpoint stall per save, sync vs async, with the
+    RAM-snapshot capture cost alongside; (3) a live two-attempt
+    preemption drill — chaos ``preempt@...,grace=...`` forces the
+    emergency state onto the peer tier, the second attempt resumes from
+    it, and goodput is decomposed over the journaled events.  The child
+    FAILS (nonzero) if any drill leaves snapshot/marker litter."""
+    _steer("cpu")
+    import shutil
+    import signal as _signal
+    import tempfile
+
+    import numpy as np
+
+    os.environ["AUTODIST_IS_TESTING"] = "True"
+    import jax.numpy as jnp
+    import optax
+
+    from autodist_tpu.autodist import (
+        AutoDist, _reset_default_autodist_for_testing)
+    from autodist_tpu.checkpoint import Saver
+    from autodist_tpu.checkpoint.tiers import (
+        CheckpointTiers, load_snapshot, route_restore)
+    from autodist_tpu.resilience import ChaosCallback, ChaosMonkey
+    from autodist_tpu.resilience.chaos import parse_chaos
+    from autodist_tpu.strategy import AllReduce
+    from autodist_tpu.telemetry import get_journal
+    from autodist_tpu.telemetry.goodput import goodput_from_run
+
+    work = tempfile.mkdtemp(prefix="bench_recovery_")
+
+    def session(dim=256):
+        _reset_default_autodist_for_testing()
+        rng = np.random.RandomState(0)
+        x = rng.randn(64, dim).astype(np.float32)
+        params = {"w": jnp.zeros((dim, dim), jnp.float32),
+                  "b": jnp.zeros((dim,), jnp.float32)}
+
+        def loss_fn(p, b):
+            pred = b["x"] @ p["w"] + p["b"]
+            return jnp.mean((pred - b["y"]) ** 2)
+
+        ad = AutoDist(strategy_builder=AllReduce())
+        with ad.scope():
+            ad.capture(params=params, optimizer=optax.adam(1e-3),
+                       loss_fn=loss_fn)
+        batch = {"x": x,
+                 "y": rng.randn(64, dim).astype(np.float32)}
+        return ad.create_distributed_session(), batch
+
+    payload = {"work_model": "adam linear 256x256 (~0.5 MB params + "
+                             "1 MB opt state)", "platform": "cpu"}
+
+    # -- 1) time-to-recover per tier --------------------------------------
+    sess, batch = session()
+    ckpt = os.path.join(work, "ck")
+    peer = os.path.join(work, "peer")
+    tiers = CheckpointTiers(sess, snapshot_every=1, keep=2, peer_dir=peer)
+    for _ in range(3):
+        sess.run(batch)
+    saver = Saver(sess)
+    saver.save(ckpt)
+    tiers.snapshot()
+    w_ref = np.asarray(sess.params["w"]).copy()
+
+    t2r = {}
+    # ram: the surviving-process path (ring already in memory)
+    fresh, _ = session()
+    snap = tiers.ring.latest()
+    t0 = time.perf_counter()
+    load_snapshot(fresh, snap)
+    t2r["ram"] = round(time.perf_counter() - t0, 6)
+    # peer: fresh process, mirror fetch + restore
+    fresh, _ = session()
+    t0 = time.perf_counter()
+    step, tier, _meta = route_restore(
+        fresh, None, tiers=CheckpointTiers(fresh, peer_dir=peer))
+    t2r["peer"] = round(time.perf_counter() - t0, 6)
+    assert tier == "peer", tier
+    np.testing.assert_allclose(np.asarray(fresh.params["w"]), w_ref,
+                               rtol=1e-6, atol=1e-7)
+    # persistent: Orbax restore of the same state
+    fresh, _ = session()
+    t0 = time.perf_counter()
+    Saver(fresh).restore(os.path.join(ckpt, f"step_{step}"))
+    t2r["persistent"] = round(time.perf_counter() - t0, 6)
+    payload["time_to_recover_s"] = t2r
+    payload["snapshot_capture_s"] = round(tiers.last_snapshot_s, 6)
+    print(json.dumps(payload), flush=True)
+
+    # -- 2) checkpoint stall: sync vs async saves -------------------------
+    stalls = {}
+    for mode, async_save in (("sync", False), ("async", True)):
+        s2, b2 = session()
+        sv = Saver(s2, async_save=async_save)
+        d = os.path.join(work, f"stall_{mode}")
+        s2.run(b2)
+        total = 0.0
+        for i in range(4):
+            s2.run(b2)
+            t0 = time.perf_counter()
+            sv.save(d, step=s2.step_count)
+            total += time.perf_counter() - t0
+        sv.wait()
+        stalls[f"{mode}_per_save_s"] = round(total / 4, 6)
+    stalls["async_stall_reduction"] = round(
+        stalls["sync_per_save_s"]
+        / max(stalls["async_per_save_s"], 1e-9), 2)
+    payload["checkpoint_stall"] = stalls
+    print(json.dumps(payload), flush=True)
+
+    # -- 3) goodput under an injected preemption schedule -----------------
+    gp_peer = os.path.join(work, "gp_peer")
+    events_before = len(get_journal().events)   # drill events only
+    os.environ["AUTODIST_PREEMPT_GRACE_S"] = "0.001"   # forces peer tier
+    a, ab = session()
+    monkey = ChaosMonkey(parse_chaos("preempt@step=6,signal=SIGUSR1"),
+                         process_index=0)
+    hist_a = a.fit({"x": ab["x"], "y": ab["y"]}, epochs=2,
+                   steps_per_epoch=8, snapshot_every=2,
+                   snapshot_dir=gp_peer,
+                   callbacks=[ChaosCallback(monkey)],
+                   preemption_signals=(_signal.SIGUSR1,))
+    assert hist_a.preempted and hist_a.preempt_tier == "peer", \
+        (hist_a.preempted, hist_a.preempt_tier)
+    records = list(a.telemetry.records) if a.telemetry else []
+    b_sess, bb = session()
+    hist_b = b_sess.fit({"x": ab["x"], "y": ab["y"]}, epochs=2,
+                        steps_per_epoch=8, snapshot_every=2,
+                        snapshot_dir=gp_peer)
+    assert hist_b.resume_tier == "peer", hist_b.resume_tier
+    # dict data resumes at epoch granularity: the partial epoch re-runs
+    # (8 steps) then epoch 1 — 6 + 8 + 8
+    assert b_sess.step_count == 22, b_sess.step_count
+    if b_sess.telemetry:
+        records += list(b_sess.telemetry.records)
+    gp = goodput_from_run(records, get_journal().events[events_before:])
+    payload["goodput_under_preemption"] = {
+        "kill_schedule": "preempt@step=6,grace=0.001 (emergency -> peer)",
+        "attempt_a": hist_a.goodput, "attempt_b": hist_b.goodput,
+        "run": gp,
+    }
+    del os.environ["AUTODIST_PREEMPT_GRACE_S"]
+    print(json.dumps(payload), flush=True)
+
+    # -- 4) no-litter invariant -------------------------------------------
+    tiers.cleanup()
+    for t in (CheckpointTiers(None, peer_dir=peer),
+              CheckpointTiers(None, peer_dir=gp_peer)):
+        t.mirror.clear()
+    litter = []
+    for root_dir in (peer, gp_peer):
+        if os.path.isdir(root_dir):
+            for r, _dirs, files in os.walk(root_dir):
+                litter += [os.path.join(r, f) for f in files]
+    if litter:
+        payload["litter"] = litter
+        print(json.dumps(payload), flush=True)
+        sys.exit(1)
+    payload["no_litter"] = True
+    shutil.rmtree(work, ignore_errors=True)
+    print(json.dumps(payload), flush=True)
+
+
 def run_kernels_child() -> None:
     """The fused-kernel measurement (child process, 8 virtual CPU
     devices — docs/kernels.md).
@@ -2671,6 +2874,8 @@ if __name__ == "__main__":
         run_kernels_child()
     elif "--serving-child" in sys.argv:
         run_serving_child()
+    elif "--recovery-child" in sys.argv:
+        run_recovery_child()
     elif "--probe" in sys.argv:
         run_probe()
     else:
