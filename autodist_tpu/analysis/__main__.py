@@ -262,6 +262,19 @@ def main(argv=None) -> int:
                              "'dot' for a Graphviz dep-graph view; the "
                              "printed JSON carries the schedule_fingerprint "
                              "telemetry and checkpoints stamp")
+    parser.add_argument("--search-report", action="store_true",
+                        help="run the leg-calibrated strategy search "
+                             "(docs/strategies.md 'Search') on the model "
+                             "and dump the top-K candidates with their "
+                             "per-leg-kind cost breakdown plus the "
+                             "legality rule that pruned each rejected "
+                             "branch; the strategy argument is ignored.  "
+                             "Constants come from the discovered "
+                             "calibration.json (AUTODIST_CALIBRATION / "
+                             "AUTODIST_TELEMETRY_DIR) when present")
+    parser.add_argument("--topk", type=int, default=5, metavar="K",
+                        help="candidates to show in --search-report "
+                             "(default 5)")
     parser.add_argument("--json", action="store_true",
                         help="emit the report as JSON")
     parser.add_argument("--warn-as-error", action="store_true",
@@ -284,9 +297,10 @@ def main(argv=None) -> int:
             print((sys.modules[fn.__module__].__doc__ or "").strip())
             print()
         return 0
-    if not args.model or not args.strategy:
+    if not args.model or (not args.strategy and not args.search_report):
         parser.error("model and strategy are required "
-                     "(or use --list-models / --list-rules)")
+                     "(or use --list-models / --list-rules / "
+                     "--search-report, which needs only the model)")
 
     from autodist_tpu.analysis import Severity, analyze
     from autodist_tpu.resource_spec import ResourceSpec
@@ -311,6 +325,20 @@ def main(argv=None) -> int:
     graph_item = _build_graph_item(args.model)
     if args.numerics:
         graph_item.numerics = _parse_numerics(args.numerics)
+
+    if args.search_report:
+        from autodist_tpu.analysis.search import (
+            format_search_report,
+            search_report,
+        )
+        report = search_report(graph_item, resource_spec, axes=axes,
+                               top_k=args.topk)
+        if args.json:
+            print(json.dumps(report, indent=1))
+        else:
+            print(format_search_report(report))
+        return 0 if report.get("best") else 1
+
     strategy = _build_strategy(args.strategy, graph_item, resource_spec)
     if args.overlap:
         from autodist_tpu.strategy.base import AllReduceSynchronizerConfig

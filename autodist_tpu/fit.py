@@ -191,7 +191,8 @@ def fit(session, data: DataArg, epochs: int = 1,
         snapshot_every: int = 0,
         snapshot_keep: Optional[int] = None,
         snapshot_dir: Optional[str] = None,
-        tiers=None) -> History:
+        tiers=None,
+        tuner=None) -> History:
     """Train ``epochs`` × (``steps_per_epoch`` or len(data)) steps.
 
     ``epochs`` is the TOTAL target, Keras-style: resuming an interrupted
@@ -284,6 +285,16 @@ def fit(session, data: DataArg, epochs: int = 1,
         the persistent save can finish inside the grace window or the
         emergency snapshot goes to the peer tier instead
         (``history.preempt_tier`` records the outcome).
+
+      tuner: a :class:`~autodist_tpu.strategy.tuner.ScheduleTuner` —
+        the drift-triggered schedule hot-swap loop (docs/strategies.md
+        "Search").  At the tuner's own ``interval`` cadence the step
+        loop hands it the session: it profiles the running schedule's
+        legs, checks the ``telemetry/leg-drift`` rule against the
+        active calibration, and on drift refits the constants,
+        re-searches, and hot-swaps the schedule in place through the
+        RAM snapshot tier — the loop, callbacks, and checkpointing
+        never notice.  No-op when None.
 
       validate: run the static pre-flight analyzer
         (:mod:`autodist_tpu.analysis`) on the session's compiled
@@ -469,7 +480,7 @@ def fit(session, data: DataArg, epochs: int = 1,
                         saver=saver, hist=hist, preempt=preempt,
                         data_track=data_track, monitor=monitor,
                         guard_state=guard_state, tiers=tiers,
-                        goodput=goodput)
+                        goodput=goodput, tuner=tuner)
                     break
                 except _RollbackRequest as rb:
                     rollbacks += 1
@@ -767,7 +778,8 @@ def _fit_epochs(*, session, data, epochs, steps_per_epoch,
                 validation_data, validation_steps, callbacks, log_every,
                 checkpoint_dir, checkpoint_every, prefetch_depth,
                 initial_epoch, saver, hist, preempt, data_track,
-                monitor=None, guard_state=None, tiers=None, goodput=None):
+                monitor=None, guard_state=None, tiers=None, goodput=None,
+                tuner=None):
     """The epoch loop (split out so ``fit`` can wrap it in the
     signal-handler scope; keyword-only — no positional-order hazard).
     Returns ``last_saved_step``."""
@@ -813,6 +825,11 @@ def _fit_epochs(*, session, data, epochs, steps_per_epoch,
                 if tiers.on_step(session.step_count,
                                  extra_meta=extra) is not None:
                     goodput["ckpt_stall_s"] += tiers.last_snapshot_s or 0.0
+            if tuner is not None:
+                # Drift-triggered schedule hot-swap (docs/strategies.md
+                # "Search"): the tuner owns its cadence and swaps the
+                # session in place, so nothing else in the loop changes.
+                tuner.on_step(session, session.step_count)
             if monitor is not None:
                 # raise/rollback/spike policies: one host sync per step
                 # (documented cost of the active policies).

@@ -784,6 +784,24 @@ def build_schedule_ir(*, axes: Dict[str, int], accum_steps: int = 1,
         fused_kernels=fused)
 
 
+def facts_fingerprint(facts: Sequence[PlanFact], *, axes: Dict[str, int],
+                      accum_steps: int = 1, guard: bool = False,
+                      fused_kernels: Sequence[str] = ()) -> str:
+    """Short stable hash of a candidate's full :func:`ir_from_facts`
+    input — the strategy search's dedupe key.  Two candidates with
+    identical fact sets build byte-identical IRs (the builder is pure),
+    so hashing the INPUT lets the search skip constructing and pricing
+    the duplicate entirely."""
+    blob = json.dumps({
+        "axes": {str(k): int(v) for k, v in axes.items()},
+        "accum_steps": int(accum_steps),
+        "guard": bool(guard),
+        "fused_kernels": list(fused_kernels),
+        "facts": [asdict(f) for f in facts],
+    }, sort_keys=True, separators=(",", ":")).encode()
+    return hashlib.sha256(blob).hexdigest()[:12]
+
+
 def ir_from_facts(facts: Sequence[PlanFact], *, axes: Dict[str, int],
                   accum_steps: int = 1, guard: bool = False,
                   fused_kernels: Sequence[str] = ()) -> ScheduleIR:

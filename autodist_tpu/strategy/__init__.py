@@ -20,9 +20,16 @@ from autodist_tpu.strategy.compiler import (
 from autodist_tpu.strategy.cost_model import (
     CostReport,
     estimate_cost,
+    plan_fingerprint,
     rank_strategies,
 )
 from autodist_tpu.strategy.parallax_strategy import Parallax
+from autodist_tpu.strategy.search import (
+    SearchResult,
+    SearchSpace,
+    beam_search,
+)
+from autodist_tpu.strategy.tuner import ScheduleTuner
 from autodist_tpu.strategy.partitioned_all_reduce_strategy import PartitionedAR
 from autodist_tpu.strategy.partitioned_ps_strategy import PartitionedPS
 from autodist_tpu.strategy.ps_lb_strategy import PSLoadBalancing
@@ -37,8 +44,9 @@ __all__ = [
     "AllReduce", "AllReduceSynchronizerConfig", "AutoStrategy",
     "CompiledStrategy", "CostReport",
     "GraphConfig", "PS", "PSLoadBalancing", "PSSynchronizerConfig", "Parallax",
-    "PartitionedAR", "PartitionedPS", "RandomAxisPartitionAR", "Strategy",
+    "PartitionedAR", "PartitionedPS", "RandomAxisPartitionAR",
+    "ScheduleTuner", "SearchResult", "SearchSpace", "Strategy",
     "StrategyBuilder", "StrategyCompiler", "UnevenPartitionedPS", "VarConfig",
-    "VarPlan", "Zero1", "estimate_cost", "parse_partitioner",
-    "rank_strategies",
+    "VarPlan", "Zero1", "beam_search", "estimate_cost", "parse_partitioner",
+    "plan_fingerprint", "rank_strategies",
 ]

@@ -48,38 +48,87 @@ class AutoStrategy(StrategyBuilder):
         tier (reference chunking semantics).
       compressor: optional gradient compressor for the AllReduce tier.
       search: cost-model search instead of (only) the tier heuristic —
-        the AutoSync move the paper pitches: build every candidate fixed
+        the AutoSync move the paper pitches.  ``True`` (or ``"rank"``)
+        RANKS a fixed candidate list: build every candidate fixed
         builder's strategy PLUS the tier heuristic's, estimate each with
         the rank-calibrated cost model
         (``tests/test_cost_model_calibration.py``), and return the
-        cheapest.  The chosen candidate's name lands in ``last_choice``
-        and the log.  Ties resolve to the earliest candidate — the
-        heuristic tier goes first, so on near-tie dense workloads the
-        structure-aware assignment wins.
+        cheapest.  ``"beam"`` runs the real search
+        (:mod:`autodist_tpu.strategy.search`): seeded beam search over
+        the per-variable partition x sync x overlap x compressor x
+        bucket_bytes space, every candidate pruned by shardlint
+        legality, verified through its schedule IR, and priced
+        leg-by-leg from the discovered ``calibration.json``.  The
+        chosen candidate's name lands in ``last_choice`` and the log
+        (``last_search`` holds the full
+        :class:`~autodist_tpu.strategy.search.SearchResult` for
+        ``"beam"``).  Deterministic run-to-run: candidates with
+        identical plan fingerprints dedupe and ties resolve by
+        ``(cost, candidate name)``.
       candidates: optional builder list for ``search=True`` (defaults to
-        the tier heuristic + every shipped fixed builder).
+        the tier heuristic + every shipped fixed builder; ignored by
+        ``search="beam"``, whose seeds are the shipped builders).
     """
+
+    SEARCH_MODES = (False, True, "rank", "beam")
 
     def __init__(self, partition_threshold: int = 1 << 20,
                  chunk_size: int = 128,
                  compressor: str = "NoneCompressor",
-                 search: bool = False, candidates=None):
+                 search=False, candidates=None):
         if partition_threshold < 1:
             raise ValueError("partition_threshold must be >= 1")
         if chunk_size < 1:
             raise ValueError("chunk_size must be >= 1")
+        if search not in self.SEARCH_MODES:
+            raise ValueError(
+                f"search must be one of {self.SEARCH_MODES}, "
+                f"got {search!r}")
         self._threshold = partition_threshold
         self._chunk_size = chunk_size
         self._compressor = compressor
         self._search = search
         self._candidates = candidates
         self.last_choice: str = ""
+        #: the full SearchResult of the last search="beam" build.
+        self.last_search = None
 
     def build(self, graph_item: GraphItem,
               resource_spec: ResourceSpec) -> Strategy:
+        if self._search == "beam":
+            return self._build_beam(graph_item, resource_spec)
         if self._search:
             return self._build_search(graph_item, resource_spec)
         return self._build_tiers(graph_item, resource_spec)
+
+    def _build_beam(self, graph_item: GraphItem,
+                    resource_spec: ResourceSpec) -> Strategy:
+        """The real search (docs/strategies.md "Search"): beam over the
+        per-variable plan space, legality-pruned, IR-verified, priced
+        leg-by-leg from calibration.  A quantizing ``compressor=`` is
+        the accuracy opt-in that widens the compressor axis beyond full
+        precision (the existing search=True rule, generalized)."""
+        from autodist_tpu.strategy.search import (
+            SearchSpace,
+            beam_search,
+        )
+
+        compressors = ["NoneCompressor"]
+        if self._compressor and self._compressor != "NoneCompressor":
+            compressors.append(self._compressor)
+        space = SearchSpace(compressors=tuple(compressors))
+        result = beam_search(graph_item, resource_spec, space=space)
+        self.last_search = result
+        if result.best is None or result.best_strategy is None:
+            from autodist_tpu.analysis import StrategyValidationError
+            from autodist_tpu.analysis.analyzer import analyze
+
+            report = analyze(
+                self._build_tiers(graph_item, resource_spec), graph_item,
+                resource_spec=resource_spec, passes=("legality", "sync"))
+            raise StrategyValidationError(report)
+        self.last_choice = result.best.name
+        return result.best_strategy
 
     def _build_search(self, graph_item: GraphItem,
                       resource_spec: ResourceSpec) -> Strategy:
@@ -133,10 +182,20 @@ class AutoStrategy(StrategyBuilder):
                 "AutoStrategy(search): using calibrated constants "
                 "(bandwidth %.3e B/s, alpha %.3e s) from calibration.json",
                 calibration.ici_bandwidth, calibration.alpha)
+        from autodist_tpu.strategy.cost_model import plan_fingerprint
+
         best = None
         pruned = 0
+        seen_plans = set()
         for builder in candidates:
             strategy = builder.build(graph_item, resource_spec)
+            # Deterministic ranking: candidates that degenerate to the
+            # SAME per-variable plan dedupe on their fingerprint, so the
+            # winner cannot flip between equal plans run-to-run.
+            fp = plan_fingerprint(strategy)
+            if fp in seen_plans:
+                continue
+            seen_plans.add(fp)
             # Static pre-flight (legality + sync coverage) BEFORE paying
             # for cost modeling: an illegal candidate (indivisible
             # partition, uncovered trainable) is pruned here instead of
@@ -153,8 +212,12 @@ class AutoStrategy(StrategyBuilder):
                 continue
             cost = estimate_cost(strategy, graph_item, resource_spec,
                                  **cost_kwargs)
-            if best is None or cost.time_s < best[2].time_s:
-                best = (type(builder).__name__, strategy, cost)
+            # Ties break by (cost, builder name) — reproducible whatever
+            # order the candidate list arrives in.
+            name = type(builder).__name__
+            if best is None or (cost.time_s, name) < (best[2].time_s,
+                                                      best[0]):
+                best = (name, strategy, cost)
         if best is None:
             from autodist_tpu.analysis import StrategyValidationError
 

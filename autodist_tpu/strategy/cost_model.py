@@ -50,8 +50,10 @@ estimates for *ranking*, not predictions of wall clock.
 """
 from __future__ import annotations
 
+import hashlib
+import json
 from dataclasses import dataclass, field
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -135,6 +137,10 @@ class CostReport:
     # Wire bytes left on the critical path after the overlap schedule
     # (== wire_bytes when nothing overlaps).
     exposed_wire_bytes: float = 0.0
+    # Per-leg-kind exposed seconds (filled by estimate_ir_cost only —
+    # the plan-level estimate has no legs to attribute): the breakdown
+    # the search explain surface prints.
+    per_kind: Dict[str, float] = field(default_factory=dict)
 
     @property
     def overlap_fraction(self) -> float:
@@ -420,6 +426,15 @@ def leg_cost_s(leg, ir, constants=None, *,
         # calibration run that never measured the fused wire should
         # not make it look free (or infinitely slow).
         kind = sir.LEG_PPERMUTE_HOP
+    if constants is not None and kind not in constants.bandwidths \
+            and kind == sir.LEG_PS_EXCHANGE \
+            and sir.LEG_ALL_REDUCE in constants.bandwidths:
+        # Unfitted PS exchanges borrow the all-reduce constants: the
+        # PS/WUS lowering moves exactly an all-reduce's ring volume
+        # (module docstring), so a calibration run that never measured
+        # a PS plan must not let PS candidates win the strategy search
+        # on optimistic default pricing.
+        kind = sir.LEG_ALL_REDUCE
     if constants is not None and kind in constants.bandwidths:
         t = wire / constants.bandwidths[kind]
         if launches:
@@ -477,6 +492,7 @@ def estimate_ir_cost(ir, *, ici_bandwidth: float = ICI_BANDWIDTH,
     accum = max(int(ir.accum_steps), 1)
     calibrated_comm_s = 0.0
     update_s = 0.0
+    comm_kind_s: Dict[str, float] = {}
     for leg in ir.legs:
         if leg.kind in (sir.LEG_UPDATE, sir.LEG_FUSED_UPDATE,
                         sir.LEG_FUSED_DETECT):
@@ -493,6 +509,8 @@ def estimate_ir_cost(ir, *, ici_bandwidth: float = ICI_BANDWIDTH,
                 t = leg_cost_s(leg, ir, constants)
                 if t is not None:
                     update_s += t
+                    report.per_kind[leg.kind] = \
+                        report.per_kind.get(leg.kind, 0.0) + t
             continue
         if leg.kind not in sir.COLLECTIVE_KINDS:
             continue
@@ -505,14 +523,24 @@ def estimate_ir_cost(ir, *, ici_bandwidth: float = ICI_BANDWIDTH,
             hidden = wire * ov.PREFETCH_OVERLAP_FRACTION
         report.wire_bytes += wire
         report.exposed_wire_bytes += wire - hidden
-        if d > 1 or leg.kind == sir.LEG_PSUM_GUARD:
+        launched = d > 1 or leg.kind == sir.LEG_PSUM_GUARD
+        if launched:
             report.num_collectives += 1
+        exposed_fraction = (wire - hidden) / wire if wire > 0 \
+            else (0.0 if hidden else 1.0)
         if constants is not None:
-            exposed_fraction = (wire - hidden) / wire if wire > 0 \
-                else (0.0 if hidden else 1.0)
             t = leg_cost_s(leg, ir, constants)
             if t is not None:
                 calibrated_comm_s += t * exposed_fraction
+                comm_kind_s[leg.kind] = comm_kind_s.get(leg.kind, 0.0) \
+                    + t * exposed_fraction
+        else:
+            t = ((wire - hidden) / ici_bandwidth
+                 + (alpha if launched else 0.0))
+            comm_kind_s[leg.kind] = comm_kind_s.get(leg.kind, 0.0) + t
+    scale = constants.scale if constants is not None else 1.0
+    for kind, t in comm_kind_s.items():
+        report.per_kind[kind] = report.per_kind.get(kind, 0.0) + t * scale
     if constants is not None:
         comm_s = constants.scale * calibrated_comm_s
     else:
@@ -522,14 +550,33 @@ def estimate_ir_cost(ir, *, ici_bandwidth: float = ICI_BANDWIDTH,
     return report
 
 
+def plan_fingerprint(strategy: Strategy) -> str:
+    """Short stable hash of a strategy's per-variable plan — the
+    node-config projection only (ids, timestamps, and replica lists are
+    excluded), so two builders that emit the SAME plan hash identically.
+    The dedupe key of the deterministic-ranking contract
+    (``rank_strategies(dedupe=True)`` / ``AutoStrategy(search=...)``)."""
+    blob = json.dumps([n.to_dict() for n in strategy.node_config],
+                      sort_keys=True, separators=(",", ":")).encode()
+    return hashlib.sha256(blob).hexdigest()[:12]
+
+
 def rank_strategies(graph_item: GraphItem, resource_spec: ResourceSpec,
-                    builders: Optional[Sequence] = None, **cost_kwargs
+                    builders: Optional[Sequence] = None,
+                    dedupe: bool = False, **cost_kwargs
                     ) -> List[Tuple[str, CostReport]]:
     """Build each candidate strategy and rank by estimated sync time.
 
     Default candidates: every shipped fixed builder plus AutoStrategy.
     Returns ``[(builder_class_name, CostReport), ...]`` fastest first —
     the pre-compile answer to "which strategy should I use here?".
+
+    Deterministic run-to-run: ties break by ``(cost, builder name)``,
+    and ``dedupe=True`` drops later candidates whose
+    :func:`plan_fingerprint` matches an earlier one (two builders that
+    degenerate to the same plan — e.g. PS and PSLoadBalancing on a
+    single reduction destination — rank once).  Default False so the
+    report still names every builder asked about.
     """
     if builders is None:
         from autodist_tpu.strategy import (
@@ -549,10 +596,16 @@ def rank_strategies(graph_item: GraphItem, resource_spec: ResourceSpec,
                     RandomAxisPartitionAR(), Parallax(), Zero1(),
                     AutoStrategy()]
     ranked = []
+    seen_plans = set()
     for b in builders:
         strat = b.build(graph_item, resource_spec)
+        if dedupe:
+            fp = plan_fingerprint(strat)
+            if fp in seen_plans:
+                continue
+            seen_plans.add(fp)
         ranked.append((type(b).__name__,
                        estimate_cost(strat, graph_item, resource_spec,
                                      **cost_kwargs)))
-    ranked.sort(key=lambda kv: kv[1].time_s)
+    ranked.sort(key=lambda kv: (kv[1].time_s, kv[0]))
     return ranked

@@ -1,0 +1,367 @@
+"""ScheduleTuner: drift-triggered re-search + schedule hot-swap.
+
+The search (:mod:`autodist_tpu.strategy.search`) finds the best schedule
+FOR THE CONSTANTS IT WAS PRICED WITH.  When the hardware or workload
+changes mid-run — a throttled host, a different batch mix, a refit that
+moves a leg kind's bandwidth — the winning schedule can silently stop
+being the winner.  The tuner closes the loop:
+
+1. **watch** — live :class:`~autodist_tpu.telemetry.profiler.LegSample`s
+   (micro-runs on the session mesh at a configured cadence, or samples
+   fed by the caller) are compared per leg kind against the ACTIVE
+   calibration through the shared ``telemetry/leg-drift`` rule
+   (:func:`~autodist_tpu.telemetry.calibration.drifted_leg_kinds` —
+   the same string the analysis pass and the CLI print);
+2. **refit + re-search** — on drift, ``fit_leg_constants`` regresses
+   fresh constants from the accumulated samples/records (persisted to
+   the discovered ``calibration.json`` so every other consumer sees
+   them) and the beam search re-runs on the fresh constants, with the
+   currently-running strategy injected as a seed so it survives when it
+   still wins;
+3. **hot-swap** — when the winner's schedule fingerprint differs from
+   the running one, the schedule is swapped THROUGH the elastic-resume
+   machinery: a RAM-tier snapshot (``checkpoint/tiers.py``) captures
+   the logical training state, the step is rebuilt with the new
+   strategy's IR (same mesh — compile only, no relaunch), and the
+   snapshot restores into it bit-exact: params and the step counter
+   always transfer exactly; optimizer moments transfer exactly within
+   a sync family and re-initialize (one WARN) when the opt layout
+   itself changes (tree optimizer vs ZeRO-1 flat shards), which is
+   precisely the state an oracle started fresh on the new schedule
+   would hold; compressor sync-state is schedule-keyed and always
+   re-initializes.  Config drift the elastic path cannot absorb (a
+   snapshot that fails its digest or leaf-count check) falls back to a
+   persistent-checkpoint restart with one WARN when ``checkpoint_dir``
+   is configured, and aborts the swap (keeping the old schedule)
+   otherwise — a tuner must never lose state.
+
+Wire it into training with ``fit(..., tuner=ScheduleTuner(...))``
+(docs/strategies.md "Search"): the tuner's :meth:`on_step` hook runs at
+its own ``interval`` cadence inside the step loop and swaps the session
+IN PLACE, so the loop, callbacks, and checkpointing never notice.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from autodist_tpu.utils import logging
+
+
+class ScheduleTuner:
+    """Self-tuning loop around the strategy search (module docstring).
+
+    Args:
+      graph_item: the captured program (the search's variable catalog).
+      resource_spec: the cluster spec candidates are built against.
+      space: optional :class:`~autodist_tpu.strategy.search.SearchSpace`
+        (budgets + searched axes) for re-searches.
+      interval: :meth:`on_step` cadence in steps (0 disables the fit
+        hook; :meth:`maybe_retune` still works when called directly).
+      profile: at each interval, micro-run the session's current IR
+        through :class:`~autodist_tpu.telemetry.profiler.LegProfiler`
+        to produce fresh samples (set False when samples arrive via
+        :meth:`feed_samples` — e.g. from trace parsing).
+      constants: the ACTIVE calibration the running schedule was priced
+        with (default: the environment-discovered ``calibration.json``).
+      calibration_path: where refit constants persist (default: the
+        discovered path; None persists nowhere).
+      checkpoint_dir: the persistent-restart fallback directory for a
+        swap the elastic path cannot absorb.
+      min_samples: drift is only judged once at least this many live
+        samples accumulated (micro-run noise must not thrash schedules).
+    """
+
+    def __init__(self, graph_item, resource_spec, *, space=None,
+                 interval: int = 0, profile: bool = True,
+                 constants=None, calibration_path: Optional[str] = None,
+                 checkpoint_dir: Optional[str] = None,
+                 min_samples: int = 1):
+        from autodist_tpu.telemetry.calibration import (
+            default_calibration_path,
+            load_default_calibration,
+        )
+
+        self._gi = graph_item
+        self._resource_spec = resource_spec
+        self._space = space
+        self._interval = max(int(interval), 0)
+        self._profile = bool(profile)
+        self._constants = constants if constants is not None \
+            else load_default_calibration()
+        self._calibration_path = calibration_path \
+            if calibration_path is not None else default_calibration_path()
+        self._checkpoint_dir = checkpoint_dir
+        self._min_samples = max(int(min_samples), 1)
+        self._samples: List = []
+        self._records: List = []
+        #: the last SearchResult a retune produced.
+        self.last_result = None
+        #: completed hot-swaps ("elastic" path) + persistent restarts.
+        self.swaps = 0
+        #: did the last swap transfer optimizer moments exactly (same
+        #: sync family), or re-initialize them (layout change)?
+        self.last_swap_exact_opt: Optional[bool] = None
+        #: per-kind drift reasons of the last check that fired.
+        self.last_drift: Dict[str, str] = {}
+
+    # -- inputs ------------------------------------------------------------
+    def feed_samples(self, samples) -> None:
+        """Accumulate live LegSamples (profiler micro-runs or parsed
+        trace spans) for the next drift check."""
+        self._samples.extend(samples)
+
+    def feed_records(self, records) -> None:
+        """Accumulate StepRecords for the refit's scale correction."""
+        self._records.extend(records)
+
+    # -- the drift trigger -------------------------------------------------
+    def drift_reasons(self) -> Dict[str, str]:
+        """Per-kind ``telemetry/leg-drift`` verdicts of the accumulated
+        samples against the ACTIVE constants ({} = no drift)."""
+        from autodist_tpu.telemetry.calibration import drifted_leg_kinds
+
+        if len(self._samples) < self._min_samples:
+            return {}
+        return drifted_leg_kinds(self._samples, self._constants)
+
+    # -- the loop ----------------------------------------------------------
+    def on_step(self, session, step: int) -> bool:
+        """The ``fit`` hook: at every ``interval`` steps, collect fresh
+        samples (when ``profile``) and run :meth:`maybe_retune`.
+        Returns True when a swap happened."""
+        if not self._interval or step <= 0 or step % self._interval:
+            return False
+        if self._profile:
+            ir = getattr(session, "schedule_ir", None)
+            if ir is not None:
+                from autodist_tpu.telemetry.profiler import LegProfiler
+
+                self.feed_samples(
+                    LegProfiler(mesh=session.mesh).profile_ir(ir))
+        rec = getattr(session, "telemetry", None)
+        if rec is not None:
+            # The recorder's ring IS the window of interest — replace,
+            # never append (appending would double-count overlapping
+            # views of the same bounded ring across intervals).
+            self._records = list(rec.records)
+        return self.maybe_retune(session)
+
+    def maybe_retune(self, session) -> bool:
+        """Check drift; on drift refit constants, re-search, and swap
+        when the winner's fingerprint differs.  Returns True when the
+        schedule changed."""
+        from autodist_tpu.telemetry import emit_event
+        from autodist_tpu.telemetry.calibration import (
+            fit_leg_constants,
+            save_calibration,
+        )
+
+        reasons = self.drift_reasons()
+        if not reasons:
+            return False
+        self.last_drift = dict(reasons)
+        for kind in sorted(reasons):
+            logging.warning("tuner: %s", reasons[kind])
+        emit_event("tuner/leg-drift", kinds=sorted(reasons),
+                   n_samples=len(self._samples))
+        refit = fit_leg_constants(self._samples, self._records)
+        if refit is None:
+            return False
+        if self._calibration_path:
+            try:
+                save_calibration(refit, self._calibration_path)
+                logging.info("tuner: refit constants persisted to %s",
+                             self._calibration_path)
+            except OSError as e:      # advisory: the search still runs
+                logging.warning("tuner: could not persist refit "
+                                "calibration (%s)", e)
+        swapped = self.retune(session, constants=refit)
+        # Fresh constants become the active baseline either way, and the
+        # window that detected the drift is consumed.
+        self._constants = refit
+        self._samples = []
+        return swapped
+
+    def retune(self, session, constants=None) -> bool:
+        """Re-run the search on ``constants`` (default: the active ones)
+        and hot-swap when the winner's fingerprint differs from the
+        running schedule's.  Returns True when a swap happened."""
+        from autodist_tpu.strategy.search import beam_search
+        from autodist_tpu.telemetry import emit_event
+
+        constants = constants if constants is not None else self._constants
+        axes = {str(k): int(v)
+                for k, v in dict(session.mesh.shape).items()}
+        current = session._step.compiled_strategy.strategy
+        result = beam_search(
+            self._gi, self._resource_spec, axes=axes, space=self._space,
+            constants=constants, extra_seeds=[("current", current)])
+        self.last_result = result
+        if result.best is None or result.best_strategy is None:
+            logging.warning("tuner: re-search produced no legal "
+                            "candidate; keeping the running schedule")
+            return False
+        # Compare through the SAME projection the search prices: the
+        # running strategy entered as the "current" seed, so its
+        # fingerprint is in the result and the comparison cannot drift
+        # on builder-vs-analyzer IR differences.
+        current_fp = None
+        for ev in result.evaluated:
+            if ev.name == "seed:current":
+                current_fp = ev.fingerprint
+                break
+        if current_fp is None:          # current deduped into an equal plan
+            from autodist_tpu.strategy.search import evaluate_candidate, \
+                genes_from_strategy
+            ev, _ = evaluate_candidate(
+                "current", genes_from_strategy(current, self._gi),
+                self._gi, self._resource_spec, axes, constants)
+            current_fp = ev.fingerprint if ev is not None else None
+        if result.best.fingerprint == current_fp:
+            logging.info(
+                "tuner: re-search confirms the running schedule "
+                "(%s, %.3f ms)", result.best.fingerprint,
+                result.best.cost_s * 1e3)
+            emit_event("tuner/retune", swapped=False,
+                       fingerprint=result.best.fingerprint)
+            return False
+        return self.hot_swap(session, result.best_strategy,
+                             winner=result.best)
+
+    # -- the swap ----------------------------------------------------------
+    def adopt_snapshot(self, session, snap, new_step) -> bool:
+        """Load a logical RAM snapshot into ``session`` running
+        ``new_step`` (possibly a DIFFERENT sync schedule than the
+        snapshot's writer).  Params and the step counter always
+        transfer exactly; optimizer moments transfer when the new
+        step's logical opt layout matches the snapshot leaf-for-leaf
+        (same sync family) and re-initialize with one WARN otherwise
+        (an opt-layout change — tree optimizer vs ZeRO-1 flat shards —
+        is exactly the state an oracle cold-started on the new schedule
+        would hold).  Compressor sync-state is schedule-keyed and
+        always re-initializes.  Returns True when the moments
+        transferred exactly."""
+        import jax
+        import numpy as np
+
+        from autodist_tpu.checkpoint.tiers import SnapshotError
+
+        if not snap.verify():
+            raise SnapshotError(
+                f"snapshot step {snap.step} failed its digest re-check "
+                "— refusing to hot-swap onto corrupted state")
+        ptree = jax.tree_util.tree_structure(self._gi.params)
+        leaves = snap.leaves["params"]
+        if ptree.num_leaves != len(leaves):
+            raise SnapshotError(
+                f"snapshot param leaf count {len(leaves)} != program "
+                f"{ptree.num_leaves} (program changed since capture)")
+        params = jax.tree_util.tree_unflatten(ptree, leaves)
+        session._params = new_step.place_params(params)
+        opt_init = new_step.init_fn(session._params)
+        target = jax.eval_shape(new_step.export_opt_state, opt_init)
+        flat_t, tdef = jax.tree_util.tree_flatten(target)
+        ls = snap.leaves.get("opt_state", [])
+        exact = len(ls) == len(flat_t) and all(
+            tuple(t.shape) == tuple(np.shape(l))
+            and np.dtype(t.dtype) == np.dtype(np.asarray(l).dtype)
+            for t, l in zip(flat_t, ls))
+        if exact:
+            session._opt_state = new_step.import_opt_state(
+                jax.tree_util.tree_unflatten(tdef, ls))
+        else:
+            session._opt_state = opt_init
+            logging.warning(
+                "tuner: optimizer-state layout changes across this "
+                "schedule swap (%d -> %d logical leaves); moments "
+                "re-initialize — the same state a run started fresh on "
+                "the new schedule would hold", len(ls), len(flat_t))
+        session._sync_state = new_step.init_sync_state(session._params)
+        session._step_count = int(snap.step)
+        return exact
+
+    def hot_swap(self, session, strategy, winner=None) -> bool:
+        """Swap the session onto ``strategy`` through the RAM snapshot
+        tier: snapshot logical state, rebuild the step on the same mesh
+        with the new IR, restore bit-exact.  Falls back to a
+        persistent-checkpoint restart (one WARN) when the elastic path
+        cannot absorb the config change; keeps the old schedule (and
+        returns False) when no fallback exists."""
+        from autodist_tpu.checkpoint.tiers import (
+            SnapshotError,
+            capture_snapshot,
+        )
+        from autodist_tpu.kernel.graph_transformer import GraphTransformer
+        from autodist_tpu.strategy.compiler import StrategyCompiler
+        from autodist_tpu.telemetry import emit_event
+
+        t0 = time.perf_counter()
+        old_fp = session.schedule_fingerprint
+        snap = capture_snapshot(session)
+        old_step = session._step
+        old_state = (session._params, session._opt_state,
+                     session._sync_state, session._step_count)
+        compiled = StrategyCompiler(
+            session.mesh, resource_spec=self._resource_spec).compile(
+                strategy, self._gi)
+        new_step = GraphTransformer(compiled, self._gi).transform(
+            extra_metrics_fn=self._gi.metrics_fn)
+        session._step = new_step
+        try:
+            self.last_swap_exact_opt = self.adopt_snapshot(
+                session, snap, new_step)
+        except SnapshotError as e:
+            session._step = old_step
+            (session._params, session._opt_state, session._sync_state,
+             session._step_count) = old_state
+            return self._persistent_restart(session, new_step, e)
+        session._flops_per_step = None
+        self.swaps += 1
+        dt = time.perf_counter() - t0
+        logging.info(
+            "tuner: hot-swapped schedule %s -> %s at step %d through the "
+            "RAM snapshot tier (%.1f ms%s)", old_fp,
+            session.schedule_fingerprint, session.step_count, dt * 1e3,
+            f"; winner {winner.name} est {winner.cost_s * 1e3:.3f} ms"
+            if winner is not None else "")
+        emit_event("tuner/hot-swap", step=session.step_count,
+                   from_fingerprint=old_fp,
+                   to_fingerprint=session.schedule_fingerprint,
+                   tier="ram", duration_s=round(dt, 6),
+                   winner=winner.name if winner is not None else None)
+        return True
+
+    def _persistent_restart(self, session, new_step, err) -> bool:
+        """The fallback for config drift elastic resume cannot absorb:
+        persist a checkpoint from the OLD schedule, rebind the new step,
+        restore from disk.  One WARN; False (old schedule kept) when no
+        ``checkpoint_dir`` is configured."""
+        from autodist_tpu.telemetry import emit_event
+
+        if not self._checkpoint_dir:
+            logging.warning(
+                "tuner: hot-swap aborted — the RAM snapshot cannot cross "
+                "this config change (%s) and no checkpoint_dir fallback "
+                "is configured; keeping the running schedule", err)
+            emit_event("tuner/hot-swap", step=session.step_count,
+                       tier=None, aborted=True, reason=str(err))
+            return False
+        from autodist_tpu.checkpoint import Saver
+
+        logging.warning(
+            "tuner: RAM snapshot cannot cross this config change (%s) — "
+            "falling back to a persistent-checkpoint restart through %s",
+            err, self._checkpoint_dir)
+        saver = Saver(session)
+        saver.save(self._checkpoint_dir, step=session.step_count)
+        saver.wait()
+        session._step = new_step
+        path = Saver.latest_checkpoint(self._checkpoint_dir)
+        restored = saver.restore(path)
+        session._flops_per_step = None
+        self.swaps += 1
+        emit_event("tuner/hot-swap", step=int(restored),
+                   to_fingerprint=session.schedule_fingerprint,
+                   tier="persistent", reason=str(err))
+        return True

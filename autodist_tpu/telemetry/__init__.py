@@ -40,6 +40,7 @@ from autodist_tpu.telemetry.calibration import (
     LEG_DRIFT_THRESHOLD,
     LegCalibration,
     STRAGGLER_THRESHOLD,
+    drifted_leg_kinds,
     fit_constants,
     fit_leg_constants,
     leg_drift_reason,
@@ -117,6 +118,7 @@ __all__ = [
     "configure_events",
     "configure_spans",
     "counter",
+    "drifted_leg_kinds",
     "emit_event",
     "export_trace",
     "fit_constants",

@@ -240,6 +240,46 @@ def leg_drift_reason(kind: str, measured_s: Optional[float],
     return None
 
 
+def drifted_leg_kinds(samples: Sequence, constants=None,
+                      threshold: float = LEG_DRIFT_THRESHOLD
+                      ) -> Dict[str, str]:
+    """Per-leg-kind drift verdicts over live LegSamples — the pure rule
+    behind the ScheduleTuner's re-search trigger (and the same
+    ``telemetry/leg-drift`` strings the analysis pass prints).
+
+    Each kind's MEASURED total is compared against its PREDICTED total:
+    under ``constants`` (a :class:`LegCalibration` — the constants the
+    running schedule was priced with) when given, else each sample's
+    carried ``predicted_s``.  Returns ``{kind: reason}`` for kinds past
+    ``threshold``; {} when nothing drifted."""
+    measured: Dict[str, float] = {}
+    predicted: Dict[str, float] = {}
+    for s in samples:
+        kind = _sample_get(s, "kind")
+        t = _sample_get(s, "measured_s")
+        if kind not in LEG_KINDS or t is None or t <= 0:
+            continue
+        if constants is not None:
+            comp = _sample_get(s, "compressor", "NoneCompressor") \
+                or "NoneCompressor"
+            p = constants.leg_time_s(
+                kind, float(_sample_get(s, "nbytes", 0) or 0),
+                quantized=comp not in _LINEAR_COMPRESSORS)
+        else:
+            p = _sample_get(s, "predicted_s")
+        if p is None or p <= 0:
+            continue
+        measured[kind] = measured.get(kind, 0.0) + float(t)
+        predicted[kind] = predicted.get(kind, 0.0) + float(p)
+    out: Dict[str, str] = {}
+    for kind in sorted(measured):
+        why = leg_drift_reason(kind, measured[kind], predicted.get(kind),
+                               threshold=threshold)
+        if why is not None:
+            out[kind] = why
+    return out
+
+
 def straggler_reason(per_host_step_time_s: Optional[Dict[str, float]],
                      threshold: float = STRAGGLER_THRESHOLD
                      ) -> Optional[str]:
@@ -548,12 +588,16 @@ def fit_leg_constants(samples: Sequence, records: Sequence = (),
 
 def save_calibration(cal: LegCalibration, path: str) -> str:
     """Write ``calibration.json`` (atomic: temp file + rename so a
-    concurrent loader never reads a torn file)."""
+    concurrent loader never reads a torn file).  The in-process default
+    cache is invalidated so a same-process refit (the ScheduleTuner
+    path) is picked up immediately, even on filesystems whose mtime
+    granularity cannot distinguish two writes in one tick."""
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "w", encoding="utf-8") as f:
         json.dump(cal.to_dict(), f, indent=2, sort_keys=True)
         f.write("\n")
     os.replace(tmp, path)
+    reset_calibration_cache_for_testing()
     return path
 
 
@@ -589,33 +633,44 @@ def default_calibration_path() -> Optional[str]:
     return None
 
 
-_default_cache: Tuple[Optional[str], float, Optional[LegCalibration]] = \
-    (None, -1.0, None)
+_default_cache: Tuple[Optional[str], Optional[tuple],
+                      Optional[LegCalibration]] = (None, None, None)
 
 
 def load_default_calibration() -> Optional[LegCalibration]:
     """The constants ``estimate_ir_cost`` and ``AutoStrategy(search=
-    True)`` pick up automatically (no flags): cached by (path, mtime)
-    so the per-candidate search loop pays one stat, not one parse."""
+    ...)`` pick up automatically (no flags): cached by the resolved
+    path plus a stat signature so the per-candidate search loop pays
+    one stat, not one parse.
+
+    The cache key is the RESOLVED path — so flipping
+    ``AUTODIST_CALIBRATION`` between an explicit file and
+    ``AUTODIST_TELEMETRY_DIR`` run-dir discovery mid-process reloads
+    whenever the resolution lands somewhere new — and the stat
+    signature is ``(mtime_ns, size, inode)``, not the float mtime: an
+    atomic rewrite (``save_calibration``'s temp-file + rename) always
+    changes the inode, so a refit landing within one mtime tick can
+    never serve stale constants to the tuner."""
     global _default_cache
     path = default_calibration_path()
     if path is None:
         return None
     try:
-        mtime = os.stat(path).st_mtime
+        st = os.stat(path)
     except OSError:
         return None
-    cached_path, cached_mtime, cached = _default_cache
-    if cached_path == path and cached_mtime == mtime:
+    sig = (st.st_mtime_ns, st.st_size, st.st_ino)
+    cached_path, cached_sig, cached = _default_cache
+    if cached_path == path and cached_sig == sig:
         return cached
     cal = load_calibration(path)
-    _default_cache = (path, mtime, cal)
+    _default_cache = (path, sig, cal)
     return cal
 
 
 def reset_calibration_cache_for_testing() -> None:
     global _default_cache
-    _default_cache = (None, -1.0, None)
+    _default_cache = (None, None, None)
 
 
 def predicted_vs_measured(records: Sequence) -> Optional[dict]:

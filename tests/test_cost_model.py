@@ -228,6 +228,113 @@ def test_auto_strategy_search_selects_overlapped_mode(spec8):
     assert chosen.node_for("w").synchronizer.overlap == "auto"
 
 
+def test_rank_strategies_deterministic_tiebreak_and_dedupe(spec8):
+    """Deterministic ranking: ties break by (cost, builder name) and
+    dedupe=True drops candidates with identical plan fingerprints."""
+    from autodist_tpu.strategy import PS, PSLoadBalancing
+    from autodist_tpu.strategy.cost_model import plan_fingerprint
+
+    gi = make_gi()
+    a = rank_strategies(gi, spec8)
+    b = rank_strategies(gi, spec8)
+    assert [n for n, _ in a] == [n for n, _ in b]
+    keys = [(r.time_s, n) for n, r in a]
+    assert keys == sorted(keys)
+    # PS and PSLoadBalancing degenerate to the same plan on a
+    # single-destination spec: same fingerprint, deduped when asked.
+    ps = PS().build(gi, spec8)
+    lb = PSLoadBalancing().build(gi, spec8)
+    assert plan_fingerprint(ps) == plan_fingerprint(lb)
+    deduped = rank_strategies(gi, spec8,
+                              builders=[PS(), PSLoadBalancing()],
+                              dedupe=True)
+    assert len(deduped) == 1
+
+
+def test_estimate_ir_cost_per_kind_breakdown(spec8):
+    """estimate_ir_cost attributes exposed cost per leg kind (the
+    search explain surface's breakdown) and the kinds sum to the comm
+    estimate."""
+    from autodist_tpu.kernel.synchronization import schedule_ir as sir
+    from autodist_tpu.strategy.cost_model import estimate_ir_cost
+
+    facts = [sir.PlanFact(name="w", shape=(1024, 1024), dtype="float32",
+                          sync_kind="AllReduce")]
+    ir = sir.ir_from_facts(facts, axes={"data": 8})
+    report = estimate_ir_cost(ir)
+    assert set(report.per_kind) == {"all_reduce"}
+    assert report.per_kind["all_reduce"] == pytest.approx(
+        report.time_s)
+
+
+def test_unfitted_ps_exchange_borrows_all_reduce_constants():
+    """A calibration that never measured a PS plan must not price PS
+    exchanges at optimistic defaults: they borrow the fitted all-reduce
+    constants (same ring volume by construction)."""
+    from autodist_tpu.kernel.synchronization import schedule_ir as sir
+    from autodist_tpu.strategy.cost_model import leg_cost_s
+    from autodist_tpu.telemetry.calibration import LegCalibration
+
+    cal = LegCalibration(bandwidths={"all_reduce": 1e8},
+                         alphas={"all_reduce": 1e-4})
+    facts = [sir.PlanFact(name="w", shape=(1024, 1024), dtype="float32",
+                          sync_kind="PS")]
+    ir = sir.ir_from_facts(facts, axes={"data": 8})
+    leg = next(l for l in ir.legs if l.kind == sir.LEG_PS_EXCHANGE)
+    t = leg_cost_s(leg, ir, cal)
+    wire = 2.0 * 7 / 8 * 1024 * 1024 * 4
+    assert t == pytest.approx(wire / 1e8 + 1e-4)
+
+
+def test_planted_calibration_json_flips_auto_strategy_beam(
+        tmp_path, monkeypatch):
+    """The satellite acceptance: planted calibration.json constants
+    (comm-bound vs compute-bound) flip AutoStrategy(search="beam")'s
+    winner through the ENV discovery path, and each winner's IR passes
+    the verifier."""
+    import json as _json
+
+    from autodist_tpu.analysis.search import facts_for_candidate
+    from autodist_tpu.kernel.synchronization import schedule_ir as sir
+    from autodist_tpu.strategy import AutoStrategy
+    from autodist_tpu.telemetry.calibration import (
+        LEG_KINDS,
+        reset_calibration_cache_for_testing,
+    )
+
+    gi = _large_dense_gi(accum=4)
+    spec = ResourceSpec(resource_info={
+        "nodes": [{"address": "a", "chips": 8, "chief": True}]})
+
+    def plant(bandwidth, quant_overhead):
+        d = {"version": 1, "scale": 1.0,
+             "quant_overhead_per_byte": quant_overhead,
+             "alphas": {k: 1e-7 for k in LEG_KINDS},
+             "bandwidths": {k: bandwidth for k in LEG_KINDS}}
+        path = tmp_path / "calibration.json"
+        path.write_text(_json.dumps(d))
+        monkeypatch.setenv("AUTODIST_CALIBRATION", str(path))
+        reset_calibration_cache_for_testing()
+
+    winners = {}
+    for name, (bw, qo) in {"comm_bound": (1e8, 0.0),
+                           "quant_hostile": (1e12, 1e-6)}.items():
+        plant(bw, qo)
+        b = AutoStrategy(search="beam", compressor="Int8Compressor")
+        strategy = b.build(gi, spec)
+        winners[name] = b.last_search.best.fingerprint
+        # the winner's IR passes the verifier
+        facts, _, guard, prune = facts_for_candidate(
+            strategy, gi, {"data": 8})
+        assert prune is None
+        ir = sir.ir_from_facts(facts, axes={"data": 8}, accum_steps=4,
+                               guard=guard)
+        assert not sir.errors(sir.verify(ir))
+    assert winners["comm_bound"] != winners["quant_hostile"]
+    monkeypatch.delenv("AUTODIST_CALIBRATION")
+    reset_calibration_cache_for_testing()
+
+
 def test_rank_strategies_prefers_sparse_aware(spec8):
     gi = make_gi()
     ranked = rank_strategies(gi, spec8)

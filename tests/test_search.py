@@ -1,0 +1,421 @@
+"""Leg-calibrated strategy search + drift-triggered hot-swap
+(docs/strategies.md "Search"): beam search over the per-variable plan
+space — legality-pruned, IR-verified, priced leg-by-leg from planted
+calibration constants — and the ScheduleTuner's drift → re-search →
+RAM-snapshot hot-swap loop, drilled live against a bit-exact oracle."""
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from autodist_tpu.autodist import AutoDist, _reset_default_autodist_for_testing
+from autodist_tpu.graph_item import GraphItem
+from autodist_tpu.kernel.synchronization import schedule_ir as sir
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.strategy import AllReduce, Zero1
+from autodist_tpu.strategy.search import (
+    SearchSpace,
+    VarGene,
+    beam_search,
+    evaluate_candidate,
+    genes_from_strategy,
+    strategy_from_genes,
+)
+from autodist_tpu.strategy.tuner import ScheduleTuner
+from autodist_tpu.telemetry.calibration import (
+    LegCalibration,
+    drifted_leg_kinds,
+)
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    _reset_default_autodist_for_testing()
+
+
+def _spec(chips=8):
+    return ResourceSpec(resource_info={
+        "nodes": [{"address": "localhost", "chips": chips, "chief": True}]})
+
+
+def _dense_gi(accum=4):
+    """Comm-bound accum fixture: one big dense matrix + bias."""
+    return GraphItem({"w": jnp.zeros((2048, 2048), jnp.float32),
+                      "b": jnp.zeros((2048,), jnp.float32)},
+                     accum_steps=accum)
+
+
+def _flat_cal(bandwidth=45e9, alpha=5e-6, quant_overhead=0.0, **over):
+    """A planted LegCalibration: every kind at the same constants,
+    selected kinds overridden via kwargs (e.g. all_reduce=1e6)."""
+    from autodist_tpu.telemetry.calibration import LEG_KINDS
+
+    cal = LegCalibration()
+    for kind in LEG_KINDS:
+        cal.bandwidths[kind] = float(over.get(kind, bandwidth))
+        cal.alphas[kind] = alpha
+    cal.quant_overhead_per_byte = quant_overhead
+    return cal
+
+
+# -- the search itself --------------------------------------------------------
+
+def test_search_is_deterministic_run_to_run():
+    gi, spec = _dense_gi(), _spec()
+    space = SearchSpace(max_rounds=3)
+    a = beam_search(gi, spec, space=space)
+    b = beam_search(gi, spec, space=space)
+    assert a.best.fingerprint == b.best.fingerprint
+    assert a.best.name == b.best.name
+    assert [e.fingerprint for e in a.top(10)] == \
+        [e.fingerprint for e in b.top(10)]
+
+
+def test_search_winner_not_worse_than_any_seed():
+    """The fixed builders seed the beam, so the winner's estimate is
+    <= every fixed candidate's by construction."""
+    gi, spec = _dense_gi(), _spec()
+    res = beam_search(gi, spec)
+    seeds = [e for e in res.evaluated if e.name.startswith("seed:")]
+    assert seeds, "no seed survived"
+    assert all(res.best.cost_s <= e.cost_s + 1e-12 for e in seeds)
+
+
+def test_search_verifies_every_priced_candidate():
+    """Every evaluated candidate's plan rebuilds to an IR that passes
+    the static verifier (the search's own gate, re-checked here)."""
+    gi, spec = _dense_gi(), _spec()
+    res = beam_search(gi, spec, space=SearchSpace(max_rounds=1))
+    axes = {"data": 8}
+    for ev in res.top(10):
+        re_ev, _ = evaluate_candidate("re", ev.genes, gi, spec, axes)
+        assert re_ev is not None and re_ev.pruned_by is None
+        assert re_ev.fingerprint == ev.fingerprint
+    from autodist_tpu.analysis.search import facts_for_candidate
+    strategy = strategy_from_genes(res.best.genes, gi, spec)
+    facts, _, guard, prune = facts_for_candidate(strategy, gi, axes)
+    assert prune is None
+    ir = sir.ir_from_facts(facts, axes=axes, accum_steps=4, guard=guard)
+    assert not sir.errors(sir.verify(ir))
+
+
+def test_illegal_candidate_prunes_with_rule_id():
+    """A gene map whose PS partition axis cannot lower is pruned by the
+    legality rules BEFORE pricing, and the rule id is recorded for the
+    explain surface."""
+    gi = GraphItem({"w": jnp.zeros((7, 3), jnp.float32)})
+    spec = _spec(8)
+    genes = (("w", VarGene(sync="ps", partition=1)),)   # dim 3 over 8 chips
+    ev, strategy = evaluate_candidate("bad", genes, gi, spec,
+                                      {"data": 8})
+    assert strategy is None
+    assert ev.pruned_by is not None
+    assert ev.pruned_by.startswith("legality/")
+
+
+def test_genes_round_trip_through_strategy():
+    gi, spec = _dense_gi(), _spec()
+    strategy = Zero1(bucket_bytes=1 << 20, overlap="pipeline").build(
+        gi, spec)
+    genes = genes_from_strategy(strategy, gi)
+    rebuilt = strategy_from_genes(genes, gi, spec)
+    assert genes_from_strategy(rebuilt, gi) == genes
+
+
+def test_sparse_ps_priced_at_touched_rows():
+    """The pricing shadow: a sparse table under PS prices its exchange
+    at touched-row bytes (the Parallax rule), so the search does not
+    mis-rank PS against densifying AllReduce."""
+    gi = GraphItem({"emb": {"table": jnp.zeros((200_000, 32))},
+                    "head": {"w": jnp.zeros((32, 8))}},
+                   sparse_vars=["emb/table"])
+    spec = _spec()
+    axes = {"data": 8}
+    ps = (("emb/table", VarGene(sync="ps")), ("head/w", VarGene()))
+    ar = (("emb/table", VarGene()), ("head/w", VarGene()))
+    ev_ps, _ = evaluate_candidate("ps", ps, gi, spec, axes)
+    ev_ar, _ = evaluate_candidate("ar", ar, gi, spec, axes)
+    # AR densifies the whole 25.6 MB table; sparse PS moves ~4096 rows.
+    assert ev_ps.cost_s < ev_ar.cost_s / 5
+
+
+def test_planted_calibration_flips_search_winner():
+    """Calibration-driven picks: comm-bound constants (slow wire, free
+    quantize) must pick the quantized wire; compute-bound constants
+    with a punitive quantize overhead must keep full precision — the
+    SAME space, flipped only by calibration.json contents."""
+    gi, spec = _dense_gi(accum=4), _spec()
+    space = SearchSpace(compressors=("NoneCompressor", "Int8Compressor"),
+                        max_rounds=2)
+    comm_bound = _flat_cal(bandwidth=1e8, alpha=1e-7, quant_overhead=0.0)
+    quant_hostile = _flat_cal(bandwidth=1e12, alpha=1e-7,
+                              quant_overhead=1e-6)
+    a = beam_search(gi, spec, space=space, constants=comm_bound)
+    b = beam_search(gi, spec, space=space, constants=quant_hostile)
+    assert a.best.fingerprint != b.best.fingerprint
+    genes_a = dict(a.best.genes)
+    genes_b = dict(b.best.genes)
+    assert any(g.compressor == "Int8Compressor"
+               for g in genes_a.values()), a.best.name
+    assert all(g.compressor == "NoneCompressor"
+               for g in genes_b.values()), b.best.name
+    # both winners' IRs pass the verifier (gated inside the search; the
+    # fingerprints exist only because verification succeeded)
+    assert a.best.fingerprint and b.best.fingerprint
+
+
+def test_auto_strategy_beam_mode_builds_and_records_choice():
+    from autodist_tpu.strategy import AutoStrategy
+
+    gi, spec = _dense_gi(), _spec()
+    b = AutoStrategy(search="beam")
+    s = b.build(gi, spec)
+    assert b.last_choice
+    assert b.last_search is not None and b.last_search.best is not None
+    assert s.node_config
+
+
+def test_search_report_cli(capsys):
+    """The explain surface: --search-report dumps top-K candidates with
+    per-leg-kind breakdown (and pruned branches when any)."""
+    from autodist_tpu.analysis.__main__ import main
+
+    rc = main(["mlp", "--search-report", "--mesh", "data=4",
+               "--topk", "3"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "top candidates" in out
+    assert "per-leg-kind" in out
+    rc = main(["mlp", "--search-report", "--mesh", "data=4", "--json"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    import json
+    report = json.loads(out)
+    assert report["best"]["per_kind_ms"]
+    assert report["n_evals"] > 0
+
+
+# -- the drift trigger --------------------------------------------------------
+
+def _samples(kind, t, n=4, nbytes=1 << 20, compressor="NoneCompressor"):
+    return [{"kind": kind, "measured_s": t, "nbytes": nbytes,
+             "compressor": compressor} for _ in range(n)]
+
+
+def test_drifted_leg_kinds_fires_past_threshold_only():
+    cal = _flat_cal(bandwidth=1e9, alpha=0.0)
+    fine = _samples("all_reduce", (1 << 20) / 1e9)          # exactly modeled
+    assert drifted_leg_kinds(fine, cal) == {}
+    slow = _samples("all_reduce", 10 * (1 << 20) / 1e9)     # 10x drift
+    out = drifted_leg_kinds(slow, cal)
+    assert set(out) == {"all_reduce"}
+    assert "all_reduce" in out["all_reduce"]
+    # BELOW-threshold drift (model overprices) fires too
+    fast = _samples("all_reduce", 0.05 * (1 << 20) / 1e9)
+    assert set(drifted_leg_kinds(fast, cal)) == {"all_reduce"}
+
+
+def test_calibration_cache_invalidates_across_discovery_switch(
+        tmp_path, monkeypatch):
+    """The stale-constants footgun: flipping AUTODIST_CALIBRATION
+    between an explicit env path and run-dir discovery mid-process must
+    reload, and a same-path atomic rewrite is picked up even when the
+    float mtime cannot distinguish the writes (inode changes)."""
+    import os
+
+    from autodist_tpu.telemetry.calibration import (
+        load_default_calibration,
+        reset_calibration_cache_for_testing,
+        save_calibration,
+    )
+
+    reset_calibration_cache_for_testing()
+    a = tmp_path / "a" / "calibration.json"
+    b_dir = tmp_path / "b"
+    a.parent.mkdir()
+    b_dir.mkdir()
+    save_calibration(_flat_cal(bandwidth=1e7), str(a))
+    save_calibration(_flat_cal(bandwidth=2e7),
+                     str(b_dir / "calibration.json"))
+    monkeypatch.setenv("AUTODIST_CALIBRATION", str(a))
+    monkeypatch.delenv("AUTODIST_TELEMETRY_DIR", raising=False)
+    assert load_default_calibration().bandwidths["all_reduce"] == 1e7
+    # switch env-path -> run-dir discovery mid-process
+    monkeypatch.delenv("AUTODIST_CALIBRATION")
+    monkeypatch.setenv("AUTODIST_TELEMETRY_DIR", str(b_dir))
+    assert load_default_calibration().bandwidths["all_reduce"] == 2e7
+    # same-path rewrite with an identical coarse mtime still reloads:
+    # pin mtime to the old file's value; the rename changed the inode.
+    st = os.stat(b_dir / "calibration.json")
+    save_calibration(_flat_cal(bandwidth=3e7),
+                     str(b_dir / "calibration.json"))
+    os.utime(b_dir / "calibration.json", ns=(st.st_atime_ns,
+                                             st.st_mtime_ns))
+    assert load_default_calibration().bandwidths["all_reduce"] == 3e7
+    reset_calibration_cache_for_testing()
+
+
+# -- the live drill: drift -> re-search -> hot-swap, bit-exact ----------------
+
+def _session(builder, params, loss_fn, batch, accum=1):
+    _reset_default_autodist_for_testing()
+    ad = AutoDist(strategy_builder=builder)
+    with ad.scope():
+        ad.capture(params=params, optimizer=optax.adam(1e-2),
+                   loss_fn=loss_fn, accum_steps=accum)
+    return ad, ad.create_distributed_session()
+
+
+class _FixedBuilder:
+    def __init__(self, strategy):
+        self._s = strategy
+
+    def build(self, graph_item, resource_spec):
+        return self._s
+
+
+def test_live_drill_drift_triggers_fingerprint_changing_hot_swap():
+    """The acceptance drill: planted leg-drift mid-run triggers a
+    re-search and a fingerprint-changing hot-swap through the RAM
+    snapshot tier, and the resumed run is bit-exact against an oracle
+    that started on the new schedule from the swap step."""
+    rng = np.random.RandomState(0)
+    params = {"l0": {"w": jnp.asarray(rng.randn(256, 256) * 0.05,
+                                      jnp.float32),
+                     "b": jnp.zeros(256, jnp.float32)},
+              "l1": {"w": jnp.asarray(rng.randn(256, 256) * 0.05,
+                                      jnp.float32),
+                     "b": jnp.zeros(256, jnp.float32)}}
+    batch = {"x": rng.randn(32, 256).astype(np.float32),
+             "y": rng.randn(32, 256).astype(np.float32)}
+
+    def loss_fn(p, b):
+        h = jnp.tanh(b["x"] @ p["l0"]["w"] + p["l0"]["b"])
+        h = h @ p["l1"]["w"] + p["l1"]["b"]
+        return jnp.mean((h - b["y"]) ** 2)
+
+    spec = _spec(8)
+    # The run starts on plain AllReduce: under the ACTIVE constants
+    # (flat defaults) that is a reasonable schedule.
+    active = _flat_cal(bandwidth=45e9, alpha=5e-6)
+    ad, sess = _session(AllReduce(), params, loss_fn, batch)
+    gi = ad.graph_item
+    old_fp = sess.schedule_fingerprint
+    assert old_fp
+
+    for _ in range(3):
+        sess.run(batch)
+    swap_step = sess.step_count
+    # Oracle anchor: the logical state AT the swap step.
+    from autodist_tpu.checkpoint.tiers import capture_snapshot
+    anchor = capture_snapshot(sess)
+
+    # Mid-run the world changes: live samples show the all_reduce leg
+    # running 20x slower than the active constants predict (a throttled
+    # interconnect), while RS/AG/PS legs stay on-model.
+    tuner = ScheduleTuner(gi, spec, constants=active,
+                          space=SearchSpace(max_rounds=2),
+                          calibration_path=None)
+    mb = float(1 << 20)
+    drifted = []
+    for nb in (1 << 18, 1 << 20, 4 << 20):
+        drifted += _samples("all_reduce", 20 * nb / 45e9, n=6, nbytes=nb)
+        for kind in ("reduce_scatter", "all_gather", "ps_exchange",
+                     "ppermute_hop", "update", "psum_guard"):
+            drifted += _samples(kind, nb / 45e9 + 5e-6, n=6, nbytes=nb)
+    del mb
+    tuner.feed_samples(drifted)
+    reasons = tuner.drift_reasons()
+    assert "all_reduce" in reasons          # the telemetry/leg-drift rule
+
+    swapped = tuner.maybe_retune(sess)
+    assert swapped, "drift did not produce a fingerprint-changing swap"
+    new_fp = sess.schedule_fingerprint
+    assert new_fp and new_fp != old_fp
+    assert tuner.swaps == 1
+    assert sess.step_count == swap_step      # swap loses no steps
+
+    # The swapped session continues...
+    for _ in range(3):
+        out = sess.run(batch)
+    swapped_params = sess.params
+    swapped_loss = float(np.asarray(out["loss"]))
+    new_strategy = sess._step.compiled_strategy.strategy
+    del sess, ad
+
+    # ...and must be bit-exact vs an oracle that STARTED on the new
+    # schedule from the swap step's state (loaded through the SAME
+    # snapshot-adoption semantics the swap used).
+    ad2, oracle = _session(_FixedBuilder(new_strategy), params, loss_fn,
+                           batch)
+    tuner.adopt_snapshot(oracle, anchor, oracle._step)
+    assert oracle.step_count == swap_step
+    assert oracle.schedule_fingerprint == new_fp
+    for _ in range(3):
+        oout = oracle.run(batch)
+    assert float(np.asarray(oout["loss"])) == swapped_loss
+    import jax
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        swapped_params, oracle.params)
+    del oracle, ad2
+    _reset_default_autodist_for_testing()
+
+
+def test_fit_tuner_wiring_swaps_mid_run():
+    """fit(tuner=...) hands the session to the tuner at its interval;
+    a planted drift swaps the schedule mid-fit and the loop finishes
+    unaware (same History shape, steps uninterrupted)."""
+    rng = np.random.RandomState(0)
+    params = {"l0": {"w": jnp.asarray(rng.randn(128, 128) * 0.05,
+                                      jnp.float32)}}
+    batch = {"x": rng.randn(16, 128).astype(np.float32),
+             "y": rng.randn(16, 128).astype(np.float32)}
+
+    def loss_fn(p, b):
+        return jnp.mean((b["x"] @ p["l0"]["w"] - b["y"]) ** 2)
+
+    spec = _spec()
+    ad, sess = _session(AllReduce(), params, loss_fn, batch)
+    old_fp = sess.schedule_fingerprint
+    tuner = ScheduleTuner(ad.graph_item, spec, interval=3, profile=False,
+                          constants=_flat_cal(),
+                          space=SearchSpace(max_rounds=1),
+                          calibration_path=None)
+    drifted = []
+    for nb in (1 << 18, 1 << 20):
+        drifted += _samples("all_reduce", 20 * nb / 45e9, n=6, nbytes=nb)
+        for kind in ("reduce_scatter", "all_gather", "ps_exchange",
+                     "ppermute_hop", "update"):
+            drifted += _samples(kind, nb / 45e9 + 5e-6, n=6, nbytes=nb)
+    tuner.feed_samples(drifted)
+    hist = sess.fit(batch, epochs=1, steps_per_epoch=8, tuner=tuner)
+    assert hist.steps_run == 8
+    assert tuner.swaps == 1
+    assert sess.schedule_fingerprint != old_fp
+    assert np.isfinite(hist.history["epoch_loss"][-1])
+    del sess, ad
+    _reset_default_autodist_for_testing()
+
+
+def test_retune_keeps_schedule_when_current_still_wins():
+    """No drift, or a re-search that confirms the running schedule,
+    must not swap (the current strategy is injected as a seed)."""
+    spec = _spec()
+    params = {"w": jnp.zeros((64, 64), jnp.float32)}
+
+    def loss_fn(p, b):
+        return jnp.mean((b["x"] @ p["w"] - b["y"]) ** 2)
+
+    rng = np.random.RandomState(0)
+    batch = {"x": rng.randn(8, 64).astype(np.float32),
+             "y": rng.randn(8, 64).astype(np.float32)}
+    ad, sess = _session(AllReduce(), params, loss_fn, batch)
+    tuner = ScheduleTuner(ad.graph_item, spec,
+                          constants=_flat_cal(), calibration_path=None)
+    # no samples -> no drift -> no retune
+    assert tuner.maybe_retune(sess) is False
+    assert tuner.swaps == 0
+    del sess, ad
+    _reset_default_autodist_for_testing()
