@@ -262,6 +262,19 @@ def main(argv=None) -> int:
                              "'dot' for a Graphviz dep-graph view; the "
                              "printed JSON carries the schedule_fingerprint "
                              "telemetry and checkpoints stamp")
+    parser.add_argument("--watermark", action="store_true",
+                        help="emit the schedule's liveness-based HBM "
+                             "watermark (docs/analysis.md): walk the "
+                             "sync-schedule IR in topological order, "
+                             "open/close buffer live intervals, and "
+                             "report per-device peak bytes, the leg at "
+                             "the peak, and per-microbatch-slot peaks "
+                             "on top of the static params+optimizer "
+                             "base.  Combines with --dump-ir json "
+                             "(one JSON object with schedule_ir + "
+                             "watermark keys); exits 1 when a budget "
+                             "(--budget-gb / the spec's hbm_gb) is "
+                             "exceeded")
     parser.add_argument("--search-report", action="store_true",
                         help="run the leg-calibrated strategy search "
                              "(docs/strategies.md 'Search') on the model "
@@ -351,10 +364,11 @@ def main(argv=None) -> int:
     elastic = {"from_axes": _parse_mesh(args.elastic_from)} \
         if args.elastic_from else None
 
-    if args.dump_ir:
+    if args.dump_ir or args.watermark:
         # Build the plan projection (legality lowering) and emit the
-        # schedule IR it lowers to — no diagnostics table, exit 0
-        # unless the projection itself cannot be built.
+        # schedule IR it lowers to and/or its liveness watermark — no
+        # diagnostics table, exit 0 unless the projection itself cannot
+        # be built (or --watermark finds a budget exceeded).
         from autodist_tpu.analysis import analyzer as _an
         from autodist_tpu.analysis.schedule import ir_for
         _an._load_passes()
@@ -370,10 +384,46 @@ def main(argv=None) -> int:
             print("no synced variables: the plan lowers to an empty "
                   "schedule", file=sys.stderr)
             return 1
+        wm = None
+        eff_budget = budget or getattr(resource_spec,
+                                       "hbm_bytes_per_chip", None)
+        if args.watermark:
+            from autodist_tpu.analysis import dataflow
+            from autodist_tpu.analysis import memory as _mem
+            base = _mem._param_and_grad_bytes(ctx)["params"] \
+                + (_mem._opt_state_bytes(ctx) or 0.0) \
+                + (_mem._activation_bytes(ctx) or 0.0)
+            wm = dataflow.watermark(ir, base_bytes=int(base))
+            if wm is None:
+                print("schedule is unexecutable (dep cycle): no "
+                      "topological order to simulate", file=sys.stderr)
+                return 1
         if args.dump_ir == "dot":
             print(ir.to_dot())
-        else:
-            print(ir.to_json(indent=1))
+            if wm is not None:
+                print(wm.summary(), file=sys.stderr)
+        elif args.dump_ir:
+            if wm is not None:
+                print(json.dumps({"schedule_ir": ir.to_dict(),
+                                  "watermark": wm.to_dict()}, indent=1))
+            else:
+                print(ir.to_json(indent=1))
+        elif wm is not None:
+            if args.json:
+                print(json.dumps(wm.to_dict(), indent=1))
+            else:
+                mib = float(1 << 20)
+                print(f"schedule watermark [{ir.fingerprint()}]: "
+                      f"{wm.summary()}")
+                for buf, n in wm.top_buffers():
+                    print(f"  {buf:40s} {n / mib:8.2f} MiB")
+                if eff_budget:
+                    verdict = "EXCEEDED" if wm.peak_bytes > eff_budget \
+                        else "ok"
+                    print(f"  budget {eff_budget / mib:.1f} MiB: "
+                          f"{verdict}")
+        if wm is not None and eff_budget and wm.peak_bytes > eff_budget:
+            return 1
         return 0
 
     report = analyze(strategy, graph_item, mesh=axes,

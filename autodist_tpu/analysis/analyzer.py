@@ -269,7 +269,13 @@ def analyze(strategy_or_compiled, graph_item: GraphItem, *,
         PASS_REGISTRY["legality"](ctx)
     for name in PASS_ORDER:
         if name in selected:
-            report.extend(PASS_REGISTRY[name](ctx))
+            diags = list(PASS_REGISTRY[name](ctx))
+            # Deterministic output: findings sort by (rule id, anchor)
+            # within each pass, so CLI tables and mutation goldens are
+            # byte-stable across runs and dict/set iteration orders.
+            diags.sort(key=lambda d: (d.rule, d.var_name, d.location,
+                                      d.message))
+            report.extend(diags)
     return report
 
 

@@ -16,10 +16,26 @@ Rules (docs/analysis.md):
 * ``memory/hbm-breakdown`` (INFO) — always emitted: the per-device sum
   ``params + optimizer + gradients + sync-state + activations`` with
   each term listed.
-* ``memory/hbm-over-budget`` (ERROR) — the sum exceeds the per-device
-  budget (``analyze(budget_bytes=...)``, or the resource spec's
-  ``hbm_gb`` yaml key).
-* ``memory/hbm-near-budget`` (WARN) — the sum exceeds 90% of the budget.
+* ``memory/watermark`` (INFO) — when the plan lowers to a schedule IR:
+  the **liveness-based watermark** (``analysis/dataflow.py``) — walk
+  the legs in a verified topological order, open each transient
+  buffer (``grad:``/``red:``/``sync:``) at its first write and close
+  it at its last read (donation closes early), stacked on the static
+  base ``params + optimizer + activations``.  Reports per-device peak
+  bytes, the leg at the peak, and per-microbatch-slot peaks.
+* ``memory/watermark-exceeds-hbm`` (ERROR) — the watermark peak
+  exceeds the per-device budget (``analyze(budget_bytes=...)``, or the
+  resource spec's ``hbm_gb`` yaml key).  This replaces the coarse-sum
+  budget comparison whenever a schedule IR exists: the schedule's
+  actual liveness (gradient and reduce buffers live simultaneously,
+  pipelined slots, donation) is what the device allocates, not the
+  flat whole-step sum.
+* ``memory/watermark-near-hbm`` (WARN) — the watermark peak exceeds
+  90% of the budget.
+* ``memory/hbm-over-budget`` (ERROR) — no schedule IR (no synced
+  trainables): the coarse sum exceeds the per-device budget.
+* ``memory/hbm-near-budget`` (WARN) — no schedule IR: the coarse sum
+  exceeds 90% of the budget.
 * ``memory/zero1-unused`` (WARN) — the footprint is within 10% of the
   budget (or over it), the mesh has a data axis, and AllReduce plans
   keep replicated optimizer state that ZeRO-1 (``sync=
@@ -200,26 +216,72 @@ def run(ctx: AnalysisContext) -> List[Diagnostic]:
         f"per-device HBM ≈ {_mib(total)} = " + " + ".join(parts)
         + budget_note))
 
+    # Liveness watermark over the schedule IR (analysis/dataflow.py):
+    # the static base (params + optimizer + activations) plus the
+    # schedule's transient buffers walked leg-by-leg.  Gradients and
+    # sync state are NOT in the base — they are the grad:/red:/sync:
+    # buffers whose live intervals the simulator opens and closes.
+    base = terms["params"] + (opt or 0.0) + (act or 0.0)
+    wm = _watermark(ctx, base)
+    if wm is not None:
+        diags.append(diag(
+            "memory/watermark", Severity.INFO,
+            f"schedule liveness watermark: {wm.summary()}"
+            + budget_note, location=wm.peak_leg))
+
     if budget:
-        if total > budget:
+        fix_over = ("shard more state (PS/weight-update sharding or "
+                    "ZeRO-1 sync='reduce_scatter'), cast optimizer "
+                    "moments to bf16 (cast_opt_state), enable remat, or "
+                    "shrink the per-device batch")
+        fix_near = "leave headroom: shard or remat before scaling up"
+        if wm is not None:
+            if wm.peak_bytes > budget:
+                diags.append(diag(
+                    "memory/watermark-exceeds-hbm", Severity.ERROR,
+                    f"schedule watermark peak ≈ {_mib(wm.peak_bytes)} at "
+                    f"leg {wm.peak_leg!r} exceeds the {_mib(budget)} "
+                    "budget (liveness-exact: the device really allocates "
+                    "this much while that leg runs)",
+                    location=wm.peak_leg, fix=fix_over))
+            elif wm.peak_bytes > 0.9 * budget:
+                diags.append(diag(
+                    "memory/watermark-near-hbm", Severity.WARN,
+                    f"schedule watermark peak ≈ {_mib(wm.peak_bytes)} at "
+                    f"leg {wm.peak_leg!r} is within 10% of the "
+                    f"{_mib(budget)} budget (XLA temporaries may tip it "
+                    "over)", location=wm.peak_leg, fix=fix_near))
+        elif total > budget:
             diags.append(diag(
                 "memory/hbm-over-budget", Severity.ERROR,
                 f"per-device footprint ≈ {_mib(total)} exceeds the "
-                f"{_mib(budget)} budget",
-                fix="shard more state (PS/weight-update sharding or "
-                    "ZeRO-1 sync='reduce_scatter'), cast optimizer "
-                    "moments to bf16 (cast_opt_state), enable remat, or "
-                    "shrink the per-device batch"))
+                f"{_mib(budget)} budget", fix=fix_over))
         elif total > 0.9 * budget:
             diags.append(diag(
                 "memory/hbm-near-budget", Severity.WARN,
                 f"per-device footprint ≈ {_mib(total)} is within 10% of "
                 f"the {_mib(budget)} budget (XLA temporaries may tip it "
-                "over)",
-                fix="leave headroom: shard or remat before scaling up"))
-        if total > 0.9 * budget and opt is not None:
+                "over)", fix=fix_near))
+        watermark_total = wm.peak_bytes if wm is not None else total
+        if watermark_total > 0.9 * budget and opt is not None:
             diags += _zero1_unused(ctx, opt)
     return diags
+
+
+def _watermark(ctx: AnalysisContext, base_bytes: float):
+    """The liveness watermark of the schedule IR this plan lowers to
+    (None when the plan has no synced trainables, the IR cannot be
+    built, or its dep graph is unexecutable — the schedule pass owns
+    those ERRORs)."""
+    try:
+        from autodist_tpu.analysis.schedule import ir_for
+        ir = ir_for(ctx)
+    except Exception:  # pragma: no cover - projection failure
+        return None
+    if ir is None:
+        return None
+    from autodist_tpu.analysis import dataflow
+    return dataflow.watermark(ir, base_bytes=int(base_bytes))
 
 
 def _zero1_unused(ctx: AnalysisContext, opt_actual: float

@@ -19,8 +19,15 @@ verifier's own ids (docs/schedule-ir.md):
   end-of-step quantized collective, or (int8/fp8 under an explicit
   pipeline request) exactly one quantized collective per microbatch
   slot ``0..accum-1``.
-* ``schedule/read-after-donate`` (ERROR) — a donated sync-state buffer
-  with a read reachable after a write.
+* ``schedule/read-after-donate`` (ERROR) — a donated buffer (any
+  namespace: ``sync:``/``param:``/``opt:``) with a read reachable
+  after a write by a leg outside its read-modify-write chain.
+* ``schedule/race-unordered-write`` / ``schedule/race-read-write``
+  (ERROR) — the happens-before race detector
+  (``analysis/dataflow.py``): two accesses of one buffer, at least one
+  a write, with no ordering path in the dep closure.
+* ``schedule/buffer-leak`` (WARN) — a transient buffer written but
+  never read nor donated.
 * ``schedule/reduction-order-divergence`` (WARN) — a low-precision or
   compressed bucket whose ring order diverges from the GSPMD psum
   tree.
@@ -147,6 +154,15 @@ _FIXES = {
     "schedule/fused-inconsistent":
         "rebuild the IR through build_schedule_ir(fused_kernels=...) so "
         "the fused legs and the program record agree",
+    "schedule/race-unordered-write":
+        "add a dep edge ordering the two writers (the builder chains "
+        "every collective a stage issues — a hand-edited program must "
+        "preserve that order)",
+    "schedule/race-read-write":
+        "order the reader against the writer with a dep edge",
+    "schedule/buffer-leak":
+        "consume the buffer (update/guard/gather) or drop the leg "
+        "producing it",
 }
 
 

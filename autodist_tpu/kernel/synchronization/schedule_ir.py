@@ -49,10 +49,25 @@ make the partition/schedule decision a first-class analyzable artifact):
     for a compressor without the per-slot contract
     (``HorovodCompressor*``), and a quantized ppermute ring chain for a
     compressor with no per-hop requantize lowering.
-  - ``schedule/read-after-donate`` (ERROR) — a donated sync-state
-    buffer has a pure read reachable after a write in the dep graph:
-    the donated buffer's old handle is deleted by then (the PR 3
-    donation audit, now a checked invariant).
+  - ``schedule/read-after-donate`` (ERROR) — a donated buffer (ANY
+    namespace: ``sync:``/``param:``/``opt:``) has a pure read
+    reachable after a write in the dep graph, by a leg OUTSIDE the
+    buffer's own read-modify-write chain (a reader whose
+    (bucket, slot) group also writes the buffer is threading carried
+    state — the quantized-ring error-feedback contract — and reads
+    the new value): the donated buffer's old handle is deleted by
+    then (the PR 3 donation audit, now a checked invariant over
+    every donated namespace).
+  - ``schedule/race-unordered-write`` (ERROR) — two legs write the
+    same buffer with no happens-before path between them (the
+    transitive dep closure, ``analysis/dataflow.py``): the lowerings
+    may commit the writes in either order.
+  - ``schedule/race-read-write`` (ERROR) — a read and a write of one
+    buffer with no happens-before path: the reader may observe either
+    value depending on issue timing.
+  - ``schedule/buffer-leak`` (WARN) — a transient buffer written but
+    never read nor donated: the sync work producing it is dead
+    (``param:``/``opt:`` step outputs are exempt).
   - ``schedule/collective-mismatch`` (ERROR) — two participant stages
     issue different ordered collective sequences for the same
     microbatch slot (the classic MPMD/manual-schedule hang; consumed
@@ -873,6 +888,9 @@ RULE_READ_AFTER_DONATE = "schedule/read-after-donate"
 RULE_COLLECTIVE_MISMATCH = "schedule/collective-mismatch"
 RULE_REDUCTION_ORDER = "schedule/reduction-order-divergence"
 RULE_FUSED_INCONSISTENT = "schedule/fused-inconsistent"
+RULE_RACE_WRITE = "schedule/race-unordered-write"
+RULE_RACE_READ_WRITE = "schedule/race-read-write"
+RULE_BUFFER_LEAK = "schedule/buffer-leak"
 
 
 @dataclass(frozen=True)
@@ -913,14 +931,18 @@ def _topo_order(legs: Sequence[Leg]) -> Optional[List[str]]:
 
 def verify(ir: ScheduleIR) -> List[Violation]:
     """Model-check one schedule program.  Pure and fast (no jax; linear
-    passes plus per-donated-buffer reachability) — viable as a pre-trace
-    gate; rule ids in the module docstring and docs/schedule-ir.md."""
+    passes plus one happens-before bitset closure,
+    ``analysis/dataflow.py``) — viable as a pre-trace gate; rule ids in
+    the module docstring and docs/schedule-ir.md.  Findings come back
+    sorted by ``(rule id, leg id)`` so output is byte-stable."""
     out: List[Violation] = []
     legs = list(ir.legs)
     ids = [l.id for l in legs]
     id_set = set()
+    unique_ids = True
     for l in legs:
         if l.id in id_set:
+            unique_ids = False
             out.append(Violation(
                 RULE_UNKNOWN_DEP, SEV_ERROR,
                 f"duplicate leg id {l.id!r}: the partial order is "
@@ -933,6 +955,7 @@ def verify(ir: ScheduleIR) -> List[Violation]:
                     RULE_UNKNOWN_DEP, SEV_ERROR,
                     f"dep edge names missing leg {dep!r}", leg=l.id))
     order = _topo_order(legs)
+    acyclic = order is not None and unique_ids
     if order is None:
         out.append(Violation(
             RULE_DEP_CYCLE, SEV_ERROR,
@@ -1096,40 +1119,19 @@ def verify(ir: ScheduleIR) -> List[Violation]:
                 "program does not record fused kernel 'quant_hop'",
                 location=node["key"]))
 
-    # -- donation race: no read reachable after a donated buffer's write --
-    donated = set(ir.donated)
-    if donated and order is not None:
-        fwd: Dict[str, List[str]] = {l.id: [] for l in legs}
-        for l in legs:
-            for dep in l.deps:
-                if dep in fwd:
-                    fwd[dep].append(l.id)
-        for buf in sorted(donated):
-            writers = [l for l in legs if buf in l.writes]
-            readers = [l for l in legs
-                       if buf in l.reads and buf not in l.writes]
-            if not writers or not readers:
-                continue
-            reader_ids = {l.id for l in readers}
-            # forward closure from each writer
-            seen: set = set()
-            frontier = [w.id for w in writers]
-            while frontier:
-                cur = frontier.pop()
-                for nxt in fwd.get(cur, ()):
-                    if nxt not in seen:
-                        seen.add(nxt)
-                        frontier.append(nxt)
-            hit = sorted(reader_ids & seen, key=lambda i: pos.get(i, 0))
-            if hit:
-                out.append(Violation(
-                    RULE_READ_AFTER_DONATE, SEV_ERROR,
-                    f"donated buffer {buf!r} is read by leg {hit[0]!r} "
-                    "after a write: the donated input's old handle is "
-                    "deleted by then — undonate it or drop the late read",
-                    leg=hit[0], location=buf))
+    # -- dataflow sanitizer: races, leaks, donation races -----------------
+    # (analysis/dataflow.py: happens-before bitset reachability over the
+    # dep closure; skipped when the graph is cyclic or ids collide — no
+    # happens-before relation exists to judge against, and the
+    # structural ERRORs above already reject the program.)
+    if acyclic:
+        from autodist_tpu.analysis import dataflow
+        out.extend(dataflow.race_violations(ir, order=order))
 
     out.extend(_check_stage_sequences(legs, pos))
+    # Deterministic diagnostics: CLI output and mutation goldens are
+    # byte-stable across runs (and across set/dict iteration orders).
+    out.sort(key=lambda v: (v.rule, v.leg, v.location, v.message))
     return out
 
 

@@ -199,21 +199,25 @@ def remap_data_state(state: Optional[dict], old_hosts: int,
 
 # -- the one-call entry point ------------------------------------------------
 
-def preflight_elastic(session, meta: dict, context: str = "elastic") -> None:
+def preflight_elastic(session, meta: dict, context: str = "elastic",
+                      resource_spec=None) -> None:
     """Re-run the static analysis passes against the (possibly shrunken)
     mesh with the checkpoint's provenance attached — ZeRO-1 reshard
     legality (``elastic/*`` rules), the full schedule verifier on the
-    new mesh (``schedule/*`` rules: ring hop chains and bucket leg
-    order are re-checked EXACTLY, not just HBM and ring degeneracy —
-    an elastic resize changes hop counts and leg order), and the HBM
-    re-estimate at 1/M — raising ``StrategyValidationError`` before any
-    restore or tracing.  The checkpoint's recorded
-    ``schedule_fingerprint`` rides along so a same-mesh resume with a
-    drifted sync config is flagged (``schedule/fingerprint-drift``)."""
+    new mesh (``schedule/*`` rules: ring hop chains, bucket leg order,
+    and the happens-before race detector are re-checked EXACTLY, not
+    just HBM and ring degeneracy — an elastic resize changes hop counts
+    and leg order), and the liveness HBM watermark at the new 1/M
+    (``memory/watermark*``; its budget rules fire when
+    ``resource_spec`` carries ``hbm_gb``) — raising
+    ``StrategyValidationError`` before any restore or tracing.  The
+    checkpoint's recorded ``schedule_fingerprint`` rides along so a
+    same-mesh resume with a drifted sync config is flagged
+    (``schedule/fingerprint-drift``)."""
     from autodist_tpu.analysis import analyze, log_report
 
     compiled = session._step.compiled_strategy
-    report = analyze(compiled, session._gi,
+    report = analyze(compiled, session._gi, resource_spec=resource_spec,
                      elastic={"from_axes": meta.get("mesh_axes") or {},
                               "buckets": meta.get("zero1_buckets"),
                               "schedule_fingerprint":

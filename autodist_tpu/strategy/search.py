@@ -21,7 +21,10 @@ schedule_ir.PlanFact` set.  Every candidate is:
     the explain surface can say WHY a branch died;
 (b) **lowered to its schedule IR** via ``ir_from_facts`` — the SAME
     planner the runtime executes — and gated by the static schedule
-    verifier (an unverifiable schedule can never win on price);
+    verifier (an unverifiable schedule can never win on price) AND by
+    the liveness HBM watermark (``analysis/dataflow.py``) against the
+    spec's ``hbm_gb``: an OOM-by-construction schedule is pruned
+    before pricing, with the watermark peak in its prune verdict;
 (c) **priced leg-by-leg** through ``estimate_ir_cost`` with the
     discovered ``calibration.json`` constants, so fused-vs-unfused,
     quantized-vs-f32, and pipelined-vs-exposed alternatives are priced
@@ -304,6 +307,24 @@ def evaluate_candidate(name: str,
         return CandidateEval(
             name=name, fingerprint=ir.fingerprint(),
             pruned_by=f"{v.rule}: {v.message}", genes=genes), None
+    # OOM gate BEFORE pricing (docs/strategies.md "Search"): the
+    # liveness watermark of this candidate's schedule, stacked on the
+    # coarse fact base, against the spec's per-chip HBM — a schedule
+    # that cannot fit is rejected here, where legality pruning already
+    # happens, instead of winning on wire cost and OOMing at step 1.
+    hbm = getattr(resource_spec, "hbm_bytes_per_chip", None)
+    if hbm:
+        from autodist_tpu.analysis import dataflow
+        wm = dataflow.watermark_for_facts(facts, ir, dict(axes))
+        if wm is not None and wm.peak_bytes > hbm:
+            return CandidateEval(
+                name=name, fingerprint=ir.fingerprint(),
+                pruned_by=(
+                    f"{dataflow.RULE_WATERMARK_EXCEEDS}: schedule "
+                    f"watermark peak ≈ {wm.peak_bytes / (1 << 20):.1f} "
+                    f"MiB at leg {wm.peak_leg!r} exceeds the "
+                    f"{hbm / (1 << 20):.1f} MiB per-chip HBM budget"),
+                genes=genes), None
     # Pricing shadow: sparse PS facts shrink to touched rows (the
     # Parallax rule) so the leg-priced estimate sees the honest wire.
     priced_ir = ir if priced_facts is facts else sir.ir_from_facts(

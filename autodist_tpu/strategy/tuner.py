@@ -19,7 +19,11 @@ being the winner.  The tuner closes the loop:
    currently-running strategy injected as a seed so it survives when it
    still wins;
 3. **hot-swap** — when the winner's schedule fingerprint differs from
-   the running one, the schedule is swapped THROUGH the elastic-resume
+   the running one, the swap is first preflighted against per-chip HBM
+   (:meth:`ScheduleTuner.watermark_veto`: the liveness watermark of
+   the winner's schedule, ``analysis/dataflow.py``, against the spec's
+   ``hbm_gb`` — a tuner must never swap onto an OOM schedule), then
+   the schedule is swapped THROUGH the elastic-resume
    machinery: a RAM-tier snapshot (``checkpoint/tiers.py``) captures
    the logical training state, the step is rebuilt with the new
    strategy's IR (same mesh — compile only, no relaunch), and the
@@ -230,6 +234,36 @@ class ScheduleTuner:
                              winner=result.best)
 
     # -- the swap ----------------------------------------------------------
+    def watermark_veto(self, strategy, axes) -> Optional[str]:
+        """Hot-swap preflight: why the candidate strategy's schedule
+        cannot fit per-chip HBM on ``axes`` (None = fits, or no
+        ``hbm_gb`` budget to check against).  The same liveness
+        watermark the search prunes with (``analysis/dataflow.py``) —
+        defense in depth for winners injected via ``retune``'s seeds or
+        a search run without the spec's budget."""
+        hbm = getattr(self._resource_spec, "hbm_bytes_per_chip", None)
+        if not hbm:
+            return None
+        from autodist_tpu.analysis import dataflow
+        from autodist_tpu.analysis.search import facts_for_candidate
+        from autodist_tpu.kernel.synchronization import schedule_ir as sir
+
+        axes = {str(k): int(v) for k, v in dict(axes).items()}
+        facts, _, guard, prune = facts_for_candidate(
+            strategy, self._gi, axes)
+        if prune is not None:
+            return f"candidate fails legality preflight ({prune})"
+        accum = int(getattr(self._gi, "accum_steps", 1) or 1)
+        ir = sir.ir_from_facts(facts, axes=axes, accum_steps=accum,
+                               guard=guard)
+        wm = dataflow.watermark_for_facts(facts, ir, axes)
+        if wm is not None and wm.peak_bytes > hbm:
+            return (f"schedule watermark peak ≈ "
+                    f"{wm.peak_bytes / (1 << 20):.1f} MiB at leg "
+                    f"{wm.peak_leg!r} exceeds the per-chip HBM budget "
+                    f"{hbm / (1 << 20):.1f} MiB")
+        return None
+
     def adopt_snapshot(self, session, snap, new_step) -> bool:
         """Load a logical RAM snapshot into ``session`` running
         ``new_step`` (possibly a DIFFERENT sync schedule than the
@@ -296,6 +330,14 @@ class ScheduleTuner:
         from autodist_tpu.strategy.compiler import StrategyCompiler
         from autodist_tpu.telemetry import emit_event
 
+        veto = self.watermark_veto(strategy, dict(session.mesh.shape))
+        if veto is not None:
+            logging.warning(
+                "tuner: hot-swap aborted — %s; keeping the running "
+                "schedule", veto)
+            emit_event("tuner/hot-swap", step=session.step_count,
+                       tier=None, aborted=True, reason=veto)
+            return False
         t0 = time.perf_counter()
         old_fp = session.schedule_fingerprint
         snap = capture_snapshot(session)

@@ -2309,12 +2309,23 @@ def run_grad_sync_child() -> None:
             raise RuntimeError("bench: session has no schedule IR")
         t_v = time.perf_counter()
         sir.assert_verified(ir, f"bench grad_sync [{type(builder).__name__}]")
+        verify_ms = (time.perf_counter() - t_v) * 1e3
+        from autodist_tpu.analysis import dataflow
         from autodist_tpu.strategy.cost_model import estimate_ir_cost
         ir_cost = estimate_ir_cost(ir)
+        # Liveness watermark of the schedule's transient buffers
+        # (analysis/dataflow.py, base 0: schedule component only) —
+        # rides the per-mode payload next to the verifier wall time so
+        # verifier-cost and watermark regressions both show up in
+        # BENCH artifacts.
+        wm = dataflow.watermark(ir)
         measure.last_ir = {
             "schedule_fingerprint": ir.fingerprint(),
             "ir_leg_count": len(ir.legs),
-            "ir_verify_ms": round((time.perf_counter() - t_v) * 1e3, 3),
+            "ir_verify_ms": round(verify_ms, 3),
+            "ir_watermark_peak_bytes": int(wm.peak_bytes)
+            if wm is not None else None,
+            "ir_watermark_peak_leg": wm.peak_leg if wm is not None else "",
             # leg-priced estimate (estimate_ir_cost): exposed wire after
             # the IR's own slot/prefetch accounting, per chip per step
             "ir_exposed_wire_bytes": round(ir_cost.exposed_wire_bytes, 1),

@@ -95,7 +95,7 @@ def test_cli_json_output_and_budget(tmp_path):
                    "--budget-gb", "0.000001"])
     out = json.loads(buf.getvalue())
     assert rc == 1
-    assert any(d["rule"] == "memory/hbm-over-budget"
+    assert any(d["rule"] == "memory/watermark-exceeds-hbm"
                for d in out["diagnostics"])
 
 

@@ -30,30 +30,40 @@ def test_hbm_breakdown_always_emitted(gi):
     assert len(report.by_rule("memory/hbm-breakdown")) == 1
 
 
-def test_hbm_over_budget_is_exactly_one_error(gi):
+def test_watermark_over_budget_is_exactly_one_error(gi):
+    """A plan that lowers to a schedule IR is budget-checked through
+    the liveness watermark, not the coarse sum (docs/analysis.md)."""
     report = analyze(full_cover(gi), gi, mesh=AXES8, budget_bytes=1024)
     errors = report.errors
     assert len(errors) == 1
-    assert errors[0].rule == "memory/hbm-over-budget"
+    assert errors[0].rule == "memory/watermark-exceeds-hbm"
+    assert len(report.by_rule("memory/watermark")) == 1
 
 
-def test_hbm_near_budget_warns():
-    # big enough that the MiB-rounded breakdown total is precise
+def test_watermark_near_budget_warns():
     gi = GraphItem({"w": jnp.zeros((1024, 1024), jnp.float32)},
                    optimizer=optax.adam(1e-3))
     s = Strategy(node_config=[ar_node("w")])
-    probe = analyze(s, gi, mesh=AXES8)
-    msg = probe.by_rule("memory/hbm-breakdown")[0].message
-    total_mib = float(msg.split("≈")[1].split("MiB")[0])
-    assert total_mib > 1.0
-    budget = int(total_mib * (1 << 20) / 0.95)      # ~95% utilization
+    # the exact watermark total, through the same helpers the pass uses
+    from autodist_tpu.analysis import analyzer as _an
+    from autodist_tpu.analysis import dataflow
+    from autodist_tpu.analysis import memory as _mem
+    from autodist_tpu.analysis.schedule import ir_for
+    ctx = _an.AnalysisContext(strategy=s, graph_item=gi, axes=AXES8)
+    _an.PASS_REGISTRY["legality"](ctx)
+    base = _mem._param_and_grad_bytes(ctx)["params"] \
+        + _mem._opt_state_bytes(ctx)
+    wm = dataflow.watermark(ir_for(ctx), base_bytes=int(base))
+    assert wm is not None and wm.peak_bytes > 0
+    budget = int(wm.peak_bytes / 0.95)              # ~95% utilization
     report = analyze(s, gi, mesh=AXES8, budget_bytes=budget)
     assert not report.has_errors()
     rules = [d.rule for d in report.warnings]
-    assert "memory/hbm-near-budget" in rules
+    assert "memory/watermark-near-hbm" in rules
     # near budget + replicated AR optimizer state on a data axis: the
     # ZeRO-1 advisory fires alongside (see test_zero1_unused_warn).
-    assert set(rules) <= {"memory/hbm-near-budget", "memory/zero1-unused"}
+    assert set(rules) <= {"memory/watermark-near-hbm",
+                          "memory/zero1-unused"}
 
 
 def test_hbm_budget_from_resource_spec(gi):
@@ -62,6 +72,20 @@ def test_hbm_budget_from_resource_spec(gi):
         "hbm_gb": 1e-6})
     assert tiny.hbm_bytes_per_chip == int(1e-6 * (1 << 30))
     report = analyze(full_cover(gi), gi, mesh=AXES8, resource_spec=tiny)
+    assert [d.rule for d in report.errors] \
+        == ["memory/watermark-exceeds-hbm"]
+
+
+def test_coarse_budget_rules_without_schedule_ir():
+    """No synced trainables -> no schedule IR -> the coarse-sum budget
+    rules still guard the footprint (activation term here)."""
+    import numpy as np
+    gi = GraphItem({"w": jnp.zeros((4, 4), jnp.float32)},
+                   untrainable_vars=["w"])
+    s = Strategy(node_config=[])
+    report = analyze(s, gi, mesh=AXES8, budget_bytes=1024,
+                     batch={"x": np.zeros((64, 1024), np.float32)})
+    assert not report.by_rule("memory/watermark")
     assert [d.rule for d in report.errors] == ["memory/hbm-over-budget"]
 
 

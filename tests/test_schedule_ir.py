@@ -156,15 +156,17 @@ def test_fuzz_planner_schedules_verify_clean():
 
 def test_fuzz_ir_from_facts_verifies_clean():
     """The mesh-free (analysis-side) builder over random plan facts —
-    including PS plans, partitioned vars, and PowerSGD fallbacks — is
-    also always accepted."""
+    including PS plans, partitioned vars, PowerSGD fallbacks, and
+    ring-threshold-crossing shapes (quantized per-hop chains with
+    donated error-feedback state) — is also always accepted."""
     rng = np.random.RandomState(7)
     for trial in range(100):
         facts = []
         for i in range(int(rng.randint(1, 8))):
             kind = str(rng.choice(["AllReduce", "AllReduce", "PS"]))
             facts.append(sir.PlanFact(
-                name=f"m/v{i}", shape=(int(rng.choice([8, 128])), 64),
+                name=f"m/v{i}",
+                shape=(int(rng.choice([8, 128, 1024])), 64),
                 dtype=str(rng.choice(["float32", "bfloat16"])),
                 sync_kind=kind,
                 compressor=str(rng.choice(
