@@ -47,6 +47,14 @@ search space, at the granularity GSPMD weight-update sharding
 
 Everything here is numpy-only and mesh-free — safe inside the
 pre-trace verifier gate, the beam search inner loop, and bench.
+
+:class:`HappensBefore` has a second consumer beyond the verifier: the
+flight recorder's hang localizer
+(:func:`autodist_tpu.telemetry.flightrec.localize_hang`) diffs
+per-host progress cursors against this exact relation to name the
+frontier leg and the culprit host of a WEDGED verdict — the legs it
+passes are lightweight views carrying only ``id``/``deps``, which is
+all the closure reads.
 """
 from __future__ import annotations
 

@@ -126,6 +126,15 @@ class ENV(enum.Enum):
     # and AutoStrategy(search=True) load the fitted constants
     # automatically — no flags.
     AUTODIST_CALIBRATION = ("AUTODIST_CALIBRATION", _str)
+    # flight recorder (docs/observability.md "Flight recorder"): "0"
+    # disables cursor recording entirely; "host" stamps host-phase
+    # cursors only (step/checkpoint boundaries — the default
+    # granularity off-TPU); "legs" additionally stamps leg-group
+    # host-callbacks inside the explicit sync path; "auto" (default,
+    # empty) resolves to "legs" on TPU backends (callbacks ride async
+    # dispatch) and "host" elsewhere (CPU host-callbacks are not free —
+    # BENCH_flightrec.json measures both).
+    AUTODIST_FLIGHTREC = ("AUTODIST_FLIGHTREC", _str)
     # fused Pallas kernel opt-in (docs/kernels.md): "all" or a comma
     # list of guard,update,quant_hop,paged_attention.  Unset = every
     # path keeps its unfused lowering; requested-but-unsupported

@@ -502,6 +502,44 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
 
     pipe_keys = ir.pipelined_keys()
     pipe_buckets = [b for b in buckets if b.key in pipe_keys]
+
+    # -- flight-recorder leg stamps (docs/observability.md) ----------------
+    # Under AUTODIST_FLIGHTREC=legs (the automatic choice on TPU) the
+    # step stamps a host-callback cursor at every leg GROUP boundary —
+    # per-bucket reduce, ZeRO-1 update, per-bucket param gather, guard
+    # rollup — keyed by the IR's own leg ids, so a wedge localizes to
+    # the exact leg the happens-before relation knows.  Resolved at
+    # build: the default off-TPU path compiles no callbacks at all.
+    from autodist_tpu.telemetry import flightrec
+
+    leg_stamps = flightrec.trace_stamps_enabled()
+    stamp_reduce: Dict[str, tuple] = {}   # key -> (leg id/template, kind)
+    stamp_gather: Dict[str, tuple] = {}
+    stamp_update: Dict[str, tuple] = {}
+    if leg_stamps:
+        import re as _re
+        for b in buckets:
+            finals = [l for l in ir.legs
+                      if l.bucket == b.key and f"red:{b.key}" in l.writes]
+            if not finals:
+                continue
+            if b.key in pipe_keys:
+                # Per-slot ids ("<key>@<slot>/..."): a {slot} template
+                # the callback resolves with the live microbatch index.
+                stamp_reduce[b.key] = (
+                    _re.sub(r"@\d+/", "@{slot}/", finals[0].id),
+                    finals[0].kind)
+            else:
+                stamp_reduce[b.key] = (finals[-1].id, finals[-1].kind)
+        for l in ir.legs:
+            if l.id.startswith("update/"):
+                stamp_update[l.bucket] = (l.id, l.kind)
+        for b in rs_buckets:
+            finals = [l for l in ir.legs
+                      if l.bucket == b.key and "@gather" in l.id
+                      and f"param:{b.key}" in l.writes]
+            if finals:
+                stamp_gather[b.key] = (finals[-1].id, finals[-1].kind)
     # Mean-reduction lowering per UNCOMPRESSED bucket under the IR's
     # resolved algorithm (ring / one-shot / XLA fused); compressed
     # buckets keep their compressor's own wire format.
@@ -751,7 +789,9 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
              pipe_qsats) = overlap_mod.pipelined_accumulate(
                 single_vg, gi.accum_steps, has_aux, pipe_buckets,
                 reduce_fns, reduced_sizes, full_params, batch,
-                quant_fns=pipe_quant_fns, quant_states=qstates0)
+                quant_fns=pipe_quant_fns, quant_states=qstates0,
+                stamps={k: v for k, v in stamp_reduce.items()
+                        if k in pipe_keys} if leg_stamps else None)
         elif has_aux:
             (loss, aux), grads = vg_local(full_params, batch)
         else:
@@ -840,6 +880,9 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
                     rs_grad_shards[b.key] = red
                 store_state(b.key, pipe_qstates.get(b.key))
                 continue
+            if b.key in stamp_reduce:   # flight-recorder leg boundary
+                lid, lkind = stamp_reduce[b.key]
+                flightrec.traced_stamp(lid, leg_kind=lkind)
             vec = pack_bucket(b, [flat[idx_of[n]][1] for n in b.names])
             if b.key in reduce_fns:   # uncompressed: schedule-lowered
                 # Profiler attribution (docs/observability.md): the
@@ -922,6 +965,9 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
         if num_active:
             inv_scale = jnp.float32(1.0) if scale is None \
                 else jnp.float32(1.0) / scale
+            if leg_stamps:
+                flightrec.traced_stamp("guard/rollup",
+                                       leg_kind=schedule_ir.LEG_PSUM_GUARD)
             with sync_span("guard_rollup"):
                 all_finite, gnorm, per_bucket = health.finalize(
                     mesh_axis_names, loss, inv_scale)
@@ -965,6 +1011,9 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
                 sz = b.padded_total // d
                 p_shards[b.key] = lax.dynamic_slice_in_dim(
                     vec, shard_idx * sz, sz, 0)
+            if rs_buckets and rs_buckets[0].key in stamp_update:
+                lid, lkind = stamp_update[rs_buckets[0].key]
+                flightrec.traced_stamp(lid, leg_kind=lkind)
             if update_fused:
                 # Fused unscale/clip/Adam update (docs/kernels.md): one
                 # kernel per bucket shard over (p, g, m, v) — exact vs
@@ -1008,6 +1057,9 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
             for key, gather_alg in ir.gather_plan():
                 b = rs_by_key[key]
                 shard = new_shards[b.key]
+                if key in stamp_gather:   # flight-recorder leg boundary
+                    lid, lkind = stamp_gather[key]
+                    flightrec.traced_stamp(lid, leg_kind=lkind)
                 with sync_span(f"param_gather/{b.key}"):
                     if gather_alg == schedule_ir.ALG_RING and d > 1:
                         full_vec = overlap_mod.ring_all_gather(
@@ -1020,6 +1072,9 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
             params = jax.tree_util.tree_unflatten(treedef, new_flat)
             opt_state = {"vars": t_state, "zero1": z_state}
         else:
+            if "~tree" in stamp_update:
+                lid, lkind = stamp_update["~tree"]
+                flightrec.traced_stamp(lid, leg_kind=lkind)
             with sync_span("tree_update"):
                 updates, opt_state = tree_optimizer.update(grads, opt_state,
                                                            params)

@@ -453,7 +453,8 @@ def pipelined_accumulate(single_vg: Callable, accum: int, has_aux: bool,
                          reduced_sizes: Dict[str, int],
                          params, batch,
                          quant_fns: Optional[Dict[str, Callable]] = None,
-                         quant_states: Optional[Dict] = None):
+                         quant_states: Optional[Dict] = None,
+                         stamps: Optional[Dict[str, tuple]] = None):
     """Software-pipelined gradient accumulation over ``accum``
     microbatches: iteration *k* issues the bucket collectives for
     microbatch *k−1*'s gradients and THEN computes microbatch *k*'s
@@ -494,6 +495,13 @@ def pipelined_accumulate(single_vg: Callable, accum: int, has_aux: bool,
     quantized residuals) are donated by XLA's loop buffer reuse; an
     uneven tail unrolls the loop (shapes differ per microbatch) with
     the same weighting.
+
+    ``stamps`` (``{bucket.key: (leg-id template, leg kind)}``) arms
+    flight-recorder leg cursors (telemetry/flightrec.py): each slot's
+    bucket reduce stamps a host-callback cursor whose ``{slot}``
+    placeholder resolves to the live microbatch index — the per-slot
+    leg id the hang localizer diffs against the happens-before
+    relation.  None (the default off-TPU) compiles no callbacks.
     """
     import jax
     import jax.numpy as jnp
@@ -528,11 +536,18 @@ def pipelined_accumulate(single_vg: Callable, accum: int, has_aux: bool,
         return jax.tree_util.tree_map(
             lambda a, x: a + w * x.astype(jnp.float32), acc, tree)
 
-    def reduce_packed(packed, qstate, sat):
+    leg_stamps = dict(stamps or {})
+
+    def reduce_packed(packed, qstate, sat, slot=None):
         red = {}
         new_q = dict(qstate)
         new_sat = dict(sat)
         for k, v in packed.items():
+            if slot is not None and k in leg_stamps:
+                from autodist_tpu.telemetry import flightrec
+
+                lid, lkind = leg_stamps[k]
+                flightrec.traced_stamp(lid, slot=slot, leg_kind=lkind)
             if k in quant_fns:
                 red[k], new_q[k], cnt = quant_fns[k](v, qstate.get(k))
                 new_sat[k] = new_sat[k] + cnt
@@ -561,12 +576,15 @@ def pipelined_accumulate(single_vg: Callable, accum: int, has_aux: bool,
             lambda x: x[rows0:].reshape((accum - 1, rows0) + x.shape[1:]),
             batch)
 
-        def body(carry, mb):
+        def body(carry, x):
+            mb, idx = x
             loss_a, g_a, red_a, prev, qs, sat_a = carry
             # the collective for the PREVIOUS microbatch's buckets: no
             # data dependence on this microbatch's backward below, so
-            # the scheduler overlaps them.
-            red, qs, sat_a = reduce_packed(prev, qs, sat_a)
+            # the scheduler overlaps them.  ``idx`` is the PREVIOUS
+            # microbatch's slot — what a flight-recorder stamp records.
+            red, qs, sat_a = reduce_packed(
+                prev, qs, sat_a, slot=idx if leg_stamps else None)
             red_a = {k: red_a[k] + w * red[k].astype(jnp.float32)
                      for k in red_a}
             loss, aux, g, packed = run_vg(mb)
@@ -576,9 +594,11 @@ def pipelined_accumulate(single_vg: Callable, accum: int, has_aux: bool,
 
         (loss_acc, g_acc, red_acc, prev, qstate0, sat_acc), scanned = \
             lax.scan(body, (loss_acc, g_acc, red_acc, packed0, qstate0,
-                            sat_acc), mbs)
+                            sat_acc), (mbs, jnp.arange(accum - 1)))
         # the one exposed reduction
-        red, qstate0, sat_acc = reduce_packed(prev, qstate0, sat_acc)
+        red, qstate0, sat_acc = reduce_packed(
+            prev, qstate0, sat_acc,
+            slot=accum - 1 if leg_stamps else None)
         red_acc = {k: red_acc[k] + w * red[k].astype(jnp.float32)
                    for k in red_acc}
         if has_aux:
@@ -590,7 +610,9 @@ def pipelined_accumulate(single_vg: Callable, accum: int, has_aux: bool,
     else:
         prev, prev_w = packed0, weights[0]
         for k in range(1, accum):
-            red, qstate0, sat_acc = reduce_packed(prev, qstate0, sat_acc)
+            red, qstate0, sat_acc = reduce_packed(
+                prev, qstate0, sat_acc,
+                slot=k - 1 if leg_stamps else None)
             red_acc = {key: red_acc[key] + prev_w * red[key].astype(
                 jnp.float32) for key in red_acc}
             off, rows = slices[k]
@@ -602,7 +624,9 @@ def pipelined_accumulate(single_vg: Callable, accum: int, has_aux: bool,
             prev, prev_w = packed, weights[k]
             if has_aux:
                 auxes.append(aux_k)
-        red, qstate0, sat_acc = reduce_packed(prev, qstate0, sat_acc)
+        red, qstate0, sat_acc = reduce_packed(
+            prev, qstate0, sat_acc,
+            slot=accum - 1 if leg_stamps else None)
         red_acc = {key: red_acc[key] + prev_w * red[key].astype(jnp.float32)
                    for key in red_acc}
         if has_aux:

@@ -18,6 +18,9 @@ any other coordinator env) as ``;``-separated events::
     preempt@step=5,grace=2.5                # ... with a 2.5s grace deadline
     storage_stall@step=4,seconds=3          # checkpoint writes block 3s
     drop_heartbeats@step=3,proc=2           # beacons stop (wedge drill)
+    hang@step=6,proc=1                      # one process blocks in the step
+    hang@step=6,proc=1,leg=g0@-1/reduce     # ... wedged "in" a named leg
+    hang@step=6,proc=1,seconds=5            # ... unblocking after 5s
     corrupt_ckpt@step=4,item=params,path=/ckpt/dir   # truncate a step dir
     nan_grad@step=3,bucket=all_reduce:float32:g0:0   # NaN into a bucket
     inf_grad@step=3,var=l0/w                # Inf into one grad leaf
@@ -33,6 +36,16 @@ a pre-save hook instead of dying at the step boundary: the process
 os._exits INSIDE the next save, leaving the partial step dir the
 verify/latest_step machinery must skip.  Per the "kills leave
 evidence" rule, every injection is journaled BEFORE it executes.
+
+``hang`` (docs/observability.md "Flight recorder") is the
+deterministic LIVE-WEDGE drill: the matched process blocks inside the
+step (the heartbeat daemon keeps beating, so beacon age stays fresh —
+exactly the WEDGED-in-a-collective signature only ``step_timeout``
+can catch), after stamping a flight-recorder cursor for ``leg=<id>``
+(default: a ``"hang"`` phase cursor) so the monitor's verdict and the
+crash bundle localize to the planted leg and process.  ``seconds=``
+bounds the block (default: forever — the supervisor's terminate path
+ends it).
 
 Filters (``step``/``proc``/``attempt``) all default to "any"; an event
 fires at most once per process.  ``proc`` matches the JAX process index
@@ -58,13 +71,14 @@ from __future__ import annotations
 
 import os
 import signal as _signal
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from autodist_tpu.utils import logging
 
 ACTIONS = ("kill", "preempt", "drop_heartbeats", "corrupt_ckpt",
-           "storage_stall", "nan_grad", "inf_grad", "loss_spike")
+           "storage_stall", "hang", "nan_grad", "inf_grad", "loss_spike")
 
 #: events NOT executed by ChaosMonkey.on_step: grad injections compile
 #: into the step (numerics guard), loss_spike rides the health monitor.
@@ -231,6 +245,27 @@ class ChaosMonkey:
 
             saver_mod.set_storage_stall(
                 float(ev.args.get("seconds", 1.0)))
+        elif ev.action == "hang":
+            # The live-wedge drill: stamp where we "are", then block the
+            # step loop while the beacon daemon keeps beating.  The
+            # journal entry above plus the planted cursor are exactly
+            # the evidence the WEDGED verdict + hang localization need.
+            from autodist_tpu.telemetry import flightrec
+
+            leg = ev.args.get("leg")
+            slot = int(ev.args.get("slot", flightrec.END_OF_STEP))
+            flightrec.record_cursor(
+                leg or "hang", kind="leg" if leg else "phase",
+                slot=slot, event="enter", step=int(step))
+            seconds = float(ev.args.get("seconds", 0.0))
+            deadline = None if seconds <= 0 \
+                else time.monotonic() + seconds
+            logging.warning(
+                "CHAOS: hang — blocking in the step%s%s",
+                f" at leg {leg}" if leg else "",
+                f" for {seconds:g}s" if deadline else " (forever)")
+            while deadline is None or time.monotonic() < deadline:
+                time.sleep(0.1)
         elif ev.action == "drop_heartbeats":
             self._heartbeats = False
         elif ev.action == "corrupt_ckpt":

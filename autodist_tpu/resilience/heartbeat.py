@@ -76,15 +76,24 @@ def heartbeat_phase(name: str):
     blocking operation (and beat immediately on entry/exit), so the
     monitor sees *why* step progress stalled instead of verdicting
     WEDGED.  No-op when no writer is registered — callers (the Saver)
-    never need to know whether heartbeats are wired."""
+    never need to know whether heartbeats are wired.  The phase also
+    stamps flight-recorder cursors (telemetry/flightrec.py), so crash
+    bundles show checkpoint/drain windows on the cursor timeline."""
+    from autodist_tpu.telemetry import flightrec
+
+    flightrec.record_cursor(name, kind="phase", event="enter")
     writer = active_writer()
     if writer is None:
-        yield
+        try:
+            yield
+        finally:
+            flightrec.record_cursor(name, kind="phase", event="exit")
         return
     prev = writer.set_phase(name)
     try:
         yield
     finally:
+        flightrec.record_cursor(name, kind="phase", event="exit")
         writer.set_phase(prev)
 
 
@@ -138,6 +147,19 @@ class HeartbeatWriter:
             payload["phase"] = self._phase
         if self._last_snapshot is not None:
             payload["snapshot"] = self._last_snapshot
+        # The latest flight-recorder cursor rides every beacon
+        # (telemetry/flightrec.py): the monitor — and a crash bundle —
+        # sees WHICH leg/phase each worker was in without any new
+        # transport.  The daemon-thread refresh re-reads it, so the
+        # cursor stays current even when the step loop is wedged.
+        try:
+            from autodist_tpu.telemetry import flightrec
+
+            cursor = flightrec.beacon_cursor()
+            if cursor is not None:
+                payload["cursor"] = cursor
+        except Exception:   # cursors are advisory; never kill the beacon
+            pass
         tmp = self._path + ".tmp"
         try:
             with open(tmp, "w", encoding="utf-8") as f:
@@ -234,9 +256,23 @@ class WorkerHealth:
     #: latest StepRecord summary the beacon carried (step, loss,
     #: step_time_ms) — what the worker was DOING at its last beat.
     snapshot: Optional[dict] = None
+    #: latest flight-recorder cursor the beacon carried
+    #: (telemetry/flightrec.py: leg id, slot, schedule fingerprint,
+    #: age) — WHERE in the schedule the worker was at its last beat.
+    cursor: Optional[dict] = None
 
     def doing(self) -> str:
-        """Human summary of the carried snapshot ('' when absent)."""
+        """Human summary of what the worker was doing: the
+        flight-recorder cursor when the beacon carried one ("in
+        ring_reduce_scatter leg rs:f32:0 slot 2 for 41 s" — leg cursor
+        age plus the beacon's own age), falling back to the StepRecord
+        snapshot ('' when neither is present)."""
+        if self.cursor:
+            from autodist_tpu.telemetry import flightrec
+
+            line = flightrec.cursor_line(self.cursor, self.age or 0.0)
+            if line:
+                return line
         if not self.snapshot:
             return ""
         parts = [f"step {self.snapshot['step']}"] \
@@ -339,6 +375,7 @@ class HeartbeatMonitor:
         step = payload.get("step")
         snap = payload.get("snapshot")
         phase = payload.get("phase")
+        cursor = payload.get("cursor")
         if age > self._timeout:
             # A stale beacon is stale regardless of its phase tag: the
             # beacon THREAD died too, so the drain/save story no longer
@@ -347,10 +384,11 @@ class HeartbeatMonitor:
             if alive:
                 return WorkerHealth(worker, WEDGED, age=age, step=step,
                                     pid=pid, snapshot=snap, phase=phase,
+                                    cursor=cursor,
                                     detail="beacon stale but process alive")
             return WorkerHealth(
                 worker, DEAD, age=age, step=step, pid=pid, snapshot=snap,
-                phase=phase,
+                phase=phase, cursor=cursor,
                 detail="beacon stale" + ("" if alive is False
                                          else " (pid unverifiable)"))
         if phase == "draining":
@@ -360,7 +398,7 @@ class HeartbeatMonitor:
             # code instead of terminating the draining worker.
             return WorkerHealth(
                 worker, DRAINING, age=age, step=step, pid=pid,
-                snapshot=snap, phase=phase,
+                snapshot=snap, phase=phase, cursor=cursor,
                 detail="preemption drain in progress (beacons fresh)")
         if self._step_timeout is not None and step is not None:
             prog = self._progress.get(worker)
@@ -373,18 +411,22 @@ class HeartbeatMonitor:
                     # step_timeout verdict does not apply.
                     return WorkerHealth(
                         worker, ALIVE, age=age, step=step, pid=pid,
-                        snapshot=snap, phase=phase,
+                        snapshot=snap, phase=phase, cursor=cursor,
                         detail=f"step {step} paused in {phase} for "
                                f"{now - prog.since:.1f}s (phase-tagged "
                                "— not a wedge)")
-                return WorkerHealth(
+                health = WorkerHealth(
                     worker, WEDGED, age=age, step=step, pid=pid,
-                    snapshot=snap, phase=phase,
+                    snapshot=snap, phase=phase, cursor=cursor,
                     detail=f"step {step} stalled for "
                            f"{now - prog.since:.1f}s (beacons fresh — "
                            "likely wedged in a collective)")
+                doing = health.doing()
+                if doing:   # the flight-recorder cursor names the leg
+                    health.detail += f"; {doing}"
+                return health
         return WorkerHealth(worker, ALIVE, age=age, step=step, pid=pid,
-                            snapshot=snap, phase=phase)
+                            snapshot=snap, phase=phase, cursor=cursor)
 
     def status(self) -> Dict[str, WorkerHealth]:
         now = time.time()
@@ -414,7 +456,7 @@ class HeartbeatMonitor:
                 emit_event("heartbeat/verdict", worker=w, state=h.state,
                            detail=h.detail, step=h.step,
                            beacon_age_s=h.age, phase=h.phase,
-                           snapshot=h.snapshot)
+                           snapshot=h.snapshot, cursor=h.cursor)
         for w in list(self._reported):
             if w not in noted:   # recovered: re-arm the transition report
                 del self._reported[w]

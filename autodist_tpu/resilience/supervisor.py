@@ -242,6 +242,10 @@ class AttemptFailure:
     kind: str                  # "exit" | "heartbeat" | "preempt"
     culprit: Optional[str]     # host/worker the failure is attributed to
     detail: str = ""
+    #: crash-bundle directory the flight recorder wrote for this
+    #: failure (telemetry/flightrec.py), or None when bundling was off
+    #: or failed — rendered by ``--hang-report``.
+    bundle: Optional[str] = None
 
 
 @dataclass
@@ -339,7 +343,7 @@ class Supervisor:
                             index + 1, failure.kind, failure.detail)
             emit_event("supervisor/attempt_failure", attempt=index,
                        failure_kind=failure.kind, culprit=failure.culprit,
-                       detail=failure.detail)
+                       detail=failure.detail, bundle=failure.bundle)
             if failure.culprit:
                 n = self._host_failures.get(failure.culprit, 0) + 1
                 self._host_failures[failure.culprit] = n
@@ -426,9 +430,11 @@ class Supervisor:
                         f"{code} (graceful drain)")
                 elif code != 0:
                     culprit = self._culprit(att) or name
-                    return AttemptFailure(
+                    failure = AttemptFailure(
                         att.index, "exit", culprit,
                         f"{name} exited with code {code}")
+                    self._attach_bundle(failure, monitor)
+                    return failure
             if not running:
                 return None   # every process finished cleanly
             if monitor is not None:
@@ -436,11 +442,54 @@ class Supervisor:
                 if bad:
                     worker, health = next(iter(bad.items()))
                     doing = health.doing()
-                    return AttemptFailure(
+                    failure = AttemptFailure(
                         att.index, "heartbeat", worker,
                         f"{worker} is {health.state} ({health.detail})"
-                        + (f"; {doing}" if doing else ""))
+                        + (f"; {doing}"
+                           if doing and doing not in health.detail
+                           else ""))
+                    self._attach_bundle(failure, monitor)
+                    return failure
             time.sleep(self._policy.poll_interval)
+
+    def _attach_bundle(self, failure: AttemptFailure, monitor) -> None:
+        """Flight-recorder crash bundle for a failed attempt
+        (telemetry/flightrec.py): snapshot journal/StepRecord tails,
+        per-host cursors, the published schedule IR, stacks, and the
+        monitor verdicts under the telemetry run dir (the supervisor
+        workdir when none is set).  When the beacon-carried cursors
+        localize the hang, the diagnosis extends the failure detail and
+        a ``flightrec/hang`` event lands in the journal.  Best-effort —
+        a bundling failure never masks the attempt failure."""
+        try:
+            from autodist_tpu.const import ENV
+            from autodist_tpu.telemetry import flightrec
+
+            run_dir = ENV.AUTODIST_TELEMETRY_DIR.val or self._workdir
+            verdicts = monitor.status() if monitor is not None else None
+            bundle = flightrec.dump_bundle(
+                run_dir, reason=f"{failure.kind}: {failure.detail}",
+                verdicts=verdicts)
+            if bundle is None:
+                return
+            failure.bundle = bundle
+            diag = (flightrec.read_bundle(bundle) or {}).get("diagnosis")
+            if diag and diag.get("detail"):
+                failure.detail += f"; flightrec: {diag['detail']}"
+            # A unique localization verdict refines the culprit: the
+            # heartbeat path otherwise attributes to the FIRST bad
+            # worker, which on a real wedge is whichever victim's stall
+            # the monitor noticed first, not the straggler blocking it.
+            culprits = (diag or {}).get("culprits") or ()
+            if (failure.kind == "heartbeat" and len(culprits) == 1
+                    and not (diag or {}).get("tie")):
+                failure.culprit = culprits[0]
+            logging.warning("supervisor: crash bundle written to %s "
+                            "(render with `python -m autodist_tpu"
+                            ".telemetry --hang-report %s`)", bundle,
+                            bundle)
+        except Exception as e:  # pragma: no cover - defensive
+            logging.warning("supervisor: crash bundle failed (%s)", e)
 
     def _culprit(self, att: Attempt) -> Optional[str]:
         markers = read_failure_markers(att.marker_dir)

@@ -20,6 +20,7 @@ import numpy as np
 from autodist_tpu.graph_item import GraphItem
 from autodist_tpu.kernel import sharding_utils as su
 from autodist_tpu.kernel.graph_transformer import DistributedStep
+from autodist_tpu.telemetry import flightrec
 from autodist_tpu.utils import logging, metrics, tracing
 
 
@@ -52,6 +53,23 @@ class DistributedSession:
         from autodist_tpu.telemetry.timeline import StepRecorder
         self._telemetry = StepRecorder.create(self._run_id,
                                               predictor=self._predict_cost)
+        # Flight recorder (docs/observability.md "Flight recorder"):
+        # stamp the schedule fingerprint onto this process's cursors,
+        # publish the IR into the run dir so the chief can localize
+        # hangs against the exact program, and arm the fatal paths
+        # (faulthandler stacks + crash-bundle-on-uncaught).  Advisory:
+        # any failure here must not block training.
+        try:
+            ir = getattr(dist_step, "schedule_ir", None)
+            if ir is not None and flightrec.enabled():
+                flightrec.set_fingerprint(ir.fingerprint())
+                if self._telemetry is not None \
+                        and self._telemetry.directory:
+                    flightrec.publish_ir(ir, self._telemetry.directory)
+                    flightrec.install_fatal_handlers(
+                        self._telemetry.directory)
+        except Exception:  # pragma: no cover - advisory only
+            pass
         if tracing.dumps_enabled():
             tracing.dump_stage(self._run_id, "1-strategy-plans",
                                tracing.plan_table(dist_step.compiled_strategy))
@@ -165,6 +183,11 @@ class DistributedSession:
         per step."""
         rec = self._telemetry
         t0 = time.perf_counter() if rec is not None else 0.0
+        # Host-phase flight-recorder cursor: "entered step N" — the
+        # coarsest progress beacon, paired with the "exit" stamp
+        # record_step makes.  One object + one ring store when enabled.
+        flightrec.record_cursor("step", kind="phase", event="enter",
+                                step=self._step_count)
         batch = self._step.place_batch(batch)
         if self._step_count == 0 and tracing.dumps_enabled():
             self._dump_programs(batch)

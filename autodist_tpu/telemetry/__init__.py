@@ -19,6 +19,10 @@ latency.  This package is the seeing half, in four tiers:
 * :mod:`~autodist_tpu.telemetry.calibration` — regress the cost
   model's bandwidth/overhead constants from accumulated records;
   shared ``telemetry/model-drift`` rule.
+* :mod:`~autodist_tpu.telemetry.flightrec` — the schedule-aware flight
+  recorder: leg-level progress cursors riding the heartbeat beacons,
+  happens-before hang localization (frontier leg + culprit host), and
+  crash bundles (``dump_bundle`` / ``--hang-report``).
 
 ``python -m autodist_tpu.telemetry <run_dir>`` summarizes a recorded
 run (step-time percentiles, phase breakdown, event timeline,
@@ -67,6 +71,21 @@ from autodist_tpu.telemetry.events import (
     load_run_events,
     read_events,
 )
+from autodist_tpu.telemetry.flightrec import (
+    Cursor,
+    CursorRing,
+    HangDiagnosis,
+    beacon_cursor,
+    cursor_line,
+    dump_bundle,
+    dump_cursors,
+    find_bundles,
+    install_fatal_handlers,
+    latest_cursor,
+    localize_hang,
+    record_cursor,
+    render_hang_report,
+)
 from autodist_tpu.telemetry.registry import (
     DEFAULT_REGISTRY,
     MetricsRegistry,
@@ -99,9 +118,12 @@ from autodist_tpu.telemetry.trace_export import (
 
 __all__ = [
     "CalibratedConstants",
+    "Cursor",
+    "CursorRing",
     "DRIFT_THRESHOLD",
     "DEFAULT_REGISTRY",
     "EventJournal",
+    "HangDiagnosis",
     "LEG_DRIFT_THRESHOLD",
     "LegCalibration",
     "LegProfiler",
@@ -113,14 +135,19 @@ __all__ = [
     "StepRecorder",
     "aggregate_run",
     "attempt_goodput",
+    "beacon_cursor",
     "checkpoint_cadence",
     "chrome_trace_events",
     "configure_events",
     "configure_spans",
     "counter",
+    "cursor_line",
+    "dump_bundle",
+    "dump_cursors",
     "drifted_leg_kinds",
     "emit_event",
     "export_trace",
+    "find_bundles",
     "fit_constants",
     "fit_leg_constants",
     "gauge",
@@ -128,6 +155,8 @@ __all__ = [
     "goodput_from_run",
     "histogram",
     "host_span",
+    "install_fatal_handlers",
+    "latest_cursor",
     "leg_drift_reason",
     "load_calibration",
     "load_default_calibration",
@@ -135,14 +164,17 @@ __all__ = [
     "load_run_events",
     "load_spans",
     "load_step_records",
+    "localize_hang",
     "merge_registry_snapshots",
     "model_drift_reason",
     "per_host_step_stats",
     "predicted_vs_measured",
     "prediction_error",
     "read_events",
+    "record_cursor",
     "record_span",
     "recovery_gap_reason",
+    "render_hang_report",
     "render_prometheus",
     "save_calibration",
     "straggler_reason",

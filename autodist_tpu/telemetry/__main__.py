@@ -24,7 +24,11 @@ next to its BENCH_*.json artifacts:
   JSON with per-host tracks (``trace_export.py``),
 * ``--compare <run_b>`` — the two-run regression report: step-time
   percentile deltas, per-phase and per-leg-kind regressions, drift
-  verdicts.
+  verdicts,
+* ``--hang-report <bundle>`` — render a flight-recorder crash bundle
+  (``telemetry/flightrec.py``): per-host cursor table, frontier leg,
+  culprit verdict, stack excerpts.  The default report gains a hang
+  section whenever ``bundle-*/`` directories exist under the run dir.
 
 Deliberately jax-free (numpy + stdlib): runs on any host that can read
 the files.  Exits 0 on success, 2 when the directory holds no telemetry.
@@ -36,11 +40,13 @@ Examples::
     python -m autodist_tpu.telemetry ./run --events 50
     python -m autodist_tpu.telemetry ./run --export-trace
     python -m autodist_tpu.telemetry ./run_a --compare ./run_b
+    python -m autodist_tpu.telemetry --hang-report ./run/bundle-<ts>
 """
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 from typing import List, Optional
@@ -267,8 +273,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         prog="python -m autodist_tpu.telemetry",
         description="Summarize a telemetry run directory "
                     "(StepRecord JSONL + event journal).")
-    p.add_argument("run_dir", help="directory holding steps-*.jsonl / "
-                                   "events-*.jsonl (searched recursively)")
+    p.add_argument("run_dir", nargs="?", default=None,
+                   help="directory holding steps-*.jsonl / "
+                        "events-*.jsonl (searched recursively)")
+    p.add_argument("--hang-report", metavar="BUNDLE", default=None,
+                   help="render a flight-recorder crash bundle "
+                        "(bundle-<ts>/ directory — or a run dir, whose "
+                        "newest bundle is used)")
     p.add_argument("--events", type=int, default=20, metavar="N",
                    help="show at most N timeline events (default 20)")
     p.add_argument("--fit", action="store_true",
@@ -290,6 +301,24 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="emit one machine-readable JSON object instead "
                         "of the human report")
     args = p.parse_args(argv)
+
+    from autodist_tpu.telemetry import flightrec
+
+    if args.hang_report:
+        target = args.hang_report
+        if not os.path.isfile(os.path.join(target, "MANIFEST.json")):
+            bundles = flightrec.find_bundles(target)
+            if not bundles:
+                print(f"no flight-recorder bundle under {target} "
+                      "(expected a bundle-<ts>/ directory)",
+                      file=sys.stderr)
+                return 2
+            target = bundles[-1]
+        print(flightrec.render_hang_report(target))
+        return 0
+
+    if args.run_dir is None:
+        p.error("run_dir is required (or pass --hang-report <bundle>)")
 
     if args.compare:
         cmp = compare_runs(args.run_dir, args.compare)
@@ -352,6 +381,20 @@ def main(argv: Optional[List[str]] = None) -> int:
             if gap:
                 gp["recovery_gap"] = gap
         summary["goodput"] = gp
+
+    # Hang section (docs/observability.md "Flight recorder"): whenever
+    # a crash bundle exists under the run dir, surface the newest one's
+    # diagnosis — frontier leg, culprit verdict, bundle path.
+    bundles = flightrec.find_bundles(args.run_dir)
+    if bundles:
+        newest = flightrec.read_bundle(bundles[-1])
+        hang: dict = {"bundle": bundles[-1], "bundle_count": len(bundles)}
+        man = newest.get("manifest") or {}
+        if man.get("reason"):
+            hang["reason"] = man["reason"]
+        if newest.get("diagnosis"):
+            hang["diagnosis"] = newest["diagnosis"]
+        summary["hang"] = hang
 
     # Cross-host section whenever records carry more than one host.
     from autodist_tpu.telemetry.aggregate import per_host_step_stats
@@ -476,6 +519,21 @@ def main(argv: Optional[List[str]] = None) -> int:
         if gp.get("recovery_gap"):
             print("  WARN resilience/recovery-gap: "
                   f"{gp['recovery_gap']}")
+    hang = summary.get("hang")
+    if hang:
+        print(f"  hang: {hang['bundle_count']} crash bundle(s); newest "
+              f"{hang['bundle']}")
+        if hang.get("reason"):
+            print(f"    reason: {hang['reason']}")
+        diag = hang.get("diagnosis")
+        if diag:
+            verdict = "TIE — no unique culprit" if diag.get("tie") else \
+                f"culprit {', '.join(diag.get('culprits', []))}"
+            print(f"    frontier leg {diag.get('frontier_leg')}  "
+                  f"({verdict})")
+            print(f"    {diag.get('detail', '')}")
+        print("    render: python -m autodist_tpu.telemetry "
+              f"--hang-report {hang['bundle']}")
     cal = summary.get("calibration")
     if cal:
         print(f"  calibrated: bandwidth {cal['ici_bandwidth']:.3e} B/s, "

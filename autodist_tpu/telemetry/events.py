@@ -171,12 +171,17 @@ def read_events(path: str) -> List[dict]:
     return out
 
 
-def load_run_events(run_dir: str) -> List[dict]:
+def load_run_events(run_dir: str,
+                    tail: Optional[int] = None) -> List[dict]:
     """The chief-side merge: every ``events-*.jsonl`` under ``run_dir``
-    (recursive), time-sorted into one timeline."""
+    (recursive), time-sorted into one timeline.  ``tail`` keeps only
+    the newest N events after the merge — what a crash bundle snapshots
+    (``telemetry/flightrec.py``)."""
     merged: List[dict] = []
     for path in glob.glob(os.path.join(run_dir, "**", "events-*.jsonl"),
                           recursive=True):
         merged.extend(read_events(path))
     merged.sort(key=lambda r: r.get("time", 0.0))
+    if tail is not None:
+        merged = merged[-max(int(tail), 0):]
     return merged

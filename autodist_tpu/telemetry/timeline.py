@@ -41,6 +41,7 @@ from collections import deque
 from dataclasses import asdict, dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
+from autodist_tpu.telemetry import flightrec
 from autodist_tpu.telemetry.registry import telemetry_enabled
 
 #: JSONL rotation threshold: records per ``steps-*.jsonl`` segment.
@@ -201,6 +202,12 @@ class StepRecorder:
             host=self._host)
         self._pending_phases = {}
         self._ring.append(rec)
+        # Host-phase flight-recorder cursor (flightrec.py): the step
+        # boundary is the coarsest progress beacon — the one every path
+        # (GSPMD included) gets for free.  The session stamps the
+        # matching "enter" before dispatch.
+        flightrec.record_cursor("step", kind="phase", event="exit",
+                                step=int(step))
         self._m_steps.inc()
         if dt is not None:
             self._m_step_time.observe(dt)

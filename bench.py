@@ -198,6 +198,7 @@ def run_child(platform: str) -> None:
     # the same thing — on both the TPU path and the CPU fallback.
     _fill_grad_sync(result)
     _fill_quant(result)
+    _fill_flightrec(result)
     _fill_profiler(result)
     _fill_search(result)
     _fill_kernels(result)
@@ -1412,6 +1413,38 @@ def _fill_quant(result) -> None:
               file=sys.stderr, flush=True)
 
 
+def _fill_flightrec(result) -> None:
+    """Flight-recorder overhead (docs/observability.md "Flight
+    recorder", BENCH_flightrec.json): recorder off vs the default
+    host-phase granularity (interleaved minima, <1% bar) plus the
+    honest legs-mode (host-callback) datapoint.  Runs in its own
+    8-virtual-device child like grad_sync; the payload lands under
+    ``grad_sync.flightrec`` AND is committed standalone as
+    BENCH_flightrec.json."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8").strip()
+    cmd = [sys.executable, "-u", os.path.abspath(__file__),
+           "--flightrec-child"]
+    try:
+        proc = subprocess.run(cmd, stdout=subprocess.PIPE, env=env,
+                              timeout=600)
+        payload = _extract_json(proc.stdout.decode())
+        if payload is None:
+            raise RuntimeError(f"no JSON from flightrec child "
+                               f"(rc={proc.returncode})")
+        result.setdefault("grad_sync", {})["flightrec"] = \
+            payload.get("flightrec")
+        with open(os.path.join(REPO, "BENCH_flightrec.json"), "w",
+                  encoding="utf-8") as f:
+            json.dump(payload, f, indent=2, sort_keys=True)
+            f.write("\n")
+    except Exception as e:  # pragma: no cover - best-effort enrichment
+        print(f"bench: flightrec section unavailable ({e!r})",
+              file=sys.stderr, flush=True)
+
+
 def _fill_profiler(result) -> None:
     """Schedule-aware profiler (docs/observability.md,
     BENCH_profiler.json): per-leg-kind measured vs leg-priced predicted
@@ -2248,6 +2281,127 @@ def run_quant_child() -> None:
     out["auto_search"] = {
         "choice": searcher.last_choice, "sync": sync.sync,
         "compressor": sync.compressor, "overlap": sync.overlap,
+    }
+    print(json.dumps(out), flush=True)
+
+
+def run_flightrec_child() -> None:
+    """Flight-recorder overhead (child process, 8 virtual CPU devices;
+    docs/observability.md "Flight recorder", BENCH_flightrec.json).
+
+    The ZeRO-1 grad_sync program with ``AUTODIST_FLIGHTREC=0`` vs the
+    recorder ON at its default (host-phase) granularity — interleaved
+    minima over 4x50-step trials, the BENCH_telemetry.json protocol,
+    against the <1% step-time bar — plus an HONEST ``legs`` datapoint:
+    leg-granularity host callbacks are the ``AUTODIST_FLIGHTREC=legs``
+    opt-in, automatic only on TPU backends where the callback rides
+    async dispatch; on CPU each callback serializes the step, which is
+    exactly why ``auto`` resolves to host granularity off-TPU (the
+    measured legs-mode overhead documents that decision)."""
+    _steer("cpu")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    os.environ["AUTODIST_IS_TESTING"] = "True"
+    from autodist_tpu.autodist import AutoDist, \
+        _reset_default_autodist_for_testing
+    from autodist_tpu.strategy import Zero1
+    from autodist_tpu.telemetry import flightrec
+
+    d = jax.device_count()
+    bucket_bytes = 256 << 10
+    rng = np.random.RandomState(0)
+    layers = 6
+    params = {f"l{i}": {"w": jnp.asarray(rng.randn(256, 256) * 0.05,
+                                         jnp.float32),
+                        "b": jnp.zeros(256, jnp.float32)}
+              for i in range(layers)}
+    batch = {"x": rng.randn(64, 256).astype(np.float32),
+             "y": rng.randn(64, 256).astype(np.float32)}
+
+    def loss_fn(p, b):
+        h = b["x"]
+        for i in range(layers):
+            h = jnp.tanh(h @ p[f"l{i}"]["w"] + p[f"l{i}"]["b"])
+        return jnp.mean((h - b["y"]) ** 2)
+
+    def measure(mode, steps=50):
+        """One session under AUTODIST_FLIGHTREC=<mode>; returns
+        (per-step seconds, cursors stamped per step, leg ids seen)."""
+        os.environ["AUTODIST_FLIGHTREC"] = mode
+        flightrec.reset_for_testing()
+        _reset_default_autodist_for_testing()
+        ad = AutoDist(strategy_builder=Zero1(bucket_bytes=bucket_bytes))
+        with ad.scope():
+            ad.capture(params=params, optimizer=optax.adam(1e-3),
+                       loss_fn=loss_fn)
+        sess = ad.create_distributed_session()
+        placed = sess.place_batch(batch)
+        seq0 = flightrec.ring().seq
+        dt = _measure_session(sess, placed, 3, steps)
+        stamped = flightrec.ring().seq - seq0
+        legs = sorted({c.leg for c in flightrec.ring().cursors()
+                       if c.kind == "leg"})
+        del sess, ad
+        _reset_default_autodist_for_testing()
+        return dt / steps, stamped / steps, legs
+
+    prev = os.environ.get("AUTODIST_FLIGHTREC")
+    ts = {"0": [], "host": []}
+    cursors_per_step = 0.0
+    for trial in range(4):
+        order = ("0", "host") if trial % 2 == 0 else ("host", "0")
+        for mode in order:
+            t, per_step, _ = measure(mode)
+            ts[mode].append(t)
+            if mode == "host":
+                cursors_per_step = per_step
+    t_off, t_on = min(ts["0"]), min(ts["host"])
+    # The legs-mode datapoint (2 interleaved-with-nothing trials is
+    # enough: the delta here is large and one-sided by design on CPU).
+    legs_ts, legs_cursors, leg_ids = [], 0.0, []
+    for _ in range(2):
+        t, per_step, legs = measure("legs")
+        legs_ts.append(t)
+        legs_cursors, leg_ids = per_step, legs
+    if prev is None:
+        os.environ.pop("AUTODIST_FLIGHTREC", None)
+    else:
+        os.environ["AUTODIST_FLIGHTREC"] = prev
+    t_legs = min(legs_ts)
+    out = {
+        "section": "grad_sync.flightrec",
+        "note": (
+            "flight-recorder overhead on the ZeRO-1 grad_sync bench "
+            "program: AUTODIST_FLIGHTREC=0 vs the default host-phase "
+            "recorder (cursor ring + beacon piggyback), interleaved "
+            "minima over 4x50-step trials on 8 virtual CPU devices — "
+            "the BENCH_telemetry.json protocol, <1% target.  "
+            "legs-mode rows measure the AUTODIST_FLIGHTREC=legs "
+            "opt-in (per-leg-group jax.debug.callback stamps): on CPU "
+            "each callback serializes the step, which is why 'auto' "
+            "resolves legs-granularity ON only for TPU backends, "
+            "where callbacks ride async dispatch."),
+        "date": time.strftime("%Y-%m-%d"),
+        "dp": d,
+        "bucket_bytes": bucket_bytes,
+        "flightrec": {
+            "mode": "reduce_scatter",
+            "step_time_ms_recorder_off": round(t_off * 1e3, 3),
+            "step_time_ms_recorder_on": round(t_on * 1e3, 3),
+            "overhead_fraction": round((t_on - t_off) / t_off, 4),
+            "target_overhead_fraction": 0.01,
+            "cursors_per_step": round(cursors_per_step, 2),
+            "legs_mode": {
+                "step_time_ms": round(t_legs * 1e3, 3),
+                "overhead_fraction": round((t_legs - t_off) / t_off, 4),
+                "cursors_per_step": round(legs_cursors, 2),
+                "leg_ids_stamped": leg_ids,
+                "default_on_tpu_only": True,
+            },
+        },
     }
     print(json.dumps(out), flush=True)
 
@@ -3153,6 +3307,8 @@ if __name__ == "__main__":
         run_child(sys.argv[sys.argv.index("--child") + 1])
     elif "--grad-sync-child" in sys.argv:
         run_grad_sync_child()
+    elif "--flightrec-child" in sys.argv:
+        run_flightrec_child()
     elif "--quant-child" in sys.argv:
         run_quant_child()
     elif "--search-child" in sys.argv:
