@@ -46,6 +46,11 @@ verifier's own ids (docs/schedule-ir.md):
   recorded ``schedule_fingerprint``, the mesh did NOT change, and this
   program's IR hashes differently: the sync config itself drifted from
   what the checkpoint executed.
+* ``moe/capacity-overflow`` (WARN) — the IR's MoE routing facts
+  predict token drops: ``capacity_factor`` keeps fewer expert slots
+  than balanced top-2 demand (the shared pure rule
+  ``schedule_ir.moe_capacity_drop_fraction``, also warned by the
+  runtime ``moe_ffn`` fallback path).
 
 Cross-stage sequence violations (``schedule/collective-mismatch``) are
 deliberately NOT emitted here — the ``collectives`` pass consumes the
@@ -92,8 +97,16 @@ def _build_ir(ctx: AnalysisContext, axes) -> Optional[object]:
     accum = int(getattr(ctx.graph_item, "accum_steps", 1) or 1)
     active, drops = _resolve_fused(ctx, facts, guard)
     ctx.fused_drops = drops
+    # MoE expert a2as: the same expert-flagged catalog projection the
+    # runtime lowerings use (schedule_ir.moe_facts_from_vars), so the
+    # analysis IR carries the dispatch/combine legs — and the capacity
+    # transient — the runtime will execute.
+    moe = sir.moe_facts_from_vars(
+        ctx.graph_item.info.variables, axes=dict(axes),
+        capacity_factor=getattr(ctx, "moe_capacity_factor", None),
+        tokens_per_group=getattr(ctx, "moe_tokens_per_group", None))
     return sir.ir_from_facts(facts, axes=dict(axes), accum_steps=accum,
-                             guard=guard, fused_kernels=active)
+                             guard=guard, fused_kernels=active, moe=moe)
 
 
 def _resolve_fused(ctx: AnalysisContext, facts, guard: bool):
@@ -163,6 +176,9 @@ _FIXES = {
     "schedule/buffer-leak":
         "consume the buffer (update/guard/gather) or drop the leg "
         "producing it",
+    "moe/capacity-overflow":
+        "raise capacity_factor to >= 2.0 (top-2 routing), shrink the "
+        "expert count, or accept the predicted token drops knowingly",
 }
 
 

@@ -392,10 +392,11 @@ class GraphTransformer:
             if vi is None or name in frozen_names:
                 continue
             facts.append(sir.fact_from_varplan(plan, vi))
+        mesh_axes = {str(k): int(v) for k, v in dict(mesh.shape).items()}
         sched = sir.ir_from_facts(
-            facts, axes={str(k): int(v)
-                         for k, v in dict(mesh.shape).items()},
-            accum_steps=gi.accum_steps, guard=num_active)
+            facts, axes=mesh_axes,
+            accum_steps=gi.accum_steps, guard=num_active,
+            moe=sir.moe_facts_from_vars(gi.info.variables, axes=mesh_axes))
         sir.assert_verified(sched, "gspmd build")
 
         def step(params, opt_state, sync_state, batch):

@@ -485,15 +485,21 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
             compressor=plan.compressor or "NoneCompressor",
             sig=schedule_ir.fact_from_varplan(plan, vi).sig(),
             stateful=name in sync_builders))
+    ir_axes = {str(a): int(mesh.shape[a]) for a in mesh_axis_names}
     ir = schedule_ir.build_schedule_ir(
-        axes={str(a): int(mesh.shape[a]) for a in mesh_axis_names},
+        axes=ir_axes,
         accum_steps=gi.accum_steps, buckets=buckets, plan=ov,
         per_var=per_var_entries, guard=num_active,
         donated=tuple(f"sync:{k}" for k in sync_builders) if donate_sync
         else (),
         stateful_keys={k for k, (kind, _) in sync_builders.items()
                        if kind == "bucket"},
-        fused_kernels=active_fused)
+        fused_kernels=active_fused,
+        # MoE expert a2as (docs/schedule-ir.md): derived from the SAME
+        # expert-flagged catalog the analyzer sees, so both sides carry
+        # identical dispatch/combine legs and fingerprints.
+        moe=schedule_ir.moe_facts_from_vars(gi.info.variables,
+                                            axes=ir_axes))
     schedule_ir.assert_verified(ir, "explicit sync build")
     logging.info(
         "explicit sync path: schedule IR %s (%d bucket(s), %d leg(s), "

@@ -110,7 +110,7 @@ from typing import (
 
 import numpy as np
 
-from autodist_tpu.const import MESH_AXIS_DATA
+from autodist_tpu.const import MESH_AXIS_DATA, MESH_AXIS_EXPERT
 from autodist_tpu.kernel.synchronization import overlap as overlap_mod
 from autodist_tpu.kernel.synchronization import quant_ring
 from autodist_tpu.kernel.synchronization.bucketing import (
@@ -139,13 +139,20 @@ LEG_UPDATE = "update"
 LEG_FUSED_HOP = "fused_hop"
 LEG_FUSED_DETECT = "fused_detect"
 LEG_FUSED_UPDATE = "fused_update"
+#: MoE expert all-to-all (docs/schedule-ir.md): the dispatch/combine
+#: pair of capacity-based expert routing (``parallel/moe.py``).  Both
+#: roles share one kind (one wire shape, one calibration constant);
+#: the leg ``sig`` distinguishes dispatch from combine so the cross-
+#: stage sequence check catches a swapped pair.
+LEG_ALL_TO_ALL = "all_to_all"
 LEG_KINDS = (LEG_REDUCE_SCATTER, LEG_ALL_GATHER, LEG_ALL_REDUCE,
              LEG_PPERMUTE_HOP, LEG_PSUM_GUARD, LEG_PS_EXCHANGE, LEG_UPDATE,
-             LEG_FUSED_HOP, LEG_FUSED_DETECT, LEG_FUSED_UPDATE)
+             LEG_FUSED_HOP, LEG_FUSED_DETECT, LEG_FUSED_UPDATE,
+             LEG_ALL_TO_ALL)
 #: kinds that issue wire traffic (every rank must agree on these).
 COLLECTIVE_KINDS = (LEG_REDUCE_SCATTER, LEG_ALL_GATHER, LEG_ALL_REDUCE,
                     LEG_PPERMUTE_HOP, LEG_PSUM_GUARD, LEG_PS_EXCHANGE,
-                    LEG_FUSED_HOP)
+                    LEG_FUSED_HOP, LEG_ALL_TO_ALL)
 #: ppermute ring-hop kinds — one chain grammar, fused or not.
 RING_HOP_KINDS = (LEG_PPERMUTE_HOP, LEG_FUSED_HOP)
 #: leg kind each fused kernel name lowers to (the consistency contract
@@ -269,6 +276,10 @@ class ScheduleIR:
     #: — already drop-filtered by the builder's caller, so the record is
     #: what actually runs, not what was requested.
     fused_kernels: Tuple[str, ...] = ()
+    #: MoE expert-routing facts behind the a2a legs (empty for non-MoE
+    #: programs) — carried so the verifier's capacity rule and the
+    #: watermark see the routing config, not just the lowered legs.
+    moe: Tuple["MoEFact", ...] = ()
     version: int = IR_VERSION
 
     # -- decision surface (what the lowerings consume) --------------------
@@ -308,6 +319,9 @@ class ScheduleIR:
             # calibration.json all key on it).
             **({"fused_kernels": list(self.fused_kernels)}
                if self.fused_kernels else {}),
+            # Same omit-when-empty contract: every non-MoE program's
+            # fingerprint is untouched by the MoE extension.
+            **({"moe": [asdict(m) for m in self.moe]} if self.moe else {}),
         }
 
     @classmethod
@@ -330,6 +344,10 @@ class ScheduleIR:
             gather_order=[tuple(kv) for kv in d.get("gather_order", ())],
             donated=tuple(d.get("donated", ())),
             fused_kernels=tuple(d.get("fused_kernels", ())),
+            moe=tuple(MoEFact(**{
+                k: v for k, v in md.items()
+                if k in MoEFact.__dataclass_fields__})
+                for md in d.get("moe", ())),
             version=int(d.get("version", IR_VERSION)))
 
     def to_json(self, indent: Optional[int] = None) -> str:
@@ -460,6 +478,185 @@ def fact_from_varplan(plan: Any, var_info: Any) -> PlanFact:
         padded=getattr(plan, "pad_axis", None) is not None)
 
 
+# -- MoE expert-routing facts (mesh-free, shared by runtime + analysis) ------
+
+#: Static per-group token-count default when no batch shape is known at
+#: build time (the IR is built before the first batch arrives, like the
+#: activation estimate in ``analysis/memory.py``).  Override with the
+#: ``tokens_per_group=`` argument or ``AUTODIST_MOE_TOKENS`` so the a2a
+#: wire bytes reflect the real batch — the runtime and the analyzer
+#: read the same knob, so their fingerprints stay identical.
+DEFAULT_MOE_TOKENS_PER_GROUP = 1024
+
+MOE_ROLE_DISPATCH = "dispatch"
+MOE_ROLE_COMBINE = "combine"
+
+
+def moe_capacity_drop_fraction(capacity_factor: float, seq: int,
+                               num_experts: int) -> float:
+    """Predicted fraction of top-2 expert assignments dropped under
+    BALANCED routing — the shared pure rule behind the
+    ``moe/capacity-overflow`` WARN (analysis) and the runtime fallback
+    warning (``parallel/moe.py``).  Every token wants 2 expert slots,
+    so balanced per-expert demand is ``2*seq/num_experts`` slots per
+    group against a capacity of ``max(1, int(capacity_factor * seq /
+    num_experts))`` (the exact ``moe_ffn`` formula, floor included);
+    skewed routing only drops more.  Group count cancels in the
+    balanced case — the surfaced message scales it back to tokens."""
+    e = max(int(num_experts), 1)
+    s = max(int(seq), 1)
+    cap = max(1, int(float(capacity_factor) * s / e))
+    demand = 2.0 * s / e
+    if demand <= 0:
+        return 0.0
+    return max(0.0, 1.0 - cap / demand)
+
+
+@dataclass(frozen=True)
+class MoEFact:
+    """One MoE layer's mesh-free expert-routing facts.
+
+    Feeds the a2a leg pair (dispatch + combine) the builder emits: per
+    group of ``seq`` tokens, top-2 routing with ``capacity_factor``
+    fills a ``[num_experts, groups, capacity, d_model]`` buffer that is
+    all-to-all'd over ``axis`` to the expert shards, transformed, and
+    all-to-all'd back — the capacity-sized transient between the two
+    a2as is the dominant MoE activation cost the watermark tracks via
+    the ``expert:<key>`` buffer."""
+
+    key: str                      # e.g. "layers_0/moe" — buffer namespace
+    groups: int                   # G: token groups per microbatch
+    seq: int                      # S: tokens per group
+    d_model: int                  # M: model width dispatched per token
+    num_experts: int              # E
+    capacity_factor: float = 2.0
+    dtype: str = "float32"
+    axis: str = MESH_AXIS_EXPERT
+    stage: str = ""               # "" = all-rank; "stage0"/"expert0" groups
+    compressor: str = "NoneCompressor"   # Int8Compressor = quantized wire
+
+    def capacity(self) -> int:
+        """Slots per expert per group — the EXACT ``moe_ffn`` formula."""
+        return max(1, int(float(self.capacity_factor) * int(self.seq)
+                          / max(int(self.num_experts), 1)))
+
+    def drop_fraction(self) -> float:
+        return moe_capacity_drop_fraction(
+            self.capacity_factor, self.seq, self.num_experts)
+
+    def payload_elems(self, axis_size: int) -> int:
+        """Per-device elements of one a2a payload: the full
+        ``[E, G, C, M]`` capacity buffer sharded over the expert axis."""
+        total = (int(self.num_experts) * int(self.groups) * self.capacity()
+                 * int(self.d_model))
+        return max(1, total // max(int(axis_size), 1))
+
+    def leg_nbytes(self, axis_size: int) -> int:
+        """Honest per-device wire bytes of one a2a leg: f32 payload, or
+        — quantized wire — 1-byte/elem payload plus the per-chunk scale
+        grid (``quant_ring.wire_nbytes``)."""
+        elems = self.payload_elems(axis_size)
+        fmt = quant_ring.wire_format_of(self.compressor or "")
+        if fmt is not None:
+            return quant_ring.wire_nbytes(elems, fmt)
+        return elems * np.dtype(self.dtype).itemsize
+
+    def sig(self, role: str) -> str:
+        """Cross-stage comparison signature — the role is IN the
+        signature so a swapped dispatch/combine pair compares unequal
+        (the classic interleaving wedge)."""
+        return "|".join(str(x) for x in (
+            "moe", role, self.compressor or "NoneCompressor",
+            int(self.num_experts)))
+
+
+def moe_tokens_per_group_default() -> int:
+    """The static token-count hint: ``AUTODIST_MOE_TOKENS`` when set,
+    else :data:`DEFAULT_MOE_TOKENS_PER_GROUP`.  Read by every MoE fact
+    producer (explicit lowering, GSPMD transform, analysis passes) so
+    one env knob keeps all fingerprints in agreement."""
+    import os
+    raw = os.environ.get("AUTODIST_MOE_TOKENS", "")
+    try:
+        val = int(raw)
+        return val if val > 0 else DEFAULT_MOE_TOKENS_PER_GROUP
+    except ValueError:
+        return DEFAULT_MOE_TOKENS_PER_GROUP
+
+
+def moe_capacity_factor_default() -> float:
+    """The capacity-factor hint shared by every MoE fact producer:
+    ``AUTODIST_MOE_CAPACITY_FACTOR`` when set, else the ``moe_ffn``
+    default of 2.0 (zero balanced drops under top-2 routing)."""
+    import os
+    raw = os.environ.get("AUTODIST_MOE_CAPACITY_FACTOR", "")
+    try:
+        val = float(raw)
+        return val if val > 0 else 2.0
+    except ValueError:
+        return 2.0
+
+
+def moe_wire_compressor_default() -> str:
+    """The ``moe`` wire knob: ``AUTODIST_MOE_WIRE=int8`` puts the
+    dispatch/combine payloads on the quantized wire
+    (``quant_ring.quantize_blocks`` per-chunk scale grid — the leg
+    bytes then carry payload + scales); anything else is the f32
+    wire."""
+    import os
+    wire = os.environ.get("AUTODIST_MOE_WIRE", "").strip().lower()
+    return "Int8Compressor" if wire == "int8" else "NoneCompressor"
+
+
+def moe_facts_from_vars(variables: Iterable[Any], *,
+                        axes: Optional[Dict[str, int]] = None,
+                        tokens_per_group: Optional[int] = None,
+                        capacity_factor: Optional[float] = None,
+                        compressor: Optional[str] = None,
+                        ) -> List[MoEFact]:
+    """Derive :class:`MoEFact`s from an expert-flagged variable catalog
+    — THE shared projection of ``expert_vars`` (runtime capture and
+    analyzer see the same ``VarInfo`` rows, so both sides build
+    identical facts and the IR instances agree).
+
+    ``variables`` yields objects with ``.name``/``.shape``/``.expert``
+    (and optionally ``.pipeline``).  Expert variables group by parent
+    path (``layers_0/moe/wi`` -> key ``layers_0/moe``); the first
+    expert variable of a group is wi-shaped ``[experts, d_model, d_ff]``
+    (one leading stage dim first when pipeline-stacked), which fixes
+    ``num_experts`` and ``d_model``.  Token counts are static hints:
+    ``groups`` defaults to the data-axis size (one token group per data
+    shard — the ``moe_ffn`` grouping), ``seq`` to
+    :func:`moe_tokens_per_group_default`."""
+    axes = dict(axes or {})
+    groups = max(int(axes.get(MESH_AXIS_DATA, 1)), 1)
+    seq = int(tokens_per_group or moe_tokens_per_group_default())
+    if capacity_factor is None:
+        capacity_factor = moe_capacity_factor_default()
+    if compressor is None:
+        compressor = moe_wire_compressor_default()
+    by_key: Dict[str, Any] = {}
+    for v in variables:
+        if not getattr(v, "expert", False):
+            continue
+        name = str(v.name)
+        key = name.rsplit("/", 1)[0] if "/" in name else name
+        if key in by_key:
+            continue                      # first var (wi) fixes the shapes
+        shape = tuple(int(x) for x in (v.shape or ()))
+        if getattr(v, "pipeline", False):
+            shape = shape[1:]             # drop the stage stacking dim
+        if len(shape) < 2:
+            continue
+        by_key[key] = MoEFact(
+            key=key, groups=groups, seq=seq, d_model=int(shape[1]),
+            num_experts=int(shape[0]),
+            capacity_factor=float(capacity_factor),
+            dtype="float32", axis=MESH_AXIS_EXPERT, stage=stage_of(key),
+            compressor=compressor or "NoneCompressor")
+    return [by_key[k] for k in sorted(by_key)]
+
+
 # -- builder -----------------------------------------------------------------
 
 @dataclass(frozen=True)
@@ -555,7 +752,8 @@ def build_schedule_ir(*, axes: Dict[str, int], accum_steps: int = 1,
                       donated: Sequence[str] = (),
                       stateful_keys: Iterable[str] = (),
                       per_var_alg: str = ALG_FUSED,
-                      fused_kernels: Sequence[str] = ()) -> ScheduleIR:
+                      fused_kernels: Sequence[str] = (),
+                      moe: Sequence[MoEFact] = ()) -> ScheduleIR:
     """Build the schedule program for one step.
 
     Pure: consumes exactly the planner's outputs (``buckets`` from
@@ -583,6 +781,39 @@ def build_schedule_ir(*, axes: Dict[str, int], accum_steps: int = 1,
     reduce_final: Dict[str, str] = {}
     detect_bytes: Dict[str, int] = {}   # f32 bytes the guard pass touches
     bucket_nodes: List[dict] = []
+
+    # MoE expert all-to-alls first: dispatch/combine happen inside the
+    # forward/backward compute, before any gradient reduction issues.
+    # Per layer and microbatch slot one PAIR: dispatch reads the routed
+    # activations (``act:<key>``) into the capacity buffer
+    # (``expert:<key>``), combine reads it back — the expert buffer's
+    # [dispatch, combine] interval is exactly the capacity-sized
+    # transient the liveness watermark charges.  With expert-axis size
+    # <= 1 the partition is trivial and GSPMD inserts no collective, so
+    # no legs exist to disagree on.
+    moe = sorted(moe, key=lambda m: m.key)
+    for mf in moe:
+        e_ax = int(axes.get(mf.axis, 1))
+        if e_ax <= 1:
+            continue
+        nb = mf.leg_nbytes(e_ax)
+        comp = mf.compressor or "NoneCompressor"
+        slots = list(range(accum)) if accum > 1 else [END_OF_STEP]
+        for slot in slots:
+            tag = mf.key if slot == END_OF_STEP else f"{mf.key}@{slot}"
+            disp = em.emit(
+                id=f"moe/{tag}/dispatch", kind=LEG_ALL_TO_ALL,
+                bucket=mf.key, dtype=mf.dtype, nbytes=nb, axis=mf.axis,
+                slot=slot, compressor=comp, alg=ALG_ONE_SHOT,
+                stage=mf.stage, sig=mf.sig(MOE_ROLE_DISPATCH),
+                reads=(f"act:{mf.key}",), writes=(f"expert:{mf.key}",))
+            em.emit(
+                id=f"moe/{tag}/combine", kind=LEG_ALL_TO_ALL,
+                bucket=mf.key, dtype=mf.dtype, nbytes=nb, axis=mf.axis,
+                slot=slot, compressor=comp, alg=ALG_ONE_SHOT,
+                stage=mf.stage, sig=mf.sig(MOE_ROLE_COMBINE),
+                deps=(disp.id,),
+                reads=(f"expert:{mf.key}",), writes=(f"act:{mf.key}",))
 
     # Per-variable fallback tier first — the explicit path's tier-3 loop
     # (and the whole GSPMD lowering) issues these before bucket chains.
@@ -796,12 +1027,13 @@ def build_schedule_ir(*, axes: Dict[str, int], accum_steps: int = 1,
         axes=axes, accum_steps=accum, overlap_mode=plan.mode, guard=guard,
         prefetch=bool(plan.prefetch), buckets=bucket_nodes, legs=em.legs,
         gather_order=gather_order, donated=tuple(donated),
-        fused_kernels=fused)
+        fused_kernels=fused, moe=tuple(moe))
 
 
 def facts_fingerprint(facts: Sequence[PlanFact], *, axes: Dict[str, int],
                       accum_steps: int = 1, guard: bool = False,
-                      fused_kernels: Sequence[str] = ()) -> str:
+                      fused_kernels: Sequence[str] = (),
+                      moe: Sequence[MoEFact] = ()) -> str:
     """Short stable hash of a candidate's full :func:`ir_from_facts`
     input — the strategy search's dedupe key.  Two candidates with
     identical fact sets build byte-identical IRs (the builder is pure),
@@ -813,13 +1045,18 @@ def facts_fingerprint(facts: Sequence[PlanFact], *, axes: Dict[str, int],
         "guard": bool(guard),
         "fused_kernels": list(fused_kernels),
         "facts": [asdict(f) for f in facts],
+        # Omit-when-empty: non-MoE candidates keep their dedupe keys.
+        **({"moe": [asdict(m)
+                    for m in sorted(moe, key=lambda m: m.key)]}
+           if moe else {}),
     }, sort_keys=True, separators=(",", ":")).encode()
     return hashlib.sha256(blob).hexdigest()[:12]
 
 
 def ir_from_facts(facts: Sequence[PlanFact], *, axes: Dict[str, int],
                   accum_steps: int = 1, guard: bool = False,
-                  fused_kernels: Sequence[str] = ()) -> ScheduleIR:
+                  fused_kernels: Sequence[str] = (),
+                  moe: Sequence[MoEFact] = ()) -> ScheduleIR:
     """Mesh-free IR construction from per-variable plan facts — the
     analyzer's and the GSPMD transform's entry point.  Routing mirrors
     the runtime exactly: when any plan implies the explicit path
@@ -871,7 +1108,7 @@ def ir_from_facts(facts: Sequence[PlanFact], *, axes: Dict[str, int],
         per_var=per_var, guard=guard, donated=donated,
         stateful_keys=stateful_buckets,
         per_var_alg=ALG_FUSED if explicit else ALG_PSUM_TREE,
-        fused_kernels=fused_kernels)
+        fused_kernels=fused_kernels, moe=moe)
 
 
 # -- the static schedule verifier --------------------------------------------
@@ -891,6 +1128,7 @@ RULE_FUSED_INCONSISTENT = "schedule/fused-inconsistent"
 RULE_RACE_WRITE = "schedule/race-unordered-write"
 RULE_RACE_READ_WRITE = "schedule/race-read-write"
 RULE_BUFFER_LEAK = "schedule/buffer-leak"
+RULE_CAPACITY_OVERFLOW = "moe/capacity-overflow"
 
 
 @dataclass(frozen=True)
@@ -1019,6 +1257,13 @@ def verify(ir: ScheduleIR) -> List[Violation]:
     for l in legs:
         if l.kind not in COLLECTIVE_KINDS or not is_quantizing(l.compressor):
             continue
+        if l.kind == LEG_ALL_TO_ALL:
+            # The MoE a2a wire quantizes statelessly — a fresh scale
+            # grid per dispatch/combine payload, no error-feedback
+            # state — so the one-quantized-reduce-per-slot contract
+            # does not bind the pair (two quantized a2as per slot are
+            # exactly the legal shape).
+            continue
         capable = quant_ring.is_quant_ring_compressor(l.compressor)
         if l.kind in RING_HOP_KINDS:
             if not capable:
@@ -1085,6 +1330,24 @@ def verify(ir: ScheduleIR) -> List[Violation]:
                 "tree order on GSPMD: low-precision rounding makes the "
                 "two lowerings diverge beyond reordering tolerance",
                 location=node["key"]))
+
+    # -- MoE capacity overflow: predicted token drops (pure rule) ---------
+    # The same ``moe_capacity_drop_fraction`` the runtime fallback path
+    # warns with, evaluated over the IR's carried routing facts — so a
+    # lossy capacity config surfaces pre-trace with exact numbers.
+    for mf in ir.moe:
+        frac = mf.drop_fraction()
+        if frac > 0.0:
+            dropped = int(round(frac * 2 * mf.groups * mf.seq))
+            out.append(Violation(
+                RULE_CAPACITY_OVERFLOW, SEV_WARN,
+                f"MoE layer {mf.key!r}: capacity_factor "
+                f"{mf.capacity_factor:g} keeps {mf.capacity()} slot(s) "
+                f"per expert per group ({mf.num_experts} experts, "
+                f"{mf.groups} group(s) x {mf.seq} tokens) — top-2 "
+                f"routing drops ~{frac:.0%} of expert assignments "
+                f"(~{dropped} per step) even under balanced load; "
+                "skewed routing drops more", location=mf.key))
 
     # -- fused-kernel consistency: legs vs the IR's fused record ----------
     # A fused-kind leg in a program whose ``fused_kernels`` record does

@@ -24,6 +24,33 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from autodist_tpu.const import MESH_AXIS_DATA, MESH_AXIS_EXPERT
+from autodist_tpu.utils import logging
+
+#: capacity configs already warned about (one line per distinct config,
+#: not one per trace).
+_warned_capacity: set = set()
+
+
+def moe_wire_format(wire: Optional[str] = None):
+    """Resolve the expert-a2a wire format: the explicit ``wire`` arg
+    ("int8" / a compressor name) wins, else the shared
+    ``AUTODIST_MOE_WIRE`` knob — the SAME default the schedule IR's
+    :func:`~autodist_tpu.kernel.synchronization.schedule_ir.
+    moe_wire_compressor_default` reads, so the legs' priced wire bytes
+    and the runtime payload cannot disagree.  Returns a
+    ``quant_ring.WireFormat`` or None (full-precision wire)."""
+    from autodist_tpu.kernel.synchronization import quant_ring, schedule_ir
+
+    name = wire if wire is not None \
+        else schedule_ir.moe_wire_compressor_default()
+    if not name or name == "NoneCompressor":
+        return None
+    if name == "int8":
+        name = "Int8Compressor"
+    fmt = quant_ring.wire_format_of(name)
+    if fmt is None:
+        raise ValueError(f"moe wire {name!r} has no quantized wire format")
+    return fmt
 
 
 def init_moe_params(rng, d_model: int, d_ff: int, num_experts: int,
@@ -95,7 +122,8 @@ def _top2_dispatch(probs: jax.Array, capacity: int
 def moe_ffn(params: dict, x: jax.Array, *,
             capacity_factor: float = 2.0,
             mesh: Optional[Mesh] = None,
-            activation=jax.nn.gelu) -> Tuple[jax.Array, jax.Array]:
+            activation=jax.nn.gelu,
+            wire: Optional[str] = None) -> Tuple[jax.Array, jax.Array]:
     """Top-2 routed expert FFN.
 
     Args:
@@ -105,12 +133,35 @@ def moe_ffn(params: dict, x: jax.Array, *,
       mesh: optional — adds sharding constraints so expert-major
         intermediates shard over ``expert`` (and groups over ``data``),
         making GSPMD lower the dispatch/combine einsums to all-to-alls.
+      wire: expert-a2a wire format ("int8"); None reads the shared
+        ``AUTODIST_MOE_WIRE`` knob.  A quantized wire crosses the a2a
+        boundary as int8 payload + per-block f32 scales on the
+        ``quant_ring`` scale grid and dequantizes on arrival — grid-
+        exact inputs round-trip bit-exactly.
 
     Returns ``(y [batch, seq, d_model], aux_loss scalar)``.
     """
     g, s, m = x.shape
     e = params["router"].shape[-1]
     capacity = max(1, int(capacity_factor * s / e))
+
+    # The runtime half of the moe/capacity-overflow lint: the SAME pure
+    # rule the schedule verifier applies to the IR's MoE facts.
+    from autodist_tpu.kernel.synchronization.schedule_ir import (
+        RULE_CAPACITY_OVERFLOW,
+        moe_capacity_drop_fraction,
+    )
+    drop = moe_capacity_drop_fraction(capacity_factor, s, e)
+    cfg = (float(capacity_factor), int(s), int(e))
+    if drop > 0 and cfg not in _warned_capacity:
+        _warned_capacity.add(cfg)
+        logging.warning(
+            "%s: capacity_factor=%g keeps %d slots/expert for balanced "
+            "top-2 demand of %.0f over %d experts — ~%.0f%% of routed "
+            "tokens will be dropped to the residual path",
+            RULE_CAPACITY_OVERFLOW, capacity_factor, capacity,
+            2.0 * s / e, e, drop * 100.0)
+    fmt = moe_wire_format(wire)
 
     logits = jnp.einsum("gsm,me->gse", x.astype(jnp.float32),
                         params["router"])
@@ -138,12 +189,26 @@ def moe_ffn(params: dict, x: jax.Array, *,
             ep_sharding = NamedSharding(mesh, P(
                 MESH_AXIS_EXPERT, MESH_AXIS_DATA if data_ok else None))
 
-    expert_in = jnp.einsum("gsec,gsm->egcm", dispatch, x)   # [E,G,C,M]
-    if ep_sharding is not None:
-        expert_in = jax.lax.with_sharding_constraint(expert_in, ep_sharding)
+    def a2a(t: jax.Array) -> jax.Array:
+        """Cross the expert a2a boundary: quantize-at-the-wire when a
+        wire format is active (the sharding constraint lands on the
+        int8 payload, so GSPMD's all-to-all ships 1/4 the bytes plus
+        the per-block scale grid), plain constraint otherwise."""
+        if ep_sharding is None:
+            return t
+        if fmt is None:
+            return jax.lax.with_sharding_constraint(t, ep_sharding)
+        from autodist_tpu.kernel.synchronization import quant_ring
+
+        q, scales, _ = quant_ring.quantize_blocks(
+            t.astype(jnp.float32).reshape(-1), fmt)
+        q = jax.lax.with_sharding_constraint(
+            q.reshape(t.shape), ep_sharding)
+        deq = quant_ring.dequantize_blocks(q.reshape(-1), scales)
+        return deq.reshape(t.shape).astype(t.dtype)
+
+    expert_in = a2a(jnp.einsum("gsec,gsm->egcm", dispatch, x))  # [E,G,C,M]
     h = activation(jnp.einsum("egcm,emf->egcf", expert_in, params["wi"]))
-    expert_out = jnp.einsum("egcf,efm->egcm", h, params["wo"])
-    if ep_sharding is not None:
-        expert_out = jax.lax.with_sharding_constraint(expert_out, ep_sharding)
+    expert_out = a2a(jnp.einsum("egcf,efm->egcm", h, params["wo"]))
     y = jnp.einsum("gsec,egcm->gsm", combine, expert_out)
     return y, aux

@@ -383,6 +383,11 @@ def _leg_wire_bytes(leg, d: int) -> float:
         return allreduce_bytes(float(leg.nbytes), d)
     if leg.kind in (sir.LEG_REDUCE_SCATTER, sir.LEG_ALL_GATHER):
         return reduce_scatter_bytes(float(leg.nbytes), d)
+    if leg.kind == sir.LEG_ALL_TO_ALL:
+        # Each device keeps its own 1/d slice and ships the other
+        # (d-1)/d of its per-device payload (the leg's nbytes are
+        # already per-device capacity-buffer bytes).
+        return float(leg.nbytes) * (d - 1) / max(d, 1)
     return float(leg.nbytes)
 
 
@@ -434,6 +439,15 @@ def leg_cost_s(leg, ir, constants=None, *,
         # (module docstring), so a calibration run that never measured
         # a PS plan must not let PS candidates win the strategy search
         # on optimistic default pricing.
+        kind = sir.LEG_ALL_REDUCE
+    if constants is not None and kind not in constants.bandwidths \
+            and kind == sir.LEG_ALL_TO_ALL \
+            and sir.LEG_ALL_REDUCE in constants.bandwidths:
+        # Unfitted expert a2as borrow the all-reduce constants (the
+        # ps_exchange rule above): both lower to one fused XLA
+        # collective over the same ICI links, so a calibration run that
+        # never measured an MoE plan must not let expert-parallel
+        # candidates win (or lose) the search on default pricing.
         kind = sir.LEG_ALL_REDUCE
     if constants is not None and kind in constants.bandwidths:
         t = wire / constants.bandwidths[kind]

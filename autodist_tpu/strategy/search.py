@@ -8,7 +8,9 @@ The space is per-variable
 
     partition axis x sync mode (AR / RS+ZeRO-1 / PS) x overlap
     (none/pipeline/ring/full) x compressor (none/int8/fp8/PowerSGD)
-    x bucket_bytes
+    x bucket_bytes x expert placement (expert-flagged variables only:
+    expert-parallel over the ``expert`` mesh axis — 1/E grads plus the
+    dispatch/combine all_to_all pair — vs dense replication)
 
 encoded as one :class:`VarGene` per trainable variable; a search state
 is the gene map, i.e. a :class:`~autodist_tpu.kernel.synchronization.
@@ -85,10 +87,16 @@ class VarGene:
     compressor: str = "NoneCompressor"
     overlap: str = "auto"
     bucket_bytes: int = 0
+    #: expert-parallel execution for an expert-flagged variable: shard
+    #: the expert stack over the ``expert`` mesh axis (grads shrink to
+    #: 1/E, the schedule gains the dispatch/combine all_to_all pair) vs
+    #: dense replication (full-size grads, no a2a).  Ignored — and kept
+    #: False — for variables without the catalog ``expert`` flag.
+    expert: bool = False
 
     def key(self) -> Tuple:
         return (self.sync, self.partition, self.compressor, self.overlap,
-                self.bucket_bytes)
+                self.bucket_bytes, self.expert)
 
 
 @dataclass
@@ -113,6 +121,11 @@ class SearchSpace:
     max_var_moves: int = 8
     sparse_rows_hint: int = 4096
     compute_time_s: float = 0.0
+    #: MoE routing overrides for expert-parallel candidates; None reads
+    #: the shared env defaults (``AUTODIST_MOE_CAPACITY_FACTOR`` /
+    #: ``AUTODIST_MOE_TOKENS``) exactly like the runtime lowering.
+    moe_capacity_factor: Optional[float] = None
+    moe_tokens_per_group: Optional[int] = None
 
 
 @dataclass
@@ -145,7 +158,8 @@ class CandidateEval:
             d["genes"] = {name: {"sync": g.sync, "partition": g.partition,
                                  "compressor": g.compressor,
                                  "overlap": g.overlap,
-                                 "bucket_bytes": g.bucket_bytes}
+                                 "bucket_bytes": g.bucket_bytes,
+                                 "expert": g.expert}
                           for name, g in self.genes}
         return d
 
@@ -214,6 +228,12 @@ def genes_from_strategy(strategy: Strategy,
                 bucket_bytes=int(getattr(sync, "bucket_bytes", 0) or 0))
         else:
             gene = VarGene()
+        if getattr(var, "expert", False):
+            # Seeds mirror the runtime lowering, which shards every
+            # expert-flagged stack over the expert axis and emits the
+            # dispatch/combine a2a pair; the dense alternative enters
+            # the beam through the all:expert=off move.
+            gene = replace(gene, expert=True)
         out.append((var.name, gene))
     return tuple(out)
 
@@ -272,7 +292,9 @@ def evaluate_candidate(name: str,
                        constants=None, *,
                        sparse_rows_hint: int = 4096,
                        compute_time_s: float = 0.0,
-                       seen_facts: Optional[set] = None
+                       seen_facts: Optional[set] = None,
+                       moe_capacity_factor: Optional[float] = None,
+                       moe_tokens_per_group: Optional[int] = None
                        ) -> Tuple[Optional[CandidateEval],
                                   Optional[Strategy]]:
     """Run one candidate through the prune/lower/verify/price pipeline.
@@ -293,14 +315,48 @@ def evaluate_candidate(name: str,
     if prune is not None:
         return CandidateEval(name=name, pruned_by=prune, genes=genes), None
     accum = int(getattr(graph_item, "accum_steps", 1) or 1)
+    # Expert-parallel lens: a gene with expert=True keeps its variable
+    # on the runtime's expert-sharded lowering — the schedule gains the
+    # dispatch/combine a2a pair (and its capacity transient, which the
+    # watermark gate below sees) while the grad collective shrinks to
+    # the 1/E local expert shard in the pricing shadow.  expert=False
+    # densifies: full-size grads, no a2a legs.
+    from autodist_tpu.const import MESH_AXIS_EXPERT
+    expert_on = {n for n, g in genes if g.expert}
+    e_ax = int(axes.get(MESH_AXIS_EXPERT, 1))
+    moe: tuple = ()
+    if expert_on:
+        moe = tuple(sir.moe_facts_from_vars(
+            [v for v in graph_item.info.variables
+             if not getattr(v, "expert", False) or v.name in expert_on],
+            axes=dict(axes), capacity_factor=moe_capacity_factor,
+            tokens_per_group=moe_tokens_per_group))
+    if expert_on and e_ax > 1:
+        from dataclasses import replace as _dreplace
+        evars = {v.name: v for v in graph_item.info.variables
+                 if getattr(v, "expert", False)}
+        shrunk, changed = [], False
+        for f in priced_facts:
+            v = evars.get(f.name)
+            if v is not None and f.name in expert_on and f.shape:
+                dim = 1 if getattr(v, "pipeline", False) else 0
+                if dim < len(f.shape) and int(f.shape[dim]) > 1:
+                    sh = list(f.shape)
+                    sh[dim] = max(1, int(sh[dim]) // e_ax)
+                    f = _dreplace(f, shape=tuple(sh))
+                    changed = True
+            shrunk.append(f)
+        if changed:
+            priced_facts = shrunk
     fact_fp = sir.facts_fingerprint(facts, axes=dict(axes),
-                                    accum_steps=accum, guard=guard)
+                                    accum_steps=accum, guard=guard,
+                                    moe=moe)
     if seen_facts is not None:
         if fact_fp in seen_facts:
             return None, None
         seen_facts.add(fact_fp)
     ir = sir.ir_from_facts(facts, axes=dict(axes), accum_steps=accum,
-                           guard=guard)
+                           guard=guard, moe=moe)
     errs = sir.errors(sir.verify(ir))
     if errs:
         v = errs[0]
@@ -328,7 +384,8 @@ def evaluate_candidate(name: str,
     # Pricing shadow: sparse PS facts shrink to touched rows (the
     # Parallax rule) so the leg-priced estimate sees the honest wire.
     priced_ir = ir if priced_facts is facts else sir.ir_from_facts(
-        priced_facts, axes=dict(axes), accum_steps=accum, guard=guard)
+        priced_facts, axes=dict(axes), accum_steps=accum, guard=guard,
+        moe=moe)
     report = estimate_ir_cost(priced_ir, constants=constants,
                               compute_time_s=compute_time_s)
     return CandidateEval(
@@ -398,6 +455,14 @@ def _moves(genes: Tuple[Tuple[str, VarGene], ...],
         with_all(f"all:bucket_bytes={bb}",
                  lambda n, g, b=bb: replace(g, bucket_bytes=b)
                  if g.sync != SYNC_PS else g)
+    # Expert-parallel toggle: only expert-flagged variables move (an
+    # expert bit on a dense variable is meaningless and would only
+    # bloat the dedupe space).
+    if any(getattr(infos[n], "expert", False) for n, _ in genes):
+        for flag in (True, False):
+            with_all(f"all:expert={'on' if flag else 'off'}",
+                     lambda n, g, f=flag: replace(g, expert=f)
+                     if getattr(infos[n], "expert", False) else g)
 
     # Per-variable flips on the largest variables.
     big = sorted((n for n, _ in genes),
@@ -477,7 +542,9 @@ def beam_search(graph_item: GraphItem, resource_spec: ResourceSpec, *,
         ev, strategy = evaluate_candidate(
             name, genes, graph_item, resource_spec, axes, constants,
             sparse_rows_hint=space.sparse_rows_hint,
-            compute_time_s=space.compute_time_s, seen_facts=seen_facts)
+            compute_time_s=space.compute_time_s, seen_facts=seen_facts,
+            moe_capacity_factor=space.moe_capacity_factor,
+            moe_tokens_per_group=space.moe_tokens_per_group)
         if ev is None:                   # identical plan, different route
             return None
         if ev.pruned_by is not None:

@@ -171,6 +171,9 @@ _SPAN_KIND_RULES: Tuple[Tuple[str, str], ...] = (
     ("zero1_shard_update", "update"),
     ("tree_update", "update"),
     ("quant_all_to_all_reduce_scatter", "reduce_scatter"),
+    ("moe_dispatch", "all_to_all"),
+    ("moe_combine", "all_to_all"),
+    ("expert_all_to_all", "all_to_all"),
     ("bucket_quant_reduce/", "all_reduce"),
     ("bucket_compressed_reduce/", "all_reduce"),
     ("bucket_reduce/", "all_reduce"),
@@ -281,7 +284,7 @@ class LegProfiler:
         mesh = self._mesh
         collective = kind in ("reduce_scatter", "all_gather", "all_reduce",
                               "ppermute_hop", "fused_hop", "psum_guard",
-                              "ps_exchange")
+                              "ps_exchange", "all_to_all")
         if collective and mesh is not None and axis \
                 and int(dict(mesh.shape).get(axis, 1)) > 1:
             from jax.sharding import PartitionSpec as P
@@ -299,6 +302,14 @@ class LegProfiler:
                 body = lambda x: jax.lax.all_gather(  # noqa: E731
                     x, axis, tiled=True)
                 out_spec = P()
+            elif kind == "all_to_all":
+                # MoE dispatch/combine: every device re-slices its
+                # per-device capacity buffer across the expert axis —
+                # the honest wire shape of the expert a2a pair.
+                body = lambda x: jax.lax.all_to_all(  # noqa: E731
+                    x.reshape(d, -1), axis, split_axis=0, concat_axis=0,
+                    tiled=False).reshape(-1)
+                out_spec = P(axis)
             elif kind in ("ppermute_hop", "fused_hop"):
                 # A fused hop is still one ppermute on the wire; its
                 # compute boundary rides the kernel, so the micro-run's

@@ -201,6 +201,7 @@ def run_child(platform: str) -> None:
     _fill_flightrec(result)
     _fill_profiler(result)
     _fill_search(result)
+    _fill_moe(result)
     _fill_kernels(result)
     mark("grad_sync")
     # Serving scale-out (paged KV + continuous batching): its own CPU
@@ -1505,6 +1506,38 @@ def _fill_search(result) -> None:
             f.write("\n")
     except Exception as e:  # pragma: no cover - best-effort enrichment
         print(f"bench: search section unavailable ({e!r})",
+              file=sys.stderr, flush=True)
+
+
+def _fill_moe(result) -> None:
+    """Expert-parallel MoE (docs/strategies.md "The expert axis",
+    BENCH_moe.json): the MoE decoder LM measured dense (experts
+    replicated, pure data parallel) vs expert-parallel (dispatch/combine
+    a2a pairs over the ``expert`` axis) vs expert-parallel with the int8
+    a2a wire — step time, honest a2a wire bytes from the schedule IR,
+    per-leg predicted-vs-measured a2a cost from the leg profiler, and
+    the liveness watermark peak (capacity transients included).  The IR
+    verifier gates every mode.  Runs in its own 8-virtual-device child;
+    committed standalone as BENCH_moe.json."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8").strip()
+    cmd = [sys.executable, "-u", os.path.abspath(__file__), "--moe-child"]
+    try:
+        proc = subprocess.run(cmd, stdout=subprocess.PIPE, env=env,
+                              timeout=900)
+        payload = _extract_json(proc.stdout.decode())
+        if payload is None:
+            raise RuntimeError(f"no JSON from moe child "
+                               f"(rc={proc.returncode})")
+        result.setdefault("grad_sync", {})["moe"] = payload
+        with open(os.path.join(REPO, "BENCH_moe.json"), "w",
+                  encoding="utf-8") as f:
+            json.dump(payload, f, indent=2, sort_keys=True)
+            f.write("\n")
+    except Exception as e:  # pragma: no cover - best-effort enrichment
+        print(f"bench: moe section unavailable ({e!r})",
               file=sys.stderr, flush=True)
 
 
@@ -3120,6 +3153,128 @@ def run_search_child() -> None:
     print(json.dumps(out), flush=True)
 
 
+def run_moe_child() -> None:
+    """Expert-parallel MoE measurement (child process, 8 virtual CPU
+    devices — docs/strategies.md "The expert axis").
+
+    One MoE decoder LM, three modes through the full AutoDist path:
+    ``dense`` (mesh data=8, experts replicated — the moe/* vars sync
+    like any other weight, zero a2a legs), ``expert`` (mesh data=2 x
+    expert=4 — the graph transformer lowers dispatch/combine
+    ``all_to_all`` pairs per MoE stack into the schedule IR), and
+    ``expert_int8`` (the ``AUTODIST_MOE_WIRE=int8`` knob: the runtime
+    a2a wire quantizes through ``quant_ring`` and the IR prices
+    payload+scale bytes honestly).  Per mode: the verifier gates the
+    IR (``assert_verified`` — a mutation in the lowering fails the
+    bench, not just a counter), step time over the same batch, the
+    IR's a2a wire bytes, and the liveness watermark peak with the
+    capacity transients in flight.  The expert mode additionally
+    leg-profiles its a2a pairs and reports predicted-vs-measured a2a
+    cost from a fit on this host's samples (the constants the beam
+    search prices expert-parallel candidates with).  Asserted
+    in-child: int8 halves-or-better the a2a wire vs f32, and the
+    expert watermark exceeds the dense one (the capacity buffers are
+    real, not free)."""
+    _steer("cpu")
+    import jax
+    import optax
+
+    os.environ["AUTODIST_IS_TESTING"] = "True"
+    from autodist_tpu.analysis import dataflow
+    from autodist_tpu.autodist import AutoDist, \
+        _reset_default_autodist_for_testing
+    from autodist_tpu.kernel.synchronization import schedule_ir as sir
+    from autodist_tpu.mesh import build_mesh
+    from autodist_tpu.models.moe_lm import moe_transformer_lm
+    from autodist_tpu.strategy import Parallax
+    from autodist_tpu.strategy.cost_model import leg_cost_s
+    from autodist_tpu.telemetry.calibration import fit_leg_constants
+    from autodist_tpu.telemetry.profiler import LegProfiler
+
+    steps = 20
+    out = {"devices": jax.device_count(), "modes": {}}
+
+    def run_mode(name, axes, wire=None):
+        if wire is None:
+            os.environ.pop("AUTODIST_MOE_WIRE", None)
+        else:
+            os.environ["AUTODIST_MOE_WIRE"] = wire
+        _reset_default_autodist_for_testing()
+        mesh = build_mesh(axes)
+        spec = moe_transformer_lm(
+            mesh, vocab_size=256, num_layers=2, num_heads=4, head_dim=16,
+            d_ff=128, num_experts=4, max_len=64, seq_len=64)
+        params = spec.init(jax.random.PRNGKey(0))
+        ad = AutoDist(strategy_builder=Parallax(), mesh_axes=axes)
+        with ad.scope():
+            ad.capture(params=params, optimizer=optax.adam(1e-3),
+                       loss_fn=spec.loss_fn, sparse_vars=spec.sparse_vars,
+                       expert_vars=spec.expert_vars)
+        sess = ad.create_distributed_session(mesh=mesh)
+        ir = sess.schedule_ir
+        sir.assert_verified(ir, f"bench moe [{name}]")
+        a2a = [l for l in ir.legs if l.kind == sir.LEG_ALL_TO_ALL]
+        wm = dataflow.watermark(ir)
+        if wm is None:
+            raise RuntimeError(f"moe bench [{name}]: unexecutable IR")
+        batch = spec.sample_batch(8)
+        dt = _measure_session(sess, batch, 3, steps)
+        row = {
+            "mesh": dict(axes),
+            "schedule_fingerprint": ir.fingerprint(),
+            "step_time_ms": round(dt / steps * 1e3, 3),
+            "n_a2a_legs": len(a2a),
+            "a2a_wire_bytes": int(sum(l.nbytes for l in a2a)),
+            "watermark_peak_mib": round(wm.peak_bytes / (1 << 20), 3),
+            "watermark_peak_leg": wm.peak_leg,
+        }
+        out["modes"][name] = row
+        return sess, ir, a2a
+
+    sess, _, _ = run_mode("dense", {"data": 8})
+    del sess
+    sess, ir_e, a2a_e = run_mode("expert", {"data": 2, "expert": 4})
+
+    # Predicted-vs-measured a2a cost: leg-profile the expert schedule,
+    # fit this host's per-kind constants, and price the a2a pair with
+    # them — the same numbers the beam search sees.
+    samples = LegProfiler(mesh=sess.mesh).profile_ir(ir_e)
+    cal = fit_leg_constants(samples)
+    if cal is None:
+        raise RuntimeError("moe bench: leg calibration fit nothing")
+    a2a_samples = [s for s in samples if s.kind == sir.LEG_ALL_TO_ALL]
+    measured_ms = sum(s.measured_s for s in a2a_samples) \
+        / max(1, len(a2a_samples)) * 1e3
+    predicted_ms = sum(leg_cost_s(l, ir_e, constants=cal)
+                       for l in a2a_e) / max(1, len(a2a_e)) * 1e3
+    out["a2a_cost"] = {
+        "fitted_kinds": sorted(cal.bandwidths),
+        "n_a2a_samples": len(a2a_samples),
+        "measured_ms_per_leg": round(measured_ms, 4),
+        "predicted_ms_per_leg": round(predicted_ms, 4),
+    }
+    del sess
+    sess, _, _ = run_mode("expert_int8", {"data": 2, "expert": 4},
+                          wire="int8")
+    del sess
+    os.environ.pop("AUTODIST_MOE_WIRE", None)
+    _reset_default_autodist_for_testing()
+
+    modes = out["modes"]
+    assert modes["dense"]["n_a2a_legs"] == 0
+    assert modes["expert"]["n_a2a_legs"] > 0
+    f32_wire = modes["expert"]["a2a_wire_bytes"]
+    int8_wire = modes["expert_int8"]["a2a_wire_bytes"]
+    assert 0 < int8_wire <= f32_wire // 2, (
+        f"int8 a2a wire {int8_wire} not <= half of f32 {f32_wire}")
+    assert modes["expert"]["watermark_peak_mib"] \
+        > modes["dense"]["watermark_peak_mib"], (
+        "expert watermark does not see the capacity transients")
+    out["int8_wire_saving_pct"] = round(
+        (1.0 - int8_wire / f32_wire) * 100.0, 1)
+    print(json.dumps(out), flush=True)
+
+
 def run_probe() -> None:
     """Cheap TPU liveness check: real matmul, real sync."""
     import jax
@@ -3313,6 +3468,8 @@ if __name__ == "__main__":
         run_quant_child()
     elif "--search-child" in sys.argv:
         run_search_child()
+    elif "--moe-child" in sys.argv:
+        run_moe_child()
     elif "--profiler-child" in sys.argv:
         run_profiler_child()
     elif "--kernels-child" in sys.argv:

@@ -56,16 +56,20 @@ def _entries(n=6, shape=(256, 256), dtype="float32", comp="NoneCompressor",
 
 
 def _ir(entries, *, bucket_bytes=256 << 10, d=8, accum=1, mode="auto",
-        guard=False, donated=(), stateful_keys=(), fused_kernels=()):
+        guard=False, donated=(), stateful_keys=(), fused_kernels=(),
+        moe=(), expert_ax=1):
     buckets = bucketing.assign_buckets(entries, bucket_bytes=bucket_bytes,
                                        shard_divisor=d)
     plan = overlap.resolve_overlap(
         [mode], accum_steps=accum, buckets=buckets, d=d,
         has_rs=any(b.mode == "reduce_scatter" for b in buckets))
+    axes = {"data": d}
+    if expert_ax > 1:
+        axes["expert"] = expert_ax
     return sir.build_schedule_ir(
-        axes={"data": d}, accum_steps=accum, buckets=buckets, plan=plan,
+        axes=axes, accum_steps=accum, buckets=buckets, plan=plan,
         guard=guard, donated=donated, stateful_keys=stateful_keys,
-        fused_kernels=fused_kernels)
+        fused_kernels=fused_kernels, moe=moe)
 
 
 def _with_legs(ir, legs):
@@ -225,10 +229,12 @@ _FUZZ_COMPRESSORS = ("NoneCompressor", "HorovodCompressorEF",
 
 
 def test_fuzz_dep_edge_deletion_matches_oracle():
-    """Randomly delete dep edges from planner-emitted IRs: the race
-    detector must report EXACTLY the conflicting pairs whose ordering
-    the deletion broke (brute-force oracle) — every mutation the
-    runtime lowering would miscompile is caught, and nothing else."""
+    """Randomly delete dep edges from planner-emitted IRs — the expert
+    axis included (MoE dispatch/combine a2a pairs, multi-layer and
+    multi-slot): the race detector must report EXACTLY the conflicting
+    pairs whose ordering the deletion broke (brute-force oracle) —
+    every mutation the runtime lowering would miscompile is caught, and
+    nothing else."""
     rng = np.random.RandomState(20260805)
     caught = 0
     for trial in range(60):
@@ -238,12 +244,22 @@ def test_fuzz_dep_edge_deletion_matches_oracle():
                 (f"v{i}", (int(rng.choice([64, 256])), 64), "float32",
                  str(rng.choice(_FUZZ_COMPRESSORS)), 0,
                  str(rng.choice(["all_reduce", "reduce_scatter"]))))
+        expert_ax = int(rng.choice([1, 2, 4]))
+        moe = tuple(
+            sir.MoEFact(key=f"layers_{j}/moe", groups=2,
+                        seq=int(rng.choice([256, 1024])), d_model=64,
+                        num_experts=int(rng.choice([4, 8])),
+                        capacity_factor=2.0,
+                        compressor=str(rng.choice(
+                            ["NoneCompressor", "Int8Compressor"])))
+            for j in range(int(rng.randint(0, 3))))
         ir = _ir(entries,
                  bucket_bytes=int(rng.choice([16 << 10, 256 << 10])),
                  d=int(rng.choice([2, 4, 8])),
                  accum=int(rng.choice([1, 3])),
                  mode=str(rng.choice(list(overlap.OVERLAP_MODES))),
-                 guard=bool(rng.randint(0, 2)))
+                 guard=bool(rng.randint(0, 2)),
+                 moe=moe, expert_ax=expert_ax)
         legs = list(ir.legs)
         assert _detector_races(ir) == []        # clean before mutation
         for _ in range(int(rng.randint(1, 4))):

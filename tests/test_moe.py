@@ -199,3 +199,105 @@ def test_pipelined_moe_lm_1f1b_matches_gpipe(num_virtual):
     l_ref = run(spec_ref, False)
     np.testing.assert_allclose(l_1f1b, l_ref, rtol=3e-4)
     assert l_1f1b[-1] < l_1f1b[0]
+
+
+# -- the quantized expert wire ------------------------------------------------
+
+@pytest.mark.moe
+@pytest.mark.quant
+def test_int8_wire_exact_dequant_parity():
+    """Grid-exact inputs must cross the int8 a2a wire bit-exactly.
+
+    Construction: d_model == the quant block size (256), identity
+    expert FFNs, identity activation, and integer token vectors in
+    [-127, 127] whose first feature pins every block's amax to 127 —
+    so the per-block scale is exactly 1.0 and int8 quantization is the
+    identity on the payload.  The quantized run must then equal the
+    full-precision run bit for bit."""
+    rng = np.random.default_rng(7)
+    g, s, m, e = 2, 8, 256, 4
+    eye = jnp.broadcast_to(jnp.eye(m, dtype=jnp.float32), (e, m, m))
+    params = {
+        "router": jnp.asarray(rng.standard_normal((m, e)), jnp.float32),
+        "wi": eye, "wo": eye,
+    }
+    x = jnp.asarray(rng.integers(-126, 127, size=(g, s, m)), jnp.float32)
+    x = x.at[:, :, 0].set(127.0)
+    mesh = build_mesh({"data": 2, "expert": 4})
+    kw = dict(capacity_factor=float(e), mesh=mesh,
+              activation=lambda t: t)
+    with jax.set_mesh(mesh):
+        y_f32, aux_f32 = moe_ffn(params, x, wire=None, **kw)
+        y_q, aux_q = moe_ffn(params, x, wire="int8", **kw)
+    np.testing.assert_array_equal(np.asarray(y_f32), np.asarray(y_q))
+    np.testing.assert_array_equal(np.asarray(aux_f32), np.asarray(aux_q))
+
+
+@pytest.mark.moe
+@pytest.mark.quant
+def test_int8_wire_stays_close_on_generic_inputs():
+    """Off-grid inputs pay only per-block int8 rounding across the two
+    a2a boundaries — the routed output stays within quantization noise
+    of the full-precision run."""
+    rng = np.random.default_rng(8)
+    g, s, m, f, e = 2, 16, 8, 32, 4
+    params = init_moe_params(jax.random.PRNGKey(3), m, f, e)
+    x = jnp.asarray(rng.standard_normal((g, s, m)), jnp.float32)
+    mesh = build_mesh({"data": 2, "expert": 4})
+    with jax.set_mesh(mesh):
+        y_f32, _ = moe_ffn(params, x, mesh=mesh)
+        y_q, _ = moe_ffn(params, x, mesh=mesh, wire="int8")
+    np.testing.assert_allclose(np.asarray(y_f32), np.asarray(y_q),
+                               rtol=0.05, atol=0.05)
+
+
+@pytest.mark.moe
+def test_moe_wire_env_knob_shared_with_ir(monkeypatch):
+    """AUTODIST_MOE_WIRE=int8 flips BOTH sides through the same knob:
+    the runtime wire format and the IR facts' compressor (whose leg
+    bytes shrink to the quantized payload + scale grid)."""
+    from autodist_tpu.kernel.synchronization import quant_ring
+    from autodist_tpu.kernel.synchronization import schedule_ir as sir
+    from autodist_tpu.parallel.moe import moe_wire_format
+
+    monkeypatch.delenv("AUTODIST_MOE_WIRE", raising=False)
+    assert moe_wire_format(None) is None
+    assert sir.moe_wire_compressor_default() == "NoneCompressor"
+    monkeypatch.setenv("AUTODIST_MOE_WIRE", "int8")
+    fmt = moe_wire_format(None)
+    assert fmt is not None and fmt.name == "int8"
+    assert sir.moe_wire_compressor_default() == "Int8Compressor"
+
+    full = sir.MoEFact(key="l0/moe", groups=2, seq=1024, d_model=64,
+                       num_experts=8)
+    quant = sir.MoEFact(key="l0/moe", groups=2, seq=1024, d_model=64,
+                        num_experts=8, compressor="Int8Compressor")
+    elems = full.payload_elems(4)
+    assert quant.leg_nbytes(4) == quant_ring.wire_nbytes(
+        elems, quant_ring.wire_format_of("Int8Compressor"))
+    assert quant.leg_nbytes(4) < full.leg_nbytes(4)
+
+
+@pytest.mark.moe
+def test_runtime_capacity_overflow_warns(monkeypatch):
+    """The runtime half of moe/capacity-overflow: an under-provisioned
+    capacity_factor logs the shared rule's verdict once per config."""
+    from autodist_tpu.parallel import moe as moe_mod
+
+    hits = []
+    monkeypatch.setattr(
+        moe_mod.logging, "warning",
+        lambda msg, *a, **k: hits.append(msg % a if a else msg))
+    rng = np.random.default_rng(9)
+    params = init_moe_params(jax.random.PRNGKey(4), 8, 16, 4)
+    x = jnp.asarray(rng.standard_normal((2, 16, 8)), jnp.float32)
+    moe_mod._warned_capacity.clear()
+    moe_ffn(params, x, capacity_factor=0.5)
+    moe_ffn(params, x, capacity_factor=0.5)       # same config: one line
+    overflow = [m for m in hits if "moe/capacity-overflow" in m]
+    assert len(overflow) == 1
+    assert "75%" in overflow[0]
+    hits.clear()
+    moe_mod._warned_capacity.clear()
+    moe_ffn(params, x, capacity_factor=2.0)       # provisioned: silent
+    assert not [m for m in hits if "moe/capacity-overflow" in m]

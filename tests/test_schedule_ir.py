@@ -156,9 +156,11 @@ def test_fuzz_planner_schedules_verify_clean():
 
 def test_fuzz_ir_from_facts_verifies_clean():
     """The mesh-free (analysis-side) builder over random plan facts —
-    including PS plans, partitioned vars, PowerSGD fallbacks, and
-    ring-threshold-crossing shapes (quantized per-hop chains with
-    donated error-feedback state) — is also always accepted."""
+    including PS plans, partitioned vars, PowerSGD fallbacks, ring-
+    threshold-crossing shapes (quantized per-hop chains with donated
+    error-feedback state), and MoE expert-routing facts (dispatch/
+    combine a2a pairs across expert axis sizes, quantized wires, multi-
+    layer, staged) — is also always accepted."""
     rng = np.random.RandomState(7)
     for trial in range(100):
         facts = []
@@ -178,10 +180,25 @@ def test_fuzz_ir_from_facts_verifies_clean():
                 overlap=str(rng.choice(list(overlap.OVERLAP_MODES))),
                 partitioned=bool(rng.randint(0, 2)),
                 staleness=int(rng.choice([0, 0, 2]))))
+        axes = {"data": int(rng.choice([1, 4, 8]))}
+        moe = tuple(
+            sir.MoEFact(key=f"layers_{j}/moe",
+                        groups=int(axes["data"]),
+                        seq=int(rng.choice([256, 1024])),
+                        d_model=int(rng.choice([64, 256])),
+                        num_experts=int(rng.choice([4, 8])),
+                        capacity_factor=2.0,
+                        dtype=str(rng.choice(["float32", "bfloat16"])),
+                        stage=str(rng.choice(["", "stage0"])),
+                        compressor=str(rng.choice(
+                            ["NoneCompressor", "Int8Compressor"])))
+            for j in range(int(rng.randint(0, 3))))
+        if moe:
+            axes["expert"] = int(rng.choice([1, 2, 4]))
         ir = sir.ir_from_facts(
-            facts, axes={"data": int(rng.choice([1, 4, 8]))},
+            facts, axes=axes,
             accum_steps=int(rng.choice([1, 4])),
-            guard=bool(rng.randint(0, 2)))
+            guard=bool(rng.randint(0, 2)), moe=moe)
         errs = _errors(ir)
         assert not errs, (trial, [str(v) for v in errs])
 
