@@ -103,10 +103,17 @@ def make_speculative_generator(target_spec: ModelSpec,
     """Build ``spec_gen(target_params, draft_params, prompt,
     max_new_tokens, gamma=4)`` → ``(tokens [B, P+N], stats)``.
 
-    ``stats`` is a dict of device scalars: ``iterations`` (verify
-    passes) and ``proposed`` / ``accepted`` draft-token counts over the
-    whole batch — ``accepted / proposed`` is the draft's acceptance
-    rate, the quantity that decides whether speculation pays off.
+    ``stats`` holds ``iterations`` (a device scalar: verify passes, the
+    batch runs in lockstep) plus PER-REQUEST ``[B]`` int32 counters:
+    ``proposed`` / ``accepted`` draft tokens and ``bonus`` (target
+    tokens emitted at the first mismatch that landed inside the
+    requested length).  ``accepted[b] / proposed[b]`` is row ``b``'s
+    acceptance rate — per-request resolution is what lets a serving
+    engine histogram acceptance length instead of averaging it away
+    (sum over the batch recovers the old aggregate counters).
+    ``accepted`` counts acceptance events; a fully-accepted tail that
+    overshoots ``max_new_tokens`` is trimmed from the output but still
+    counted.
 
     Requirements: both specs are transformer_lm-family and share the
     vocabulary (the draft proposes token ids the target scores); the
@@ -172,7 +179,8 @@ def make_speculative_generator(target_spec: ModelSpec,
                          cache(d_cfg, d_embed), cache(d_cfg, d_embed))
 
         def body(carry):
-            tokens, n, tk, tv, dk, dv, iters, proposed, accepted = carry
+            (tokens, n, tk, tv, dk, dv, iters, proposed, accepted,
+             bonus_ct) = carry
             active = n < end
 
             # -- draft: gamma cheap sequential proposals ---------------
@@ -233,22 +241,27 @@ def make_speculative_generator(target_spec: ModelSpec,
             slot = jnp.minimum(n + a, buf_len - 1)
             tokens = tokens.at[rows, slot].set(
                 jnp.where(active, bonus, tokens[rows, slot]))
-            n = jnp.where(active, jnp.minimum(n + a + 1, end), n)
 
             iters = iters + 1
-            proposed = proposed + jnp.sum(jnp.where(active, gamma, 0))
-            accepted = accepted + jnp.sum(jnp.where(active, a, 0))
-            return tokens, n, tk, tv, dk, dv, iters, proposed, accepted
+            proposed = proposed + jnp.where(active, gamma, 0)
+            accepted = accepted + jnp.where(active, a, 0)
+            bonus_ct = bonus_ct + jnp.where(active & (n + a < end), 1, 0)
+            n = jnp.where(active, jnp.minimum(n + a + 1, end), n)
+            return (tokens, n, tk, tv, dk, dv, iters, proposed, accepted,
+                    bonus_ct)
 
         def cond(carry):
             return jnp.any(carry[1] < end)
 
         n0 = jnp.full((b,), p_len, jnp.int32)
         zero = jnp.zeros((), jnp.int32)
-        tokens, n, *_rest, iters, proposed, accepted = lax.while_loop(
-            cond, body, (tokens0, n0, tk, tv, dk, dv, zero, zero, zero))
+        zero_b = jnp.zeros((b,), jnp.int32)
+        (tokens, n, *_rest, iters, proposed, accepted,
+         bonus_ct) = lax.while_loop(
+            cond, body,
+            (tokens0, n0, tk, tv, dk, dv, zero, zero_b, zero_b, zero_b))
         stats = {"iterations": iters, "proposed": proposed,
-                 "accepted": accepted}
+                 "accepted": accepted, "bonus": bonus_ct}
         return tokens[:, :end], stats
 
     return spec_gen

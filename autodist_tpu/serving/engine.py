@@ -91,6 +91,31 @@ class AdmissionError(RuntimeError):
         self.retry_after_s = float(retry_after_s)
 
 
+def check_speculative_args(gamma, temperature, *, span=None,
+                           window=None) -> None:
+    """Submit-time validation of speculative-decoding knobs, mirroring
+    the temperature-floor rule: a knob combination that would fail (or
+    silently diverge) mid-run is rejected as a typed ``ValueError`` at
+    submit instead.  ``gamma`` must be >= 1; greedy acceptance is only
+    target-exact at ``temperature == 0``; and the verify window needs
+    ``gamma`` slack positions past ``span = prompt + max_new_tokens``
+    (draft proposals may overshoot before being trimmed)."""
+    if int(gamma) < 1:
+        raise ValueError(f"speculative gamma must be >= 1, got {gamma}")
+    if float(temperature) != 0.0:
+        raise ValueError(
+            f"speculative decoding is greedy-only (temperature 0): "
+            f"greedy acceptance guarantees target-exact output, "
+            f"sampled acceptance does not; got temperature="
+            f"{temperature}")
+    if span is not None and window is not None \
+            and span + int(gamma) > int(window):
+        raise ValueError(
+            f"prompt + max_new_tokens + gamma = {span + int(gamma)} "
+            f"exceeds the engine window {window}; shrink gamma, "
+            f"raise window=, or split the request")
+
+
 def _sample_per_slot(logits, key, temp, top_k, top_p):
     """Per-slot temperature over one logits batch [B, V]: rows with
     ``temp[b] == 0`` take the argmax, others sample from

@@ -533,7 +533,17 @@ def _paged_prefill_program(knobs, params, tokens, kc, vc, chunk_kpb,
     sample their first generated token from the chunk's last position
     and deposit it at ``n_shared + c_len``.  Duplicate ``slot_ids``
     (pow-2 padding repeats the last row) are resolved by reading back
-    the LANDED token, as in the slot engine's prefill."""
+    the LANDED token, as in the slot engine's prefill.
+
+    Besides the landed token, the program returns ``preds`` — the
+    greedy argmax at EVERY chunk position (``preds[k, j]`` is the
+    model's token for logical position ``n_shared[k] + j + 1``).
+    Prefill callers ignore it; it is what makes multi-token
+    speculative VERIFY this same traced program: the scheduler feeds
+    the gamma+1 candidate tokens as a chunk with ``n_shared`` at the
+    request's committed length, and greedy acceptance falls out of
+    comparing ``preds`` against the drafts on the host — no extra
+    program cache entries beyond the (gamma-bucketed) chunk length."""
 
     top_k, top_p, bs = knobs
     num_layers = kc.shape[0]
@@ -598,10 +608,15 @@ def _paged_prefill_program(knobs, params, tokens, kc, vc, chunk_kpb,
     kc = kc.at[:, blk, off].set(ksl.astype(kc.dtype))
     vc = vc.at[:, blk, off].set(vsl.astype(vc.dtype))
 
-    last = jnp.take_along_axis(
-        x, jnp.clip(c_lens - 1, 0, pb - 1)[:, None, None].astype(jnp.int32),
-        axis=1)[:, 0]                                    # [K, D]
-    logits = head_logits(embed, last)
+    # Every position's logits: the last position's row feeds sampling
+    # (the prefill path), the full [K, Pb] argmax is the verify surface.
+    all_logits = head_logits(embed, x.reshape(k_rows * pb, -1)) \
+        .reshape(k_rows, pb, -1)                         # [K, Pb, V]
+    preds = jnp.argmax(all_logits, axis=-1).astype(tokens.dtype)
+    logits = jnp.take_along_axis(
+        all_logits,
+        jnp.clip(c_lens - 1, 0, pb - 1)[:, None, None].astype(jnp.int32),
+        axis=1)[:, 0]                                    # [K, V]
     temp_k = jnp.take(temp, slot_ids)
     toks = _sample_per_slot(logits, key, temp_k, top_k, top_p)
     w_pos = jnp.clip(n_shared + c_lens, 0, tokens.shape[1] - 1)
@@ -609,4 +624,13 @@ def _paged_prefill_program(knobs, params, tokens, kc, vc, chunk_kpb,
     tokens = tokens.at[slot_ids, w_pos].set(
         jnp.where(is_final, toks.astype(tokens.dtype), cur))
     landed = tokens[slot_ids, w_pos]
-    return tokens, kc, vc, landed
+    return tokens, kc, vc, landed, preds
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _commit_tokens_program(tokens, rows, pos, vals):
+    """Batched point-writes into the device token buffer: one token per
+    ``(rows[i], pos[i])`` pair.  The speculative round's bonus-token
+    commit — pow-2 padded by repeating the last entry (duplicate writes
+    of the same value are idempotent)."""
+    return tokens.at[rows, pos].set(vals.astype(tokens.dtype))

@@ -211,6 +211,13 @@ class Router:
 
         score = outstanding + queue_depth_total
                 + occupancy_weight * block_occupancy
+                + draft_occupancy_weight * block_occupancy_draft
+
+    ``draft_occupancy_weight`` (default 0: no behavior change) lets a
+    mixed fleet penalize replicas whose pool pressure comes from
+    speculative draft pages — draft KV is evictable only by finishing
+    its request, so a draft-heavy replica has less admission headroom
+    than its raw occupancy suggests.
 
     Routing policy per request: try live replicas in score order; on
     transport failure or 5xx mark the replica down (it re-enters
@@ -221,6 +228,7 @@ class Router:
     def __init__(self, endpoints: Sequence[Any], *,
                  probe_ttl_s: float = 1.0, stats_ttl_s: float = 0.25,
                  occupancy_weight: float = 4.0,
+                 draft_occupancy_weight: float = 0.0,
                  max_attempts: Optional[int] = None,
                  retry_wait_s: float = 0.25):
         if not endpoints:
@@ -229,6 +237,7 @@ class Router:
         self._probe_ttl = float(probe_ttl_s)
         self._stats_ttl = float(stats_ttl_s)
         self._occ_w = float(occupancy_weight)
+        self._draft_occ_w = float(draft_occupancy_weight)
         self._max_attempts = (max_attempts if max_attempts is not None
                               else 2 * len(self._eps) + 2)
         self._retry_wait = float(retry_wait_s)
@@ -282,6 +291,8 @@ class Router:
         score = float(st.get("outstanding", 0))
         score += float(st.get("queue_depth_total", 0))
         score += self._occ_w * float(st.get("block_occupancy", 0.0))
+        score += self._draft_occ_w * float(
+            st.get("block_occupancy_draft", 0.0))
         with self._lock:
             self._scores[ep.name] = (time.monotonic(), score)
             inflight = self._inflight.get(ep.name, 0)

@@ -33,10 +33,27 @@ never see a request boundary.
 * **Immediate slot recycling.**  Harvest frees a finished request's
   slot and returns its non-shared blocks to the pool in the same
   boundary; the next admission reuses both without any drain.
+* **Speculative decoding as a first-class mode.**  Constructed with a
+  ``draft_spec``/``draft_params`` pair, the engine replaces per-token
+  decode ticks with draft-and-verify rounds: the draft model proposes
+  ``gamma`` tokens through its OWN paged K/V (draft pages come from
+  the SAME :class:`BlockPool` — admission pre-reserves both spans, and
+  COW/trie/eviction rules are unchanged because draft blocks are
+  request-private and never trie-shared), and the target verifies all
+  gamma+1 candidates in one ``_paged_prefill_program`` dispatch — the
+  ``n_shared`` cached-context mask makes multi-token verify the SAME
+  traced program as chunked prefill.  Greedy acceptance keeps the
+  output token-exact vs the target-only oracle regardless of draft
+  quality.  ``gamma`` adapts to SLO pressure every round: it shrinks
+  toward 1 when the latency-class queue backs up or free slots vanish,
+  regrows when slots idle, and an acceptance-length EWMA caps it so a
+  badly-mismatched draft degrades gracefully toward plain decode
+  instead of wasting verify bandwidth (docs/serving.md).
 
 Greedy output is token-exact vs the per-request ``generate`` oracle and
 vs the slot engine — including requests admitted mid-run — pinned in
-``tests/test_serving_scheduler.py``.
+``tests/test_serving_scheduler.py`` (speculative mode:
+``tests/test_spec_serving.py``).
 """
 from __future__ import annotations
 
@@ -54,9 +71,11 @@ from autodist_tpu.models.generate import (_vocab_size, check_sampling_args,
                                           require_lm_spec)
 from autodist_tpu.serving.engine import (AdmissionError, TEMPERATURE_FLOOR,
                                          _sharded_zeros,
-                                         _write_prompt_program)
+                                         _write_prompt_program,
+                                         check_speculative_args)
 from autodist_tpu.serving.paged_kv import (SCRATCH_BLOCK, BlockPool,
                                            BlockPoolExhausted, PrefixTrie,
+                                           _commit_tokens_program,
                                            _paged_chunk_program,
                                            _paged_prefill_program)
 
@@ -90,6 +109,21 @@ class PagedRequest:
     blocks: List[int] = field(default_factory=list)
     slot: Optional[int] = None
     charged: int = 0               # prompt tokens whose K/V are in pool
+    # Speculative-mode lifecycle (unused on a target-only engine):
+    gamma: int = 0                 # per-request proposal-depth cap
+    draft_blocks: List[int] = field(default_factory=list)
+    draft_charged: int = 0         # prompt tokens in the DRAFT's pages
+    spec_rounds: int = 0           # draft-and-verify rounds this request
+    spec_proposed: int = 0         # draft tokens proposed
+    spec_accepted: int = 0         # draft tokens accepted
+    spec_bonus: int = 0            # target bonus tokens committed
+    # Cumulative wall time of the two round windows, dispatch-side
+    # attribution: draft and verify queue back-to-back on the device
+    # stream with one host sync at the end of verify, so the draft
+    # window covers its dispatch and the verify window includes the
+    # sync + acceptance.
+    draft_s: float = 0.0
+    verify_s: float = 0.0
 
 
 @dataclass
@@ -108,8 +142,26 @@ class PagedEngineStats:
     prompt_tokens: int = 0
     cached_prompt_tokens: int = 0  # prompt tokens served from the trie
     prefix_requests: int = 0       # requests with >= 1 cached block
+    spec_rounds: int = 0           # per-request draft-and-verify rounds
+    draft_prefill_dispatches: int = 0
+    draft_tokens_proposed: int = 0
+    draft_tokens_accepted: int = 0
+    bonus_tokens: int = 0          # target tokens at the first mismatch
 
     _slots: int = field(default=0, repr=False)
+
+    @property
+    def acceptance_rate(self) -> float:
+        """Fraction of draft proposals the target's argmax confirmed."""
+        return (self.draft_tokens_accepted / self.draft_tokens_proposed
+                if self.draft_tokens_proposed else 0.0)
+
+    @property
+    def mean_accept_len(self) -> float:
+        """Mean accepted draft tokens per verify round (excludes the
+        always-committed bonus token)."""
+        return (self.draft_tokens_accepted / self.spec_rounds
+                if self.spec_rounds else 0.0)
 
     @property
     def slot_utilization(self) -> float:
@@ -160,7 +212,10 @@ class PagedDecodeEngine:
                  top_k: int = 0, top_p: float = 0.0,
                  eos_id: Optional[int] = None,
                  rng: Optional[jax.Array] = None, mesh=None,
-                 model_axis: str = "model"):
+                 model_axis: str = "model",
+                 draft_spec: Optional[ModelSpec] = None,
+                 draft_params=None, gamma: int = 4,
+                 adapt_gamma: bool = True):
         require_lm_spec(spec, "PagedDecodeEngine")
         cfg = spec.config
         if slots < 1 or chunk < 1:
@@ -189,6 +244,24 @@ class PagedDecodeEngine:
                 f"and reserve_blocks={reserve_blocks}")
         vocab = _vocab_size(params)
         check_sampling_args(vocab, temperature, top_k, top_p, eos_id, rng)
+        if (draft_spec is None) != (draft_params is None):
+            raise ValueError("draft_spec and draft_params must be "
+                             "passed together")
+        if draft_spec is not None:
+            require_lm_spec(draft_spec, "PagedDecodeEngine draft")
+            dcfg = draft_spec.config
+            if dcfg["vocab_size"] != cfg["vocab_size"]:
+                raise ValueError(
+                    f"target/draft vocab mismatch: {cfg['vocab_size']} "
+                    f"vs {dcfg['vocab_size']}")
+            if window > dcfg["max_len"]:
+                raise ValueError(
+                    f"window={window} exceeds the draft model's "
+                    f"max_len {dcfg['max_len']}")
+            # Engine-level knob validation mirrors submit's per-request
+            # rule: speculation is greedy-acceptance, target-exact only
+            # at temperature 0.
+            check_speculative_args(gamma, temperature)
 
         self._spec = spec
         self._params = params
@@ -215,6 +288,15 @@ class PagedDecodeEngine:
             raise ValueError(f"model_axis {model_axis!r} not in mesh "
                              f"axes {mesh.axis_names}")
 
+        self._draft_spec = draft_spec
+        self._draft_params = draft_params
+        self._gamma_max = int(gamma)
+        self._adapt_gamma = bool(adapt_gamma)
+        self._gamma = self._gamma_max        # SLO-adapted, in [1, max]
+        self._accept_ewma = float(self._gamma_max)  # optimistic start
+        self._gamma_hist: Dict[int, int] = {}
+        self._draft_blocks_live = 0
+
         self._knobs = (self._top_k, self._top_p, block_size)
         self._queues: Dict[str, Deque[PagedRequest]] = {
             c: deque() for c in SLO_CLASSES}
@@ -237,6 +319,7 @@ class PagedDecodeEngine:
     def _alloc_state(self) -> None:
         slots, w, cfg = self._slots, self._window, self._cfg
         self._tokens = self._kc = self._vc = None   # drop before realloc
+        self._dkc = self._dvc = None
         self._start = np.zeros(slots, np.int32)
         self._p_end = np.zeros(slots, np.int32)
         self._end = np.zeros(slots, np.int32)
@@ -245,15 +328,35 @@ class PagedDecodeEngine:
         self._temp = np.full(slots, self._temperature, np.float32)
         self._eos = np.full(slots, self._eos_id, np.int32)
         self._bt = np.full((slots, self._maxb), SCRATCH_BLOCK, np.int32)
+        # Speculative-mode state: the draft's block table (draft pages
+        # come from the same pool, so the table has the same shape),
+        # per-slot committed-token counts (spec rounds advance by a
+        # variable amount — the tick no longer measures progress), and
+        # the adaptation state.
+        self._dbt = np.full((slots, self._maxb), SCRATCH_BLOCK, np.int32)
+        self._committed = np.zeros(slots, np.int32)
+        self._gamma = self._gamma_max
+        self._accept_ewma = float(self._gamma_max)
+        self._gamma_hist = {}
+        self._draft_blocks_live = 0
         self._tick = 0
         heads, hd = cfg["num_heads"], cfg["head_dim"]
         dtype = self._params["pos_embed"].dtype
         pool_shape = (cfg["num_layers"], self._num_blocks,
                       self._block_size, heads, hd)
+        if self._draft_spec is not None:
+            dcfg = self._draft_spec.config
+            dpool_shape = (dcfg["num_layers"], self._num_blocks,
+                           self._block_size, dcfg["num_heads"],
+                           dcfg["head_dim"])
+            ddtype = self._draft_params["pos_embed"].dtype
         if self._mesh is None:
             self._tokens = jnp.zeros((slots, w), jnp.int32)
             self._kc = jnp.zeros(pool_shape, dtype)
             self._vc = jnp.zeros(pool_shape, dtype)
+            if self._draft_spec is not None:
+                self._dkc = jnp.zeros(dpool_shape, ddtype)
+                self._dvc = jnp.zeros(dpool_shape, ddtype)
         else:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -263,6 +366,11 @@ class PagedDecodeEngine:
             self._tokens = _sharded_zeros((slots, w), jnp.int32, rep)()
             self._kc = _sharded_zeros(pool_shape, dtype, heads_sh)()
             self._vc = _sharded_zeros(pool_shape, dtype, heads_sh)()
+            if self._draft_spec is not None:
+                self._dkc = _sharded_zeros(dpool_shape, ddtype,
+                                           heads_sh)()
+                self._dvc = _sharded_zeros(dpool_shape, ddtype,
+                                           heads_sh)()
 
     # ------------------------------------------------------------------
     # public API
@@ -322,18 +430,25 @@ class PagedDecodeEngine:
     def submit(self, prompt, max_new_tokens: int, *,
                temperature: Optional[float] = None,
                eos_id: Optional[int] = None, slo: str = SLO_LATENCY,
-               use_prefix: bool = False, trace_id: str = "") -> int:
+               use_prefix: bool = False, trace_id: str = "",
+               gamma: Optional[int] = None) -> int:
         """Queue a request into its SLO class; returns its id.
 
         ``trace_id`` tags this request's queue-wait/prefill/decode
         spans in the telemetry span stream (propagated from the
         router's HTTP header by the server; empty = spans recorded
-        untagged).
+        untagged).  On a speculative engine ``gamma`` caps THIS
+        request's proposal depth (default: the engine's ``gamma``);
+        the SLO adaptation only ever shrinks below it.
 
         Raises :class:`AdmissionError` (with ``retry_after_s``) when the
         class's queue is at ``max_queue``; raises ``ValueError`` for a
         request that could NEVER be admitted (span over the window, or
-        more blocks than the pool minus the reserve can ever hold)."""
+        more blocks than the pool minus the reserve can ever hold) and
+        for speculation knobs that would fail mid-run — ``gamma < 1``,
+        non-greedy temperature, or the span plus gamma slack
+        overflowing the window are all rejected HERE, mirroring the
+        temperature-floor rule (``check_speculative_args``)."""
         self._check_usable()
         if slo not in SLO_CLASSES:
             raise ValueError(f"slo must be one of {SLO_CLASSES}, "
@@ -362,6 +477,16 @@ class PagedDecodeEngine:
                 f"prompt + max_new_tokens = {span} exceeds the engine "
                 f"window {self._window}; raise window= or split")
         temperature, eos_id = self._check_knobs(temperature, eos_id)
+        if self._draft_spec is None:
+            if gamma is not None:
+                raise ValueError(
+                    "per-request gamma needs a speculative engine "
+                    "(pass draft_spec/draft_params at construction)")
+            gamma = 0
+        else:
+            gamma = self._gamma_max if gamma is None else int(gamma)
+            check_speculative_args(gamma, temperature, span=span,
+                                   window=self._window)
         q = self._queues[slo]
         if len(q) >= self._max_queue:
             self.stats.rejected_full += 1
@@ -372,7 +497,7 @@ class PagedDecodeEngine:
                            slo=slo, temperature=temperature,
                            eos_id=eos_id, strip=strip,
                            trace_id=str(trace_id or ""),
-                           submit_t=time.monotonic())
+                           submit_t=time.monotonic(), gamma=gamma)
         self._next_id += 1
         q.append(req)
         self.stats.submitted += 1
@@ -442,7 +567,10 @@ class PagedDecodeEngine:
             self._harvest()
             self._admit()
         if np.any(self._active & ~self._done):
-            self._run_chunk()
+            if self._draft_spec is not None:
+                self._run_spec_round()
+            else:
+                self._run_chunk()
         if self._pending_work():
             return True
         self._harvest()
@@ -524,6 +652,28 @@ class PagedDecodeEngine:
             "deferred_admissions": self.stats.deferred_blocks,
             "rejected_full": self.stats.rejected_full,
         }
+        # Occupancy split (always present; draft is 0 on a target-only
+        # engine) so capacity regressions are attributable to the pool
+        # that grew — the router can weight draft pressure separately.
+        cap = max(self.pool.capacity, 1)
+        draft_used = self._draft_blocks_live
+        out["draft_blocks_used"] = draft_used
+        out["block_occupancy_draft"] = round(draft_used / cap, 4)
+        out["block_occupancy_target"] = round(
+            max(self.pool.used_count - draft_used, 0) / cap, 4)
+        if self._draft_spec is not None:
+            out["speculative"] = {
+                "gamma": self._gamma,
+                "gamma_max": self._gamma_max,
+                "accept_ewma": round(self._accept_ewma, 4),
+                "rounds": self.stats.spec_rounds,
+                "proposed": self.stats.draft_tokens_proposed,
+                "accepted": self.stats.draft_tokens_accepted,
+                "bonus": self.stats.bonus_tokens,
+                "acceptance_rate": round(self.stats.acceptance_rate, 4),
+                "mean_accept_len": round(self.stats.mean_accept_len, 4),
+                "gamma_hist": dict(self._gamma_hist),
+            }
         if self.trie is not None:
             out["trie_blocks"] = len(self.trie)
             out["trie_evictions"] = self.trie.stats.evictions
@@ -539,6 +689,10 @@ class PagedDecodeEngine:
         assert self.pool.used_count == cached, (
             f"{self.pool.used_count - cached} block(s) leaked "
             f"(used={self.pool.used_count}, trie-cached={cached})")
+        assert self._draft_blocks_live == 0, (
+            f"{self._draft_blocks_live} draft block(s) leaked")
+        assert np.all(self._dbt == SCRATCH_BLOCK), \
+            "draft block-table rows leaked (stale entries after drain)"
 
     # ------------------------------------------------------------------
     # scheduler internals
@@ -586,29 +740,43 @@ class PagedDecodeEngine:
         """Reserve the request's whole worst-case span in blocks:
         trie-matched prefix blocks are referenced (not recomputed), the
         rest allocated fresh, with ``reserve_blocks`` kept free as the
-        watermark.  All-or-nothing; under pressure unpinned cached
-        blocks are LRU-evicted first."""
+        watermark.  On a speculative engine the DRAFT span is
+        pre-reserved in the same breath — draft K/V is just more pages
+        of the same pool, so the admission math is one sum: a
+        speculative request needs ``blocks_for_tokens(span)`` twice
+        (the draft processes at most ``span - 1`` prompt+committed
+        positions, so the same block count covers it), and either both
+        spans fit or neither is taken.  Draft blocks are always fresh
+        (never trie-shared: their contents are the DRAFT model's K/V,
+        incompatible with target prefix reuse).  All-or-nothing; under
+        pressure unpinned cached blocks are LRU-evicted first."""
         span = req.prompt.size + req.max_new_tokens
         need_total = self.pool.blocks_for_tokens(span)
+        need_draft = need_total if self._draft_spec is not None else 0
         n_cached, cached = (self.trie.match(req.prompt)
                             if self.trie is not None else (0, []))
         need_new = need_total - len(cached)
-        short = need_new + self._reserve - self.pool.free_count
+        short = need_new + need_draft + self._reserve \
+            - self.pool.free_count
         if short > 0 and self.trie is not None:
             self.trie.evict(short)
-        if self.pool.free_count < need_new + self._reserve:
+        if self.pool.free_count < need_new + need_draft + self._reserve:
             for blk in cached:      # undo the match references
                 self.pool.release(blk)
             return False
         try:
-            fresh = self.pool.alloc(need_new)
+            both = self.pool.alloc(need_new + need_draft)
         except BlockPoolExhausted:   # pragma: no cover - guarded above
             for blk in cached:
                 self.pool.release(blk)
             return False
+        fresh, draft = both[:need_new], both[need_new:]
         req.blocks = cached + fresh
+        req.draft_blocks = draft
+        self._draft_blocks_live += len(draft)
         req.n_cached = n_cached
         req.charged = n_cached
+        req.draft_charged = 0       # no trie for draft pages
         return True
 
     def _place(self, req: PagedRequest, b: int) -> None:
@@ -618,6 +786,9 @@ class PagedDecodeEngine:
         p = req.prompt.size
         self._bt[b, :] = SCRATCH_BLOCK
         self._bt[b, :len(req.blocks)] = req.blocks
+        self._dbt[b, :] = SCRATCH_BLOCK
+        self._dbt[b, :len(req.draft_blocks)] = req.draft_blocks
+        self._committed[b] = 0
         pb = _pow2_bucket(p, self._window)
         padded = np.zeros(pb, np.int32)
         padded[:p] = req.prompt
@@ -650,10 +821,14 @@ class PagedDecodeEngine:
         """One prefill wave: each prefilling request charges its next
         chunk, batched by pow-2 chunk bucket into few dispatches (the
         compile dimensions are the bucket and the pow-2-padded row
-        count, both logarithmic sets)."""
+        count, both logarithmic sets).  On a speculative engine the
+        DRAFT model then catches up to the target's charge level over
+        its own pages in a second bucketed pass — the draft has no
+        prefix cache, so its first chunk also covers the trie-matched
+        region the target skipped."""
+        wave = [self._prefilling[b] for b in sorted(self._prefilling)]
         buckets: Dict[int, List[PagedRequest]] = {}
-        for b in sorted(self._prefilling):
-            req = self._prefilling[b]
+        for req in wave:
             c = self._next_chunk_len(req)
             pb = _pow2_bucket(c, self._window)
             buckets.setdefault(pb, []).append(req)
@@ -662,6 +837,24 @@ class PagedDecodeEngine:
             while entries:
                 k = 1 << (len(entries).bit_length() - 1)   # pow2 <= len
                 self._run_prefill_chunk(entries[:k], pb)
+                entries = entries[k:]
+        if self._draft_spec is None:
+            return
+        # Draft catch-up (requests that just finished their FINAL
+        # target chunk left _prefilling, but still need draft pages
+        # charged before their first spec round — hence the wave
+        # snapshot above).
+        dbuckets: Dict[int, List[PagedRequest]] = {}
+        for req in wave:
+            c = req.charged - req.draft_charged
+            if c > 0:
+                dbuckets.setdefault(_pow2_bucket(c, self._window),
+                                    []).append(req)
+        for pb in sorted(dbuckets):
+            entries = dbuckets[pb]
+            while entries:
+                k = 1 << (len(entries).bit_length() - 1)
+                self._run_draft_prefill_chunk(entries[:k], pb)
                 entries = entries[k:]
 
     def _run_prefill_chunk(self, reqs: List[PagedRequest],
@@ -685,7 +878,7 @@ class PagedDecodeEngine:
             bt_rows[i] = self._bt[req.slot]
         self._rng, sub = jax.random.split(self._rng)
         try:
-            self._tokens, self._kc, self._vc, landed = \
+            self._tokens, self._kc, self._vc, landed, _ = \
                 _paged_prefill_program(
                     self._knobs, self._params, self._tokens, self._kc,
                     self._vc, jnp.asarray(chunk), jnp.asarray(bt_rows),
@@ -715,11 +908,54 @@ class PagedDecodeEngine:
             self._done[b] = (req.max_new_tokens == 1
                              or (req.eos_id >= 0 and tok == req.eos_id))
             self._active[b] = True
+            self._committed[b] = p + 1   # prompt + the landed token
             self._slot_req[b] = req
             del self._prefilling[b]
             req.first_token_t = now
             if self.trie is not None:
                 self.trie.insert(req.prompt, req.blocks)
+
+    def _run_draft_prefill_chunk(self, reqs: List[PagedRequest],
+                                 pb: int) -> None:
+        """Charge a prompt chunk into the DRAFT model's pages: the same
+        ``_paged_prefill_program`` (same traced shape family) over the
+        draft params/pools and the draft block table.  The draft has no
+        prefix cache — ``n_shared`` is the request's own draft charge,
+        so its first chunk recomputes the trie-matched region the
+        target skipped (draft K/V is model-specific; target cache
+        entries cannot seed it).  Never ``is_final``: only the TARGET
+        ever samples tokens."""
+        k_real = len(reqs)
+        k_pad = 1 << (k_real - 1).bit_length()
+        chunk = np.zeros((k_pad, pb), np.int32)
+        n_shared = np.zeros(k_pad, np.int32)
+        c_lens = np.ones(k_pad, np.int32)
+        is_final = np.zeros(k_pad, bool)
+        slot_ids = np.zeros(k_pad, np.int32)
+        bt_rows = np.full((k_pad, self._maxb), SCRATCH_BLOCK, np.int32)
+        for i in range(k_pad):
+            req = reqs[min(i, k_real - 1)]   # pad repeats the last row
+            c = req.charged - req.draft_charged
+            chunk[i, :c] = req.prompt[req.draft_charged:req.charged]
+            n_shared[i] = req.draft_charged
+            c_lens[i] = c
+            slot_ids[i] = req.slot
+            bt_rows[i] = self._dbt[req.slot]
+        self._rng, sub = jax.random.split(self._rng)
+        try:
+            self._tokens, self._dkc, self._dvc, _, _ = \
+                _paged_prefill_program(
+                    self._knobs, self._draft_params, self._tokens,
+                    self._dkc, self._dvc, jnp.asarray(chunk),
+                    jnp.asarray(bt_rows), jnp.asarray(slot_ids),
+                    jnp.asarray(n_shared), jnp.asarray(c_lens),
+                    jnp.asarray(is_final), jnp.asarray(self._temp), sub)
+        except Exception:
+            self._poisoned = True
+            raise
+        self.stats.draft_prefill_dispatches += 1
+        for req in reqs:
+            req.draft_charged = req.charged
 
     def _run_chunk(self) -> None:
         n = self._chunk
@@ -752,13 +988,224 @@ class PagedDecodeEngine:
         self.stats.busy_slot_ticks += int(busy)
         self.stats.chunks += 1
 
+    def _retune_gamma(self) -> None:
+        """SLO-aware gamma adaptation, one adjustment per round:
+
+        * **shrink** toward 1 when the latency class queues back up or
+          every slot is taken with work still waiting — speculation
+          spends batch-wide verify FLOPs to cut per-request latency,
+          exactly the wrong trade when requests are queueing;
+        * **grow** back toward ``gamma_max`` when slots idle and
+          nothing is queued (the utilization gap speculation exists to
+          spend);
+        * an acceptance-length EWMA caps gamma at ``2 * ewma`` so a
+          badly-mismatched draft degrades toward plain decode (gamma 1)
+          instead of paying gamma-deep drafts it never lands.
+        """
+        if not self._adapt_gamma:
+            return
+        g = self._gamma
+        queued = any(self._queues.values())
+        free = len(self._free_slots())
+        if self._queues[SLO_LATENCY] or (free == 0 and queued):
+            g = max(1, g - 1)
+        elif free > 0 and not queued:
+            g = min(self._gamma_max, g + 1)
+        self._gamma = min(g, max(1, int(round(2 * self._accept_ewma))))
+
+    def _run_spec_round(self) -> None:
+        """One draft-and-verify round over every live decode slot — the
+        speculative replacement for ``_run_chunk``'s per-token ticks.
+
+        Let ``m`` be a slot's committed token count (prompt + landed;
+        its target K/V covers positions ``0..m-2``, its tokens row is
+        authoritative through ``m-1``) and ``ge = min(gamma, request
+        cap, tokens remaining)``.  The round is two dispatches plus one
+        point-write:
+
+        1. **Draft scan** — ``_paged_chunk_program`` over the draft
+           params/pools/table, re-based so tick 0 is a CATCH-UP tick:
+           ``start = 2 - m`` makes ``rel`` walk ``m-2, m-1, ...``, and
+           ``p_end = 2`` keeps tick 0 teacher-forced, so it re-writes
+           the draft K/V at ``m-2`` (covering the committed tokens a
+           full acceptance landed past the previous scan) WITHOUT
+           touching the committed token at ``m-1``.  Ticks 1..ge then
+           write greedy proposals at positions ``m..m+ge-1`` in the
+           shared device tokens row (``temp=0``, ``eos=-1``: proposal
+           depth is bounded by ``end = ge + 2``, never by content).
+        2. **Verify** — ``_paged_prefill_program`` over the TARGET with
+           the committed token + proposals as a ``ge+1``-token chunk at
+           ``n_shared = m-1``: one dispatch scores all candidates and
+           returns ``preds`` (the target argmax at every position).
+           The chunk is GATHERED ON DEVICE from the tokens buffer the
+           draft just wrote — draft and verify queue back-to-back on
+           the device stream, and the round pays exactly ONE host sync
+           (fetching ``preds`` + proposals together after verify).
+           Host-side greedy acceptance takes the longest agreeing
+           prefix ``a`` and the target's own token at the first
+           mismatch as the bonus — so every round commits ``a+1``
+           tokens (capped at the request's budget) and the output is
+           token-exact vs the target-only oracle by construction.
+        3. **Commit** — accepted proposals already sit in the tokens
+           row (the draft wrote them); only the bonus needs a batched
+           point-write (``_commit_tokens_program``).
+
+        Stale-K/V safety is positional: the verify chunk's context mask
+        stops at ``m-1`` and its own positions are freshly written, and
+        the draft scan rewrites every position past ``m-2`` before any
+        later tick attends it — rejected-proposal K/V from earlier
+        rounds is always re-written before it is ever re-read."""
+        live = [b for b in range(self._slots)
+                if self._active[b] and not self._done[b]]
+        if not live:
+            return
+        self._retune_gamma()
+        g_used = self._gamma
+        reqs = [self._slot_req[b] for b in live]
+        m = np.array([int(self._committed[b]) for b in live])
+        end_total = np.array([r.prompt.size + r.max_new_tokens
+                              for r in reqs])
+        ge = np.minimum(np.minimum(g_used,
+                                   np.array([r.gamma for r in reqs])),
+                        end_total - m).astype(np.int32)
+        # --- draft scan ------------------------------------------------
+        start = np.zeros(self._slots, np.int32)
+        p_end = np.zeros(self._slots, np.int32)
+        end = np.zeros(self._slots, np.int32)
+        done0 = np.ones(self._slots, bool)
+        active = np.zeros(self._slots, bool)
+        for i, b in enumerate(live):
+            start[b] = 2 - m[i]
+            p_end[b] = 2
+            end[b] = int(ge[i]) + 2
+            done0[b] = False
+            active[b] = True
+        # Exact tick count, not a pow-2 bucket: the static set is
+        # {2..gamma_max+1} — as bounded as a bucket family, without the
+        # dead padding ticks a pow-2 round-up would add to every round.
+        n = int(ge.max()) + 1
+        dtemp = np.zeros(self._slots, np.float32)    # greedy proposals
+        deos = np.full(self._slots, -1, np.int32)    # depth-bounded only
+        self._rng, sub = jax.random.split(self._rng)
+        t0 = time.monotonic()
+        try:
+            self._tokens, self._dkc, self._dvc, _, _ = \
+                _paged_chunk_program(
+                    n, self._knobs, self._draft_params, self._tokens,
+                    self._dkc, self._dvc, jnp.asarray(self._dbt),
+                    jnp.asarray(start), jnp.asarray(p_end),
+                    jnp.asarray(end), jnp.asarray(done0),
+                    jnp.asarray(active), jnp.asarray(dtemp),
+                    jnp.asarray(deos), jnp.int32(0), sub)
+        except Exception:
+            self._poisoned = True
+            raise
+        t1 = time.monotonic()
+        # --- verify ----------------------------------------------------
+        k_real = len(live)
+        k_pad = 1 << (k_real - 1).bit_length()
+        pb = _pow2_bucket(int(ge.max()) + 1, self._window)
+        n_shared = np.zeros(k_pad, np.int32)
+        c_lens = np.ones(k_pad, np.int32)
+        is_final = np.zeros(k_pad, bool)
+        slot_ids = np.zeros(k_pad, np.int32)
+        bt_rows = np.full((k_pad, self._maxb), SCRATCH_BLOCK, np.int32)
+        cols = np.zeros((k_pad, pb), np.int32)
+        for i in range(k_pad):
+            j = min(i, k_real - 1)       # pad repeats the last row
+            b = live[j]
+            n_shared[i] = m[j] - 1
+            c_lens[i] = int(ge[j]) + 1
+            slot_ids[i] = b
+            bt_rows[i] = self._bt[b]
+            cols[i] = np.clip(m[j] - 1 + np.arange(pb), 0,
+                              self._window - 1)
+        self._rng, sub = jax.random.split(self._rng)
+        try:
+            # Device-side gather: the committed token + proposals are
+            # already rows of the tokens buffer the draft scan wrote.
+            chunk = self._tokens[jnp.asarray(slot_ids)[:, None],
+                                 jnp.asarray(cols)]
+            self._tokens, self._kc, self._vc, _, preds = \
+                _paged_prefill_program(
+                    self._knobs, self._params, self._tokens, self._kc,
+                    self._vc, chunk, jnp.asarray(bt_rows),
+                    jnp.asarray(slot_ids), jnp.asarray(n_shared),
+                    jnp.asarray(c_lens), jnp.asarray(is_final),
+                    jnp.asarray(self._temp), sub)
+            preds = np.asarray(preds)    # the round's ONE host sync
+            toks = np.asarray(self._tokens)
+        except Exception:
+            self._poisoned = True
+            raise
+        t2 = time.monotonic()
+        # --- host acceptance + bonus commit ----------------------------
+        rows: List[int] = []
+        pos: List[int] = []
+        vals: List[int] = []
+        accepts = []
+        for i, b in enumerate(live):
+            req = reqs[i]
+            g_i = int(ge[i])
+            props = toks[b, m[i]:m[i] + g_i]
+            a = 0
+            while a < g_i and int(preds[i, a]) == int(props[a]):
+                a += 1
+            accepts.append(a)
+            new_m = min(int(m[i]) + a + 1, int(end_total[i]))
+            committed_new = [int(t) for t in props[:a]]
+            if m[i] + a < end_total[i]:
+                bonus = int(preds[i, a])
+                rows.append(b)
+                pos.append(int(m[i]) + a)
+                vals.append(bonus)
+                committed_new.append(bonus)
+                req.spec_bonus += 1
+                self.stats.bonus_tokens += 1
+            req.spec_rounds += 1
+            req.spec_proposed += g_i
+            req.spec_accepted += a
+            req.draft_s += t1 - t0
+            req.verify_s += t2 - t1
+            self.stats.draft_tokens_proposed += g_i
+            self.stats.draft_tokens_accepted += a
+            if new_m >= end_total[i] or (
+                    req.eos_id >= 0 and req.eos_id in committed_new):
+                self._done[b] = True
+            self._committed[b] = new_m
+        if rows:
+            kp = 1 << (len(rows) - 1).bit_length()
+            while len(rows) < kp:        # idempotent pow-2 padding
+                rows.append(rows[-1])
+                pos.append(pos[-1])
+                vals.append(vals[-1])
+            try:
+                self._tokens = _commit_tokens_program(
+                    self._tokens, jnp.asarray(np.array(rows, np.int32)),
+                    jnp.asarray(np.array(pos, np.int32)),
+                    jnp.asarray(np.array(vals, np.int32)))
+            except Exception:
+                self._poisoned = True
+                raise
+        self._accept_ewma = (0.8 * self._accept_ewma
+                             + 0.2 * float(np.mean(accepts)))
+        self._gamma_hist[g_used] = self._gamma_hist.get(g_used, 0) + 1
+        self.stats.spec_rounds += len(live)
+        self.stats.ticks += 1
+        self.stats.busy_slot_ticks += len(live)
+
     def _slot_tokens(self, b: int, req: PagedRequest) -> np.ndarray:
         """Tokens written so far for slot ``b``: logical positions
         0..written-1 pulled as one row slice, eos-truncated after the
         prompt, prefix strip applied."""
         s, pe, e = int(self._start[b]), int(self._p_end[b]), \
             int(self._end[b])
-        written = min(e, self._tick + 1) - s
+        if self._draft_spec is not None:
+            # Spec rounds advance by a variable amount; the per-slot
+            # committed count is the progress measure, not the tick.
+            written = int(self._committed[b])
+        else:
+            written = min(e, self._tick + 1) - s
         row = np.array(self._tokens[b])
         seq = row[:max(written, 0)]
         eos = int(self._eos[b])
@@ -800,15 +1247,36 @@ class PagedDecodeEngine:
                     dur_s=max(now_mono - first, 0.0),
                     trace_id=req.trace_id, request_id=req.request_id,
                     generated=int(gen))
+        if req.spec_rounds:
+            # Cumulative draft/verify windows inside the decode span,
+            # so the trace export shows where speculative rounds spent
+            # their time (draft proposing vs target verifying).
+            record_span("spec_draft", start_unix=wall(first),
+                        dur_s=req.draft_s, trace_id=req.trace_id,
+                        request_id=req.request_id,
+                        rounds=int(req.spec_rounds),
+                        proposed=int(req.spec_proposed),
+                        accepted=int(req.spec_accepted))
+            record_span("spec_verify", start_unix=wall(first),
+                        dur_s=req.verify_s, trace_id=req.trace_id,
+                        request_id=req.request_id,
+                        bonus=int(req.spec_bonus))
 
     def _free_slot(self, b: int, req: PagedRequest) -> None:
         """Return the request's blocks to the pool (shared prefix
-        blocks just drop this reader's reference) and clear the block
-        table row — the slot and the memory recycle at THIS boundary."""
+        blocks just drop this reader's reference; draft pages are
+        request-private, so they always free) and clear both block
+        table rows — the slot and the memory recycle at THIS
+        boundary."""
         for blk in req.blocks:
             self.pool.release(blk)
         req.blocks = []
+        for blk in req.draft_blocks:
+            self.pool.release(blk)
+        self._draft_blocks_live -= len(req.draft_blocks)
+        req.draft_blocks = []
         self._bt[b, :] = SCRATCH_BLOCK
+        self._dbt[b, :] = SCRATCH_BLOCK
 
     def _harvest(self) -> None:
         for b in range(self._slots):
@@ -841,3 +1309,15 @@ class PagedDecodeEngine:
                 "trace_id": req.trace_id,
                 "slo": req.slo,
             }
+            if self._draft_spec is not None:
+                self._timings[req.request_id].update({
+                    "spec_rounds": float(req.spec_rounds),
+                    "spec_proposed": float(req.spec_proposed),
+                    "spec_accepted": float(req.spec_accepted),
+                    "spec_bonus": float(req.spec_bonus),
+                    "accept_len_mean": (
+                        req.spec_accepted / req.spec_rounds
+                        if req.spec_rounds else 0.0),
+                    "draft_s": req.draft_s,
+                    "verify_s": req.verify_s,
+                })

@@ -172,6 +172,29 @@ class EngineServer:
                     "admission queue depth by SLO class",
                     labels={"slo": c})
                 for c in ("latency", "throughput")}
+            self._m_occ_target = self._registry.gauge(
+                "autodist_serving_block_occupancy_target",
+                "pool fraction holding TARGET-model KV blocks")
+            self._m_occ_draft = self._registry.gauge(
+                "autodist_serving_block_occupancy_draft",
+                "pool fraction holding draft-model KV blocks "
+                "(speculative decoding)")
+        # Speculative-mode telemetry (engine built with a draft model):
+        # fixed-bound histograms again, so the acceptance-length and
+        # gamma distributions merge exactly across replicas.
+        self._spec = getattr(engine, "_draft_spec", None) is not None
+        if self._spec:
+            self._m_accept_len = self._registry.histogram(
+                "autodist_serving_spec_accept_len",
+                "mean accepted draft tokens per verify round, per "
+                "request", buckets=DEPTH_BUCKETS)
+            self._m_gamma_hist = self._registry.histogram(
+                "autodist_serving_spec_gamma",
+                "SLO-adapted proposal depth, sampled per driver fold",
+                buckets=DEPTH_BUCKETS)
+            self._m_gamma = self._registry.gauge(
+                "autodist_serving_spec_gamma_current",
+                "current SLO-adapted proposal depth")
 
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
@@ -262,13 +285,21 @@ class EngineServer:
             self._m_queue_wait.observe(timing["queue_wait_s"])
             if timing.get("per_token_s"):
                 self._m_itl.observe(timing["per_token_s"])
+            if self._spec and timing.get("spec_rounds"):
+                self._m_accept_len.observe(timing["accept_len_mean"])
         sched = self._engine.scheduler_stats()
         self._m_occupancy.set(sched["block_occupancy"])
         self._m_prefix_rate.set(sched["prefix_hit_rate"])
+        self._m_occ_target.set(sched["block_occupancy_target"])
+        self._m_occ_draft.set(sched["block_occupancy_draft"])
         for c, depth in sched["queue_depth"].items():
             g = self._m_class_depth.get(c)
             if g is not None:
                 g.set(depth)
+        if self._spec:
+            gamma = sched["speculative"]["gamma"]
+            self._m_gamma.set(gamma)
+            self._m_gamma_hist.observe(float(gamma))
 
     # -- request plumbing (called from handler threads) --------------------
 
@@ -280,7 +311,7 @@ class EngineServer:
     def _submit(self, prompt: np.ndarray, max_new: int,
                 temperature=None, eos_id=None,
                 use_prefix: bool = False, slo: Optional[str] = None,
-                trace_id: str = "") -> int:
+                trace_id: str = "", gamma: Optional[int] = None) -> int:
         with self._locked():
             if self._stop or self._engine_error is not None:
                 raise _Unavailable()
@@ -292,6 +323,12 @@ class EngineServer:
                 # the slot engine ignores trace ids (its submit has no
                 # per-request lifecycle timestamps to span).
                 kwargs["trace_id"] = trace_id
+            if gamma is not None:
+                if not self._spec:
+                    raise ValueError(
+                        "this server's engine is not speculative; "
+                        "drop the gamma field")
+                kwargs["gamma"] = gamma
             if slo is not None:
                 if not self._paged:
                     raise ValueError(
@@ -558,9 +595,12 @@ class _Handler(BaseHTTPRequestHandler):
             slo = body.get("slo")
             if slo is not None and not isinstance(slo, str):
                 raise ValueError("slo must be a string")
+            gamma = body.get("gamma")
+            if gamma is not None and type(gamma) is not int:
+                raise ValueError("gamma must be an int")
             rid = srv._submit(prompt, max_new, temperature=temperature,
                               eos_id=eos_id, use_prefix=use_prefix,
-                              slo=slo, trace_id=trace_id)
+                              slo=slo, trace_id=trace_id, gamma=gamma)
         except _Unavailable:
             self._json(503, {"error": "engine unavailable"})
             return
@@ -684,7 +724,8 @@ class _Handler(BaseHTTPRequestHandler):
 
 def serve(spec, params, *, host: str = "127.0.0.1", port: int = 8000,
           tokenizer=None, prefix_tokens=None, prefix_text=None,
-          paged: bool = False, **engine_kwargs) -> EngineServer:
+          paged: bool = False, speculative=None,
+          **engine_kwargs) -> EngineServer:
     """Build an engine over ``(spec, params)`` and start an
     :class:`EngineServer` on it.  ``paged=True`` selects the
     paged-KV continuous-batching :class:`PagedDecodeEngine`
@@ -695,11 +736,31 @@ def serve(spec, params, *, host: str = "127.0.0.1", port: int = 8000,
     engine's ``eos_id`` automatically (explicit ``eos_id=`` wins).
     ``prefix_tokens`` (ids) or ``prefix_text`` (tokenizer required)
     registers the shared cached system prompt; requests opt in with
-    ``"use_prefix": true``."""
+    ``"use_prefix": true``.
+
+    ``speculative`` turns on speculative decoding (docs/serving.md):
+    a dict with ``spec`` and ``params`` for the draft model, plus
+    optional ``gamma`` (proposal depth, default 4) and ``adapt_gamma``
+    (SLO adaptation, default True).  Speculation is a mode of the
+    paged scheduler, so it implies ``paged=True``."""
     if "eos_id" not in engine_kwargs:
         eos = getattr(tokenizer, "eos_id", None)
         if eos is not None:
             engine_kwargs["eos_id"] = int(eos)
+    if speculative is not None:
+        unknown = set(speculative) - {"spec", "params", "gamma",
+                                      "adapt_gamma"}
+        if unknown or not {"spec", "params"} <= set(speculative):
+            raise ValueError(
+                "speculative= takes a dict with 'spec' and 'params' "
+                f"(optional 'gamma', 'adapt_gamma'); got "
+                f"{sorted(speculative)}")
+        paged = True
+        engine_kwargs["draft_spec"] = speculative["spec"]
+        engine_kwargs["draft_params"] = speculative["params"]
+        for k in ("gamma", "adapt_gamma"):
+            if k in speculative:
+                engine_kwargs[k] = speculative[k]
     if paged:
         from autodist_tpu.serving.scheduler import PagedDecodeEngine
 

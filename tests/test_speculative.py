@@ -50,7 +50,10 @@ def test_exact_greedy_equivalence_bad_draft(target, draft, gamma):
     tokens, stats = sg(t_params, d_params, prompt, new, gamma)
     np.testing.assert_array_equal(np.asarray(tokens), oracle)
     assert int(stats["iterations"]) <= new    # >= 1 token per iteration
-    assert int(stats["proposed"]) >= int(stats["accepted"]) >= 0
+    proposed = np.asarray(stats["proposed"])
+    accepted = np.asarray(stats["accepted"])
+    assert proposed.shape == accepted.shape == (prompt.shape[0],)
+    assert np.all(proposed >= accepted) and np.all(accepted >= 0)
 
 
 @pytest.mark.slow
@@ -68,7 +71,8 @@ def test_perfect_draft_accepts_everything(target):
     np.testing.assert_array_equal(np.asarray(tokens), oracle)
     iters = int(stats["iterations"])
     assert iters <= -(-new // (gamma + 1)) + 1, stats   # ceil + ragged tail
-    assert int(stats["accepted"]) == int(stats["proposed"]), stats
+    np.testing.assert_array_equal(np.asarray(stats["accepted"]),
+                                  np.asarray(stats["proposed"]))
 
 
 @pytest.mark.slow
@@ -86,6 +90,38 @@ def test_ragged_acceptance_rows_advance_independently(target, draft):
     sg = make_speculative_generator(t_spec, d_spec)
     tokens, _ = sg(t_params, d_params, prompt, new, gamma=4)
     np.testing.assert_array_equal(np.asarray(tokens), oracle)
+
+
+@pytest.mark.slow
+def test_per_request_counters(target, draft):
+    """proposed/accepted/bonus are per-request ``[B]`` vectors (the
+    serving engine histograms acceptance length per request): rows with
+    different agreement levels report different counts, and each row's
+    counters obey the budget arithmetic ``accepted + bonus >= new`` is
+    impossible — committed tokens are ``accepted + bonus`` capped at
+    ``new``."""
+    t_spec, t_params = target
+    d_spec, d_params = draft
+    rng = np.random.RandomState(3)
+    prompt = rng.randint(0, VOCAB, (3, 5)).astype(np.int32)
+    new, gamma = 7, 3
+    sg = make_speculative_generator(t_spec, d_spec)
+    _, stats = sg(t_params, d_params, prompt, new, gamma)
+    proposed = np.asarray(stats["proposed"])
+    accepted = np.asarray(stats["accepted"])
+    bonus = np.asarray(stats["bonus"])
+    assert proposed.shape == accepted.shape == bonus.shape == (3,)
+    assert np.all(accepted + bonus <= new)
+    assert np.all(accepted + bonus >= 1)       # every row finished
+    assert np.all(bonus >= 1)                  # a stop needs a mismatch
+    #                                            or budget cap, but the
+    #                                            FIRST round always
+    #                                            commits >= 1 token
+    # A perfect draft accepts everything on every row.
+    sg_perfect = make_speculative_generator(t_spec, t_spec)
+    _, st2 = sg_perfect(t_params, t_params, prompt, new, gamma)
+    np.testing.assert_array_equal(np.asarray(st2["accepted"]),
+                                  np.asarray(st2["proposed"]))
 
 
 def test_validation_errors(target, draft):
