@@ -275,6 +275,19 @@ def main(argv=None) -> int:
                              "watermark keys); exits 1 when a budget "
                              "(--budget-gb / the spec's hbm_gb) is "
                              "exceeded")
+    parser.add_argument("--simulate", default=None, metavar="SWEEP",
+                        help="sweep mesh shape x slice count x DCN "
+                             "bandwidth over the pure cost/watermark "
+                             "model (docs/strategies.md 'Two-tier sync "
+                             "and --simulate'): SWEEP is a JSON file or "
+                             "an inline spec like "
+                             "'mesh=data=1024;slices=1,2,4;dcn=25,100"
+                             ";hbm=32'.  Per point, per sync mode "
+                             "(flat/hier/hier_int8): predicted step "
+                             "time, exposed wire per tier, watermark "
+                             "HBM, goodput under preemption.  Nothing "
+                             "traces or compiles; exits 1 when any "
+                             "point exceeds the HBM budget")
     parser.add_argument("--search-report", action="store_true",
                         help="run the leg-calibrated strategy search "
                              "(docs/strategies.md 'Search') on the model "
@@ -351,6 +364,46 @@ def main(argv=None) -> int:
         else:
             print(format_search_report(report))
         return 0 if report.get("best") else 1
+
+    if args.simulate:
+        import autodist_tpu.strategy as S
+        from autodist_tpu.analysis.simulate import (
+            format_sweep_report,
+            parse_sweep_spec,
+            run_sweep,
+        )
+        from autodist_tpu.telemetry.calibration import (
+            load_default_calibration,
+        )
+
+        try:
+            config = parse_sweep_spec(args.simulate)
+        except ValueError as e:
+            raise SystemExit(str(e))
+        if args.budget_gb and "hbm_gb" not in config:
+            config["hbm_gb"] = float(args.budget_gb)
+        builder_cls = getattr(S, args.strategy, None)
+        if builder_cls is None or not (
+                isinstance(builder_cls, type)
+                and issubclass(builder_cls, S.StrategyBuilder)):
+            raise SystemExit(
+                f"--simulate needs a builder class name, got "
+                f"{args.strategy!r}")
+
+        def make_strategy(spec, hier):
+            builder = builder_cls(hier=True) if hier else builder_cls()
+            return builder.build(graph_item, spec)
+
+        report = run_sweep(graph_item, make_strategy, config,
+                           constants=load_default_calibration())
+        if args.json:
+            print(json.dumps(report, indent=1))
+        else:
+            print(format_sweep_report(report))
+        priced_any = any("best_mode" in p for p in report["points"])
+        if report["n_over_hbm"] or not priced_any:
+            return 1
+        return 0
 
     strategy = _build_strategy(args.strategy, graph_item, resource_spec)
     if args.overlap:

@@ -80,6 +80,10 @@ class PlanLite:
     # against quantizing compressors' wire dtypes.
     guard: bool = False
     loss_scale: float = 0.0
+    # Two-tier hierarchical sync requested (ICI within slice, DCN
+    # across) — effective only on a multi-slice spec whose slice count
+    # tiles the data axis (schedule_ir.hier_applies).
+    hier: bool = False
 
     def physical_shape(self) -> Tuple[int, ...]:
         shape = list(self.var.shape)

@@ -318,13 +318,23 @@ def _buffer_sizes(ir, legs) -> Dict[str, int]:
     ``param:``/``opt:`` buffers are sized 0 here — they live in the
     static base."""
     d = max(int(ir.axes.get(MESH_AXIS_DATA, 1)), 1)
+    s = max(int(getattr(ir, "num_slices", 1) or 1), 1)
     sizes: Dict[str, int] = {}
     for node in ir.buckets:
         key, nb = node["key"], int(node["nbytes"])
         sizes[f"grad:{key}"] = nb
-        sizes[f"red:{key}"] = (nb // d
-                               if node["mode"] == sir.MODE_REDUCE_SCATTER
-                               else nb)
+        if node["mode"] == sir.MODE_REDUCE_SCATTER:
+            # ZeRO-1 reduce result: 1/d of the bucket — except a
+            # hierarchical bucket, whose slice-local RS first lands the
+            # LARGER 1/(d/s) intermediate (the cross-slice exchange
+            # shrinks it to 1/d afterwards); the watermark must cover
+            # the honest peak.
+            if node.get("hier") and s > 1 and d % s == 0 and d // s > 1:
+                sizes[f"red:{key}"] = nb // (d // s)
+            else:
+                sizes[f"red:{key}"] = nb // d
+        else:
+            sizes[f"red:{key}"] = nb
         sizes[f"sync:{key}"] = int(node["padded_total"]) * 4
     for l in legs:
         for buf in tuple(l.reads) + tuple(l.writes):

@@ -41,6 +41,11 @@ Rules (docs/analysis.md):
   padded, or non-bucketable compressor); it falls back to a per-variable
   collective with replicated optimizer state.  Shares
   ``bucketing.bucket_drop_reason`` with the runtime.
+* ``legality/slice-mismatch`` (ERROR) — the resource spec's ``num_slices``
+  does not divide the mesh's device count: a two-tier topology would
+  leave a ragged slice.  Shares ``resource_spec.slice_mismatch_reason``
+  with the session-build fail-fast, so the CLI and ``AutoDist`` can
+  never disagree.
 """
 from __future__ import annotations
 
@@ -251,7 +256,8 @@ def _lower_from_strategy(ctx: AnalysisContext
                 zero1=_zero1_effective(mode, placement, pad,
                                        sync.compressor, d, diags, var),
                 bucket_bytes=int(getattr(sync, "bucket_bytes", 0) or 0),
-                overlap=getattr(sync, "overlap", "auto") or "auto")
+                overlap=getattr(sync, "overlap", "auto") or "auto",
+                hier=bool(getattr(sync, "hier", False)))
         elif isinstance(sync, PSSynchronizerConfig):
             shard_axis = model_axis or (
                 MESH_AXIS_DATA if axis is not None else None)
@@ -397,7 +403,8 @@ def _lower_from_compiled(ctx: AnalysisContext
             zero1=_zero1_effective(mode, placement, pad, vp.compressor,
                                    d, diags, var),
             bucket_bytes=int(getattr(vp, "bucket_bytes", 0) or 0),
-            overlap=getattr(vp, "overlap", "auto") or "auto")
+            overlap=getattr(vp, "overlap", "auto") or "auto",
+            hier=bool(getattr(vp, "hier", False)))
 
     for name, var in known.items():
         if name not in plans:
@@ -482,6 +489,31 @@ def _stamp_numerics(ctx: AnalysisContext, plans) -> None:
             plan.loss_scale = float(peak)
 
 
+def _check_slices(ctx: AnalysisContext) -> List[Diagnostic]:
+    """The ``legality/slice-mismatch`` rule: a multi-slice spec whose
+    slice count cannot tile this mesh's device count.  Same pure rule
+    (``slice_mismatch_reason``) as the ``ResourceSpec`` fail-fast —
+    here it additionally catches spec-vs-mesh drift (a spec validated
+    against its own chip count, analyzed against different axes)."""
+    from autodist_tpu.resource_spec import slice_mismatch_reason
+
+    spec = ctx.resource_spec
+    if spec is None:
+        return []
+    s = int(getattr(spec, "num_slices", 1) or 1)
+    total = 1
+    for size in ctx.axes.values():
+        total *= max(int(size), 1)
+    reason = slice_mismatch_reason(total, s)
+    if reason is None:
+        return []
+    return [diag(
+        "legality/slice-mismatch", Severity.ERROR, reason,
+        location=f"axes={dict(ctx.axes)}",
+        fix="pick a num_slices that divides the device count, or "
+            "resize the mesh to a multiple of the slice count")]
+
+
 @register_pass("legality")
 def run(ctx: AnalysisContext) -> List[Diagnostic]:
     if ctx.compiled is not None:
@@ -492,4 +524,5 @@ def run(ctx: AnalysisContext) -> List[Diagnostic]:
     ctx.plans = plans
     diags += _check_batch_layout(ctx)
     diags += _check_mesh_hint(ctx)
+    diags += _check_slices(ctx)
     return diags

@@ -46,6 +46,11 @@ verifier's own ids (docs/schedule-ir.md):
   recorded ``schedule_fingerprint``, the mesh did NOT change, and this
   program's IR hashes differently: the sync config itself drifted from
   what the checkpoint executed.
+* ``schedule/hier-tier-order`` (ERROR) — a hierarchical bucket's
+  ICI→DCN→ICI chain is malformed: cross-slice DCN leg missing (silent
+  divergence — slices never exchange), out of order against its
+  slice-local reduce-scatter/all-gather, duplicated, or hier legs on a
+  topology where ``num_slices`` cannot tile the axis.
 * ``moe/capacity-overflow`` (WARN) — the IR's MoE routing facts
   predict token drops: ``capacity_factor`` keeps fewer expert slots
   than balanced top-2 demand (the shared pure rule
@@ -105,8 +110,10 @@ def _build_ir(ctx: AnalysisContext, axes) -> Optional[object]:
         ctx.graph_item.info.variables, axes=dict(axes),
         capacity_factor=getattr(ctx, "moe_capacity_factor", None),
         tokens_per_group=getattr(ctx, "moe_tokens_per_group", None))
+    num_slices = int(getattr(ctx.resource_spec, "num_slices", 1) or 1)
     return sir.ir_from_facts(facts, axes=dict(axes), accum_steps=accum,
-                             guard=guard, fused_kernels=active, moe=moe)
+                             guard=guard, fused_kernels=active, moe=moe,
+                             num_slices=num_slices)
 
 
 def _resolve_fused(ctx: AnalysisContext, facts, guard: bool):
@@ -179,6 +186,10 @@ _FIXES = {
     "moe/capacity-overflow":
         "raise capacity_factor to >= 2.0 (top-2 routing), shrink the "
         "expert count, or accept the predicted token drops knowingly",
+    "schedule/hier-tier-order":
+        "restore the per-bucket ICI->DCN->ICI chain the hierarchical "
+        "builder emits (slice-local reduce-scatter, cross-slice "
+        "exchange, slice-local all-gather, dep-ordered)",
 }
 
 

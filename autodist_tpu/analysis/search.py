@@ -19,8 +19,8 @@ from autodist_tpu.graph_item import GraphItem
 
 
 def project_plans(strategy, graph_item: GraphItem,
-                  axes: Dict[str, int]
-                  ) -> Tuple[dict, Optional[str]]:
+                  axes: Dict[str, int], *,
+                  resource_spec=None) -> Tuple[dict, Optional[str]]:
     """Run the analyzer's pure legality+sync passes over one candidate.
 
     Returns ``(plans, prune_reason)``: the PlanLite projection keyed by
@@ -38,7 +38,8 @@ def project_plans(strategy, graph_item: GraphItem,
     # fact construction without a second lowering.
     _load_passes()
     ctx = AnalysisContext(strategy=strategy, graph_item=graph_item,
-                          axes={str(k): int(v) for k, v in axes.items()})
+                          axes={str(k): int(v) for k, v in axes.items()},
+                          resource_spec=resource_spec)
     diags = list(PASS_REGISTRY["legality"](ctx))
     diags += PASS_REGISTRY["sync"](ctx)
     from autodist_tpu.analysis.diagnostics import Severity
@@ -50,7 +51,8 @@ def project_plans(strategy, graph_item: GraphItem,
 
 def facts_for_candidate(strategy, graph_item: GraphItem,
                         axes: Dict[str, int], *,
-                        sparse_rows_hint: int = 4096):
+                        sparse_rows_hint: int = 4096,
+                        resource_spec=None):
     """The search's prune+project step for one candidate strategy.
 
     Returns ``(facts, priced_facts, guard, prune_reason)``:
@@ -67,7 +69,8 @@ def facts_for_candidate(strategy, graph_item: GraphItem,
       or None."""
     from autodist_tpu.kernel.synchronization import schedule_ir as sir
 
-    plans, prune = project_plans(strategy, graph_item, axes)
+    plans, prune = project_plans(strategy, graph_item, axes,
+                                 resource_spec=resource_spec)
     if prune is not None:
         return [], [], False, prune
     facts, priced, guard = [], [], False

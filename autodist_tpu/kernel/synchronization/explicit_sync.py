@@ -111,6 +111,9 @@ def uses_explicit_path(compiled: CompiledStrategy) -> bool:
                 overlap_mod.OVERLAP_PIPELINE, overlap_mod.OVERLAP_RING,
                 overlap_mod.OVERLAP_FULL):
             return True
+        if getattr(plan, "hier", False):
+            # two-tier ICI+DCN sync only exists on the shard_map path
+            return True
     return (any(plan.fused for plan in compiled.var_plans.values())
             and bool(compiled.fusable_groups()))
 
@@ -280,6 +283,19 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
     bucketed_names = {n for b in buckets for n in b.names}
     rs_buckets = [b for b in buckets if b.mode == MODE_REDUCE_SCATTER]
     rs_names = {n for b in rs_buckets for n in b.names}
+    # -- hierarchical two-tier sync (docs/schedule-ir.md) ------------------
+    # A bucket lowers ICI->DCN->ICI only when EVERY member var's plan
+    # opted in AND the data axis factors into >1 slices of >1 devices;
+    # the IR builder applies the same gate (plus linear-compressor /
+    # no-quantized-wire), so the effective set below is read back from
+    # the built IR's bucket nodes — one source of truth.
+    num_slices = int(getattr(compiled, "num_slices", 1) or 1)
+    hier_on = schedule_ir.hier_applies(d, num_slices)
+    hier_keys = [
+        b.key for b in buckets
+        if hier_on and b.names
+        and all(bool(getattr(compiled.var_plans.get(n), "hier", False))
+                for n in b.names)]
     for name, plan in compiled.var_plans.items():
         if (getattr(plan, "sync_mode", MODE_ALL_REDUCE)
                 == MODE_REDUCE_SCATTER and name not in rs_names):
@@ -489,6 +505,7 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
     ir = schedule_ir.build_schedule_ir(
         axes=ir_axes,
         accum_steps=gi.accum_steps, buckets=buckets, plan=ov,
+        num_slices=num_slices, hier_keys=hier_keys,
         per_var=per_var_entries, guard=num_active,
         donated=tuple(f"sync:{k}" for k in sync_builders) if donate_sync
         else (),
@@ -549,8 +566,18 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
     # Mean-reduction lowering per UNCOMPRESSED bucket under the IR's
     # resolved algorithm (ring / one-shot / XLA fused); compressed
     # buckets keep their compressor's own wire format.
-    reduce_fns = {b.key: overlap_mod.bucket_reduce_fn(
-        b, ov, MESH_AXIS_DATA, d, alg=ir.reduce_alg(b.key))
+    # Effective hier set: read back from the built IR's bucket nodes so
+    # the runtime closures and the verified program can never disagree
+    # about which buckets went two-tier.
+    hier_bucket_keys = {n["key"] for n in ir.buckets if n.get("hier")}
+    hier_dcn_fmt = quant_ring.wire_format_of(
+        schedule_ir.dcn_wire_compressor_default())
+    reduce_fns = {b.key: (
+        overlap_mod.hier_bucket_reduce_fn(
+            b, MESH_AXIS_DATA, d, num_slices, dcn_wire=hier_dcn_fmt)
+        if b.key in hier_bucket_keys else
+        overlap_mod.bucket_reduce_fn(
+            b, ov, MESH_AXIS_DATA, d, alg=ir.reduce_alg(b.key)))
         for b in buckets
         if overlap_mod.is_linear_compressor(b.compressor)}
     # Quantized-wire buckets (int8/fp8, docs/overlap.md) lower through
@@ -1015,8 +1042,18 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
             for b in rs_buckets:
                 vec = pack_bucket(b, [by_name[n] for n in b.names])
                 sz = b.padded_total // d
-                p_shards[b.key] = lax.dynamic_slice_in_dim(
-                    vec, shard_idx * sz, sz, 0)
+                if b.key in hier_bucket_keys:
+                    # Two-tier scatter permutes ownership: device
+                    # g*d_in+i ends with global chunk i*s+g, so slice
+                    # the matching param chunk for the shard update.
+                    d_in = d // num_slices
+                    owner = ((shard_idx % d_in) * num_slices
+                             + shard_idx // d_in)
+                    p_shards[b.key] = lax.dynamic_slice_in_dim(
+                        vec, owner * sz, sz, 0)
+                else:
+                    p_shards[b.key] = lax.dynamic_slice_in_dim(
+                        vec, shard_idx * sz, sz, 0)
             if rs_buckets and rs_buckets[0].key in stamp_update:
                 lid, lkind = stamp_update[rs_buckets[0].key]
                 flightrec.traced_stamp(lid, leg_kind=lkind)
@@ -1067,7 +1104,13 @@ def make_explicit_step(gi: GraphItem, compiled: CompiledStrategy):
                     lid, lkind = stamp_gather[key]
                     flightrec.traced_stamp(lid, leg_kind=lkind)
                 with sync_span(f"param_gather/{b.key}"):
-                    if gather_alg == schedule_ir.ALG_RING and d > 1:
+                    if key in hier_bucket_keys:
+                        # DCN gather (across slices, chunk order) then
+                        # ICI gather (within slice) undoes the two-tier
+                        # ownership permutation exactly.
+                        full_vec = overlap_mod.hier_gather_fn(
+                            MESH_AXIS_DATA, d, num_slices)(shard)
+                    elif gather_alg == schedule_ir.ALG_RING and d > 1:
                         full_vec = overlap_mod.ring_all_gather(
                             shard, MESH_AXIS_DATA, d)
                     else:

@@ -200,7 +200,8 @@ def prefetch_applies(overlap: str, *, sync_mode: str,
 
 
 def explicit_hint(compressor: str, sync_mode: str, bucket_bytes: int,
-                  fused: bool = False, overlap: str = OVERLAP_AUTO) -> bool:
+                  fused: bool = False, overlap: str = OVERLAP_AUTO,
+                  hier: bool = False) -> bool:
     """Mirror of ``explicit_sync.uses_explicit_path`` for ONE plan —
     mesh-free, so the analyzer and cost model can tell whether this
     variable's sync runs on the schedulable shard_map path."""
@@ -211,6 +212,10 @@ def explicit_hint(compressor: str, sync_mode: str, bucket_bytes: int,
     if int(bucket_bytes or 0) > 0:
         return True
     if overlap in (OVERLAP_PIPELINE, OVERLAP_RING, OVERLAP_FULL):
+        return True
+    if hier:
+        # the GSPMD psum tree cannot express the two-tier ICI+DCN
+        # decomposition — a hier request forces the shard_map lowering
         return True
     return bool(fused)
 
@@ -426,6 +431,127 @@ def bucket_reduce_fn(bucket: Bucket, plan: OverlapPlan, axis_name: str,
         return named("reduce_scatter", lambda v: lax.psum_scatter(
             v, axis_name, scatter_dimension=0, tiled=True) / n)
     return named("all_reduce", lambda v: lax.pmean(v, axis_name))
+
+
+# -- hierarchical ICI+DCN collectives (trace-time, inside shard_map) ---------
+
+def hier_groups(d: int, s: int) -> Tuple[List[List[int]], List[List[int]]]:
+    """``(within, across)`` axis-index groups for a ``d``-device data
+    axis factored into ``s`` slices of ``d // s`` devices each, laid out
+    slice-major (device ``g * d_in + i`` is position ``i`` of slice
+    ``g``).  ``within`` groups share a slice (ICI-tier legs); ``across``
+    groups hold the same within-slice position in every slice (DCN-tier
+    legs — exactly one participant per slice)."""
+    d_in = d // s
+    within = [[g * d_in + i for i in range(d_in)] for g in range(s)]
+    across = [[g * d_in + i for g in range(s)] for i in range(d_in)]
+    return within, across
+
+
+def _dcn_quantized_sum(sh, axis_name: str, s: int, fmt,
+                       across: List[List[int]]):
+    """The int8/fp8 DCN leg: quantize the local partial on the shared
+    per-chunk scale grid (:mod:`quant_ring`'s one quantization rule),
+    all-gather payload + scales over the ``across`` groups, dequantize
+    every slice's contribution and sum.  Wire per device ≈
+    ``s × (1 byte/elem + scales)`` instead of ``s × 4`` — the honest
+    bytes the schedule IR's ``dcn_all_reduce``/``dcn_exchange`` legs
+    book when the bucket carries a DCN wire compressor."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from autodist_tpu.kernel.synchronization import quant_ring
+
+    q, scales, _sat = quant_ring.quantize_blocks(sh, fmt)
+    qs = lax.all_gather(q, axis_name, axis=0, axis_index_groups=across)
+    ss = lax.all_gather(scales, axis_name, axis=0,
+                        axis_index_groups=across)
+    out = jnp.zeros_like(sh)
+    for j in range(s):
+        out = out + quant_ring.dequantize_blocks(qs[j], ss[j])
+    return out
+
+
+def hier_bucket_reduce_fn(bucket: Bucket, axis_name: str, d: int, s: int,
+                          *, dcn_wire=None) -> Callable:
+    """Two-level mean reduction for one bucket on a ``d``-device axis
+    factored into ``s`` slices: ICI reduce-scatter within each slice,
+    one cross-slice leg over DCN, then (for ``all_reduce`` buckets) an
+    ICI all-gather back.  Same contract as :func:`bucket_reduce_fn` —
+    ``vec -> mean(vec)`` for AR buckets, ``vec -> local shard of
+    mean(vec)`` for reduce-scatter ones (device ``g·d_in + i`` ends
+    holding global chunk ``i·s + g``; the explicit path's owner-index
+    arithmetic and two-stage gather account for that permutation).
+
+    ``dcn_wire`` (a :class:`quant_ring.WireFormat` or None) quantizes
+    only the cross-slice leg — the narrow DCN hop — leaving ICI legs
+    full precision."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from autodist_tpu.kernel.synchronization.bucketing import (
+        MODE_REDUCE_SCATTER,
+    )
+    from autodist_tpu.telemetry.timeline import sync_span
+
+    rs = bucket.mode == MODE_REDUCE_SCATTER
+    within, across = hier_groups(d, s)
+
+    def reduce_ar(v):
+        with sync_span("hier_reduce_scatter"):
+            sh = lax.psum_scatter(v, axis_name, scatter_dimension=0,
+                                  tiled=True, axis_index_groups=within)
+        with sync_span("dcn_all_reduce"):
+            if dcn_wire is not None:
+                sh = _dcn_quantized_sum(sh, axis_name, s, dcn_wire,
+                                        across)
+            else:
+                sh = lax.psum(sh, axis_name, axis_index_groups=across)
+        sh = sh / d
+        with sync_span("hier_all_gather"):
+            return lax.all_gather(sh, axis_name, axis=0, tiled=True,
+                                  axis_index_groups=within)
+
+    def reduce_rs(v):
+        with sync_span("hier_reduce_scatter"):
+            sh = lax.psum_scatter(v, axis_name, scatter_dimension=0,
+                                  tiled=True, axis_index_groups=within)
+        with sync_span("dcn_exchange"):
+            if dcn_wire is not None:
+                sh = _dcn_quantized_sum(sh, axis_name, s, dcn_wire,
+                                        across)
+                sh = jnp.reshape(sh, (s, -1))[
+                    lax.axis_index(axis_name) // (d // s)]
+            else:
+                sh = lax.psum_scatter(sh, axis_name, scatter_dimension=0,
+                                      tiled=True,
+                                      axis_index_groups=across)
+        return sh / d
+
+    return reduce_rs if rs else reduce_ar
+
+
+def hier_gather_fn(axis_name: str, d: int, s: int) -> Callable:
+    """ZeRO-1 param reconstruction for hier buckets: the within+across
+    scatters leave device ``g·d_in + i`` holding global chunk
+    ``i·s + g``, so gathering over the ``across`` groups first (chunks
+    ``i·s .. i·s+s-1`` in order) then over ``within`` (blocks ``0·s ..``
+    upward) re-assembles the flat vector in original chunk order."""
+    from jax import lax
+
+    from autodist_tpu.telemetry.timeline import sync_span
+
+    within, across = hier_groups(d, s)
+
+    def gather(shard):
+        with sync_span("hier_all_gather/dcn"):
+            part = lax.all_gather(shard, axis_name, axis=0, tiled=True,
+                                  axis_index_groups=across)
+        with sync_span("hier_all_gather/ici"):
+            return lax.all_gather(part, axis_name, axis=0, tiled=True,
+                                  axis_index_groups=within)
+
+    return gather
 
 
 # -- accumulation pipelining (trace-time, inside shard_map) ------------------

@@ -84,6 +84,14 @@ make the partition/schedule decision a first-class analyzable artifact):
     record, or a fused hop for a compressor with no per-hop requantize
     lowering: the fused and unfused halves of the lowering disagree
     about what runs.
+  - ``schedule/hier-tier-order`` (ERROR) — the two-tier hierarchy's
+    ordering contract: a slice-local ``hier_reduce_scatter`` with no
+    cross-slice DCN leg after it (slices silently diverge), a DCN leg
+    not ordered between its slice-local RS and AG, more than one DCN
+    exchange per bucket/slot, a ZeRO-1 shard exchange without the
+    DCN-then-ICI param gather pair, a tier tag that contradicts the
+    leg kind, or hier legs on a program whose ``num_slices`` does not
+    factor the data axis.
 
 Everything here is mesh-free and jax-free at module import (numpy
 only), so the analyzer's sub-second verdict survives, and the verifier
@@ -145,14 +153,40 @@ LEG_FUSED_UPDATE = "fused_update"
 #: the leg ``sig`` distinguishes dispatch from combine so the cross-
 #: stage sequence check catches a swapped pair.
 LEG_ALL_TO_ALL = "all_to_all"
+#: hierarchical two-tier collectives (docs/schedule-ir.md): the pod
+#: recipe — reduce-scatter within a slice over ICI, exchange the
+#: slice-partial shards over the (much slower) DCN, all-gather the
+#: reduced result back over ICI.  ``hier_reduce_scatter`` /
+#: ``hier_all_gather`` are the slice-local halves; ``dcn_all_reduce``
+#: is the cross-slice shard reduction of plain data parallelism and
+#: ``dcn_exchange`` the ZeRO-1 variant (a cross-slice reduce-scatter:
+#: each device keeps only its owner sub-shard, so the weight update
+#: stays 1/d).  Each carries an explicit ``tier`` tag so the cost
+#: model prices the two networks with distinct calibrated constants.
+LEG_HIER_REDUCE_SCATTER = "hier_reduce_scatter"
+LEG_DCN_ALL_REDUCE = "dcn_all_reduce"
+LEG_DCN_EXCHANGE = "dcn_exchange"
+LEG_HIER_ALL_GATHER = "hier_all_gather"
 LEG_KINDS = (LEG_REDUCE_SCATTER, LEG_ALL_GATHER, LEG_ALL_REDUCE,
              LEG_PPERMUTE_HOP, LEG_PSUM_GUARD, LEG_PS_EXCHANGE, LEG_UPDATE,
              LEG_FUSED_HOP, LEG_FUSED_DETECT, LEG_FUSED_UPDATE,
-             LEG_ALL_TO_ALL)
+             LEG_ALL_TO_ALL, LEG_HIER_REDUCE_SCATTER, LEG_DCN_ALL_REDUCE,
+             LEG_DCN_EXCHANGE, LEG_HIER_ALL_GATHER)
 #: kinds that issue wire traffic (every rank must agree on these).
 COLLECTIVE_KINDS = (LEG_REDUCE_SCATTER, LEG_ALL_GATHER, LEG_ALL_REDUCE,
                     LEG_PPERMUTE_HOP, LEG_PSUM_GUARD, LEG_PS_EXCHANGE,
-                    LEG_FUSED_HOP, LEG_ALL_TO_ALL)
+                    LEG_FUSED_HOP, LEG_ALL_TO_ALL,
+                    LEG_HIER_REDUCE_SCATTER, LEG_DCN_ALL_REDUCE,
+                    LEG_DCN_EXCHANGE, LEG_HIER_ALL_GATHER)
+#: the two network tiers a leg can ride; ``""`` = the (single-tier)
+#: default, serialized away so pre-hier programs keep their recorded
+#: fingerprints.
+TIER_ICI = "ici"
+TIER_DCN = "dcn"
+#: the hierarchical leg vocabulary and its cross-slice (DCN) subset.
+HIER_KINDS = (LEG_HIER_REDUCE_SCATTER, LEG_DCN_ALL_REDUCE,
+              LEG_DCN_EXCHANGE, LEG_HIER_ALL_GATHER)
+DCN_KINDS = (LEG_DCN_ALL_REDUCE, LEG_DCN_EXCHANGE)
 #: ppermute ring-hop kinds — one chain grammar, fused or not.
 RING_HOP_KINDS = (LEG_PPERMUTE_HOP, LEG_FUSED_HOP)
 #: leg kind each fused kernel name lowers to (the consistency contract
@@ -248,6 +282,10 @@ class Leg:
     chain: str = ""
     stage: str = ""
     sig: str = ""
+    #: network tier (:data:`TIER_ICI`/:data:`TIER_DCN`) for hierarchical
+    #: legs; ``""`` (single-tier) is stripped from the serialized form
+    #: so every pre-hier program keeps its recorded fingerprint.
+    tier: str = ""
     deps: Tuple[str, ...] = ()
     reads: Tuple[str, ...] = ()
     writes: Tuple[str, ...] = ()
@@ -280,6 +318,11 @@ class ScheduleIR:
     #: programs) — carried so the verifier's capacity rule and the
     #: watermark see the routing config, not just the lowered legs.
     moe: Tuple["MoEFact", ...] = ()
+    #: second network tier: how many ICI slices the data axis spans
+    #: (DCN legs reduce over ``num_slices`` participants, ICI legs over
+    #: ``data/num_slices``).  1 = single-slice, serialized away so
+    #: pre-hier programs keep their fingerprints.
+    num_slices: int = 1
     version: int = IR_VERSION
 
     # -- decision surface (what the lowerings consume) --------------------
@@ -302,6 +345,13 @@ class ScheduleIR:
         return [tuple(kv) for kv in self.gather_order]
 
     # -- serialization -----------------------------------------------------
+    @staticmethod
+    def _leg_dict(l: Leg) -> dict:
+        d = asdict(l)
+        if not d.get("tier"):
+            d.pop("tier", None)     # single-tier legs serialize as before
+        return d
+
     def to_dict(self) -> dict:
         return {
             "version": self.version,
@@ -311,7 +361,7 @@ class ScheduleIR:
             "guard": bool(self.guard),
             "prefetch": bool(self.prefetch),
             "buckets": [dict(b) for b in self.buckets],
-            "legs": [asdict(l) for l in self.legs],
+            "legs": [self._leg_dict(l) for l in self.legs],
             "gather_order": [list(kv) for kv in self.gather_order],
             "donated": list(self.donated),
             # Omitted when empty so every pre-fusion program keeps its
@@ -322,6 +372,9 @@ class ScheduleIR:
             # Same omit-when-empty contract: every non-MoE program's
             # fingerprint is untouched by the MoE extension.
             **({"moe": [asdict(m) for m in self.moe]} if self.moe else {}),
+            # Omit-when-1: single-slice programs keep their fingerprints.
+            **({"num_slices": int(self.num_slices)}
+               if int(self.num_slices) > 1 else {}),
         }
 
     @classmethod
@@ -348,6 +401,7 @@ class ScheduleIR:
                 k: v for k, v in md.items()
                 if k in MoEFact.__dataclass_fields__})
                 for md in d.get("moe", ())),
+            num_slices=int(d.get("num_slices", 1)),
             version=int(d.get("version", IR_VERSION)))
 
     def to_json(self, indent: Optional[int] = None) -> str:
@@ -410,6 +464,11 @@ class PlanFact:
     staleness: int = 0
     partitioned: bool = False
     padded: bool = False
+    #: two-tier hierarchical sync requested (takes effect only when the
+    #: program's ``num_slices`` makes :func:`hier_applies` true AND the
+    #: variable's bucket is linear-compressor — quantized gradient wires
+    #: keep the flat lowering, the DCN leg owns its own wire knob).
+    hier: bool = False
 
     @property
     def nbytes(self) -> int:
@@ -424,7 +483,8 @@ class PlanFact:
         return "|".join(str(x) for x in (
             self.sync_kind, self.compressor or "NoneCompressor",
             bool(self.fused), int(self.group), self.sync_mode,
-            int(self.staleness), bool(self.partitioned)))
+            int(self.staleness), bool(self.partitioned))
+            + (("hier",) if self.hier else ()))
 
 
 def plan_route(fact: PlanFact) -> Tuple[bool, bool]:
@@ -442,7 +502,7 @@ def plan_route(fact: PlanFact) -> Tuple[bool, bool]:
                       fact.padded, fact.compressor) is None)
     explicit = overlap_mod.explicit_hint(
         fact.compressor, fact.sync_mode, fact.bucket_bytes,
-        fused=fact.fused, overlap=fact.overlap)
+        fused=fact.fused, overlap=fact.overlap, hier=fact.hier)
     return bucketable, explicit
 
 
@@ -458,7 +518,8 @@ def fact_from_planlite(name: str, plan: Any) -> PlanFact:
         overlap=getattr(plan, "overlap", overlap_mod.OVERLAP_AUTO) or
         overlap_mod.OVERLAP_AUTO,
         staleness=int(getattr(plan, "staleness", 0) or 0),
-        partitioned=bool(plan.placement), padded=plan.pad is not None)
+        partitioned=bool(plan.placement), padded=plan.pad is not None,
+        hier=bool(getattr(plan, "hier", False)))
 
 
 def fact_from_varplan(plan: Any, var_info: Any) -> PlanFact:
@@ -475,7 +536,8 @@ def fact_from_varplan(plan: Any, var_info: Any) -> PlanFact:
         overlap_mod.OVERLAP_AUTO,
         staleness=int(getattr(plan, "staleness", 0) or 0),
         partitioned=plan.param_spec != P(),
-        padded=getattr(plan, "pad_axis", None) is not None)
+        padded=getattr(plan, "pad_axis", None) is not None,
+        hier=bool(getattr(plan, "hier", False)))
 
 
 # -- MoE expert-routing facts (mesh-free, shared by runtime + analysis) ------
@@ -595,6 +657,31 @@ def moe_capacity_factor_default() -> float:
         return val if val > 0 else 2.0
     except ValueError:
         return 2.0
+
+
+def hier_applies(d: int, num_slices: int) -> bool:
+    """Does the two-tier hierarchy actually factor this data axis?  THE
+    shared gate (runtime lowering, ``ir_from_facts``, beam search, the
+    ``--simulate`` sweep): ``num_slices`` > 1 slices that evenly divide
+    the axis, with at least 2 chips per slice (a 1-chip slice has no
+    ICI stage — that degenerates to the flat DCN collective)."""
+    d = max(int(d), 1)
+    s = max(int(num_slices), 1)
+    return s > 1 and d % s == 0 and d // s > 1
+
+
+def dcn_wire_compressor_default() -> str:
+    """The DCN wire knob: ``AUTODIST_DCN_WIRE=int8`` puts the
+    cross-slice shard exchange on the quantized wire
+    (``quant_ring.quantize_blocks`` — a fresh per-chunk scale grid per
+    step, stateless, no error feedback; DCN is exactly where the 4x
+    compression pays most); anything else is the full-precision wire.
+    Read by every hier leg producer (explicit lowering,
+    ``ir_from_facts``, bench modes) so one env knob keeps all
+    fingerprints in agreement."""
+    import os
+    wire = os.environ.get("AUTODIST_DCN_WIRE", "").strip().lower()
+    return "Int8Compressor" if wire == "int8" else "NoneCompressor"
 
 
 def moe_wire_compressor_default() -> str:
@@ -753,7 +840,9 @@ def build_schedule_ir(*, axes: Dict[str, int], accum_steps: int = 1,
                       stateful_keys: Iterable[str] = (),
                       per_var_alg: str = ALG_FUSED,
                       fused_kernels: Sequence[str] = (),
-                      moe: Sequence[MoEFact] = ()) -> ScheduleIR:
+                      moe: Sequence[MoEFact] = (),
+                      num_slices: int = 1,
+                      hier_keys: Iterable[str] = ()) -> ScheduleIR:
     """Build the schedule program for one step.
 
     Pure: consumes exactly the planner's outputs (``buckets`` from
@@ -766,9 +855,18 @@ def build_schedule_ir(*, axes: Dict[str, int], accum_steps: int = 1,
     lists the donated sync-state buffer names (``sync:<key>``);
     ``fused_kernels`` the ACTIVE fused Pallas kernels (already
     drop-filtered — ``ops.fused_kernels.resolve_fused``), which switch
-    the affected legs to their fused kinds (docs/kernels.md)."""
+    the affected legs to their fused kinds (docs/kernels.md).
+    ``num_slices``/``hier_keys`` select the two-tier hierarchical
+    lowering: buckets named in ``hier_keys`` (linear-compressor only —
+    the caller gates) reduce slice-locally over ICI, exchange over DCN,
+    and gather back, when :func:`hier_applies` holds."""
     axes = {str(k): int(v) for k, v in axes.items()}
     d = max(int(axes.get(MESH_AXIS_DATA, 1)), 1)
+    hier_on = hier_applies(d, num_slices)
+    s = max(int(num_slices), 1) if hier_on else 1
+    d_in = d // s
+    hier_set = set(hier_keys) if hier_on else set()
+    dcn_comp = dcn_wire_compressor_default()
     accum = max(int(accum_steps), 1)
     buckets = sorted(buckets, key=lambda b: b.order)
     if plan is None:
@@ -834,9 +932,17 @@ def build_schedule_ir(*, axes: Dict[str, int], accum_steps: int = 1,
         rs = b.mode == MODE_REDUCE_SCATTER
         linear = overlap_mod.is_linear_compressor(b.compressor)
         qfmt = quant_ring.wire_format_of(b.compressor or "")
+        # Two-tier hierarchical lowering: linear-compressor buckets the
+        # caller named.  A quantized gradient wire keeps the flat path
+        # (its per-hop error-feedback contract has no two-level form);
+        # the DCN leg's own wire knob quantizes the cross-slice shard.
+        hier = b.key in hier_set and linear and qfmt is None
         # The reduce lowering — the EXACT rule bucket_reduce_fn (linear)
         # / quant_bucket_reduce (quantized wire) applies.
-        if linear and plan.ring and d > 1 and b.nbytes >= plan.ring_threshold:
+        if hier:
+            alg = ALG_ONE_SHOT
+        elif linear and plan.ring and d > 1 \
+                and b.nbytes >= plan.ring_threshold:
             alg = ALG_RING
         elif linear and plan.one_shot_small and d > 1 and not rs:
             alg = ALG_ONE_SHOT
@@ -848,9 +954,12 @@ def build_schedule_ir(*, axes: Dict[str, int], accum_steps: int = 1,
         pipelined = bool(
             plan.pipeline and accum > 1
             and overlap_mod.pipeline_eligible(b, plan.mode, accum))
-        gather_alg = (ALG_RING if plan.ring and d > 1
-                      and b.nbytes >= plan.ring_threshold else ALG_FUSED) \
-            if rs else ""
+        if rs:
+            gather_alg = ALG_ONE_SHOT if hier else (
+                ALG_RING if plan.ring and d > 1
+                and b.nbytes >= plan.ring_threshold else ALG_FUSED)
+        else:
+            gather_alg = ""
         stage = _bucket_stage(b)
         # Quantized wire accounting (docs/schedule-ir.md): a quantized
         # leg's nbytes is the HONEST transfer — 1-byte/elem payload plus
@@ -892,12 +1001,50 @@ def build_schedule_ir(*, axes: Dict[str, int], accum_steps: int = 1,
             # fused-kernel hop boundary (omitted when off so every
             # pre-fusion bucket node — and fingerprint — is unchanged)
             **({"hop_fused": True} if hop_fused else {}),
+            # two-tier lowering flag (same omit-when-off contract)
+            **({"hier": True} if hier else {}),
         })
         slots = list(range(accum)) if pipelined else [END_OF_STEP]
         for slot in slots:
             reads = (f"grad:{b.key}",) + state
             writes = (f"red:{b.key}",) + state
-            if alg == ALG_RING:
+            if hier:
+                # ICI -> DCN (-> ICI) per bucket: slice-local reduce-
+                # scatter, cross-slice shard exchange, slice-local
+                # gather (plain AR only — ZeRO-1 keeps the 1/d owner
+                # sub-shard for the update and gathers after it).
+                dcn_fmt = quant_ring.wire_format_of(dcn_comp)
+                shard_elems = int(b.padded_total) // d_in
+                dcn_nb = quant_ring.wire_nbytes(shard_elems, dcn_fmt) \
+                    if dcn_fmt is not None else int(b.nbytes) // d_in
+                rs_leg = em.emit(
+                    id=f"{b.key}@{slot}/hier_rs",
+                    kind=LEG_HIER_REDUCE_SCATTER, bucket=b.key,
+                    dtype=b.dtype, nbytes=int(b.nbytes),
+                    axis=MESH_AXIS_DATA, slot=slot,
+                    compressor=b.compressor or "NoneCompressor",
+                    alg=ALG_ONE_SHOT, stage=stage, sig=_bucket_sig(b),
+                    tier=TIER_ICI, reads=reads, writes=writes)
+                dcn_leg = em.emit(
+                    id=f"{b.key}@{slot}/dcn",
+                    kind=LEG_DCN_EXCHANGE if rs else LEG_DCN_ALL_REDUCE,
+                    bucket=b.key, dtype=b.dtype, nbytes=dcn_nb,
+                    axis=MESH_AXIS_DATA, slot=slot, compressor=dcn_comp,
+                    alg=ALG_ONE_SHOT, stage=stage, sig=_bucket_sig(b),
+                    tier=TIER_DCN, deps=(rs_leg.id,),
+                    reads=(f"red:{b.key}",), writes=writes)
+                last = dcn_leg
+                if not rs:
+                    last = em.emit(
+                        id=f"{b.key}@{slot}/hier_ag",
+                        kind=LEG_HIER_ALL_GATHER, bucket=b.key,
+                        dtype=b.dtype, nbytes=int(b.nbytes),
+                        axis=MESH_AXIS_DATA, slot=slot,
+                        compressor="NoneCompressor", alg=ALG_ONE_SHOT,
+                        stage=stage, sig=_bucket_sig(b), tier=TIER_ICI,
+                        deps=(dcn_leg.id,),
+                        reads=(f"red:{b.key}",), writes=writes)
+            elif alg == ALG_RING:
                 if rs:
                     last = _ring_chain(
                         em, chain=f"{b.key}@{slot}/rs", b=b, d=d,
@@ -1004,7 +1151,27 @@ def build_schedule_ir(*, axes: Dict[str, int], accum_steps: int = 1,
         for b in overlap_mod.gather_schedule(rs_buckets, plan.prefetch):
             n = by_key[b.key]
             gather_order.append((b.key, n["gather_alg"]))
-            if n["gather_alg"] == ALG_RING:
+            if n.get("hier"):
+                # Two-tier ZeRO-1 gather, full precision (the update ran
+                # on the dequantized owner sub-shard): cross-slice DCN
+                # gather reassembles each slice-chunk, then the ICI
+                # gather reassembles the full flat parameter vector.
+                g1 = em.emit(
+                    id=f"{b.key}@gather/dcn", kind=LEG_HIER_ALL_GATHER,
+                    bucket=b.key, dtype=b.dtype,
+                    nbytes=int(b.nbytes) // d_in,
+                    axis=MESH_AXIS_DATA, slot=END_OF_STEP,
+                    alg=ALG_ONE_SHOT, stage=n["stage"], sig=_bucket_sig(b),
+                    tier=TIER_DCN, deps=(update_of[b.key],),
+                    reads=(f"param:{b.key}",), writes=(f"param:{b.key}",))
+                em.emit(
+                    id=f"{b.key}@gather/ici", kind=LEG_HIER_ALL_GATHER,
+                    bucket=b.key, dtype=b.dtype, nbytes=int(b.nbytes),
+                    axis=MESH_AXIS_DATA, slot=END_OF_STEP,
+                    alg=ALG_ONE_SHOT, stage=n["stage"], sig=_bucket_sig(b),
+                    tier=TIER_ICI, deps=(g1.id,),
+                    reads=(f"param:{b.key}",), writes=(f"param:{b.key}",))
+            elif n["gather_alg"] == ALG_RING:
                 # Fresh parameters gather FULL PRECISION whatever the
                 # gradient wire was (ZeRO-1 updates from the dequantized
                 # shard) — tag the chain accordingly.
@@ -1027,13 +1194,14 @@ def build_schedule_ir(*, axes: Dict[str, int], accum_steps: int = 1,
         axes=axes, accum_steps=accum, overlap_mode=plan.mode, guard=guard,
         prefetch=bool(plan.prefetch), buckets=bucket_nodes, legs=em.legs,
         gather_order=gather_order, donated=tuple(donated),
-        fused_kernels=fused, moe=tuple(moe))
+        fused_kernels=fused, moe=tuple(moe), num_slices=s)
 
 
 def facts_fingerprint(facts: Sequence[PlanFact], *, axes: Dict[str, int],
                       accum_steps: int = 1, guard: bool = False,
                       fused_kernels: Sequence[str] = (),
-                      moe: Sequence[MoEFact] = ()) -> str:
+                      moe: Sequence[MoEFact] = (),
+                      num_slices: int = 1) -> str:
     """Short stable hash of a candidate's full :func:`ir_from_facts`
     input — the strategy search's dedupe key.  Two candidates with
     identical fact sets build byte-identical IRs (the builder is pure),
@@ -1049,6 +1217,9 @@ def facts_fingerprint(facts: Sequence[PlanFact], *, axes: Dict[str, int],
         **({"moe": [asdict(m)
                     for m in sorted(moe, key=lambda m: m.key)]}
            if moe else {}),
+        # Omit-when-1: single-slice candidates keep their dedupe keys.
+        **({"num_slices": int(num_slices)}
+           if int(num_slices) > 1 else {}),
     }, sort_keys=True, separators=(",", ":")).encode()
     return hashlib.sha256(blob).hexdigest()[:12]
 
@@ -1056,7 +1227,8 @@ def facts_fingerprint(facts: Sequence[PlanFact], *, axes: Dict[str, int],
 def ir_from_facts(facts: Sequence[PlanFact], *, axes: Dict[str, int],
                   accum_steps: int = 1, guard: bool = False,
                   fused_kernels: Sequence[str] = (),
-                  moe: Sequence[MoEFact] = ()) -> ScheduleIR:
+                  moe: Sequence[MoEFact] = (),
+                  num_slices: int = 1) -> ScheduleIR:
     """Mesh-free IR construction from per-variable plan facts — the
     analyzer's and the GSPMD transform's entry point.  Routing mirrors
     the runtime exactly: when any plan implies the explicit path
@@ -1103,12 +1275,20 @@ def ir_from_facts(facts: Sequence[PlanFact], *, axes: Dict[str, int],
     if explicit and not any(e.stateful for e in per_var):
         donated = tuple(f"sync:{k}" for k in stateful_buckets) \
             + (("sync:~numerics",) if guard else ())
+    # Hier bucket selection — the EXACT rule the runtime applies: a
+    # bucket lowers two-tier when every member variable requested it.
+    hier_by_name = {f.name: bool(f.hier) for f in facts}
+    hier_keys = [b.key for b in buckets
+                 if b.names and all(hier_by_name.get(n, False)
+                                    for n in b.names)] \
+        if hier_applies(d, num_slices) else []
     return build_schedule_ir(
         axes=axes, accum_steps=accum_steps, buckets=buckets, plan=plan,
         per_var=per_var, guard=guard, donated=donated,
         stateful_keys=stateful_buckets,
         per_var_alg=ALG_FUSED if explicit else ALG_PSUM_TREE,
-        fused_kernels=fused_kernels, moe=moe)
+        fused_kernels=fused_kernels, moe=moe,
+        num_slices=num_slices, hier_keys=hier_keys)
 
 
 # -- the static schedule verifier --------------------------------------------
@@ -1129,6 +1309,7 @@ RULE_RACE_WRITE = "schedule/race-unordered-write"
 RULE_RACE_READ_WRITE = "schedule/race-read-write"
 RULE_BUFFER_LEAK = "schedule/buffer-leak"
 RULE_CAPACITY_OVERFLOW = "moe/capacity-overflow"
+RULE_HIER_TIER_ORDER = "schedule/hier-tier-order"
 
 
 @dataclass(frozen=True)
@@ -1264,6 +1445,12 @@ def verify(ir: ScheduleIR) -> List[Violation]:
             # does not bind the pair (two quantized a2as per slot are
             # exactly the legal shape).
             continue
+        if l.tier == TIER_DCN:
+            # The DCN wire quantizes statelessly too (a fresh scale
+            # grid per cross-slice exchange, no error feedback) — the
+            # per-slot quantized contract does not bind it; the
+            # hier-tier-order rule below owns its shape.
+            continue
         capable = quant_ring.is_quant_ring_compressor(l.compressor)
         if l.kind in RING_HOP_KINDS:
             if not capable:
@@ -1391,10 +1578,146 @@ def verify(ir: ScheduleIR) -> List[Violation]:
         from autodist_tpu.analysis import dataflow
         out.extend(dataflow.race_violations(ir, order=order))
 
+    out.extend(_check_hier_tiers(ir, legs, pos))
     out.extend(_check_stage_sequences(legs, pos))
     # Deterministic diagnostics: CLI output and mutation goldens are
     # byte-stable across runs (and across set/dict iteration orders).
     out.sort(key=lambda v: (v.rule, v.leg, v.location, v.message))
+    return out
+
+
+def _check_hier_tiers(ir: ScheduleIR, legs: Sequence[Leg],
+                      pos: Dict[str, int]) -> List[Violation]:
+    """The two-tier ordering contract (``schedule/hier-tier-order``).
+
+    Per bucket and microbatch slot: a slice-local ``hier_reduce_scatter``
+    MUST be followed by exactly one cross-slice DCN leg (a missing one
+    means slices never exchange gradients — silent divergence), the DCN
+    leg must be ordered between its slice-local RS and AG, and the
+    ZeRO-1 variant's two-tier param gather must run DCN-then-ICI after
+    the shard exchange.  Tier tags must match kinds, and hier legs are
+    only legal on a program whose ``num_slices`` actually factors the
+    data axis."""
+    out: List[Violation] = []
+    hier_legs = [l for l in legs if l.kind in HIER_KINDS]
+    if not hier_legs:
+        return out
+    s = max(int(ir.num_slices), 1)
+    d = max(int(ir.axes.get(MESH_AXIS_DATA, 1)), 1)
+    if not hier_applies(d, s):
+        out.append(Violation(
+            RULE_HIER_TIER_ORDER, SEV_ERROR,
+            f"hierarchical legs on a program whose data axis ({d}) does "
+            f"not factor into num_slices={s} slices of >= 2 chips: "
+            "there is no (slice, within-slice) decomposition to run "
+            "them over", leg=hier_legs[0].id))
+    want_tier = {LEG_HIER_REDUCE_SCATTER: (TIER_ICI,),
+                 LEG_DCN_ALL_REDUCE: (TIER_DCN,),
+                 LEG_DCN_EXCHANGE: (TIER_DCN,),
+                 LEG_HIER_ALL_GATHER: (TIER_ICI, TIER_DCN)}
+    for l in legs:
+        tiers = want_tier.get(l.kind)
+        if tiers is not None and l.tier not in tiers:
+            out.append(Violation(
+                RULE_HIER_TIER_ORDER, SEV_ERROR,
+                f"leg {l.id!r} of kind {l.kind!r} carries tier "
+                f"{l.tier!r}; this kind rides "
+                f"{' or '.join(repr(t) for t in tiers)}", leg=l.id))
+        elif tiers is None and l.tier:
+            out.append(Violation(
+                RULE_HIER_TIER_ORDER, SEV_ERROR,
+                f"single-tier leg {l.id!r} ({l.kind}) carries tier tag "
+                f"{l.tier!r}: only hierarchical kinds are tiered",
+                leg=l.id))
+
+    groups: Dict[Tuple[str, int], List[Leg]] = {}
+    for l in hier_legs:
+        groups.setdefault((l.bucket, l.slot), []).append(l)
+    by_bucket: Dict[str, Dict[str, List[Leg]]] = {}
+    for (bucket, slot), ls in sorted(groups.items()):
+        rs_l = [l for l in ls if l.kind == LEG_HIER_REDUCE_SCATTER]
+        dcn_l = [l for l in ls if l.kind in DCN_KINDS]
+        ag_ici = [l for l in ls if l.kind == LEG_HIER_ALL_GATHER
+                  and l.tier == TIER_ICI]
+        bb = by_bucket.setdefault(bucket, {"ex": [], "ag_dcn": [],
+                                           "ag_ici": []})
+        bb["ex"].extend(l for l in dcn_l if l.kind == LEG_DCN_EXCHANGE)
+        bb["ag_dcn"].extend(l for l in ls
+                            if l.kind == LEG_HIER_ALL_GATHER
+                            and l.tier == TIER_DCN)
+        bb["ag_ici"].extend(ag_ici)
+        where = f"slot {slot}" if slot != END_OF_STEP else "end of step"
+        if rs_l and not dcn_l:
+            out.append(Violation(
+                RULE_HIER_TIER_ORDER, SEV_ERROR,
+                f"bucket {bucket!r} ({where}) reduce-scatters within "
+                "each slice but never exchanges the shards across "
+                "slices: replicas in different slices silently diverge",
+                location=bucket))
+            continue
+        if dcn_l and not rs_l:
+            out.append(Violation(
+                RULE_HIER_TIER_ORDER, SEV_ERROR,
+                f"bucket {bucket!r} ({where}) issues a cross-slice DCN "
+                "leg with no slice-local reduce-scatter before it: the "
+                "DCN wire would carry the full unreduced bucket",
+                location=bucket))
+            continue
+        if not dcn_l:
+            continue
+        if len(dcn_l) > 1:
+            out.append(Violation(
+                RULE_HIER_TIER_ORDER, SEV_ERROR,
+                f"bucket {bucket!r} ({where}) schedules {len(dcn_l)} "
+                "cross-slice DCN legs: the hierarchy owes exactly one "
+                "shard exchange per bucket per slot", location=bucket))
+        dcn0 = min(pos.get(l.id, 0) for l in dcn_l)
+        if rs_l and max(pos.get(l.id, 0) for l in rs_l) > dcn0:
+            out.append(Violation(
+                RULE_HIER_TIER_ORDER, SEV_ERROR,
+                f"bucket {bucket!r} ({where}) orders its cross-slice "
+                "DCN leg before the slice-local reduce-scatter "
+                "finishes: the exchange would ship unreduced data",
+                location=bucket))
+        if any(l.kind == LEG_DCN_ALL_REDUCE for l in dcn_l):
+            if not ag_ici:
+                out.append(Violation(
+                    RULE_HIER_TIER_ORDER, SEV_ERROR,
+                    f"bucket {bucket!r} ({where}) exchanges shards over "
+                    "DCN but never all-gathers them back within the "
+                    "slice: every chip keeps only 1/slice-size of the "
+                    "reduced gradient", location=bucket))
+            elif min(pos.get(l.id, 0) for l in ag_ici) < \
+                    max(pos.get(l.id, 0) for l in dcn_l):
+                out.append(Violation(
+                    RULE_HIER_TIER_ORDER, SEV_ERROR,
+                    f"bucket {bucket!r} ({where}) orders the slice-"
+                    "local all-gather before the cross-slice exchange: "
+                    "the gather would replicate slice-partial sums",
+                    location=bucket))
+    # ZeRO-1 variant: the two-tier param gather (DCN then ICI) must
+    # follow the shard exchange at the bucket level (gathers are
+    # end-of-step while pipelined exchanges are per-slot).
+    for bucket, bb in sorted(by_bucket.items()):
+        if not bb["ex"]:
+            continue
+        ex_last = max(pos.get(l.id, 0) for l in bb["ex"])
+        if not bb["ag_dcn"] or not bb["ag_ici"]:
+            out.append(Violation(
+                RULE_HIER_TIER_ORDER, SEV_ERROR,
+                f"bucket {bucket!r} exchanges ZeRO-1 shards over DCN "
+                "but lacks the two-tier param gather (DCN then ICI): "
+                "parameters are never reassembled", location=bucket))
+            continue
+        ag_dcn = min(pos.get(l.id, 0) for l in bb["ag_dcn"])
+        ag_ici = min(pos.get(l.id, 0) for l in bb["ag_ici"])
+        if not (ex_last < ag_dcn < ag_ici):
+            out.append(Violation(
+                RULE_HIER_TIER_ORDER, SEV_ERROR,
+                f"bucket {bucket!r}: the ZeRO-1 two-tier gather must "
+                "run cross-slice (DCN) then within-slice (ICI) after "
+                "the shard exchange; this program orders them "
+                "otherwise", location=bucket))
     return out
 
 

@@ -26,6 +26,8 @@ Example yaml::
         shared_envs: {TPU_NAME: my-pod}
     network_bandwidth: 100   # Gbps, used by load-balancing strategies
     hbm_gb: 16               # per-chip HBM budget (pre-flight analyzer)
+    num_slices: 2            # optional: two-tier pod = slices joined by DCN
+    dcn_gbps: 25             # optional: cross-slice DCN bandwidth per stream
     mesh:                    # optional
       data: 4
       model: 2
@@ -111,6 +113,30 @@ class ResourceSpecError(ValueError):
     pass
 
 
+#: Single source of truth for the slice/device divisibility rule — quoted by
+#: both the session-build fail-fast (``ResourceSpec._validate``) and the
+#: static analyzer (``autodist_tpu/analysis/legality.py``).
+RULE_SLICE_MISMATCH = "legality/slice-mismatch"
+
+
+def slice_mismatch_reason(num_devices: int, num_slices: int) -> Optional[str]:
+    """Reason string when ``num_slices`` cannot tile ``num_devices``, else None.
+
+    A two-tier (ICI within a slice, DCN across slices) topology only makes
+    sense when every slice holds the same whole number of chips; a slice count
+    that does not divide the device count would leave a ragged slice whose
+    cross-slice exchange has no peer.
+    """
+    if num_slices <= 1:
+        return None
+    if num_devices <= 0:
+        return None  # device count unknown at this point; checked elsewhere
+    if num_devices % num_slices != 0:
+        return (f"{RULE_SLICE_MISMATCH}: num_slices={num_slices} does not "
+                f"divide device count {num_devices}")
+    return None
+
+
 class ResourceSpec:
     """Parsed cluster description.
 
@@ -126,6 +152,11 @@ class ResourceSpec:
         self.network_bandwidth_gbps: float = 1.0
         self.ici_connected: bool = False
         self.mesh_hint: Dict[str, int] = {}
+        # Second network tier: a pod is `num_slices` ICI-connected slices
+        # joined by data-center network at `dcn_gbps` per chip-pair stream.
+        # num_slices=1 means the flat single-slice model (all pre-hier specs).
+        self.num_slices: int = 1
+        self.dcn_gbps: Optional[float] = None
         # Per-chip HBM budget in GiB (yaml `hbm_gb`): consumed by the
         # static analyzer's pre-flight footprint check
         # (autodist_tpu/analysis/memory.py).  None = no budget declared.
@@ -191,6 +222,16 @@ class ResourceSpec:
         # defining difference from the reference's GPU clusters.  Yaml key:
         # `ici_connected: true`.
         self.ici_connected = bool(info.get("ici_connected", False))
+        if info.get("num_slices") is not None:
+            self.num_slices = int(info["num_slices"])
+            if self.num_slices < 1:
+                raise ResourceSpecError(
+                    f"num_slices must be >= 1, got {self.num_slices}")
+        if info.get("dcn_gbps") is not None:
+            self.dcn_gbps = float(info["dcn_gbps"])
+            if self.dcn_gbps <= 0:
+                raise ResourceSpecError(
+                    f"dcn_gbps must be positive, got {self.dcn_gbps}")
         if info.get("hbm_gb") is not None:
             self.hbm_gb = float(info["hbm_gb"])
             if self.hbm_gb <= 0:
@@ -226,6 +267,9 @@ class ResourceSpec:
             if n.ssh_config and n.ssh_config not in self._ssh_configs:
                 raise ResourceSpecError(f"node {n.address} names unknown ssh config "
                                         f"{n.ssh_config!r}")
+        reason = slice_mismatch_reason(self.num_chips, self.num_slices)
+        if reason is not None:
+            raise ResourceSpecError(reason)
 
     # -- queries -----------------------------------------------------------
     @property
@@ -254,6 +298,15 @@ class ResourceSpec:
     @property
     def num_chips(self) -> int:
         return sum(n.chips for n in self._nodes)
+
+    @property
+    def dcn_bytes_per_s(self) -> Optional[float]:
+        """Declared cross-slice DCN bandwidth in bytes/s (None when the spec
+        does not carry one) — the per-tier constant used to price ``dcn``
+        legs before any fitted calibration exists."""
+        if self.dcn_gbps is None:
+            return None
+        return self.dcn_gbps * 1e9 / 8.0
 
     @property
     def hbm_bytes_per_chip(self) -> Optional[int]:

@@ -40,12 +40,18 @@ class AllReduce(StrategyBuilder):
     the way to get trace-time bucketing without a compressor).
 
     ``overlap`` picks the bucket-collective schedule (``docs/overlap.md``):
-    ``"auto"`` | ``"none"`` | ``"pipeline"`` | ``"ring"`` | ``"full"``."""
+    ``"auto"`` | ``"none"`` | ``"pipeline"`` | ``"ring"`` | ``"full"``.
+
+    ``hier=True`` requests the two-tier ICI+DCN lowering on multi-slice
+    resource specs (``resource_spec.num_slices > 1``): slice-local
+    reduce-scatter, one cross-slice DCN leg, slice-local all-gather.
+    No-op on single-slice specs."""
 
     def __init__(self, chunk_size: int = 128, all_reduce_spec: str = "AUTO",
                  compressor: str = "NoneCompressor",
                  fused_groups: bool = False, sync: str = "all_reduce",
-                 bucket_bytes: int = 0, overlap: str = "auto"):
+                 bucket_bytes: int = 0, overlap: str = "auto",
+                 hier: bool = False):
         if chunk_size < 1:
             raise ValueError("chunk_size must be >= 1")
         from autodist_tpu.kernel.synchronization.bucketing import SYNC_MODES
@@ -64,6 +70,7 @@ class AllReduce(StrategyBuilder):
         self._sync = sync
         self._bucket_bytes = bucket_bytes
         self._overlap = overlap
+        self._hier = hier
 
     def build(self, graph_item: GraphItem, resource_spec: ResourceSpec) -> Strategy:
         node_config = [
@@ -77,6 +84,7 @@ class AllReduce(StrategyBuilder):
                     sync=self._sync,
                     bucket_bytes=self._bucket_bytes,
                     overlap=self._overlap,
+                    hier=self._hier,
                 ),
             )
             for i, var in enumerate(graph_item.trainable_var_infos)

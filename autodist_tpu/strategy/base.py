@@ -80,6 +80,11 @@ class AllReduceSynchronizerConfig:
     sync: str = "all_reduce"  # all_reduce | reduce_scatter (ZeRO-1)
     bucket_bytes: int = 0     # gradient-bucket size cap (0 = default)
     overlap: str = "auto"     # auto | none | pipeline | ring | full
+    # Two-tier hierarchical sync: reduce-scatter within each ICI slice,
+    # exchange across slices over DCN, all-gather back.  Only takes
+    # effect on a multi-slice ResourceSpec (num_slices > 1) whose slice
+    # count tiles the data axis; routes through the explicit path.
+    hier: bool = False
 
     kind: str = "AllReduce"
 

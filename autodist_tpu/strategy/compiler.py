@@ -124,6 +124,10 @@ class VarPlan:
     # partitioner (kernel/partitioner.py:376-426).
     pad_axis: Optional[int] = None
     pad_dim: int = 0
+    # Two-tier hierarchical sync (ICI within a slice, DCN across): the
+    # explicit path lowers this var's bucket as RS-within → exchange-
+    # across → AG-within when the CompiledStrategy carries num_slices>1.
+    hier: bool = False
 
 
 @dataclass
@@ -134,6 +138,9 @@ class CompiledStrategy:
     mesh: Mesh
     var_plans: Dict[str, VarPlan]
     batch_axes: Tuple[str, ...] = (MESH_AXIS_DATA,)
+    # Slice count of the two-tier topology (from ResourceSpec.num_slices;
+    # 1 = flat single-slice mesh — all pre-hier behavior).
+    num_slices: int = 1
 
     @property
     def data_axis_size(self) -> int:
@@ -231,6 +238,9 @@ class StrategyCompiler:
     def __init__(self, mesh: Mesh, resource_spec=None):
         self.mesh = mesh
         self._host_to_data_coord = self._build_host_map(resource_spec)
+        # Two-tier topology (validated against the device count at
+        # ResourceSpec build; re-checked per-mesh by hier_applies).
+        self.num_slices = int(getattr(resource_spec, "num_slices", 1) or 1)
 
     def _build_host_map(self, resource_spec) -> Dict[str, int]:
         """Map node address → the data-axis coordinate of its first chip,
@@ -366,7 +376,8 @@ class StrategyCompiler:
                     var_name=name, sync_kind="AllReduce", param_spec=spec,
                     opt_spec=spec, grad_reduce_axes=grad_axes)
         return CompiledStrategy(strategy=strategy, mesh=self.mesh,
-                                var_plans=plans, batch_axes=grad_axes)
+                                var_plans=plans, batch_axes=grad_axes,
+                                num_slices=self.num_slices)
 
     def _structural_spec(self, var: VarInfo, spec: P, target: int,
                          mesh_axis: str, label: str) -> P:
@@ -436,6 +447,7 @@ class StrategyCompiler:
                 or "all_reduce",
                 bucket_bytes=int(getattr(sync, "bucket_bytes", 0) or 0),
                 overlap=getattr(sync, "overlap", "auto") or "auto",
+                hier=bool(getattr(sync, "hier", False)),
                 partition_axis=axis if model_axis else None,
                 num_shards=num_shards if model_axis else 1,
                 sparse=var.sparse,

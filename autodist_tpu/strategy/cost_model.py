@@ -69,6 +69,10 @@ from autodist_tpu.utils import logging
 # Effective per-chip bandwidths (bytes/sec) and collective launch latency.
 # ICI default ≈ v5e neighbor-link effective bandwidth; override per call.
 ICI_BANDWIDTH = 45e9
+# Cross-slice data-center network default (≈ 200 Gbps per chip-pair
+# stream = 25 GB/s): the clock for `tier: dcn` legs when neither a
+# fitted calibration nor a ResourceSpec `dcn_gbps` overrides it.
+DCN_BANDWIDTH = 25e9
 COLLECTIVE_ALPHA = 5e-6
 # Per-chip HBM bandwidth (v5e ≈ 810 GB/s): clocks the optimizer-update
 # memory traffic term — the weight update is bandwidth-bound (read+write
@@ -137,6 +141,12 @@ class CostReport:
     # Wire bytes left on the critical path after the overlap schedule
     # (== wire_bytes when nothing overlaps).
     exposed_wire_bytes: float = 0.0
+    # Per-network-tier wire accounting (filled by estimate_ir_cost):
+    # keys "ici" / "dcn"; flat single-tier programs book everything
+    # under "ici".  The `--simulate` sweep and the search explain
+    # surface read these to show WHERE the exposed bytes travel.
+    wire_by_tier: Dict[str, float] = field(default_factory=dict)
+    exposed_wire_by_tier: Dict[str, float] = field(default_factory=dict)
     # Per-leg-kind exposed seconds (filled by estimate_ir_cost only —
     # the plan-level estimate has no legs to attribute): the breakdown
     # the search explain surface prints.
@@ -235,6 +245,13 @@ def estimate_cost(strategy: Strategy, graph_item: GraphItem,
     multi_node = (resource_spec.num_nodes > 1
                   and not resource_spec.ici_connected)
     dcn = resource_spec.network_bandwidth_gbps * 1e9 / 8
+    # A multi-slice pod bottlenecks flat collectives on the cross-slice
+    # DCN tier regardless of ici_connected — the plan-level estimate has
+    # no hierarchical legs, so the honest flat price uses the DCN clock.
+    if getattr(resource_spec, "num_slices", 1) > 1:
+        multi_node = True
+        if resource_spec.dcn_gbps is not None:
+            dcn = resource_spec.dcn_bytes_per_s
     bandwidth = min(ici_bandwidth, dcn) if multi_node else ici_bandwidth
 
     from autodist_tpu.kernel.synchronization import overlap as ov
@@ -267,7 +284,8 @@ def estimate_cost(strategy: Strategy, graph_item: GraphItem,
             explicit = ov.explicit_hint(
                 sync.compressor, mode,
                 getattr(sync, "bucket_bytes", 0),
-                fused=getattr(sync, "fused", False), overlap=ov_mode)
+                fused=getattr(sync, "fused", False), overlap=ov_mode,
+                hier=getattr(sync, "hier", False))
             pipelined = ov.pipeline_applies(
                 ov_mode, accum_steps=accum, compressor=sync.compressor,
                 bucketable=bucketable, explicit_path=explicit,
@@ -372,16 +390,66 @@ def estimate_cost(strategy: Strategy, graph_item: GraphItem,
     return report
 
 
+def leg_participants(leg, ir) -> int:
+    """Device count a leg's ring spans — the ``d`` of its byte algebra.
+
+    Flat legs span the full mesh axis.  Hierarchical legs split the axis
+    by the IR's ``num_slices``: ``tier: ici`` legs ring over the
+    within-slice group (``d // num_slices``), ``tier: dcn`` legs over
+    one representative per slice (``num_slices`` peers)."""
+    from autodist_tpu.kernel.synchronization import schedule_ir as sir
+
+    d = max(int(ir.axes.get(leg.axis, 1)), 1) if leg.axis else 1
+    tier = getattr(leg, "tier", "")
+    s = max(int(getattr(ir, "num_slices", 1) or 1), 1)
+    if tier == sir.TIER_DCN:
+        return max(s, 1)
+    if tier == sir.TIER_ICI and s > 1 and d % s == 0:
+        return max(d // s, 1)
+    return d
+
+
+def leg_tier(leg, ir) -> str:
+    """Network tier a leg's wire actually traverses.
+
+    Tiered (hierarchical) legs carry their tier explicitly.  An
+    UNTIERED collective on the data axis of a multi-slice program is a
+    flat ring spanning slice boundaries — its throughput is bound by
+    the DCN crossings, so it prices (and books its wire) as DCN.  This
+    is the term that makes the hierarchy win exactly when it should:
+    the flat alternative pays full ring volume at DCN speed, the
+    two-tier lowering pays only the 1/d_in cross-slice exchange there."""
+    from autodist_tpu.const import MESH_AXIS_DATA
+    from autodist_tpu.kernel.synchronization import schedule_ir as sir
+
+    tier = getattr(leg, "tier", "")
+    if tier:
+        return tier
+    s = max(int(getattr(ir, "num_slices", 1) or 1), 1)
+    if s > 1 and leg.axis == MESH_AXIS_DATA:
+        d = max(int(ir.axes.get(leg.axis, 1)), 1)
+        if d % s == 0 and d > s:
+            return sir.TIER_DCN
+    return sir.TIER_ICI
+
+
 def _leg_wire_bytes(leg, d: int) -> float:
     """One leg's per-device wire bytes under the ring algebra (hop legs
-    already carry per-hop bytes; the guard psum is scalar-sized)."""
+    already carry per-hop bytes; the guard psum is scalar-sized).
+    ``d`` is the leg's OWN participant count (:func:`leg_participants`)
+    — within-slice group size for ``tier: ici`` legs, slice count for
+    ``tier: dcn`` legs — so hierarchical legs price their honest
+    per-tier traffic."""
     from autodist_tpu.kernel.synchronization import schedule_ir as sir
 
     if leg.kind in sir.RING_HOP_KINDS:
         return float(leg.nbytes)
-    if leg.kind in (sir.LEG_ALL_REDUCE, sir.LEG_PS_EXCHANGE):
+    if leg.kind in (sir.LEG_ALL_REDUCE, sir.LEG_PS_EXCHANGE,
+                    sir.LEG_DCN_ALL_REDUCE):
         return allreduce_bytes(float(leg.nbytes), d)
-    if leg.kind in (sir.LEG_REDUCE_SCATTER, sir.LEG_ALL_GATHER):
+    if leg.kind in (sir.LEG_REDUCE_SCATTER, sir.LEG_ALL_GATHER,
+                    sir.LEG_HIER_REDUCE_SCATTER, sir.LEG_DCN_EXCHANGE,
+                    sir.LEG_HIER_ALL_GATHER):
         return reduce_scatter_bytes(float(leg.nbytes), d)
     if leg.kind == sir.LEG_ALL_TO_ALL:
         # Each device keeps its own 1/d slice and ships the other
@@ -391,20 +459,64 @@ def _leg_wire_bytes(leg, d: int) -> float:
     return float(leg.nbytes)
 
 
+#: Borrow source for UNFITTED leg kinds, in one place (new kinds declare
+#: theirs here instead of growing another if-chain in ``leg_cost_s``):
+#: when a calibration carries no constants for ``kind``, it is priced
+#: with the mapped kind's fitted constants instead of the optimistic
+#: defaults, resolved transitively (``fused_hop`` → ``ppermute_hop``;
+#: ``dcn_exchange`` → ``dcn_all_reduce`` → ``ps_exchange`` →
+#: ``all_reduce``).  Rationale per edge: a fused wire is the unfused
+#: wire; PS/WUS and expert a2a move an all-reduce's ring volume over the
+#: same links; the DCN kinds borrow the ps_exchange chain so an
+#: ICI-only calibration prices hierarchy pessimistically (never free).
+FALLBACK_KINDS = {
+    "fused_hop": "ppermute_hop",
+    "ps_exchange": "all_reduce",
+    "all_to_all": "all_reduce",
+    "dcn_all_reduce": "ps_exchange",
+    "dcn_exchange": "dcn_all_reduce",
+    "hier_reduce_scatter": "reduce_scatter",
+    "hier_all_gather": "all_gather",
+}
+
+
+def resolve_priced_kind(kind: str, constants) -> str:
+    """Kind whose fitted constants price ``kind``: itself when fitted,
+    else the first fitted ancestor along :data:`FALLBACK_KINDS`; the
+    original kind when the whole chain is unfitted (default pricing)."""
+    if constants is None or kind in constants.bandwidths:
+        return kind
+    seen = {kind}
+    cur = kind
+    while cur not in constants.bandwidths:
+        nxt = FALLBACK_KINDS.get(cur)
+        if nxt is None or nxt in seen:
+            return kind
+        seen.add(nxt)
+        cur = nxt
+    return cur
+
+
 def leg_cost_s(leg, ir, constants=None, *,
                ici_bandwidth: float = ICI_BANDWIDTH,
+               dcn_bandwidth: float = DCN_BANDWIDTH,
                alpha: float = COLLECTIVE_ALPHA) -> Optional[float]:
     """Price ONE schedule-IR leg: wire bytes / bandwidth + a launch
     alpha, per-kind when ``constants`` (a
     ``telemetry.calibration.LegCalibration``) is given, the global
     defaults otherwise.  Update legs price their HBM traffic (the
-    per-kind ``update`` bandwidth, or :data:`HBM_BANDWIDTH`).  Returns
-    None for a leg kind the model does not price.  This is the
+    per-kind ``update`` bandwidth, or :data:`HBM_BANDWIDTH`).  An
+    unfitted kind borrows its :data:`FALLBACK_KINDS` ancestor's fitted
+    constants (one declaration per kind, resolved transitively by
+    :func:`resolve_priced_kind`); with no fitted ancestor either, the
+    leg prices at the default clock for its tier —
+    ``ici_bandwidth``, or ``dcn_bandwidth`` for ``tier: dcn`` legs.
+    Returns None for a leg kind the model does not price.  This is the
     prediction half of every per-leg measured-vs-predicted pair
     (``telemetry.profiler.LegSample.predicted_s``)."""
     from autodist_tpu.kernel.synchronization import schedule_ir as sir
 
-    d = max(int(ir.axes.get(leg.axis, 1)), 1) if leg.axis else 1
+    d = leg_participants(leg, ir)
     if leg.kind in (sir.LEG_UPDATE, sir.LEG_FUSED_UPDATE,
                     sir.LEG_FUSED_DETECT):
         # HBM-bound local passes.  Fused kinds price through their OWN
@@ -423,43 +535,28 @@ def leg_cost_s(leg, ir, constants=None, *,
         return None
     wire = _leg_wire_bytes(leg, d)
     launches = 1 if (d > 1 or leg.kind == sir.LEG_PSUM_GUARD) else 0
-    kind = leg.kind
-    if constants is not None and kind not in constants.bandwidths \
-            and kind == sir.LEG_FUSED_HOP \
-            and sir.LEG_PPERMUTE_HOP in constants.bandwidths:
-        # Unfitted fused hops borrow the unfused hop constants — a
-        # calibration run that never measured the fused wire should
-        # not make it look free (or infinitely slow).
-        kind = sir.LEG_PPERMUTE_HOP
-    if constants is not None and kind not in constants.bandwidths \
-            and kind == sir.LEG_PS_EXCHANGE \
-            and sir.LEG_ALL_REDUCE in constants.bandwidths:
-        # Unfitted PS exchanges borrow the all-reduce constants: the
-        # PS/WUS lowering moves exactly an all-reduce's ring volume
-        # (module docstring), so a calibration run that never measured
-        # a PS plan must not let PS candidates win the strategy search
-        # on optimistic default pricing.
-        kind = sir.LEG_ALL_REDUCE
-    if constants is not None and kind not in constants.bandwidths \
-            and kind == sir.LEG_ALL_TO_ALL \
-            and sir.LEG_ALL_REDUCE in constants.bandwidths:
-        # Unfitted expert a2as borrow the all-reduce constants (the
-        # ps_exchange rule above): both lower to one fused XLA
-        # collective over the same ICI links, so a calibration run that
-        # never measured an MoE plan must not let expert-parallel
-        # candidates win (or lose) the search on default pricing.
-        kind = sir.LEG_ALL_REDUCE
+    kind = resolve_priced_kind(leg.kind, constants)
     if constants is not None and kind in constants.bandwidths:
-        t = wire / constants.bandwidths[kind]
+        bw_fit = constants.bandwidths[kind]
+        if leg_tier(leg, ir) == sir.TIER_DCN:
+            # The cross-slice ceiling is a TOPOLOGY parameter, not a
+            # collective property: a DCN-bound leg can never beat the
+            # spec's dcn bandwidth, however fast the fitted constant
+            # (measured on whatever fabric calibrated it) claims.
+            bw_fit = min(bw_fit, dcn_bandwidth)
+        t = wire / bw_fit
         if launches:
             t += constants.alphas.get(kind, COLLECTIVE_ALPHA)
         if sir.is_quantizing(leg.compressor):
             t += constants.quant_overhead_per_byte * wire
         return t
-    return wire / ici_bandwidth + alpha * launches
+    bw = dcn_bandwidth if leg_tier(leg, ir) == sir.TIER_DCN \
+        else ici_bandwidth
+    return wire / bw + alpha * launches
 
 
 def estimate_ir_cost(ir, *, ici_bandwidth: float = ICI_BANDWIDTH,
+                     dcn_bandwidth: float = DCN_BANDWIDTH,
                      alpha: float = COLLECTIVE_ALPHA,
                      compute_time_s: float = 0.0,
                      constants=None) -> CostReport:
@@ -528,28 +625,37 @@ def estimate_ir_cost(ir, *, ici_bandwidth: float = ICI_BANDWIDTH,
             continue
         if leg.kind not in sir.COLLECTIVE_KINDS:
             continue
-        d = max(int(ir.axes.get(leg.axis, 1)), 1) if leg.axis else 1
+        d = leg_participants(leg, ir)
         wire = _leg_wire_bytes(leg, d)
         hidden = 0.0
         if leg.slot != sir.END_OF_STEP and leg.slot < accum - 1:
             hidden = wire                     # rides behind backward k+1
-        elif leg.kind == sir.LEG_ALL_GATHER and ir.prefetch:
+        elif leg.kind in (sir.LEG_ALL_GATHER, sir.LEG_HIER_ALL_GATHER) \
+                and ir.prefetch:
             hidden = wire * ov.PREFETCH_OVERLAP_FRACTION
+        tier = leg_tier(leg, ir)
         report.wire_bytes += wire
         report.exposed_wire_bytes += wire - hidden
+        report.wire_by_tier[tier] = report.wire_by_tier.get(tier, 0.0) \
+            + wire
+        report.exposed_wire_by_tier[tier] = \
+            report.exposed_wire_by_tier.get(tier, 0.0) + wire - hidden
         launched = d > 1 or leg.kind == sir.LEG_PSUM_GUARD
         if launched:
             report.num_collectives += 1
         exposed_fraction = (wire - hidden) / wire if wire > 0 \
             else (0.0 if hidden else 1.0)
         if constants is not None:
-            t = leg_cost_s(leg, ir, constants)
+            t = leg_cost_s(leg, ir, constants,
+                           ici_bandwidth=ici_bandwidth,
+                           dcn_bandwidth=dcn_bandwidth, alpha=alpha)
             if t is not None:
                 calibrated_comm_s += t * exposed_fraction
                 comm_kind_s[leg.kind] = comm_kind_s.get(leg.kind, 0.0) \
                     + t * exposed_fraction
         else:
-            t = ((wire - hidden) / ici_bandwidth
+            bw = dcn_bandwidth if tier == sir.TIER_DCN else ici_bandwidth
+            t = ((wire - hidden) / bw
                  + (alpha if launched else 0.0))
             comm_kind_s[leg.kind] = comm_kind_s.get(leg.kind, 0.0) + t
     scale = constants.scale if constants is not None else 1.0
@@ -558,7 +664,9 @@ def estimate_ir_cost(ir, *, ici_bandwidth: float = ICI_BANDWIDTH,
     if constants is not None:
         comm_s = constants.scale * calibrated_comm_s
     else:
-        comm_s = (report.exposed_wire_bytes / ici_bandwidth
+        exposed_dcn = report.exposed_wire_by_tier.get(sir.TIER_DCN, 0.0)
+        comm_s = ((report.exposed_wire_bytes - exposed_dcn) / ici_bandwidth
+                  + exposed_dcn / dcn_bandwidth
                   + alpha * report.num_collectives)
     report.time_s = max(compute_time_s, comm_s) + update_s
     return report

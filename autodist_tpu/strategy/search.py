@@ -11,6 +11,8 @@ The space is per-variable
     x bucket_bytes x expert placement (expert-flagged variables only:
     expert-parallel over the ``expert`` mesh axis — 1/E grads plus the
     dispatch/combine all_to_all pair — vs dense replication)
+    x two-tier hier sync (multi-slice specs only: slice-local ICI legs
+    plus one cross-slice DCN leg per bucket vs the flat collective)
 
 encoded as one :class:`VarGene` per trainable variable; a search state
 is the gene map, i.e. a :class:`~autodist_tpu.kernel.synchronization.
@@ -93,10 +95,15 @@ class VarGene:
     #: dense replication (full-size grads, no a2a).  Ignored — and kept
     #: False — for variables without the catalog ``expert`` flag.
     expert: bool = False
+    #: two-tier ICI+DCN sync (AllReduce-family genes only): slice-local
+    #: reduce-scatter + one cross-slice DCN leg + slice-local gather.
+    #: Only meaningful on a multi-slice spec — the move generator never
+    #: toggles it when ``resource_spec.num_slices <= 1``.
+    hier: bool = False
 
     def key(self) -> Tuple:
         return (self.sync, self.partition, self.compressor, self.overlap,
-                self.bucket_bytes, self.expert)
+                self.bucket_bytes, self.expert, self.hier)
 
 
 @dataclass
@@ -159,7 +166,8 @@ class CandidateEval:
                                  "compressor": g.compressor,
                                  "overlap": g.overlap,
                                  "bucket_bytes": g.bucket_bytes,
-                                 "expert": g.expert}
+                                 "expert": g.expert,
+                                 "hier": g.hier}
                           for name, g in self.genes}
         return d
 
@@ -225,7 +233,8 @@ def genes_from_strategy(strategy: Strategy,
                 partition=None,
                 compressor=sync.compressor or "NoneCompressor",
                 overlap=getattr(sync, "overlap", "auto") or "auto",
-                bucket_bytes=int(getattr(sync, "bucket_bytes", 0) or 0))
+                bucket_bytes=int(getattr(sync, "bucket_bytes", 0) or 0),
+                hier=bool(getattr(sync, "hier", False)))
         else:
             gene = VarGene()
         if getattr(var, "expert", False):
@@ -275,7 +284,8 @@ def strategy_from_genes(genes: Sequence[Tuple[str, VarGene]],
                     sync="reduce_scatter" if g.sync == SYNC_RS
                     else "all_reduce",
                     bucket_bytes=g.bucket_bytes,
-                    overlap=g.overlap)))
+                    overlap=g.overlap,
+                    hier=g.hier)))
     return Strategy(
         node_config=node_config,
         graph_config=GraphConfig(
@@ -311,10 +321,12 @@ def evaluate_candidate(name: str,
     genes = tuple(genes)
     strategy = strategy_from_genes(genes, graph_item, resource_spec)
     facts, priced_facts, guard, prune = facts_for_candidate(
-        strategy, graph_item, axes, sparse_rows_hint=sparse_rows_hint)
+        strategy, graph_item, axes, sparse_rows_hint=sparse_rows_hint,
+        resource_spec=resource_spec)
     if prune is not None:
         return CandidateEval(name=name, pruned_by=prune, genes=genes), None
     accum = int(getattr(graph_item, "accum_steps", 1) or 1)
+    num_slices = int(getattr(resource_spec, "num_slices", 1) or 1)
     # Expert-parallel lens: a gene with expert=True keeps its variable
     # on the runtime's expert-sharded lowering — the schedule gains the
     # dispatch/combine a2a pair (and its capacity transient, which the
@@ -350,13 +362,13 @@ def evaluate_candidate(name: str,
             priced_facts = shrunk
     fact_fp = sir.facts_fingerprint(facts, axes=dict(axes),
                                     accum_steps=accum, guard=guard,
-                                    moe=moe)
+                                    moe=moe, num_slices=num_slices)
     if seen_facts is not None:
         if fact_fp in seen_facts:
             return None, None
         seen_facts.add(fact_fp)
     ir = sir.ir_from_facts(facts, axes=dict(axes), accum_steps=accum,
-                           guard=guard, moe=moe)
+                           guard=guard, moe=moe, num_slices=num_slices)
     errs = sir.errors(sir.verify(ir))
     if errs:
         v = errs[0]
@@ -385,9 +397,13 @@ def evaluate_candidate(name: str,
     # Parallax rule) so the leg-priced estimate sees the honest wire.
     priced_ir = ir if priced_facts is facts else sir.ir_from_facts(
         priced_facts, axes=dict(axes), accum_steps=accum, guard=guard,
-        moe=moe)
+        moe=moe, num_slices=num_slices)
+    from autodist_tpu.strategy.cost_model import DCN_BANDWIDTH
+    dcn_bw = getattr(resource_spec, "dcn_bytes_per_s", None) \
+        or DCN_BANDWIDTH
     report = estimate_ir_cost(priced_ir, constants=constants,
-                              compute_time_s=compute_time_s)
+                              compute_time_s=compute_time_s,
+                              dcn_bandwidth=dcn_bw)
     return CandidateEval(
         name=name, fingerprint=ir.fingerprint(),
         cost_s=float(report.time_s),
@@ -422,7 +438,8 @@ def _seed_builders() -> List[Tuple[str, StrategyBuilder]]:
 
 def _moves(genes: Tuple[Tuple[str, VarGene], ...],
            graph_item: GraphItem,
-           space: SearchSpace
+           space: SearchSpace,
+           num_slices: int = 1
            ) -> List[Tuple[str, Tuple[Tuple[str, VarGene], ...]]]:
     """The deterministic neighbor list of one beam state: global knob
     turns first (they move the most bytes), then single-variable flips
@@ -455,6 +472,14 @@ def _moves(genes: Tuple[Tuple[str, VarGene], ...],
         with_all(f"all:bucket_bytes={bb}",
                  lambda n, g, b=bb: replace(g, bucket_bytes=b)
                  if g.sync != SYNC_PS else g)
+    # Two-tier hierarchy toggle: meaningful only on multi-slice specs
+    # (PS genes ignore it — single-slice candidates never grow the
+    # gene, so flat fingerprints stay stable).
+    if num_slices > 1:
+        for flag in (True, False):
+            with_all(f"all:hier={'on' if flag else 'off'}",
+                     lambda n, g, f=flag: replace(g, hier=f)
+                     if g.sync != SYNC_PS else g)
     # Expert-parallel toggle: only expert-flagged variables move (an
     # expert bit on a dense variable is meaningless and would only
     # bloat the dedupe space).
@@ -587,7 +612,9 @@ def beam_search(graph_item: GraphItem, resource_spec: ResourceSpec, *,
         for state in beam:
             if over_budget():
                 break
-            for tag, genes in _moves(state.genes, graph_item, space):
+            for tag, genes in _moves(
+                    state.genes, graph_item, space,
+                    int(getattr(resource_spec, "num_slices", 1) or 1)):
                 if over_budget():
                     break
                 ev = consider(f"{state.name}+{tag}", genes)

@@ -70,12 +70,16 @@ class Zero1(StrategyBuilder):
         in reverse bucket order (prefetch); ``"none"`` restores the
         phase-serial schedule; ``"pipeline"``/``"ring"``/``"full"``
         request mechanisms explicitly.
+      hier: request the two-tier ICI+DCN lowering on multi-slice
+        resource specs — slice-local reduce-scatter, cross-slice DCN
+        shard exchange, and a two-stage (DCN then ICI) param gather.
+        No-op on single-slice specs.
     """
 
     def __init__(self, bucket_bytes: int = DEFAULT_BUCKET_BYTES,
                  chunk_size: int = 512,
                  compressor: str = "NoneCompressor",
-                 overlap: str = "auto"):
+                 overlap: str = "auto", hier: bool = False):
         from autodist_tpu.kernel.synchronization.overlap import OVERLAP_MODES
         if bucket_bytes < 1:
             raise ValueError("bucket_bytes must be >= 1")
@@ -88,6 +92,7 @@ class Zero1(StrategyBuilder):
         self._chunk_size = chunk_size
         self._compressor = compressor
         self._overlap = overlap
+        self._hier = hier
 
     def build(self, graph_item: GraphItem,
               resource_spec: ResourceSpec) -> Strategy:
@@ -100,6 +105,7 @@ class Zero1(StrategyBuilder):
                     sync="reduce_scatter",
                     bucket_bytes=self._bucket_bytes,
                     overlap=self._overlap,
+                    hier=self._hier,
                 ),
             )
             for i, var in enumerate(graph_item.trainable_var_infos)

@@ -208,6 +208,12 @@ def compare_runs(dir_a: str, dir_b: str) -> Optional[dict]:
                 "a_measured_ms": round(a * 1e3, 4) if a else None,
                 "b_measured_ms": round(b * 1e3, 4) if b else None,
                 "delta_pct": delta}
+            # Kinds on one side only (e.g. hier/dcn legs after flipping a
+            # run to two-tier sync) are not deltas — label instead of crash.
+            if kind not in legs_a:
+                kinds[kind]["status"] = "new"
+            elif kind not in legs_b:
+                kinds[kind]["status"] = "removed"
             if delta is not None and delta > REGRESSION_THRESHOLD:
                 regressions.append(
                     f"leg kind {kind} regressed {delta:+.1%}: "
@@ -242,6 +248,15 @@ def _print_compare(cmp: dict) -> None:
               + (f"  ({delta:+.1%})" if delta is not None else ""))
     for kind, row in (cmp.get("leg_kinds") or {}).items():
         a, b = row.get("a_measured_ms"), row.get("b_measured_ms")
+        if row.get("status") == "new":
+            print(f"  legs  {kind:16s} {'-':>9s} -> "
+                  f"{b if b is not None else 0.0:9.3f} ms  (new in b)")
+            continue
+        if row.get("status") == "removed":
+            print(f"  legs  {kind:16s} "
+                  f"{a if a is not None else 0.0:9.3f} -> {'-':>9s} ms"
+                  "  (removed in b)")
+            continue
         if a is None or b is None:
             continue
         delta = row["delta_pct"]

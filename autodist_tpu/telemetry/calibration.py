@@ -318,7 +318,9 @@ def straggler_reason(per_host_step_time_s: Optional[Dict[str, float]],
 #: ``telemetry/leg-drift`` watches each independently.
 LEG_KINDS = ("reduce_scatter", "all_gather", "all_reduce",
              "ppermute_hop", "psum_guard", "ps_exchange", "update",
-             "fused_hop", "fused_detect", "fused_update", "all_to_all")
+             "fused_hop", "fused_detect", "fused_update", "all_to_all",
+             "hier_reduce_scatter", "dcn_all_reduce", "dcn_exchange",
+             "hier_all_gather")
 
 #: compressor names whose wire is full-precision: any other compressor
 #: tag on a sample marks it quantized for the quantize-overhead term.

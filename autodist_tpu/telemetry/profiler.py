@@ -284,7 +284,9 @@ class LegProfiler:
         mesh = self._mesh
         collective = kind in ("reduce_scatter", "all_gather", "all_reduce",
                               "ppermute_hop", "fused_hop", "psum_guard",
-                              "ps_exchange", "all_to_all")
+                              "ps_exchange", "all_to_all",
+                              "hier_reduce_scatter", "dcn_all_reduce",
+                              "dcn_exchange", "hier_all_gather")
         if collective and mesh is not None and axis \
                 and int(dict(mesh.shape).get(axis, 1)) > 1:
             from jax.sharding import PartitionSpec as P
@@ -293,11 +295,16 @@ class LegProfiler:
 
             d = int(dict(mesh.shape)[axis])
             n = ((n + d - 1) // d) * d
-            if kind == "reduce_scatter":
+            if kind in ("reduce_scatter", "hier_reduce_scatter",
+                        "dcn_exchange"):
+                # The hier/dcn RS-shaped kinds run the same scatter
+                # primitive — the micro-run times its wire on THIS
+                # mesh's links (a CPU simulated-slice mesh has no DCN;
+                # real per-tier constants come from pod traces).
                 body = lambda x: jax.lax.psum_scatter(  # noqa: E731
                     x, axis, scatter_dimension=0, tiled=True)
                 out_spec = P(axis)
-            elif kind == "all_gather":
+            elif kind in ("all_gather", "hier_all_gather"):
                 # per-device shard gathers back to the full vector
                 body = lambda x: jax.lax.all_gather(  # noqa: E731
                     x, axis, tiled=True)
@@ -318,7 +325,7 @@ class LegProfiler:
                 body = lambda x: jax.lax.ppermute(  # noqa: E731
                     x, axis, perm)
                 out_spec = P(axis)
-            else:  # all_reduce / psum_guard / ps_exchange
+            else:  # all_reduce / psum_guard / ps_exchange / dcn_all_reduce
                 body = lambda x: jax.lax.psum(x, axis)  # noqa: E731
                 out_spec = P()
             fn = jax.jit(compat.shard_map(

@@ -202,6 +202,7 @@ def run_child(platform: str) -> None:
     _fill_profiler(result)
     _fill_search(result)
     _fill_moe(result)
+    _fill_hier(result)
     _fill_kernels(result)
     mark("grad_sync")
     # Serving scale-out (paged KV + continuous batching): its own CPU
@@ -1541,6 +1542,40 @@ def _fill_moe(result) -> None:
             f.write("\n")
     except Exception as e:  # pragma: no cover - best-effort enrichment
         print(f"bench: moe section unavailable ({e!r})",
+              file=sys.stderr, flush=True)
+
+
+def _fill_hier(result) -> None:
+    """Hierarchical ICI+DCN grad sync (docs/strategies.md "Two-tier
+    sync and --simulate", BENCH_hier.json): the comm-bound dense model
+    on a simulated 2-slice mesh measured flat (single ring over the
+    whole data axis) vs hierarchical (within-slice reduce-scatter →
+    cross-slice DCN all-reduce → within-slice all-gather) vs
+    hierarchical with the int8 DCN wire — step time, honest per-tier
+    wire bytes from the schedule IR, per-tier predicted-vs-measured
+    cost from the leg profiler (distinct fitted ICI and DCN constants),
+    and loss parity against flat.  ``assert_verified`` gates every
+    mode.  Runs in its own 8-virtual-device child; committed standalone
+    as BENCH_hier.json."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8").strip()
+    cmd = [sys.executable, "-u", os.path.abspath(__file__), "--hier-child"]
+    try:
+        proc = subprocess.run(cmd, stdout=subprocess.PIPE, env=env,
+                              timeout=900)
+        payload = _extract_json(proc.stdout.decode())
+        if payload is None:
+            raise RuntimeError(f"no JSON from hier child "
+                               f"(rc={proc.returncode})")
+        result.setdefault("grad_sync", {})["hier"] = payload
+        with open(os.path.join(REPO, "BENCH_hier.json"), "w",
+                  encoding="utf-8") as f:
+            json.dump(payload, f, indent=2, sort_keys=True)
+            f.write("\n")
+    except Exception as e:  # pragma: no cover - best-effort enrichment
+        print(f"bench: hier section unavailable ({e!r})",
               file=sys.stderr, flush=True)
 
 
@@ -3546,6 +3581,150 @@ def run_moe_child() -> None:
     print(json.dumps(out), flush=True)
 
 
+def run_hier_child() -> None:
+    """Hierarchical ICI+DCN measurement (child process, 8 virtual CPU
+    devices — docs/strategies.md "Two-tier sync and --simulate").
+
+    One comm-bound dense model on a simulated 2-slice topology
+    (``num_slices=2`` over ``data=8`` — two 4-chip slices joined by a
+    25 Gbit/s DCN), three modes through the full AutoDist path:
+    ``flat`` (one ring over the whole data axis — every hop crosses
+    the slice boundary), ``hier`` (the two-tier lowering:
+    within-slice reduce-scatter → cross-slice DCN all-reduce →
+    within-slice all-gather), and ``hier_int8`` (the
+    ``AUTODIST_DCN_WIRE=int8`` knob: only the DCN leg quantizes
+    through ``quant_ring``; the ICI legs stay f32).  Per mode: the
+    verifier gates the IR (``assert_verified`` — a mutation in the
+    two-level lowering fails the bench, not just a counter), step time
+    over the same batch, the IR's wire bytes split per tier, and loss
+    parity against the flat baseline.  The hier mode additionally
+    leg-profiles its schedule and fits per-kind constants so the
+    report carries predicted-vs-measured cost per tier — the distinct
+    ICI and DCN constants ``--simulate`` extrapolates from.  Asserted
+    in-child: the hier IR carries dcn-tier legs, hier moves fewer DCN
+    bytes than flat's full-ring wire, and int8 shrinks the DCN wire
+    further."""
+    _steer("cpu")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    os.environ["AUTODIST_IS_TESTING"] = "True"
+    from autodist_tpu.autodist import AutoDist, \
+        _reset_default_autodist_for_testing
+    from autodist_tpu.kernel.synchronization import schedule_ir as sir
+    from autodist_tpu.resource_spec import ResourceSpec
+    from autodist_tpu.strategy import AllReduce
+    from autodist_tpu.strategy.cost_model import leg_cost_s, leg_tier
+    from autodist_tpu.telemetry.calibration import fit_leg_constants
+    from autodist_tpu.telemetry.profiler import LegProfiler
+
+    steps = 20
+    out = {"devices": jax.device_count(), "modes": {}}
+
+    rng = np.random.RandomState(0)
+    dims = [(1024, 1024), (1024, 512), (512, 256)]
+    params = {f"w{i}": jnp.asarray(rng.randn(*d) * 0.02, jnp.float32)
+              for i, d in enumerate(dims)}
+    batch = {"x": rng.randn(32, 1024).astype(np.float32),
+             "y": rng.randn(32, 256).astype(np.float32)}
+
+    def loss_fn(p, b):
+        h = b["x"]
+        for i in range(len(dims)):
+            h = jnp.tanh(h @ p[f"w{i}"])
+        return jnp.mean((h - b["y"]) ** 2)
+
+    spec = ResourceSpec(resource_info={
+        "nodes": [{"address": "localhost", "chips": 8, "chief": True}],
+        "mesh": {"data": 8}, "num_slices": 2, "dcn_gbps": 25})
+
+    def run_mode(name, hier, wire=None):
+        if wire is None:
+            os.environ.pop("AUTODIST_DCN_WIRE", None)
+        else:
+            os.environ["AUTODIST_DCN_WIRE"] = wire
+        _reset_default_autodist_for_testing()
+        ad = AutoDist(strategy_builder=AllReduce(bucket_bytes=1 << 22,
+                                                 hier=hier),
+                      resource_spec=spec)
+        with ad.scope():
+            ad.capture(params=params, optimizer=optax.adam(1e-3),
+                       loss_fn=loss_fn)
+        sess = ad.create_distributed_session()
+        ir = sess.schedule_ir
+        sir.assert_verified(ir, f"bench hier [{name}]")
+        wire_by_tier = {sir.TIER_ICI: 0, sir.TIER_DCN: 0}
+        for l in ir.legs:
+            wire_by_tier[leg_tier(l, ir)] += l.nbytes
+        losses = [float(sess.run(batch)["loss"]) for _ in range(3)]
+        dt = _measure_session(sess, batch, 3, steps)
+        out["modes"][name] = {
+            "schedule_fingerprint": ir.fingerprint(),
+            "step_time_ms": round(dt / steps * 1e3, 3),
+            "n_legs": len(ir.legs),
+            "n_dcn_legs": sum(1 for l in ir.legs
+                              if leg_tier(l, ir) == sir.TIER_DCN),
+            "ici_wire_bytes": int(wire_by_tier[sir.TIER_ICI]),
+            "dcn_wire_bytes": int(wire_by_tier[sir.TIER_DCN]),
+            "losses": [round(x, 6) for x in losses],
+        }
+        return sess, ir, losses
+
+    sess, _, losses_flat = run_mode("flat", hier=False)
+    del sess
+    sess, ir_h, losses_h = run_mode("hier", hier=True)
+
+    # Per-tier predicted-vs-measured: leg-profile the hier schedule,
+    # fit this host's per-kind constants, and price each tier with
+    # them — the ICI-vs-DCN split --simulate extrapolates to pods.
+    samples = LegProfiler(mesh=sess.mesh).profile_ir(ir_h)
+    cal = fit_leg_constants(samples)
+    if cal is None:
+        raise RuntimeError("hier bench: leg calibration fit nothing")
+    dcn_kinds = set(sir.DCN_KINDS)
+    tiers = {}
+    for tier in (sir.TIER_ICI, sir.TIER_DCN):
+        t_samples = [s for s in samples
+                     if (s.kind in dcn_kinds) == (tier == sir.TIER_DCN)]
+        t_legs = [l for l in ir_h.legs if leg_tier(l, ir_h) == tier]
+        tiers[tier] = {
+            "n_samples": len(t_samples),
+            "measured_ms": round(
+                sum(s.measured_s for s in t_samples) * 1e3, 4),
+            "predicted_ms": round(
+                sum(leg_cost_s(l, ir_h, constants=cal)
+                    for l in t_legs) * 1e3, 4),
+        }
+    out["per_tier_cost"] = tiers
+    out["fitted_bandwidths_gbps"] = {
+        k: round(v * 8 / 1e9, 2) for k, v in sorted(cal.bandwidths.items())}
+    del sess
+    sess, _, losses_q = run_mode("hier_int8", hier=True, wire="int8")
+    del sess
+    os.environ.pop("AUTODIST_DCN_WIRE", None)
+    _reset_default_autodist_for_testing()
+
+    modes = out["modes"]
+    assert modes["hier"]["n_dcn_legs"] > 0, "hier IR carries no DCN legs"
+    assert modes["hier"]["dcn_wire_bytes"] \
+        < modes["flat"]["dcn_wire_bytes"], (
+        "hier does not shrink the DCN wire vs the flat ring")
+    assert modes["hier_int8"]["dcn_wire_bytes"] \
+        < modes["hier"]["dcn_wire_bytes"], (
+        "int8 DCN wire not below f32 hier wire")
+    np.testing.assert_allclose(losses_h, losses_flat, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(losses_q, losses_flat, rtol=2e-2, atol=2e-2)
+    out["dcn_wire_saving_vs_flat_pct"] = round(
+        (1.0 - modes["hier"]["dcn_wire_bytes"]
+         / modes["flat"]["dcn_wire_bytes"]) * 100.0, 1)
+    out["int8_dcn_wire_saving_pct"] = round(
+        (1.0 - modes["hier_int8"]["dcn_wire_bytes"]
+         / modes["hier"]["dcn_wire_bytes"]) * 100.0, 1)
+    print(json.dumps(out), flush=True)
+
+
 def run_probe() -> None:
     """Cheap TPU liveness check: real matmul, real sync."""
     import jax
@@ -3741,6 +3920,8 @@ if __name__ == "__main__":
         run_search_child()
     elif "--moe-child" in sys.argv:
         run_moe_child()
+    elif "--hier-child" in sys.argv:
+        run_hier_child()
     elif "--profiler-child" in sys.argv:
         run_profiler_child()
     elif "--kernels-child" in sys.argv:

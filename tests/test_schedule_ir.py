@@ -351,6 +351,163 @@ def test_stage_mismatch_detected_cross_stage():
     assert not _errors(uniform)
 
 
+# -- hierarchical ICI+DCN: fuzz + mutation goldens ---------------------------
+
+def _hier_ir(entries=None, *, d=8, s=2, accum=1, mode="auto"):
+    entries = entries if entries is not None else \
+        _entries(n=2, mode="all_reduce")
+    buckets = bucketing.assign_buckets(entries, bucket_bytes=256 << 10,
+                                       shard_divisor=d)
+    plan = overlap.resolve_overlap(
+        [mode], accum_steps=accum, buckets=buckets, d=d,
+        has_rs=any(b.mode == "reduce_scatter" for b in buckets))
+    return sir.build_schedule_ir(
+        axes={"data": d}, accum_steps=accum, buckets=buckets, plan=plan,
+        num_slices=s, hier_keys=[b.key for b in buckets])
+
+
+@pytest.mark.hier
+def test_hier_builder_emits_two_tier_legs():
+    ir = _hier_ir()
+    kinds = {l.kind for l in ir.legs}
+    assert sir.LEG_HIER_REDUCE_SCATTER in kinds
+    assert sir.LEG_DCN_ALL_REDUCE in kinds
+    assert sir.LEG_HIER_ALL_GATHER in kinds
+    assert all(l.tier == sir.TIER_DCN for l in ir.legs
+               if l.kind in sir.DCN_KINDS)
+    assert ir.num_slices == 2
+    assert not _errors(ir)
+
+
+@pytest.mark.hier
+def test_hier_zero1_exchange_and_two_tier_gather():
+    ir = _hier_ir(_entries(n=2, mode="reduce_scatter"))
+    assert any(l.kind == sir.LEG_DCN_EXCHANGE for l in ir.legs)
+    ag = [l for l in ir.legs if l.kind == sir.LEG_HIER_ALL_GATHER]
+    assert {l.tier for l in ag} == {sir.TIER_DCN, sir.TIER_ICI}
+    assert not _errors(ir)
+
+
+@pytest.mark.hier
+def test_fuzz_hier_schedules_verify_clean():
+    """Random slice counts x hier bucket subsets x compressors x accum
+    x both builders: the verifier must accept every planner-emitted
+    two-tier IR (zero false positives).  Non-factoring slice counts
+    and quantized buckets silently keep the flat lowering — also
+    always clean."""
+    rng = np.random.RandomState(20260807)
+    for trial in range(150):
+        d = int(rng.choice([2, 4, 8, 16]))
+        s = int(rng.choice([1, 2, 3, 4, 8]))
+        n = int(rng.randint(1, 6))
+        entries = [(f"v{i}",
+                    tuple(int(rng.choice([8, 64, 256]))
+                          for _ in range(int(rng.randint(1, 3)))),
+                    str(rng.choice(["float32", "bfloat16"])),
+                    str(rng.choice(_FUZZ_COMPRESSORS)),
+                    0,
+                    str(rng.choice(["all_reduce", "reduce_scatter"])))
+                   for i in range(n)]
+        buckets = bucketing.assign_buckets(
+            entries, bucket_bytes=int(rng.choice([16 << 10, 256 << 10])),
+            shard_divisor=d)
+        plan = overlap.resolve_overlap(
+            [str(rng.choice(list(overlap.OVERLAP_MODES)))],
+            accum_steps=int(rng.choice([1, 2, 4])), buckets=buckets, d=d,
+            has_rs=any(b.mode == "reduce_scatter" for b in buckets))
+        keys = [b.key for b in buckets if rng.randint(0, 2)]
+        ir = sir.build_schedule_ir(
+            axes={"data": d}, accum_steps=plan.accum_steps
+            if hasattr(plan, "accum_steps") else 1,
+            buckets=buckets, plan=plan, num_slices=s, hier_keys=keys)
+        errs = _errors(ir)
+        assert not errs, (trial, d, s, keys, [str(v) for v in errs])
+        facts = [sir.PlanFact(
+            name=f"m/v{i}", shape=(int(rng.choice([64, 512])), 32),
+            dtype="float32", sync_kind="AllReduce",
+            compressor=str(rng.choice(_FUZZ_COMPRESSORS)),
+            sync_mode=str(rng.choice(["all_reduce", "reduce_scatter"])),
+            hier=bool(rng.randint(0, 2)))
+            for i in range(int(rng.randint(1, 4)))]
+        ir2 = sir.ir_from_facts(facts, axes={"data": d}, num_slices=s)
+        errs = _errors(ir2)
+        assert not errs, (trial, d, s, [str(v) for v in errs])
+
+
+def _hier_legs(ir):
+    rs = next(l for l in ir.legs
+              if l.kind == sir.LEG_HIER_REDUCE_SCATTER)
+    dcn = next(l for l in ir.legs if l.kind in sir.DCN_KINDS
+               and l.bucket == rs.bucket and l.slot == rs.slot)
+    return rs, dcn
+
+
+@pytest.mark.hier
+def test_mutation_dropped_dcn_leg():
+    """Dropping the cross-slice exchange (slices silently diverge) is
+    the worst two-tier bug — its own hier-tier-order diagnostic."""
+    ir = _hier_ir()
+    rs, dcn = _hier_legs(ir)
+    clone = sir.ScheduleIR.from_dict(ir.to_dict())
+    clone.legs = [dataclasses.replace(
+        l, deps=tuple(rs.id if dep == dcn.id else dep for dep in l.deps))
+        for l in clone.legs if l.id != dcn.id]
+    assert sir.RULE_HIER_TIER_ORDER in _rules(_errors(clone))
+
+
+@pytest.mark.hier
+def test_mutation_duplicated_dcn_leg():
+    ir = _hier_ir()
+    _, dcn = _hier_legs(ir)
+    clone = sir.ScheduleIR.from_dict(ir.to_dict())
+    clone.legs = list(clone.legs) + [dataclasses.replace(
+        dcn, id=dcn.id + "~again", deps=(dcn.id,))]
+    assert sir.RULE_HIER_TIER_ORDER in _rules(_errors(clone))
+
+
+@pytest.mark.hier
+def test_mutation_wrong_tier_tag():
+    ir = _hier_ir()
+    rs, _ = _hier_legs(ir)
+    clone = sir.ScheduleIR.from_dict(ir.to_dict())
+    clone.legs = [dataclasses.replace(l, tier=sir.TIER_DCN)
+                  if l.id == rs.id else l for l in clone.legs]
+    assert sir.RULE_HIER_TIER_ORDER in _rules(_errors(clone))
+
+
+@pytest.mark.hier
+def test_mutation_dropped_rs_to_dcn_dep_races():
+    """Deleting the rs -> dcn dep edge leaves two unordered writers of
+    ``red:<key>`` — the dataflow race rule catches it even though both
+    legs are still present and correctly tiered."""
+    ir = _hier_ir()
+    rs, dcn = _hier_legs(ir)
+    clone = sir.ScheduleIR.from_dict(ir.to_dict())
+    clone.legs = [dataclasses.replace(
+        l, deps=tuple(dep for dep in l.deps if dep != rs.id))
+        if l.id == dcn.id else l for l in clone.legs]
+    rules = _rules(_errors(clone))
+    assert sir.RULE_RACE_WRITE in rules or sir.RULE_RACE_READ_WRITE in rules
+
+
+@pytest.mark.hier
+def test_mutation_renamed_dep_unknown():
+    ir = _hier_ir()
+    _, dcn = _hier_legs(ir)
+    clone = sir.ScheduleIR.from_dict(ir.to_dict())
+    clone.legs = [dataclasses.replace(l, deps=("no-such-leg",))
+                  if l.id == dcn.id else l for l in clone.legs]
+    assert sir.RULE_UNKNOWN_DEP in _rules(_errors(clone))
+
+
+@pytest.mark.hier
+def test_mutation_hier_legs_on_unfactorable_mesh():
+    ir = _hier_ir(d=8, s=2)
+    clone = sir.ScheduleIR.from_dict(ir.to_dict())
+    clone.num_slices = 1
+    assert sir.RULE_HIER_TIER_ORDER in _rules(_errors(clone))
+
+
 def test_reduction_order_divergence_warns_for_bf16_ring():
     ir = _ir(_entries(dtype="bfloat16"), d=8, mode="full")
     warns = [v for v in sir.verify(ir)
