@@ -60,13 +60,19 @@ def parse_sweep_spec(spec: str) -> Dict[str, Any]:
     """Parse the ``--simulate`` argument: a JSON file path, or an
     inline ``key=value`` spec with ``;``-separated groups::
 
-        mesh=data=1024;slices=1,2,4;dcn=12.5,25,100
+        mesh=data=1024;slices=1,2,4;dcn=12.5,25,100;stages=1,2,4
 
     Inline keys: ``mesh`` (repeatable, ``axis=size[,axis=size...]``),
     ``slices``, ``dcn`` (Gbit/s values), ``hbm`` (GiB), ``mtbf``,
-    ``ckpt`` (interval steps).  JSON files carry the same content as
+    ``ckpt`` (interval steps), ``stages`` (MPMD pipeline stage counts —
+    each ``S > 1`` point composes a :class:`~autodist_tpu.kernel.
+    synchronization.schedule_ir.PipelineFact` into the program and
+    reports 1F1B bubble fraction + DCN activation bytes), ``mb``
+    (pipeline microbatches; default ``2*S``), ``act`` (per-boundary
+    activation MiB; default 1).  JSON files carry the same content as
     ``{"meshes": [{"data": 1024}], "slices": [...], "dcn_gbps": [...],
-    "hbm_gb": ..., "mtbf_s": ..., "ckpt_interval_steps": ...}``."""
+    "hbm_gb": ..., "mtbf_s": ..., "ckpt_interval_steps": ...,
+    "stages": [...], "microbatches": ..., "act_mib": ...}``."""
     if os.path.exists(spec):
         with open(spec, "r", encoding="utf-8") as f:
             cfg = json.load(f)
@@ -101,6 +107,12 @@ def parse_sweep_spec(spec: str) -> Dict[str, Any]:
             cfg["mtbf_s"] = float(val)
         elif key == "ckpt":
             cfg["ckpt_interval_steps"] = int(val)
+        elif key == "stages":
+            cfg["stages"] = [int(x) for x in val.split(",") if x.strip()]
+        elif key == "mb":
+            cfg["microbatches"] = int(val)
+        elif key == "act":
+            cfg["act_mib"] = float(val)
         else:
             raise ValueError(f"unknown --simulate key {key!r}")
     if not cfg["meshes"]:
@@ -171,16 +183,22 @@ def simulate_mode(graph_item, strategy, resource_spec: ResourceSpec,
                   axes: Dict[str, int], *, dcn_wire: Optional[str] = None,
                   constants=None, compute_time_s: float = 0.0,
                   mtbf_s: float = DEFAULT_MTBF_S,
-                  ckpt_interval_steps: int = DEFAULT_CKPT_INTERVAL_STEPS
-                  ) -> Dict[str, Any]:
+                  ckpt_interval_steps: int = DEFAULT_CKPT_INTERVAL_STEPS,
+                  pipeline=()) -> Dict[str, Any]:
     """Price ONE (point, sync-mode) cell through the search's own
     mesh-free pipeline; returns the cell dict (``pruned_by`` set when
-    legality, the verifier, or the watermark killed it)."""
+    legality, the verifier, or the watermark killed it).  ``pipeline``
+    composes MPMD :class:`~autodist_tpu.kernel.synchronization.
+    schedule_ir.PipelineFact`\\ s into the program: the cell then runs
+    with the pipeline's ``send_act``/``recv_act`` legs in the IR (same
+    verifier, same watermark) and reports ``bubble_fraction`` plus the
+    DCN activation bytes column."""
     from autodist_tpu.analysis import dataflow
     from autodist_tpu.analysis.search import facts_for_candidate
     from autodist_tpu.kernel.synchronization import schedule_ir as sir
     from autodist_tpu.strategy.cost_model import (
         DCN_BANDWIDTH,
+        act_transport_bytes,
         estimate_ir_cost,
     )
 
@@ -190,6 +208,11 @@ def simulate_mode(graph_item, strategy, resource_spec: ResourceSpec,
         return {"pruned_by": prune}
     num_slices = int(getattr(resource_spec, "num_slices", 1) or 1)
     accum = int(getattr(graph_item, "accum_steps", 1) or 1)
+    pipeline = list(pipeline or ())
+    for pf in pipeline:
+        # A pipeline point IS a grad-accumulation point: one optimizer
+        # step spans the schedule's microbatches.
+        accum = max(accum, int(pf.num_microbatches))
 
     # The DCN wire format is the runtime's AUTODIST_DCN_WIRE knob; the
     # sweep pins it per mode so flat/hier/hier_int8 cells are
@@ -198,7 +221,8 @@ def simulate_mode(graph_item, strategy, resource_spec: ResourceSpec,
     os.environ["AUTODIST_DCN_WIRE"] = dcn_wire or ""
     try:
         ir = sir.ir_from_facts(facts, axes=dict(axes), accum_steps=accum,
-                               guard=guard, num_slices=num_slices)
+                               guard=guard, num_slices=num_slices,
+                               pipeline=pipeline)
     finally:
         if prev is None:
             os.environ.pop("AUTODIST_DCN_WIRE", None)
@@ -226,6 +250,11 @@ def simulate_mode(graph_item, strategy, resource_spec: ResourceSpec,
                               compute_time_s=compute_time_s,
                               dcn_bandwidth=dcn_bw)
     step_s = float(report.time_s)
+    if ir.pipeline:
+        total_act, exposed_act = act_transport_bytes(ir)
+        cell["bubble_fraction"] = float(report.bubble_fraction)
+        cell["dcn_act_bytes"] = {"total": float(total_act),
+                                 "exposed": float(exposed_act)}
     cell.update({
         "predicted_step_s": step_s,
         "exposed_wire_by_tier": {k: float(v) for k, v in sorted(
@@ -261,18 +290,23 @@ def run_sweep(graph_item,
     ckpt = int(config.get("ckpt_interval_steps",
                           DEFAULT_CKPT_INTERVAL_STEPS))
     compute_s = float(config.get("compute_time_s", 0.0))
+    stages_list: List[int] = [int(x) for x in
+                              (config.get("stages") or [1])]
+    microbatches = int(config.get("microbatches", 0) or 0)
+    act_mib = float(config.get("act_mib", 1.0))
 
     t0 = time.perf_counter()
     points: List[Dict[str, Any]] = []
     over_hbm = 0
-    from autodist_tpu.kernel.synchronization.schedule_ir import (
-        hier_applies,
-    )
+    from autodist_tpu.kernel.synchronization import schedule_ir as sir
 
-    for axes, s, dcn in itertools.product(meshes, slices, dcn_list):
+    hier_applies = sir.hier_applies
+
+    for axes, s, dcn, st in itertools.product(meshes, slices, dcn_list,
+                                              stages_list):
         point: Dict[str, Any] = {
             "mesh": dict(axes), "num_slices": int(s),
-            "dcn_gbps": dcn,
+            "dcn_gbps": dcn, "stages": int(st),
         }
         points.append(point)
         import math
@@ -281,6 +315,18 @@ def run_sweep(graph_item,
         if reason is not None:
             point["pruned_by"] = reason
             continue
+        # The pipeline dimension prunes with the SAME rule string the
+        # MPMD partitioner raises (pipeline/stage-mismatch).
+        mb = microbatches if microbatches else 2 * max(int(st), 1)
+        reason = sir.stage_mismatch_reason(st, mb)
+        if reason is not None:
+            point["pruned_by"] = reason
+            continue
+        pipe = [] if st <= 1 else [sir.PipelineFact(
+            key="pipe", num_stages=int(st), num_microbatches=mb,
+            act_nbytes=int(act_mib * (1 << 20)))]
+        if pipe:
+            point["microbatches"] = mb
         spec = _fabricated_spec(axes, s, dcn, hbm_gb)
         d = int(axes.get(MESH_AXIS_DATA, 1))
         modes: Dict[str, Dict[str, Any]] = {}
@@ -298,7 +344,8 @@ def run_sweep(graph_item,
                 graph_item, strategy, spec, axes,
                 dcn_wire="int8" if mode == MODE_HIER_INT8 else None,
                 constants=constants, compute_time_s=compute_s,
-                mtbf_s=mtbf_s, ckpt_interval_steps=ckpt)
+                mtbf_s=mtbf_s, ckpt_interval_steps=ckpt,
+                pipeline=pipe)
         priced = {m: c for m, c in modes.items()
                   if "predicted_step_s" in c}
         if priced:
@@ -316,7 +363,10 @@ def run_sweep(graph_item,
     return {
         "config": {"meshes": meshes, "slices": slices,
                    "dcn_gbps": dcn_list, "hbm_gb": hbm_gb,
-                   "mtbf_s": mtbf_s, "ckpt_interval_steps": ckpt},
+                   "mtbf_s": mtbf_s, "ckpt_interval_steps": ckpt,
+                   "stages": stages_list,
+                   "microbatches": microbatches or None,
+                   "act_mib": act_mib},
         "calibrated": constants is not None,
         "points": points,
         "n_points": len(points),
@@ -339,6 +389,9 @@ def format_sweep_report(report: Dict[str, Any]) -> str:
         head = (f"[{mesh}] slices={p['num_slices']} "
                 f"dcn={p['dcn_gbps'] if p['dcn_gbps'] is not None else '-'}"
                 f" Gbit/s")
+        if int(p.get("stages", 1) or 1) > 1:
+            head += (f" stages={p['stages']}"
+                     f" mb={p.get('microbatches', '-')}")
         if "pruned_by" in p and "modes" not in p:
             lines.append(f"  {head}: PRUNED ({p['pruned_by']})")
             continue
@@ -351,10 +404,17 @@ def format_sweep_report(report: Dict[str, Any]) -> str:
                 f"{t}={b / 1e6:.2f}MB"
                 for t, b in c["exposed_wire_by_tier"].items())
             gp = c["goodput"].get("goodput_ratio")
+            pipe = ""
+            if "bubble_fraction" in c:
+                act = c.get("dcn_act_bytes") or {}
+                pipe = (f"  bubble {c['bubble_fraction']:.3f}"
+                        f"  act dcn "
+                        f"{act.get('exposed', 0.0) / 1e6:.2f}MB exposed"
+                        f"/{act.get('total', 0.0) / 1e6:.2f}MB")
             lines.append(
                 f"    {mode:10s} step {c['predicted_step_s'] * 1e3:9.3f}"
                 f" ms  exposed {tiers or '-'}  "
                 f"hbm {c.get('watermark_peak_bytes', 0) / (1 << 30):.2f}"
                 f" GiB  goodput "
-                f"{gp if gp is not None else '-'}")
+                f"{gp if gp is not None else '-'}{pipe}")
     return "\n".join(lines)

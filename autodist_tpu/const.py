@@ -190,6 +190,14 @@ class ENV(enum.Enum):
     AUTODIST_COORDINATOR_ADDRESS = ("AUTODIST_COORDINATOR_ADDRESS", _str)
     AUTODIST_NUM_PROCESSES = ("AUTODIST_NUM_PROCESSES", _int1)
     AUTODIST_PROCESS_ID = ("AUTODIST_PROCESS_ID", _int0)
+    # MPMD pipeline runtime (parallel/mpmd, docs/pipeline.md): which
+    # pipeline stage this process runs (stamped by StageRunner; the
+    # chaos `stage=` filter and telemetry read it), the shared
+    # activation-transport directory (a tmpfs path in production; any
+    # shared dir in tests), and the transport recv deadline in seconds
+    AUTODIST_STAGE = ("AUTODIST_STAGE", _str)
+    AUTODIST_MPMD_DIR = ("AUTODIST_MPMD_DIR", _str)
+    AUTODIST_MPMD_TIMEOUT_S = ("AUTODIST_MPMD_TIMEOUT_S", _float0)
     SYS_DATA_PATH = ("SYS_DATA_PATH", _str)
     SYS_RESOURCE_PATH = ("SYS_RESOURCE_PATH", _str)
 

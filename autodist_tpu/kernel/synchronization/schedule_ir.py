@@ -92,6 +92,13 @@ make the partition/schedule decision a first-class analyzable artifact):
     DCN-then-ICI param gather pair, a tier tag that contradicts the
     leg kind, or hier legs on a program whose ``num_slices`` does not
     factor the data axis.
+  - ``schedule/act-transport`` (ERROR) — the MPMD pipeline transport
+    pairing contract: every ``act:`` boundary buffer owes exactly one
+    ``send_act`` and one ``recv_act`` joining two different named
+    stages, the recv dep-ordered after its send on the same microbatch
+    slot, tier ``dcn``, send slots monotone per boundary chain
+    (orphaned/mis-ordered halves are the cross-slice wedge the MPMD
+    runtime would block on — docs/pipeline.md).
 
 Everything here is mesh-free and jax-free at module import (numpy
 only), so the analyzer's sub-second verdict survives, and the verifier
@@ -167,17 +174,33 @@ LEG_HIER_REDUCE_SCATTER = "hier_reduce_scatter"
 LEG_DCN_ALL_REDUCE = "dcn_all_reduce"
 LEG_DCN_EXCHANGE = "dcn_exchange"
 LEG_HIER_ALL_GATHER = "hier_all_gather"
+#: MPMD pipeline activation transport (docs/pipeline.md): the
+#: point-to-point DCN legs carrying one microbatch's boundary
+#: activation (``send_act``, forward) or its cotangent (same pair of
+#: kinds, ``sig`` role ``bwd``) between per-stage programs on separate
+#: slices.  Always tier ``dcn``, always an ``act:`` buffer, always
+#: emitted in 1F1B tick order so the per-stage dep chains ARE the
+#: runtime issue order (``parallel/mpmd``).
+LEG_SEND_ACT = "send_act"
+LEG_RECV_ACT = "recv_act"
 LEG_KINDS = (LEG_REDUCE_SCATTER, LEG_ALL_GATHER, LEG_ALL_REDUCE,
              LEG_PPERMUTE_HOP, LEG_PSUM_GUARD, LEG_PS_EXCHANGE, LEG_UPDATE,
              LEG_FUSED_HOP, LEG_FUSED_DETECT, LEG_FUSED_UPDATE,
              LEG_ALL_TO_ALL, LEG_HIER_REDUCE_SCATTER, LEG_DCN_ALL_REDUCE,
-             LEG_DCN_EXCHANGE, LEG_HIER_ALL_GATHER)
+             LEG_DCN_EXCHANGE, LEG_HIER_ALL_GATHER,
+             LEG_SEND_ACT, LEG_RECV_ACT)
 #: kinds that issue wire traffic (every rank must agree on these).
 COLLECTIVE_KINDS = (LEG_REDUCE_SCATTER, LEG_ALL_GATHER, LEG_ALL_REDUCE,
                     LEG_PPERMUTE_HOP, LEG_PSUM_GUARD, LEG_PS_EXCHANGE,
                     LEG_FUSED_HOP, LEG_ALL_TO_ALL,
                     LEG_HIER_REDUCE_SCATTER, LEG_DCN_ALL_REDUCE,
-                    LEG_DCN_EXCHANGE, LEG_HIER_ALL_GATHER)
+                    LEG_DCN_EXCHANGE, LEG_HIER_ALL_GATHER,
+                    LEG_SEND_ACT, LEG_RECV_ACT)
+#: the point-to-point pipeline transport subset: excluded from the
+#: cross-stage sequence comparison (adjacent stages legitimately issue
+#: conjugate, not identical, send/recv sequences — the pairwise
+#: ``schedule/act-transport`` rule owns their deadlock check instead).
+TRANSPORT_KINDS = (LEG_SEND_ACT, LEG_RECV_ACT)
 #: the two network tiers a leg can ride; ``""`` = the (single-tier)
 #: default, serialized away so pre-hier programs keep their recorded
 #: fingerprints.
@@ -216,6 +239,73 @@ def stage_of(name: str) -> str:
     ``"expert3"``) or ``""`` for all-rank (SPMD-uniform) work."""
     m = STAGE_RE.search(name or "")
     return f"{m.group(1)}{int(m.group(2))}" if m else ""
+
+
+def stage_name(index: int, kind: str = "stage") -> str:
+    """THE stage spelling: what the MPMD partitioner prefixes parameter
+    names with, what :class:`PipelineFact` legs carry in ``Leg.stage``,
+    and exactly what :func:`stage_of` recovers — one helper so
+    hand-laid ``stage0/`` param groups and auto-partitioned stages lint
+    identically (``assert stage_of(stage_name(i) + "/w") ==
+    stage_name(i)``)."""
+    return f"{kind}{int(index)}"
+
+
+def stage_index(stage: str) -> Optional[int]:
+    """Inverse of :func:`stage_name`: the numeric index of a
+    ``stage<i>``/``expert<i>`` participant tag, or None for all-rank."""
+    m = re.match(r"([a-z]+)(\d+)$", stage or "")
+    return int(m.group(2)) if m else None
+
+
+# -- 1F1B schedule algebra (pure; re-exported by parallel.pipeline_1f1b) -----
+
+#: the prune rule for an inexpressible pipeline shape — one rule string
+#: shared by the MPMD partitioner (raise), the ``--simulate`` sweep
+#: (prune), and ``preflight_stage_resize`` (ElasticResumeError), like
+#: ``legality/slice-mismatch``.
+RULE_STAGE_MISMATCH = "pipeline/stage-mismatch"
+
+
+def stage_mismatch_reason(num_stages: int, num_microbatches: int,
+                          num_layers: Optional[int] = None
+                          ) -> Optional[str]:
+    """Why this (stages, microbatches, layers) shape cannot run 1F1B,
+    or None when it can."""
+    s, m = int(num_stages), int(num_microbatches)
+    if s < 1:
+        return f"{RULE_STAGE_MISMATCH}: num_stages {s} < 1"
+    if num_layers is not None and s > int(num_layers):
+        return (f"{RULE_STAGE_MISMATCH}: {s} stages cannot split "
+                f"{int(num_layers)} layer(s) contiguously")
+    if m < s:
+        return (f"{RULE_STAGE_MISMATCH}: 1F1B needs num_microbatches "
+                f"({m}) >= stages ({s})")
+    return None
+
+
+def schedule_ticks_1f1b(num_stages: int, num_microbatches: int,
+                        num_virtual_stages: int = 1) -> int:
+    """Total ticks of the interleaved 1F1B schedule: microbatch ``j``
+    injects at tick ``(j // S) * S * V + j % S`` and its last backward
+    completes ``2 * (S * V - 1)`` ticks after injection."""
+    s = max(int(num_stages), 1)
+    v = max(int(num_virtual_stages), 1)
+    m = max(int(num_microbatches), 1)
+    t_last = ((m - 1) // s) * s * v + (m - 1) % s
+    return t_last + 2 * (s * v - 1) + 1
+
+
+def bubble_fraction_1f1b(num_stages: int, num_microbatches: int,
+                         num_virtual_stages: int = 1) -> float:
+    """Fraction of pipeline ticks spent idle (warm-up + drain): each
+    microbatch occupies one forward+backward tick pair per device, so
+    ``M * V`` of the schedule's ticks are useful work."""
+    s = max(int(num_stages), 1)
+    v = max(int(num_virtual_stages), 1)
+    m = max(int(num_microbatches), 1)
+    ticks = schedule_ticks_1f1b(s, m, v)
+    return max(0.0, 1.0 - (m * v) / ticks)
 
 
 def is_quantizing(compressor: str) -> bool:
@@ -323,6 +413,10 @@ class ScheduleIR:
     #: ``data/num_slices``).  1 = single-slice, serialized away so
     #: pre-hier programs keep their fingerprints.
     num_slices: int = 1
+    #: MPMD pipeline facts behind the send_act/recv_act legs (empty for
+    #: single-program schedules) — carried so the cost model prices the
+    #: bubble fraction from the routing config, not just the legs.
+    pipeline: Tuple["PipelineFact", ...] = ()
     version: int = IR_VERSION
 
     # -- decision surface (what the lowerings consume) --------------------
@@ -375,6 +469,10 @@ class ScheduleIR:
             # Omit-when-1: single-slice programs keep their fingerprints.
             **({"num_slices": int(self.num_slices)}
                if int(self.num_slices) > 1 else {}),
+            # Same omit-when-empty contract: every non-pipeline
+            # program's fingerprint is untouched by the MPMD extension.
+            **({"pipeline": [asdict(p) for p in self.pipeline]}
+               if self.pipeline else {}),
         }
 
     @classmethod
@@ -402,6 +500,10 @@ class ScheduleIR:
                 if k in MoEFact.__dataclass_fields__})
                 for md in d.get("moe", ())),
             num_slices=int(d.get("num_slices", 1)),
+            pipeline=tuple(PipelineFact(**{
+                k: v for k, v in pd.items()
+                if k in PipelineFact.__dataclass_fields__})
+                for pd in d.get("pipeline", ())),
             version=int(d.get("version", IR_VERSION)))
 
     def to_json(self, indent: Optional[int] = None) -> str:
@@ -744,6 +846,74 @@ def moe_facts_from_vars(variables: Iterable[Any], *,
     return [by_key[k] for k in sorted(by_key)]
 
 
+# -- MPMD pipeline facts (mesh-free, shared by runtime + analysis) -----------
+
+PIPE_ROLE_FWD = "fwd"
+PIPE_ROLE_BWD = "bwd"
+
+
+@dataclass(frozen=True)
+class PipelineFact:
+    """One MPMD pipeline's mesh-free transport facts.
+
+    Feeds the ``send_act``/``recv_act`` leg grid the builder emits in
+    1F1B tick order: per stage boundary ``b`` (stage ``b`` →
+    ``b + 1``) and microbatch slot ``m``, one forward activation pair
+    (``act:<key>/f<b>@<m>``) and one backward cotangent pair
+    (``act:<key>/b<b>@<m>``), all tier ``dcn``.  The per-stage dep
+    chains ARE the runtime's issue order (``parallel/mpmd`` executes
+    the same IR instance, flight-recorder cursors carry the leg ids),
+    so the verifier's pairwise ``schedule/act-transport`` rule and the
+    dataflow race/leak rules model exactly what runs."""
+
+    key: str                      # e.g. "pipe" — buffer/leg namespace
+    num_stages: int               # S
+    num_microbatches: int         # M (== the program's accum_steps)
+    act_nbytes: int               # full-precision bytes of one boundary
+    num_virtual: int = 1          # V: virtual stages per device
+    dtype: str = "float32"
+    compressor: str = "NoneCompressor"   # Int8Compressor = quantized wire
+
+    def ticks(self) -> int:
+        return schedule_ticks_1f1b(
+            self.num_stages, self.num_microbatches, self.num_virtual)
+
+    def bubble_fraction(self) -> float:
+        return bubble_fraction_1f1b(
+            self.num_stages, self.num_microbatches, self.num_virtual)
+
+    def leg_nbytes(self) -> int:
+        """Honest wire bytes of one transport leg: the f32 boundary, or
+        — quantized wire — 1-byte/elem payload plus the per-chunk scale
+        grid (``quant_ring.wire_nbytes``)."""
+        fmt = quant_ring.wire_format_of(self.compressor or "")
+        if fmt is not None:
+            elems = max(1, int(self.act_nbytes)
+                        // np.dtype(self.dtype).itemsize)
+            return quant_ring.wire_nbytes(elems, fmt)
+        return int(self.act_nbytes)
+
+    def sig(self, role: str) -> str:
+        """Transport-leg signature — the role (fwd activation vs bwd
+        cotangent) is IN the signature so a swapped pair compares
+        unequal."""
+        return "|".join(str(x) for x in (
+            "pipe", role, self.compressor or "NoneCompressor",
+            int(self.num_stages)))
+
+
+def pipeline_wire_compressor_default() -> str:
+    """The activation-transport wire knob: ``AUTODIST_PIPE_WIRE=int8``
+    puts the cross-slice boundary activations on the quantized wire
+    (stateless per-microbatch scale grid, like the DCN gradient wire);
+    anything else is the full-precision wire.  Read by every pipeline
+    fact producer (the MPMD runtime, the ``--simulate`` sweep, bench
+    modes) so one env knob keeps all fingerprints in agreement."""
+    import os
+    wire = os.environ.get("AUTODIST_PIPE_WIRE", "").strip().lower()
+    return "Int8Compressor" if wire == "int8" else "NoneCompressor"
+
+
 # -- builder -----------------------------------------------------------------
 
 @dataclass(frozen=True)
@@ -831,6 +1001,83 @@ def _ring_chain(em: _Emitter, *, chain: str, b: Bucket,
     return prev
 
 
+def _emit_pipeline_legs(em: _Emitter, pf: PipelineFact) -> None:
+    """Emit one pipeline's ``send_act``/``recv_act`` grid in 1F1B tick
+    order (V=1 transport grid; virtual stages only shape the bubble).
+
+    The order matters: the `_Emitter` per-stage chaining makes each
+    stage's transport legs a total order, and emitting them in tick
+    order makes that chain EXACTLY the order the MPMD StageRunner
+    executes — forward recv/send for microbatch ``t - s`` first, then
+    backward recv/send for ``t - 2(S-1) + s`` — so the verifier's
+    partial order, the liveness watermark's buffer intervals, and the
+    flight-recorder's cursor sequence all model the real runtime."""
+    s_n = max(int(pf.num_stages), 1)
+    m_n = max(int(pf.num_microbatches), 1)
+    if s_n < 2:
+        return
+    nb = pf.leg_nbytes()
+    comp = pf.compressor or "NoneCompressor"
+    drain = 2 * (s_n - 1)
+    pid = f"pipe/{pf.key}"
+    for t in range(schedule_ticks_1f1b(s_n, m_n, 1)):
+        for st in range(s_n):
+            stage = stage_name(st)
+            jf = t - st
+            jb = t - drain + st
+            if 0 <= jf < m_n:
+                if st > 0:
+                    # forward boundary input arrives over DCN
+                    em.emit(
+                        id=f"{pid}/f{st - 1}@{jf}/recv", kind=LEG_RECV_ACT,
+                        bucket=pf.key, dtype=pf.dtype, nbytes=nb,
+                        axis="", slot=jf, compressor=comp,
+                        alg=ALG_ONE_SHOT, chain=f"{pid}/f{st - 1}",
+                        stage=stage, sig=pf.sig(PIPE_ROLE_FWD),
+                        tier=TIER_DCN,
+                        deps=(f"{pid}/f{st - 1}@{jf}/send",),
+                        reads=(f"act:{pf.key}/f{st - 1}@{jf}",))
+                if st < s_n - 1:
+                    # boundary output ships right after the stage's fwd
+                    em.emit(
+                        id=f"{pid}/f{st}@{jf}/send", kind=LEG_SEND_ACT,
+                        bucket=pf.key, dtype=pf.dtype, nbytes=nb,
+                        axis="", slot=jf, compressor=comp,
+                        alg=ALG_ONE_SHOT, chain=f"{pid}/f{st}",
+                        stage=stage, sig=pf.sig(PIPE_ROLE_FWD),
+                        tier=TIER_DCN,
+                        deps=(f"{pid}/f{st - 1}@{jf}/recv",)
+                        if st > 0 else (),
+                        writes=(f"act:{pf.key}/f{st}@{jf}",))
+            if 0 <= jb < m_n:
+                if st < s_n - 1:
+                    # cotangent from downstream arrives before this
+                    # stage's backward for microbatch jb
+                    em.emit(
+                        id=f"{pid}/b{st}@{jb}/recv", kind=LEG_RECV_ACT,
+                        bucket=pf.key, dtype=pf.dtype, nbytes=nb,
+                        axis="", slot=jb, compressor=comp,
+                        alg=ALG_ONE_SHOT, chain=f"{pid}/b{st}",
+                        stage=stage, sig=pf.sig(PIPE_ROLE_BWD),
+                        tier=TIER_DCN,
+                        deps=(f"{pid}/b{st}@{jb}/send",),
+                        reads=(f"act:{pf.key}/b{st}@{jb}",))
+                if st > 0:
+                    # backward needs the incoming cotangent — or, on
+                    # the last stage (fwd and bwd share the tick), the
+                    # microbatch's forward input
+                    dep = f"{pid}/b{st}@{jb}/recv" if st < s_n - 1 \
+                        else f"{pid}/f{st - 1}@{jb}/recv"
+                    em.emit(
+                        id=f"{pid}/b{st - 1}@{jb}/send", kind=LEG_SEND_ACT,
+                        bucket=pf.key, dtype=pf.dtype, nbytes=nb,
+                        axis="", slot=jb, compressor=comp,
+                        alg=ALG_ONE_SHOT, chain=f"{pid}/b{st - 1}",
+                        stage=stage, sig=pf.sig(PIPE_ROLE_BWD),
+                        tier=TIER_DCN, deps=(dep,),
+                        writes=(f"act:{pf.key}/b{st - 1}@{jb}",))
+
+
 def build_schedule_ir(*, axes: Dict[str, int], accum_steps: int = 1,
                       buckets: Sequence[Bucket] = (),
                       plan: Optional[overlap_mod.OverlapPlan] = None,
@@ -842,7 +1089,8 @@ def build_schedule_ir(*, axes: Dict[str, int], accum_steps: int = 1,
                       fused_kernels: Sequence[str] = (),
                       moe: Sequence[MoEFact] = (),
                       num_slices: int = 1,
-                      hier_keys: Iterable[str] = ()) -> ScheduleIR:
+                      hier_keys: Iterable[str] = (),
+                      pipeline: Sequence[PipelineFact] = ()) -> ScheduleIR:
     """Build the schedule program for one step.
 
     Pure: consumes exactly the planner's outputs (``buckets`` from
@@ -879,6 +1127,15 @@ def build_schedule_ir(*, axes: Dict[str, int], accum_steps: int = 1,
     reduce_final: Dict[str, str] = {}
     detect_bytes: Dict[str, int] = {}   # f32 bytes the guard pass touches
     bucket_nodes: List[dict] = []
+
+    # MPMD pipeline transport grid first: boundary activations and
+    # cotangents move DURING the forward/backward compute, before any
+    # within-stage gradient reduction issues — and emitting them first
+    # seeds each stage's issue chain so a stage's grad collectives
+    # order after its pipeline drain.
+    pipeline = sorted(pipeline, key=lambda p: p.key)
+    for pf in pipeline:
+        _emit_pipeline_legs(em, pf)
 
     # MoE expert all-to-alls first: dispatch/combine happen inside the
     # forward/backward compute, before any gradient reduction issues.
@@ -1194,14 +1451,16 @@ def build_schedule_ir(*, axes: Dict[str, int], accum_steps: int = 1,
         axes=axes, accum_steps=accum, overlap_mode=plan.mode, guard=guard,
         prefetch=bool(plan.prefetch), buckets=bucket_nodes, legs=em.legs,
         gather_order=gather_order, donated=tuple(donated),
-        fused_kernels=fused, moe=tuple(moe), num_slices=s)
+        fused_kernels=fused, moe=tuple(moe), num_slices=s,
+        pipeline=tuple(pipeline))
 
 
 def facts_fingerprint(facts: Sequence[PlanFact], *, axes: Dict[str, int],
                       accum_steps: int = 1, guard: bool = False,
                       fused_kernels: Sequence[str] = (),
                       moe: Sequence[MoEFact] = (),
-                      num_slices: int = 1) -> str:
+                      num_slices: int = 1,
+                      pipeline: Sequence[PipelineFact] = ()) -> str:
     """Short stable hash of a candidate's full :func:`ir_from_facts`
     input — the strategy search's dedupe key.  Two candidates with
     identical fact sets build byte-identical IRs (the builder is pure),
@@ -1220,6 +1479,10 @@ def facts_fingerprint(facts: Sequence[PlanFact], *, axes: Dict[str, int],
         # Omit-when-1: single-slice candidates keep their dedupe keys.
         **({"num_slices": int(num_slices)}
            if int(num_slices) > 1 else {}),
+        # Omit-when-empty: non-pipeline candidates keep their keys.
+        **({"pipeline": [asdict(p)
+                         for p in sorted(pipeline, key=lambda p: p.key)]}
+           if pipeline else {}),
     }, sort_keys=True, separators=(",", ":")).encode()
     return hashlib.sha256(blob).hexdigest()[:12]
 
@@ -1228,7 +1491,8 @@ def ir_from_facts(facts: Sequence[PlanFact], *, axes: Dict[str, int],
                   accum_steps: int = 1, guard: bool = False,
                   fused_kernels: Sequence[str] = (),
                   moe: Sequence[MoEFact] = (),
-                  num_slices: int = 1) -> ScheduleIR:
+                  num_slices: int = 1,
+                  pipeline: Sequence[PipelineFact] = ()) -> ScheduleIR:
     """Mesh-free IR construction from per-variable plan facts — the
     analyzer's and the GSPMD transform's entry point.  Routing mirrors
     the runtime exactly: when any plan implies the explicit path
@@ -1288,7 +1552,7 @@ def ir_from_facts(facts: Sequence[PlanFact], *, axes: Dict[str, int],
         stateful_keys=stateful_buckets,
         per_var_alg=ALG_FUSED if explicit else ALG_PSUM_TREE,
         fused_kernels=fused_kernels, moe=moe,
-        num_slices=num_slices, hier_keys=hier_keys)
+        num_slices=num_slices, hier_keys=hier_keys, pipeline=pipeline)
 
 
 # -- the static schedule verifier --------------------------------------------
@@ -1310,6 +1574,7 @@ RULE_RACE_READ_WRITE = "schedule/race-read-write"
 RULE_BUFFER_LEAK = "schedule/buffer-leak"
 RULE_CAPACITY_OVERFLOW = "moe/capacity-overflow"
 RULE_HIER_TIER_ORDER = "schedule/hier-tier-order"
+RULE_ACT_TRANSPORT = "schedule/act-transport"
 
 
 @dataclass(frozen=True)
@@ -1445,6 +1710,11 @@ def verify(ir: ScheduleIR) -> List[Violation]:
             # does not bind the pair (two quantized a2as per slot are
             # exactly the legal shape).
             continue
+        if l.kind in TRANSPORT_KINDS:
+            # The pipeline activation wire quantizes statelessly (a
+            # fresh scale grid per microbatch boundary, no error
+            # feedback) — the act-transport rule owns its pairing.
+            continue
         if l.tier == TIER_DCN:
             # The DCN wire quantizes statelessly too (a fresh scale
             # grid per cross-slice exchange, no error feedback) — the
@@ -1579,7 +1849,15 @@ def verify(ir: ScheduleIR) -> List[Violation]:
         out.extend(dataflow.race_violations(ir, order=order))
 
     out.extend(_check_hier_tiers(ir, legs, pos))
-    out.extend(_check_stage_sequences(legs, pos))
+    # MPMD pipeline stages are SEPARATE programs on disjoint process
+    # groups (parallel/mpmd): they never co-issue, so the SPMD
+    # cross-stage sequence comparison does not apply between them (the
+    # act-transport rule owns their coupling).  Within a stage the DP
+    # replicas share this one IR, so uniformity holds by construction.
+    mpmd_stages = frozenset(
+        stage_name(i) for pf in ir.pipeline for i in range(pf.num_stages))
+    out.extend(_check_stage_sequences(legs, pos, mpmd_stages=mpmd_stages))
+    out.extend(_check_act_transport(legs, pos))
     # Deterministic diagnostics: CLI output and mutation goldens are
     # byte-stable across runs (and across set/dict iteration orders).
     out.sort(key=lambda v: (v.rule, v.leg, v.location, v.message))
@@ -1614,7 +1892,13 @@ def _check_hier_tiers(ir: ScheduleIR, legs: Sequence[Leg],
     want_tier = {LEG_HIER_REDUCE_SCATTER: (TIER_ICI,),
                  LEG_DCN_ALL_REDUCE: (TIER_DCN,),
                  LEG_DCN_EXCHANGE: (TIER_DCN,),
-                 LEG_HIER_ALL_GATHER: (TIER_ICI, TIER_DCN)}
+                 LEG_HIER_ALL_GATHER: (TIER_ICI, TIER_DCN),
+                 # pipeline transport is tiered too (always DCN) — the
+                 # act-transport rule owns the full contract; admitted
+                 # here so a mixed hier+pipeline program does not flag
+                 # the tag as a single-tier violation.
+                 LEG_SEND_ACT: (TIER_DCN,),
+                 LEG_RECV_ACT: (TIER_DCN,)}
     for l in legs:
         tiers = want_tier.get(l.kind)
         if tiers is not None and l.tier not in tiers:
@@ -1722,14 +2006,28 @@ def _check_hier_tiers(ir: ScheduleIR, legs: Sequence[Leg],
 
 
 def _check_stage_sequences(legs: Sequence[Leg],
-                           pos: Dict[str, int]) -> List[Violation]:
+                           pos: Dict[str, int],
+                           mpmd_stages: FrozenSet[str] = frozenset()
+                           ) -> List[Violation]:
     """Exact cross-stage deadlock check: every participant stage must
     issue an identical ordered collective sequence per microbatch slot.
     Stages compare within a kind family (stage* with stage*, expert*
-    with expert*); all-rank (``""``) legs are uniform by construction."""
+    with expert*); all-rank (``""``) legs are uniform by construction.
+    ``mpmd_stages`` names stages that are separate MPMD programs on
+    disjoint process groups — those never co-issue, so they are exempt
+    from the comparison (an unbalanced pipeline legitimately gives its
+    stages different intra-stage collective sequences)."""
     out: List[Violation] = []
     by_stage: Dict[str, List[Leg]] = {}
     for l in legs:
+        # Pipeline transport legs are point-to-point: adjacent stages
+        # issue CONJUGATE (send vs recv) sequences by design, and edge
+        # stages issue fewer than middle stages — the pairwise
+        # act-transport rule owns their deadlock check.
+        if l.kind in TRANSPORT_KINDS:
+            continue
+        if l.stage in mpmd_stages:
+            continue
         if l.kind in COLLECTIVE_KINDS and l.stage:
             by_stage.setdefault(l.stage, []).append(l)
     families: Dict[str, Dict[int, List[Leg]]] = {}
@@ -1772,6 +2070,97 @@ def _check_stage_sequences(legs: Sequence[Leg],
                         "different collective sequences (deadlock under "
                         "manual scheduling)", location=f"{kind}{idx}"))
                     break
+    return out
+
+
+def _check_act_transport(legs: Sequence[Leg],
+                         pos: Dict[str, int]) -> List[Violation]:
+    """The pipeline transport pairing contract
+    (``schedule/act-transport``).
+
+    Every ``act:`` boundary buffer owes exactly one ``send_act`` and
+    one ``recv_act`` (an orphaned half means one stage blocks forever
+    on a peer that never posts/fetches); the pair must join DIFFERENT
+    named stages (a same-stage pair moves nothing across the slice
+    boundary), the recv must dep-order after its send, both halves must
+    agree on the microbatch slot, the wire is always tier ``dcn``, and
+    within one boundary chain the send slots must issue in order (a
+    swapped pair means adjacent stages disagree on which microbatch is
+    in flight — the MPMD wedge)."""
+    out: List[Violation] = []
+    t_legs = [l for l in legs if l.kind in TRANSPORT_KINDS]
+    if not t_legs:
+        return out
+    pairs: Dict[str, Dict[str, List[Leg]]] = {}
+    for l in t_legs:
+        if l.tier != TIER_DCN:
+            out.append(Violation(
+                RULE_ACT_TRANSPORT, SEV_ERROR,
+                f"transport leg {l.id!r} carries tier {l.tier!r}: "
+                "pipeline activation transport rides the DCN tier",
+                leg=l.id))
+        bufs = l.writes if l.kind == LEG_SEND_ACT else l.reads
+        act = [b for b in bufs if b.startswith("act:")]
+        if len(act) != 1:
+            out.append(Violation(
+                RULE_ACT_TRANSPORT, SEV_ERROR,
+                f"transport leg {l.id!r} names {len(act)} act: "
+                "buffer(s); a send writes exactly one boundary "
+                "activation and a recv reads exactly one", leg=l.id))
+            continue
+        side = "send" if l.kind == LEG_SEND_ACT else "recv"
+        pairs.setdefault(act[0], {"send": [], "recv": []})[side].append(l)
+    for buf, halves in sorted(pairs.items()):
+        sends, recvs = halves["send"], halves["recv"]
+        if len(sends) != 1 or len(recvs) != 1:
+            out.append(Violation(
+                RULE_ACT_TRANSPORT, SEV_ERROR,
+                f"boundary buffer {buf!r} has {len(sends)} send_act and "
+                f"{len(recvs)} recv_act leg(s): an orphaned transport "
+                "half blocks its peer stage forever", location=buf))
+            continue
+        send, recv = sends[0], recvs[0]
+        if not send.stage or not recv.stage or send.stage == recv.stage:
+            out.append(Violation(
+                RULE_ACT_TRANSPORT, SEV_ERROR,
+                f"boundary buffer {buf!r} moves from stage "
+                f"{send.stage or '<all-rank>'!r} to "
+                f"{recv.stage or '<all-rank>'!r}: transport must join "
+                "two DIFFERENT named stages", location=buf))
+        if send.id not in recv.deps:
+            out.append(Violation(
+                RULE_ACT_TRANSPORT, SEV_ERROR,
+                f"recv_act {recv.id!r} does not depend on its send_act "
+                f"{send.id!r}: the fetch may observe a stale or absent "
+                "payload", leg=recv.id))
+        elif pos.get(send.id, 0) > pos.get(recv.id, 0):
+            out.append(Violation(
+                RULE_ACT_TRANSPORT, SEV_ERROR,
+                f"recv_act {recv.id!r} is ordered before its send_act "
+                f"{send.id!r}", leg=recv.id))
+        if send.slot != recv.slot:
+            out.append(Violation(
+                RULE_ACT_TRANSPORT, SEV_ERROR,
+                f"boundary buffer {buf!r}: send slot {send.slot} != "
+                f"recv slot {recv.slot}: the pair must move ONE "
+                "microbatch", location=buf))
+    # Slot monotonicity per boundary chain: the sender must post
+    # microbatches in issue order, or adjacent stages disagree on which
+    # payload is in flight.
+    chains: Dict[str, List[Leg]] = {}
+    for l in t_legs:
+        if l.kind == LEG_SEND_ACT and l.chain:
+            chains.setdefault(l.chain, []).append(l)
+    for chain, ls in sorted(chains.items()):
+        ordered = sorted(ls, key=lambda l: pos.get(l.id, 0))
+        slots = [l.slot for l in ordered]
+        if slots != sorted(slots):
+            out.append(Violation(
+                RULE_ACT_TRANSPORT, SEV_ERROR,
+                f"boundary chain {chain!r} posts microbatch slots "
+                f"{slots}, not in order: adjacent stages disagree on "
+                "the payload in flight (mis-ordered send chain)",
+                location=chain))
     return out
 
 

@@ -63,23 +63,14 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from autodist_tpu.const import MESH_AXIS_DATA, MESH_AXIS_PIPE
+# The tick/bubble algebra is pure and shared with the mesh-free side
+# (schedule IR pricing, the --simulate sweep, the MPMD StageRunner), so
+# it lives jax-free in schedule_ir; re-exported here for compatibility.
+from autodist_tpu.kernel.synchronization.schedule_ir import (  # noqa: F401
+    bubble_fraction_1f1b,
+    schedule_ticks_1f1b,
+)
 from autodist_tpu.utils import compat
-
-
-def schedule_ticks_1f1b(num_stages: int, num_microbatches: int,
-                        num_virtual_stages: int = 1) -> int:
-    """Total ring ticks of the 1F1B schedule: last microbatch injected at
-    ``(M−1)//S·SV + (M−1)%S``, its backward drains ``2(SV−1)`` hops."""
-    s, m, v = num_stages, num_microbatches, num_virtual_stages
-    return ((m - 1) // s) * s * v + ((m - 1) % s) + 2 * (s * v - 1) + 1
-
-
-def bubble_fraction_1f1b(num_stages: int, num_microbatches: int,
-                         num_virtual_stages: int = 1) -> float:
-    """Idle fraction: 1 − ideal/actual ticks, ideal = M·V ticks of one
-    chunk-forward + one chunk-backward each."""
-    t = schedule_ticks_1f1b(num_stages, num_microbatches, num_virtual_stages)
-    return 1.0 - (num_microbatches * num_virtual_stages) / t
 
 
 def one_f_one_b(stage_fn: Callable, loss_fn: Callable, stage_params: Any,

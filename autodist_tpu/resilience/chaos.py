@@ -13,6 +13,7 @@ any other coordinator env) as ``;``-separated events::
 
     kill@step=6,proc=1,attempt=0            # worker 1 exits 43 at step 6
     kill@step=6,proc=1,attempt=0,code=9     # ... with exit code 9
+    kill@step=1,proc=0,stage=1              # only stage1's worker 0 (MPMD)
     kill@step=6,during=save                 # die INSIDE the next Saver.save
     preempt@step=5,signal=SIGTERM           # deliver a preemption notice
     preempt@step=5,grace=2.5                # ... with a 2.5s grace deadline
@@ -47,12 +48,22 @@ crash bundle localize to the planted leg and process.  ``seconds=``
 bounds the block (default: forever — the supervisor's terminate path
 ends it).
 
-Filters (``step``/``proc``/``attempt``) all default to "any"; an event
-fires at most once per process.  ``proc`` matches the JAX process index
-(or ``AUTODIST_PROCESS_ID`` before the runtime is up); ``attempt``
-matches ``AUTODIST_ATTEMPT``, which the job supervisor stamps on every
-relaunch — so ``attempt=0`` means "fail the first try, let the retry
-succeed", the canonical recovery drill.
+Filters (``step``/``proc``/``attempt``/``stage``) all default to
+"any"; an event fires at most once per process.  ``proc`` matches the
+JAX process index (or ``AUTODIST_PROCESS_ID`` before the runtime is
+up); ``attempt`` matches ``AUTODIST_ATTEMPT``, which the job
+supervisor stamps on every relaunch — so ``attempt=0`` means "fail the
+first try, let the retry succeed", the canonical recovery drill.
+``stage`` matches the MPMD pipeline stage a process runs
+(``AUTODIST_STAGE``, which :class:`~autodist_tpu.parallel.mpmd.runner.
+StageRunner` stamps on construction): ``stage=1`` and ``stage=stage1``
+both mean "only the stage-1 program's workers" — the spelling is
+normalized through the schedule IR's shared ``stage_name`` helper, the
+same one the partitioner and ``stage_of`` use.  Note ``proc`` is a
+WITHIN-stage index under MPMD (each stage program is its own
+jax.distributed world), so ``kill@step=1,proc=0,stage=1`` kills
+exactly one worker of one stage — the cross-slice recovery drill in
+tests/integration/mpmd_train.py.
 
 Numerics events (docs/numerics.md) drive the PR 5 guard/rollback tests
 through this same path, but fire differently from the host-side
@@ -96,11 +107,13 @@ class ChaosEvent:
     step: Optional[int] = None      # fire at this step (None = first check)
     proc: Optional[int] = None      # only this process index (None = all)
     attempt: Optional[int] = None   # only this supervisor attempt
+    stage: Optional[str] = None     # only this MPMD pipeline stage
     args: Dict[str, str] = field(default_factory=dict)
     fired: bool = False
 
     def matches(self, step: int, proc: Optional[int],
-                attempt: Optional[int]) -> bool:
+                attempt: Optional[int],
+                stage: Optional[str] = None) -> bool:
         if self.fired:
             return False
         if self.proc is not None and proc is not None and self.proc != proc:
@@ -108,7 +121,20 @@ class ChaosEvent:
         if self.attempt is not None and attempt is not None \
                 and self.attempt != attempt:
             return False
+        if self.stage is not None and stage is not None \
+                and self.stage != stage:
+            return False
         return self.step is None or step >= self.step
+
+
+def _norm_stage(v: str) -> str:
+    """One spelling for stage filters: ``1`` → ``stage1`` via the
+    schedule IR's shared :func:`stage_name` helper (the same canonical
+    form ``stage_of``, the partitioner, and ``AUTODIST_STAGE`` use)."""
+    from autodist_tpu.kernel.synchronization.schedule_ir import stage_name
+
+    v = v.strip()
+    return stage_name(int(v)) if v.isdigit() else v
 
 
 def parse_chaos(spec: str) -> List[ChaosEvent]:
@@ -136,6 +162,8 @@ def parse_chaos(spec: str) -> List[ChaosEvent]:
                 ev.proc = int(v)
             elif k == "attempt":
                 ev.attempt = int(v)
+            elif k == "stage":
+                ev.stage = _norm_stage(v)
             else:
                 ev.args[k] = v.strip()
         events.append(ev)
@@ -154,10 +182,12 @@ class ChaosMonkey:
 
     def __init__(self, events: List[ChaosEvent],
                  process_index: Optional[int] = None,
-                 attempt: Optional[int] = None):
+                 attempt: Optional[int] = None,
+                 stage: Optional[str] = None):
         self._events = list(events)
         self._proc = process_index
         self._attempt = attempt
+        self._stage = stage
         self._heartbeats = True
         self._exit = os._exit            # patchable seam for unit tests
 
@@ -187,6 +217,13 @@ class ChaosMonkey:
             pid = os.environ.get("AUTODIST_PROCESS_ID")
             return int(pid) if pid is not None else None
 
+    def _stage_name(self) -> Optional[str]:
+        """Which MPMD pipeline stage this process runs, if any — the
+        ``AUTODIST_STAGE`` identity a StageRunner stamps at startup."""
+        if self._stage is not None:
+            return self._stage
+        return os.environ.get("AUTODIST_STAGE") or None
+
     def on_step(self, step: int) -> None:
         """Fire every event matching this completed step (each once).
         Numerics events (``nan_grad``/``inf_grad``/``loss_spike``) are
@@ -195,10 +232,11 @@ class ChaosMonkey:
         if not self._events:
             return
         proc = self._process_index()
+        stage = self._stage_name()
         for ev in self._events:
             if ev.action in GRAD_ACTIONS or ev.action in MONITOR_ACTIONS:
                 continue
-            if ev.matches(int(step), proc, self._attempt):
+            if ev.matches(int(step), proc, self._attempt, stage):
                 ev.fired = True
                 self._fire(ev, step)
 
@@ -355,6 +393,7 @@ def _env_events_for(actions, process_index: Optional[int] = None
         except Exception:
             pid = os.environ.get("AUTODIST_PROCESS_ID")
             process_index = int(pid) if pid is not None else None
+    stage = os.environ.get("AUTODIST_STAGE") or None
     out = []
     for ev in parse_chaos(spec):
         if ev.action not in actions:
@@ -364,6 +403,8 @@ def _env_events_for(actions, process_index: Optional[int] = None
             continue
         if ev.attempt is not None and attempt is not None \
                 and ev.attempt != attempt:
+            continue
+        if ev.stage is not None and stage is not None and ev.stage != stage:
             continue
         out.append(ev)
     return out

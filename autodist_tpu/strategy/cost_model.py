@@ -151,6 +151,10 @@ class CostReport:
     # the plan-level estimate has no legs to attribute): the breakdown
     # the search explain surface prints.
     per_kind: Dict[str, float] = field(default_factory=dict)
+    # MPMD pipeline bubble (filled by estimate_ir_cost from the IR's
+    # carried PipelineFacts): the 1F1B warm-up/drain idle fraction that
+    # stretches the compute term — 0.0 for single-program schedules.
+    bubble_fraction: float = 0.0
 
     @property
     def overlap_fraction(self) -> float:
@@ -399,6 +403,10 @@ def leg_participants(leg, ir) -> int:
     one representative per slice (``num_slices`` peers)."""
     from autodist_tpu.kernel.synchronization import schedule_ir as sir
 
+    if leg.kind in sir.TRANSPORT_KINDS:
+        # Pipeline activation transport is point-to-point: one sender
+        # stage, one receiver stage, whatever the mesh axes say.
+        return 2
     d = max(int(ir.axes.get(leg.axis, 1)), 1) if leg.axis else 1
     tier = getattr(leg, "tier", "")
     s = max(int(getattr(ir, "num_slices", 1) or 1), 1)
@@ -456,6 +464,11 @@ def _leg_wire_bytes(leg, d: int) -> float:
         # (d-1)/d of its per-device payload (the leg's nbytes are
         # already per-device capacity-buffer bytes).
         return float(leg.nbytes) * (d - 1) / max(d, 1)
+    if leg.kind == sir.LEG_RECV_ACT:
+        # The send half books the payload (one DCN transfer per
+        # boundary pair); the recv is the blocking fetch — a launch,
+        # not a second copy of the wire bytes.
+        return 0.0
     return float(leg.nbytes)
 
 
@@ -477,6 +490,11 @@ FALLBACK_KINDS = {
     "dcn_exchange": "dcn_all_reduce",
     "hier_reduce_scatter": "reduce_scatter",
     "hier_all_gather": "all_gather",
+    # Pipeline activation transport rides the same cross-slice links as
+    # the DCN shard exchange; an ICI-only calibration prices it
+    # pessimistically through the same chain (never free).
+    "send_act": "dcn_all_reduce",
+    "recv_act": "send_act",
 }
 
 
@@ -553,6 +571,28 @@ def leg_cost_s(leg, ir, constants=None, *,
     bw = dcn_bandwidth if leg_tier(leg, ir) == sir.TIER_DCN \
         else ici_bandwidth
     return wire / bw + alpha * launches
+
+
+def act_transport_bytes(ir) -> Tuple[float, float]:
+    """``(total, exposed)`` DCN activation-transport wire bytes per
+    step: the ``send_act`` legs' wire (``recv_act`` books zero — same
+    blob, counted once).  Exposure mirrors :func:`estimate_ir_cost`'s
+    slot rule — a transfer in microbatch slot ``< accum-1`` rides
+    behind the next microbatch's compute (the 1F1B steady state), only
+    the final slot's boundary crossings are exposed.  The per-point
+    ``--simulate`` column (docs/pipeline.md)."""
+    from autodist_tpu.kernel.synchronization import schedule_ir as sir
+
+    accum = max(int(ir.accum_steps), 1)
+    total = exposed = 0.0
+    for leg in ir.legs:
+        if leg.kind != sir.LEG_SEND_ACT:
+            continue
+        wire = _leg_wire_bytes(leg, leg_participants(leg, ir))
+        total += wire
+        if leg.slot == sir.END_OF_STEP or leg.slot >= accum - 1:
+            exposed += wire
+    return total, exposed
 
 
 def estimate_ir_cost(ir, *, ici_bandwidth: float = ICI_BANDWIDTH,
@@ -668,6 +708,15 @@ def estimate_ir_cost(ir, *, ici_bandwidth: float = ICI_BANDWIDTH,
         comm_s = ((report.exposed_wire_bytes - exposed_dcn) / ici_bandwidth
                   + exposed_dcn / dcn_bandwidth
                   + alpha * report.num_collectives)
+    # MPMD pipeline bubble (docs/pipeline.md): the 1F1B warm-up/drain
+    # idle ticks stretch the compute term by 1/(1 - bubble) — the
+    # steady-state transport legs are already priced (hidden behind
+    # slots 0..M-2, exposed on the last slot) by the loop above.
+    for pf in getattr(ir, "pipeline", ()) or ():
+        report.bubble_fraction = max(report.bubble_fraction,
+                                     pf.bubble_fraction())
+    if report.bubble_fraction > 0.0 and compute_time_s > 0.0:
+        compute_time_s = compute_time_s / (1.0 - report.bubble_fraction)
     report.time_s = max(compute_time_s, comm_s) + update_s
     return report
 

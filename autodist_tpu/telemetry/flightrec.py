@@ -392,15 +392,18 @@ def load_published_ir(run_dir: str,
 # -- hang localization -------------------------------------------------------
 
 class _LegView:
-    """Minimal leg adapter (id/deps/kind) over IR legs or raw dicts —
-    what the happens-before structures consume."""
+    """Minimal leg adapter (id/deps/kind/stage) over IR legs or raw
+    dicts — what the happens-before structures consume; ``stage`` lets
+    the hang report name the wedged pipeline stage."""
 
-    __slots__ = ("id", "deps", "kind")
+    __slots__ = ("id", "deps", "kind", "stage")
 
-    def __init__(self, id: str, deps: Tuple[str, ...], kind: str):
+    def __init__(self, id: str, deps: Tuple[str, ...], kind: str,
+                 stage: str = ""):
         self.id = id
         self.deps = deps
         self.kind = kind
+        self.stage = stage
 
 
 def leg_views(legs_or_ir) -> List[_LegView]:
@@ -414,9 +417,11 @@ def leg_views(legs_or_ir) -> List[_LegView]:
         if isinstance(l, dict):
             out.append(_LegView(str(l.get("id", "")),
                                 tuple(l.get("deps", ()) or ()),
-                                str(l.get("kind", ""))))
+                                str(l.get("kind", "")),
+                                str(l.get("stage", "") or "")))
         else:
-            out.append(_LegView(l.id, tuple(l.deps), l.kind))
+            out.append(_LegView(l.id, tuple(l.deps), l.kind,
+                                str(getattr(l, "stage", "") or "")))
     return out
 
 
@@ -493,13 +498,17 @@ class HangDiagnosis:
     detail: str = ""
     fingerprint: Optional[str] = None
     per_host: Dict[str, dict] = field(default_factory=dict)
+    #: pipeline stage of the frontier leg ("" when the schedule has no
+    #: per-stage legs) — names the wedged stage in the MPMD hang report.
+    stage: str = ""
 
     def to_dict(self) -> dict:
         return {"frontier_leg": self.frontier_leg,
                 "frontier_legs": list(self.frontier_legs),
                 "culprits": list(self.culprits), "tie": self.tie,
                 "detail": self.detail, "fingerprint": self.fingerprint,
-                "per_host": self.per_host}
+                "per_host": self.per_host,
+                **({"stage": self.stage} if self.stage else {})}
 
     @classmethod
     def from_dict(cls, d: dict) -> "HangDiagnosis":
@@ -509,7 +518,8 @@ class HangDiagnosis:
                    tie=bool(d.get("tie", False)),
                    detail=str(d.get("detail", "")),
                    fingerprint=d.get("fingerprint"),
-                   per_host=dict(d.get("per_host", {})))
+                   per_host=dict(d.get("per_host", {})),
+                   stage=str(d.get("stage", "") or ""))
 
 
 def localize_hang(legs_or_ir, cursors: Dict[str, Optional[dict]],
@@ -539,6 +549,24 @@ def localize_hang(legs_or_ir, cursors: Dict[str, Optional[dict]],
         return None
     diag = HangDiagnosis(fingerprint=fingerprint, per_host=per_host)
 
+    def _stamp_stage(d: HangDiagnosis) -> HangDiagnosis:
+        """Name the wedged pipeline stage (and call out a transport
+        frontier — the cross-slice MPMD wedge) from the frontier leg's
+        IR metadata."""
+        if d.frontier_leg is None or legs_or_ir is None:
+            return d
+        for v in leg_views(legs_or_ir):
+            if v.id == d.frontier_leg:
+                if v.stage:
+                    d.stage = v.stage
+                    extra = f" — wedged at pipeline stage {v.stage!r}"
+                    if v.kind in ("send_act", "recv_act"):
+                        extra += (f" on {v.kind} leg {v.id!r} (cross-"
+                                  "slice activation transport)")
+                    d.detail += extra
+                break
+        return d
+
     steps = {h: int(c["step"]) for h, c in per_host.items()
              if c.get("step") is not None}
     if steps and len(set(steps.values())) > 1:
@@ -553,7 +581,7 @@ def localize_hang(legs_or_ir, cursors: Dict[str, Optional[dict]],
             f"peers reached step {hi}"
             + (f" — last cursor {cursor_line(per_host[culprits[0]])}"
                if culprits else ""))
-        return diag
+        return _stamp_stage(diag)
 
     views = leg_views(legs_or_ir) if legs_or_ir is not None else []
     known_ids = {v.id for v in views}
@@ -594,7 +622,7 @@ def localize_hang(legs_or_ir, cursors: Dict[str, Optional[dict]],
             f"host(s) {', '.join(culprits)} never completed frontier "
             f"leg {diag.frontier_leg}, a happens-before dependency of "
             f"the leg(s) every peer is blocked in ({', '.join(blocked)})")
-    return diag
+    return _stamp_stage(diag)
 
 
 # -- crash bundles -----------------------------------------------------------
@@ -845,6 +873,8 @@ def render_hang_report(bundle_dir: str, stack_lines: int = 12) -> str:
                      + (f"  (frontier set: "
                         f"{', '.join(diag.get('frontier_legs', []))})"
                         if len(diag.get("frontier_legs", [])) > 1 else ""))
+        if diag.get("stage"):
+            lines.append(f"  wedged stage: {diag['stage']}")
         verdict = "TIE — no unique culprit" if diag.get("tie") \
             else f"culprit: {', '.join(diag.get('culprits', []))}"
         lines.append(f"  {verdict}")

@@ -203,6 +203,7 @@ def run_child(platform: str) -> None:
     _fill_search(result)
     _fill_moe(result)
     _fill_hier(result)
+    _fill_mpmd(result)
     _fill_kernels(result)
     mark("grad_sync")
     # Serving scale-out (paged KV + continuous batching): its own CPU
@@ -1576,6 +1577,36 @@ def _fill_hier(result) -> None:
             f.write("\n")
     except Exception as e:  # pragma: no cover - best-effort enrichment
         print(f"bench: hier section unavailable ({e!r})",
+              file=sys.stderr, flush=True)
+
+
+def _fill_mpmd(result) -> None:
+    """MPMD pipeline runtime (docs/pipeline.md, BENCH_mpmd.json): the
+    same 4-layer model as 1, 2, and 4 per-stage programs coupled only
+    by the activation transport — step time, exposed DCN activation
+    bytes per microbatch, and the 1F1B bubble predicted
+    (``bubble_fraction_1f1b``) vs measured (``1 - t1/(S*tS)``).
+    ``assert_verified`` gates every mode and each mode asserts its
+    runtime fingerprint equals an independent ``ir_from_facts``
+    rebuild.  Runs in its own CPU child; committed standalone as
+    BENCH_mpmd.json."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    cmd = [sys.executable, "-u", os.path.abspath(__file__), "--mpmd-child"]
+    try:
+        proc = subprocess.run(cmd, stdout=subprocess.PIPE, env=env,
+                              timeout=900)
+        payload = _extract_json(proc.stdout.decode())
+        if payload is None or proc.returncode != 0:
+            raise RuntimeError(f"no JSON from mpmd child "
+                               f"(rc={proc.returncode})")
+        result["mpmd"] = payload
+        with open(os.path.join(REPO, "BENCH_mpmd.json"), "w",
+                  encoding="utf-8") as f:
+            json.dump(payload, f, indent=2, sort_keys=True)
+            f.write("\n")
+    except Exception as e:  # pragma: no cover - best-effort enrichment
+        print(f"bench: mpmd section unavailable ({e!r})",
               file=sys.stderr, flush=True)
 
 
@@ -3725,6 +3756,141 @@ def run_hier_child() -> None:
     print(json.dumps(out), flush=True)
 
 
+def run_mpmd_child() -> None:
+    """MPMD pipeline measurement (child process, CPU — docs/pipeline.md).
+
+    One 4-layer MLP trained three ways through the SAME
+    :func:`~autodist_tpu.parallel.mpmd.partition.build_pipeline_ir`
+    program: single-stage (no pipeline, the baseline ``t1``), 2-stage,
+    and 4-stage MPMD — each stage its own
+    :class:`~autodist_tpu.parallel.mpmd.runner.StageRunner` on its own
+    thread, coupled only by the in-memory activation transport (the
+    cross-slice DCN plane's fast path).  Per mode: ``assert_verified``
+    gates the IR, the runtime fingerprint is asserted equal to an
+    independent ``ir_from_facts`` rebuild (static == runtime), step
+    time over the same batch, exposed DCN activation bytes per
+    microbatch (``2*(S-1)*leg_nbytes`` — one forward + one backward
+    boundary crossing), and the 1F1B bubble predicted
+    (``bubble_fraction_1f1b(S, M)``) vs measured
+    (``1 - t1/(S*tS)`` — with S stages the work is spread over S
+    runners, so a bubble-free pipeline would step in ``t1/S``).
+    Asserted in-child: every transport leg rides the dcn tier, the leg
+    count is ``4*(S-1)*M``, and all three modes produce the same
+    step-0 loss (they are the SAME model and the SAME f32 SGD)."""
+    _steer("cpu")
+    import threading
+    import time as _time
+
+    import jax  # noqa: F401
+    import jax.numpy as jnp
+    import numpy as np
+
+    from autodist_tpu.kernel.synchronization import schedule_ir as sir
+    from autodist_tpu.parallel import mpmd
+    from autodist_tpu.parallel.mpmd import transport as tmod
+    from autodist_tpu.strategy.cost_model import act_transport_bytes
+
+    n_layers, width, m_n, batch = 4, 64, 8, 32
+    steps, warmup = 6, 2
+    rng = np.random.RandomState(0)
+    layers = [{"w": (rng.randn(width, width) * 0.2).astype(np.float32),
+               "b": np.zeros((width,), np.float32)}
+              for _ in range(n_layers)]
+    x = rng.randn(batch, width).astype(np.float32)
+    tgt = rng.randn(batch, width).astype(np.float32)
+    rows = batch // m_n
+    x_mbs = [x[j * rows:(j + 1) * rows] for j in range(m_n)]
+    t_mbs = [tgt[j * rows:(j + 1) * rows] for j in range(m_n)]
+
+    def mse(y, t):
+        return jnp.mean((y - t) ** 2)
+
+    out = {"microbatches": m_n, "batch": batch, "layers": n_layers,
+           "width": width, "modes": {}}
+
+    for s_n in (1, 2, 4):
+        part, stage_params = mpmd.partition_params(layers, s_n)
+        prog = mpmd.build_pipeline_ir(
+            layer_params=layers, num_stages=s_n, num_microbatches=m_n,
+            act_nbytes=rows * width * 4)
+        sir.assert_verified(prog.ir, f"bench mpmd [stages={s_n}]")
+        rebuilt = sir.ir_from_facts(
+            list(prog.facts), axes=dict(prog.axes),
+            accum_steps=int(prog.ir.accum_steps),
+            pipeline=list(prog.pipeline))
+        assert rebuilt.fingerprint() == prog.ir.fingerprint(), \
+            "static fingerprint diverges from the runtime IR"
+        transport_legs = [l for l in prog.ir.legs
+                          if l.kind in sir.TRANSPORT_KINDS]
+        assert all(l.tier == sir.TIER_DCN for l in transport_legs), \
+            "activation transport off the dcn tier"
+        assert len(transport_legs) == 4 * (s_n - 1) * m_n, \
+            (len(transport_legs), s_n)
+
+        def stage_fn_for(si):
+            def fn(p, h):
+                for j in part.layers[si]:
+                    pre = f"{sir.stage_name(si)}/l{j}"
+                    h = jnp.tanh(h @ p[f"{pre}/w"] + p[f"{pre}/b"])
+                return h
+            return fn
+
+        tmod.reset_registry()
+        runners = [mpmd.StageRunner(
+            prog, si, stage_fn=stage_fn_for(si),
+            params=stage_params[si],
+            transport=mpmd.ActivationTransport("", channel="dp0"),
+            lr=0.1, loss_fn=mse if si == s_n - 1 else None)
+            for si in range(s_n)]
+
+        def one_step():
+            res = [None] * s_n
+
+            def run(si):
+                res[si] = runners[si].run_step(
+                    x_mbs if si == 0 else None,
+                    t_mbs if si == s_n - 1 else None)
+
+            ths = [threading.Thread(target=run, args=(si,))
+                   for si in range(s_n)]
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join()
+            return float(res[s_n - 1])
+
+        losses = [one_step() for _ in range(warmup)]
+        t0 = _time.perf_counter()
+        losses += [one_step() for _ in range(steps)]
+        dt = (_time.perf_counter() - t0) / steps
+
+        total_act, exposed_act = act_transport_bytes(prog.ir)
+        pf = prog.pipeline[0] if prog.pipeline else None
+        out["modes"][f"stages{s_n}"] = {
+            "stages": s_n,
+            "schedule_fingerprint": prog.ir.fingerprint(),
+            "step_time_ms": round(dt * 1e3, 3),
+            "losses": [round(v, 6) for v in losses],
+            "n_transport_legs": len(transport_legs),
+            "bubble_predicted": round(
+                sir.bubble_fraction_1f1b(s_n, m_n), 4),
+            "act_dcn_bytes": {"total": int(total_act),
+                              "exposed": int(exposed_act)},
+            "act_dcn_bytes_per_microbatch": int(
+                2 * (s_n - 1) * (pf.leg_nbytes() if pf else 0)),
+        }
+
+    t1 = out["modes"]["stages1"]["step_time_ms"]
+    for s_n in (2, 4):
+        mode = out["modes"][f"stages{s_n}"]
+        mode["bubble_measured"] = round(
+            max(0.0, 1.0 - t1 / (s_n * mode["step_time_ms"])), 4)
+    first = [m["losses"][0] for m in out["modes"].values()]
+    assert max(first) - min(first) <= 1e-5, \
+        f"pipelined modes diverge at step 0: {first}"
+    print(json.dumps(out), flush=True)
+
+
 def run_probe() -> None:
     """Cheap TPU liveness check: real matmul, real sync."""
     import jax
@@ -3922,6 +4088,8 @@ if __name__ == "__main__":
         run_moe_child()
     elif "--hier-child" in sys.argv:
         run_hier_child()
+    elif "--mpmd-child" in sys.argv:
+        run_mpmd_child()
     elif "--profiler-child" in sys.argv:
         run_profiler_child()
     elif "--kernels-child" in sys.argv:
