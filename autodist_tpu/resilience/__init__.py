@@ -35,6 +35,7 @@ _EXPORTS = {
     "grad_injections": "autodist_tpu.resilience.chaos",
     "loss_spike_events": "autodist_tpu.resilience.chaos",
     "parse_chaos": "autodist_tpu.resilience.chaos",
+    "ServingChaos": "autodist_tpu.resilience.chaos",
     "Attempt": "autodist_tpu.resilience.supervisor",
     "FailFast": "autodist_tpu.resilience.supervisor",
     "FailurePolicy": "autodist_tpu.resilience.supervisor",

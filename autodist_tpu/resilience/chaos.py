@@ -26,6 +26,10 @@ any other coordinator env) as ``;``-separated events::
     nan_grad@step=3,bucket=all_reduce:float32:g0:0   # NaN into a bucket
     inf_grad@step=3,var=l0/w                # Inf into one grad leaf
     loss_spike@step=9,factor=1e6            # spike the MONITORED loss
+    kill_replica@replica=0,tokens=5         # serving: die mid-decode
+    slow_replica@replica=1,seconds=0.05     # serving: per-step latency
+    drop_response@replica=0,count=2         # serving: sever 2 responses
+    stale_stats@replica=0                   # serving: freeze /v1/stats
 
 Recovery-tier drills (docs/resilience.md): ``preempt@...,grace=<s>``
 stamps ``AUTODIST_PREEMPT_GRACE_S`` before delivering the signal, so
@@ -77,6 +81,25 @@ detection is exact on every sync path.  They require
 OBSERVED loss once (``factor=``, default 1e6) without touching the real
 trajectory — the synthetic detector drill behind the
 rollback-vs-oracle parity test.
+
+Serving events (docs/serving.md "Fault tolerance") are consumed by a
+:class:`ServingChaos` inside the replica's :class:`~autodist_tpu.
+serving.server.EngineServer`, not by ``on_step``: a replica has no
+training step, so the firing clock is serving progress — ``requests=``
+(completion submissions so far) and ``tokens=`` (generated tokens so
+far, the "mid-decode" trigger), both defaulting to fire on the first
+driver tick.  ``replica=`` filters on the replica index (the trailing
+integer of ``AUTODIST_REPLICA_NAME``, or ``AUTODIST_REPLICA``
+explicitly); the other filters keep their meaning.  ``kill_replica``
+os._exits (code=, default 43) — the router's token-exact in-flight
+recovery drill; ``slow_replica`` injects ``seconds=`` of latency into
+every subsequent driver iteration (the straggler behind hedged
+requests); ``drop_response`` severs the next ``count=`` completion
+responses after a request fully decodes (the retry-idempotence drill);
+``stale_stats`` freezes the ``/v1/stats`` payload at its arming-time
+snapshot, so the router's load scores go stale.  Per the "kills leave
+evidence" rule, every serving injection is journaled BEFORE it
+executes.
 """
 from __future__ import annotations
 
@@ -89,12 +112,18 @@ from typing import Dict, List, Optional
 from autodist_tpu.utils import logging
 
 ACTIONS = ("kill", "preempt", "drop_heartbeats", "corrupt_ckpt",
-           "storage_stall", "hang", "nan_grad", "inf_grad", "loss_spike")
+           "storage_stall", "hang", "nan_grad", "inf_grad", "loss_spike",
+           "kill_replica", "slow_replica", "drop_response",
+           "stale_stats")
 
 #: events NOT executed by ChaosMonkey.on_step: grad injections compile
 #: into the step (numerics guard), loss_spike rides the health monitor.
 GRAD_ACTIONS = ("nan_grad", "inf_grad")
 MONITOR_ACTIONS = ("loss_spike",)
+#: ... and serving events ride the replica's ServingChaos (the
+#: EngineServer driver loop), clocked by serving progress, not steps.
+SERVING_ACTIONS = ("kill_replica", "slow_replica", "drop_response",
+                   "stale_stats")
 
 DEFAULT_KILL_CODE = 43   # distinct from crashes (1) and supervised aborts
 
@@ -108,6 +137,7 @@ class ChaosEvent:
     proc: Optional[int] = None      # only this process index (None = all)
     attempt: Optional[int] = None   # only this supervisor attempt
     stage: Optional[str] = None     # only this MPMD pipeline stage
+    replica: Optional[int] = None   # only this serving replica index
     args: Dict[str, str] = field(default_factory=dict)
     fired: bool = False
 
@@ -164,6 +194,8 @@ def parse_chaos(spec: str) -> List[ChaosEvent]:
                 ev.attempt = int(v)
             elif k == "stage":
                 ev.stage = _norm_stage(v)
+            elif k == "replica":
+                ev.replica = int(v)
             else:
                 ev.args[k] = v.strip()
         events.append(ev)
@@ -234,7 +266,8 @@ class ChaosMonkey:
         proc = self._process_index()
         stage = self._stage_name()
         for ev in self._events:
-            if ev.action in GRAD_ACTIONS or ev.action in MONITOR_ACTIONS:
+            if ev.action in GRAD_ACTIONS or ev.action in MONITOR_ACTIONS \
+                    or ev.action in SERVING_ACTIONS:
                 continue
             if ev.matches(int(step), proc, self._attempt, stage):
                 ev.fired = True
@@ -331,6 +364,117 @@ class ChaosCallback:
     def on_epoch_end(self, epoch: int, logs) -> None: ...
 
     def on_train_end(self, history) -> None: ...
+
+
+def replica_index_from_env() -> Optional[int]:
+    """This process's serving-replica index: ``AUTODIST_REPLICA``
+    explicitly, else the trailing integer of ``AUTODIST_REPLICA_NAME``
+    (the pool names replicas ``replica-<i>``)."""
+    raw = os.environ.get("AUTODIST_REPLICA")
+    if raw is not None:
+        return int(raw)
+    name = os.environ.get("AUTODIST_REPLICA_NAME", "")
+    tail = name.rsplit("-", 1)[-1] if "-" in name else name
+    return int(tail) if tail.isdigit() else None
+
+
+class ServingChaos:
+    """Serving-plane fault injection, consumed by the replica's
+    :class:`~autodist_tpu.serving.server.EngineServer`.
+
+    The firing clock is serving progress, not training steps: the
+    server's driver loop calls :meth:`on_tick` with its cumulative
+    submission and generated-token counts, and an event fires once
+    when both its ``requests=`` and ``tokens=`` thresholds are met
+    (both default 0 — fire on the first tick).  ``kill_replica``
+    os._exits immediately; the other actions ARM behavior the server
+    consults: :attr:`slow_s` (injected per-iteration driver latency),
+    :meth:`take_drop` (sever the next N completion responses),
+    :attr:`stats_stale` (freeze the ``/v1/stats`` snapshot).  Every
+    injection is journaled before it executes."""
+
+    def __init__(self, events: List[ChaosEvent],
+                 replica: Optional[int] = None,
+                 attempt: Optional[int] = None):
+        self._events = [ev for ev in events
+                        if ev.action in SERVING_ACTIONS]
+        self._replica = replica
+        self._attempt = attempt
+        self.slow_s = 0.0
+        self.stats_stale = False
+        self._drop_pending = 0
+        self._exit = os._exit            # patchable seam for unit tests
+
+    @classmethod
+    def from_env(cls, replica: Optional[int] = None) -> "ServingChaos":
+        from autodist_tpu.const import ENV
+
+        events = parse_chaos(ENV.AUTODIST_CHAOS.val)
+        if replica is None:
+            replica = replica_index_from_env()
+        return cls(events, replica=replica,
+                   attempt=ENV.AUTODIST_ATTEMPT.val)
+
+    def __bool__(self) -> bool:
+        return bool(self._events)
+
+    @property
+    def events(self) -> List[ChaosEvent]:
+        return list(self._events)
+
+    def _matches(self, ev: ChaosEvent, requests: int,
+                 generated: int) -> bool:
+        if ev.fired:
+            return False
+        if ev.replica is not None and self._replica is not None \
+                and ev.replica != self._replica:
+            return False
+        if ev.attempt is not None and self._attempt is not None \
+                and ev.attempt != self._attempt:
+            return False
+        if requests < int(ev.args.get("requests", 0)):
+            return False
+        return generated >= int(ev.args.get("tokens", 0))
+
+    def on_tick(self, *, requests: int = 0, generated: int = 0) -> None:
+        """Fire every event whose progress thresholds this tick meets
+        (each once).  Called from the server's driver loop."""
+        for ev in self._events:
+            if self._matches(ev, int(requests), int(generated)):
+                ev.fired = True
+                self._fire(ev, int(requests), int(generated))
+
+    def _fire(self, ev: ChaosEvent, requests: int,
+              generated: int) -> None:
+        logging.warning(
+            "CHAOS: firing %s (replica=%s requests=%d generated=%d)",
+            ev.action, self._replica, requests, generated)
+        # Journal BEFORE executing — a kill_replica os._exit leaves no
+        # later chance, and the post-mortem timeline must show the
+        # fault was deliberate (same rule as ChaosMonkey._fire).
+        from autodist_tpu.telemetry import emit_event
+        emit_event("chaos/" + ev.action, replica=self._replica,
+                   requests=requests, generated=generated,
+                   args=dict(ev.args))
+        if ev.action == "kill_replica":
+            # os._exit: no atexit, no socket shutdown — connected
+            # clients see a mid-stream hangup, which is the point (the
+            # router's partial-token recovery drill).
+            self._exit(int(ev.args.get("code", DEFAULT_KILL_CODE)))
+        elif ev.action == "slow_replica":
+            self.slow_s = float(ev.args.get("seconds", 0.05))
+        elif ev.action == "drop_response":
+            self._drop_pending += int(ev.args.get("count", 1))
+        elif ev.action == "stale_stats":
+            self.stats_stale = True
+
+    def take_drop(self) -> bool:
+        """Consume one armed response drop (the handler severs the
+        connection instead of writing the completion)."""
+        if self._drop_pending > 0:
+            self._drop_pending -= 1
+            return True
+        return False
 
 
 def corrupt_checkpoint(path: str, item: str = "params",

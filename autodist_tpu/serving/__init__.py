@@ -15,24 +15,33 @@ saved_model_builder.py:24-64``):
   Prometheus ``/metrics``) in front of either engine;
 * the :class:`Router` + :class:`SupervisedReplicaPool` pair: N
   replicas supervised through the PR 4 resilience machinery, with
-  queue-depth/block-headroom load balancing and re-routing of
-  in-flight requests when a replica dies.
+  queue-depth/block-headroom load balancing, re-routing of in-flight
+  requests when a replica dies, token-exact mid-decode recovery,
+  per-replica circuit breakers, graceful drain (``/admin/drain`` +
+  SIGTERM, ``rolling_restart()``), deadline shedding
+  (:class:`DeadlineError` → 503), and optional hedging
+  (docs/serving.md, "Fault tolerance").
 """
-from autodist_tpu.serving.engine import (AdmissionError, DecodeEngine,
-                                         EngineStats, Request)
+from autodist_tpu.serving.engine import (AdmissionError, DeadlineError,
+                                         DecodeEngine, EngineStats,
+                                         Request)
 from autodist_tpu.serving.paged_kv import (BlockPool, BlockPoolExhausted,
                                            PrefixTrie)
 from autodist_tpu.serving.scheduler import (PagedDecodeEngine,
                                             SLO_CLASSES, SLO_LATENCY,
                                             SLO_THROUGHPUT)
-from autodist_tpu.serving.router import (Router, RouterBusy, RouterError,
+from autodist_tpu.serving.router import (Router, RouterBusy,
+                                         RouterDeadlineError, RouterError,
                                          RouterRequestError,
                                          SupervisedReplicaPool)
-from autodist_tpu.serving.server import EngineServer, serve
+from autodist_tpu.serving.server import (EngineServer,
+                                         install_drain_on_sigterm, serve)
 
-__all__ = ["AdmissionError", "DecodeEngine", "EngineStats", "Request",
+__all__ = ["AdmissionError", "DeadlineError", "DecodeEngine",
+           "EngineStats", "Request",
            "BlockPool", "BlockPoolExhausted", "PrefixTrie",
            "PagedDecodeEngine", "SLO_CLASSES", "SLO_LATENCY",
-           "SLO_THROUGHPUT", "Router", "RouterBusy", "RouterError",
+           "SLO_THROUGHPUT", "Router", "RouterBusy",
+           "RouterDeadlineError", "RouterError",
            "RouterRequestError", "SupervisedReplicaPool", "EngineServer",
-           "serve"]
+           "install_drain_on_sigterm", "serve"]

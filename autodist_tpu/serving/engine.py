@@ -91,6 +91,21 @@ class AdmissionError(RuntimeError):
         self.retry_after_s = float(retry_after_s)
 
 
+class DeadlineError(RuntimeError):
+    """Typed deadline shed: a submit was rejected because the measured
+    queue-wait/per-token percentiles say the request cannot finish
+    inside its ``deadline_s``.  Distinct from :class:`AdmissionError`
+    (queue full): the queue may be shallow — the request itself is
+    infeasible under current service rates.  The HTTP front surfaces
+    this as 503 + ``Retry-After`` with ``"shed": true``, which the
+    router treats as route-elsewhere WITHOUT marking the replica down
+    (shedding is a load signal, not a health signal)."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
+
+
 def check_speculative_args(gamma, temperature, *, span=None,
                            window=None) -> None:
     """Submit-time validation of speculative-decoding knobs, mirroring

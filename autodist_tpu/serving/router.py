@@ -26,6 +26,30 @@ supervised relaunch with exact resume — applied to serving:
   at admission capacity does the router surface
   :class:`RouterBusy` with the largest ``Retry-After`` hint.
 
+Fault tolerance on top of re-routing (docs/serving.md):
+
+* **Token-exact recovery** — greedy ``prompt_tokens`` requests go out
+  as SSE streams; the router records each delta, and when a replica
+  dies mid-decode it resubmits ``prompt + partial`` so the survivor
+  only prefills the carried tokens and decodes the REST.  The stitched
+  result is bit-identical to an uninterrupted run, and carries
+  ``recovered: true`` / ``resumed_tokens`` as evidence of
+  resume-not-restart.
+* **Drain awareness** — a 429 with ``draining: true`` (or a draining
+  flag in ``/v1/stats``) takes the replica out of candidate rotation
+  without marking it down: it is healthy, just leaving.
+* **Circuit breaker** — ``breaker_threshold`` consecutive transport
+  or 5xx failures open a per-replica breaker for ``breaker_hold_s``
+  (doubling per re-open); expiry is the half-open probe.
+* **Deadline shed** — a 503 with ``shed: true`` routes elsewhere
+  without a health penalty; ``complete(timeout_s=...)`` itself raises
+  :class:`RouterDeadlineError` the moment its budget is spent instead
+  of posting with a floored timeout.
+* **Hedging** (``hedge_after_s``) — a latency-class request still
+  unanswered after the hint is mirrored to the next-best replica;
+  first 200 wins, the loser is cancelled through ``POST /v1/cancel``
+  with the request id from the stream's announce event.
+
 The router speaks the replicas' HTTP surface (``serving/server.py``)
 through a tiny stdlib client, but takes any duck-typed endpoint —
 the unit tests drive it with in-process fakes; the drill uses real
@@ -33,6 +57,7 @@ subprocess replicas.
 """
 from __future__ import annotations
 
+import contextlib
 import http.client
 import json
 import os
@@ -57,6 +82,13 @@ class RouterBusy(RouterError):
     def __init__(self, message: str, retry_after_s: float = 1.0):
         super().__init__(message)
         self.retry_after_s = float(retry_after_s)
+
+
+class RouterDeadlineError(RouterError):
+    """``complete(timeout_s=...)`` expired before any replica answered.
+    No further attempts are made once the budget is spent — the old
+    behavior posted one more request with a floored 1 s timeout, which
+    both wasted replica work and lied to the caller."""
 
 
 class RouterRequestError(RuntimeError):
@@ -111,6 +143,69 @@ class HTTPReplicaClient:
         headers = {"X-Autodist-Trace": trace_id} if trace_id else None
         return self._request("POST", "/v1/completions", body, timeout,
                              headers=headers)
+
+    def post_completion_stream(self, body: dict, timeout: float = 120.0,
+                               trace_id: str = "",
+                               on_event=None) -> Tuple[int, dict]:
+        """POST a streaming completion and read the SSE events.
+
+        Non-200 answers return ``(status, parsed_body)`` exactly like
+        :meth:`post_completion`.  On 200 every ``data:`` event is
+        handed to ``on_event`` as it arrives (the router's recovery
+        ledger hangs off this callback) and the FINAL event is
+        returned as the payload.  A connection that dies before the
+        final event raises ``OSError`` — by then ``on_event`` has
+        already seen every delta the replica managed to send, which is
+        exactly the partial-progress record recovery needs."""
+        conn = http.client.HTTPConnection(self.host, self.port,
+                                          timeout=timeout)
+        try:
+            payload = json.dumps(body)
+            hdrs = {"Content-Type": "application/json"}
+            if trace_id:
+                hdrs["X-Autodist-Trace"] = trace_id
+            try:
+                conn.request("POST", "/v1/completions", payload, hdrs)
+                resp = conn.getresponse()
+            except http.client.HTTPException as e:
+                raise OSError(f"stream setup failed: {e}") from e
+            if resp.status != 200:
+                raw = resp.read()
+                try:
+                    data = json.loads(raw) if raw else {}
+                except ValueError:
+                    data = {"raw": raw.decode(errors="replace")}
+                if isinstance(data, dict):
+                    data["_headers"] = dict(resp.getheaders())
+                return resp.status, data
+            final: Optional[dict] = None
+            try:
+                for line in resp:
+                    line = line.strip()
+                    if not line.startswith(b"data: "):
+                        continue
+                    try:
+                        ev = json.loads(line[len(b"data: "):])
+                    except ValueError as e:
+                        raise OSError(f"garbled stream event: {e}") from e
+                    if on_event is not None:
+                        on_event(ev)
+                    if ev.get("done") or ev.get("error"):
+                        final = ev
+                        break
+            except http.client.HTTPException as e:
+                raise OSError(f"stream read failed: {e}") from e
+            if final is None:
+                raise OSError("stream severed before the final event")
+            return 200, final
+        finally:
+            conn.close()
+
+    def cancel(self, request_id: int, timeout: float = 5.0) -> bool:
+        status, data = self._request("POST", "/v1/cancel",
+                                     {"id": int(request_id)},
+                                     timeout=timeout)
+        return status == 200 and bool(data.get("cancelled"))
 
     def stats(self, timeout: float = 5.0) -> dict:
         status, data = self._request("GET", "/v1/stats", timeout=timeout)
@@ -200,6 +295,21 @@ class ReplicaEndpoint:
         return cli.post_completion(body, timeout=timeout,
                                    trace_id=trace_id)
 
+    def post_stream(self, body: dict, timeout: float,
+                    trace_id: str = "", on_event=None) -> Tuple[int, dict]:
+        cli = self.client()
+        if cli is None:
+            raise OSError(f"{self.name}: no address published")
+        return cli.post_completion_stream(body, timeout=timeout,
+                                          trace_id=trace_id,
+                                          on_event=on_event)
+
+    def cancel(self, request_id: int) -> bool:
+        cli = self.client()
+        if cli is None:
+            raise OSError(f"{self.name}: no address published")
+        return cli.cancel(request_id)
+
 
 class Router:
     """Load-balancing, re-routing front over a set of endpoints.
@@ -223,14 +333,26 @@ class Router:
     transport failure or 5xx mark the replica down (it re-enters
     rotation when a later probe passes) and try the next; on 429
     remember the Retry-After hint and try the next; other 4xx raise
-    :class:`RouterRequestError` without re-routing."""
+    :class:`RouterRequestError` without re-routing.
+
+    ``recover`` (default on) turns greedy ``prompt_tokens`` requests
+    into SSE streams against endpoints exposing ``post_stream``, so a
+    replica death mid-decode resumes token-exactly on a survivor
+    instead of restarting.  ``breaker_threshold`` / ``breaker_hold_s``
+    parameterize the per-replica circuit breaker (0 disables it).
+    ``hedge_after_s`` (None = off) arms first-wins hedging for
+    latency-class stragglers."""
 
     def __init__(self, endpoints: Sequence[Any], *,
                  probe_ttl_s: float = 1.0, stats_ttl_s: float = 0.25,
                  occupancy_weight: float = 4.0,
                  draft_occupancy_weight: float = 0.0,
                  max_attempts: Optional[int] = None,
-                 retry_wait_s: float = 0.25):
+                 retry_wait_s: float = 0.25,
+                 recover: bool = True,
+                 breaker_threshold: int = 3,
+                 breaker_hold_s: float = 5.0,
+                 hedge_after_s: Optional[float] = None):
         if not endpoints:
             raise ValueError("Router needs at least one endpoint")
         self._eps = list(endpoints)
@@ -241,11 +363,20 @@ class Router:
         self._max_attempts = (max_attempts if max_attempts is not None
                               else 2 * len(self._eps) + 2)
         self._retry_wait = float(retry_wait_s)
+        self._recover = bool(recover)
+        self._breaker_threshold = int(breaker_threshold)
+        self._breaker_hold_s = float(breaker_hold_s)
+        self._hedge_after = (None if hedge_after_s is None
+                             else float(hedge_after_s))
         self._lock = threading.Lock()
         self._down_until: Dict[str, float] = {}
         self._probed: Dict[str, Tuple[float, bool]] = {}
         self._scores: Dict[str, Tuple[float, float]] = {}
         self._inflight: Dict[str, int] = {}
+        self._draining_until: Dict[str, float] = {}
+        self._fails: Dict[str, int] = {}
+        self._breaker_until: Dict[str, float] = {}
+        self._breaker_hold: Dict[str, float] = {}
         self.registry = MetricsRegistry()
         self._m_routed = {}
         self._m_reroutes = self.registry.counter(
@@ -258,12 +389,30 @@ class Router:
         self._m_live = self.registry.gauge(
             "autodist_router_live_replicas",
             "replicas passing their latest health probe")
+        self._m_recovered = self.registry.counter(
+            "autodist_router_recovered_total",
+            "requests resumed token-exactly on a survivor after a "
+            "replica died mid-decode")
+        self._m_recovered_tokens = self.registry.counter(
+            "autodist_router_recovered_tokens_total",
+            "streamed tokens carried over (not re-decoded) by "
+            "in-flight recovery")
+        self._m_hedged = self.registry.counter(
+            "autodist_router_hedged_total",
+            "requests mirrored to a second replica after hedge_after_s")
+        self._m_hedge_wins = self.registry.counter(
+            "autodist_router_hedge_wins_total",
+            "hedged requests won by the secondary replica")
+        self._m_breaker = self.registry.counter(
+            "autodist_router_breaker_open_total",
+            "circuit-breaker opens (consecutive-failure threshold hit)")
 
     # -- health / scoring --------------------------------------------------
     def _alive(self, ep) -> bool:
         now = time.monotonic()
         with self._lock:
-            if self._down_until.get(ep.name, 0.0) > now:
+            if self._down_until.get(ep.name, 0.0) > now \
+                    or self._breaker_until.get(ep.name, 0.0) > now:
                 return False
             ts, ok = self._probed.get(ep.name, (0.0, False))
             if now - ts < self._probe_ttl:
@@ -280,6 +429,51 @@ class Router:
             self._down_until[ep.name] = time.monotonic() + hold_s
             self._probed.pop(ep.name, None)
 
+    def _note_failure(self, ep) -> None:
+        """One consecutive-failure tick toward the replica's circuit
+        breaker.  At ``breaker_threshold`` the breaker opens for the
+        current hold (doubling per re-open, capped at 60 s); the count
+        is NOT reset on open, so the half-open probe after expiry
+        re-opens on its first failure instead of needing a fresh run
+        of ``threshold`` failures."""
+        if self._breaker_threshold <= 0:
+            return
+        opened = 0.0
+        with self._lock:
+            n = self._fails.get(ep.name, 0) + 1
+            self._fails[ep.name] = n
+            if n >= self._breaker_threshold:
+                hold = self._breaker_hold.get(ep.name,
+                                              self._breaker_hold_s)
+                self._breaker_until[ep.name] = time.monotonic() + hold
+                self._breaker_hold[ep.name] = min(hold * 2.0, 60.0)
+                opened = hold
+        if opened:
+            self._m_breaker.inc()
+            logging.warning("router: circuit breaker OPEN for %s "
+                            "(%.1fs hold)", ep.name, opened)
+
+    def _note_success(self, ep) -> None:
+        with self._lock:
+            self._fails.pop(ep.name, None)
+            self._breaker_hold.pop(ep.name, None)
+            self._breaker_until.pop(ep.name, None)
+
+    def breaker_open(self, ep) -> bool:
+        with self._lock:
+            return self._breaker_until.get(ep.name, 0.0) \
+                > time.monotonic()
+
+    def _is_draining(self, ep) -> bool:
+        with self._lock:
+            return self._draining_until.get(ep.name, 0.0) \
+                > time.monotonic()
+
+    def _set_draining(self, ep, hold_s: float) -> None:
+        with self._lock:
+            self._draining_until[ep.name] = \
+                time.monotonic() + max(float(hold_s), 0.5)
+
     def _score(self, ep) -> float:
         now = time.monotonic()
         with self._lock:
@@ -288,6 +482,11 @@ class Router:
             if now - ts < self._stats_ttl:
                 return score + inflight
         st = ep.fetch_stats() or {}
+        if st.get("draining"):
+            # The stats surface says the replica is leaving rotation:
+            # remember it so the NEXT candidate pass skips it without
+            # burning an attempt on a guaranteed 429.
+            self._set_draining(ep, 1.0)
         score = float(st.get("outstanding", 0))
         score += float(st.get("queue_depth_total", 0))
         score += self._occ_w * float(st.get("block_occupancy", 0.0))
@@ -309,7 +508,11 @@ class Router:
         Blocks its caller like a replica-local request would — the
         caller's thread IS the in-flight state, which is what makes
         re-routing safe: a failed attempt leaves nothing behind on the
-        dead replica that the retry could double-serve."""
+        dead replica that the retry could double-serve.  With
+        ``recover`` on and a greedy ``prompt_tokens`` body, a replica
+        death mid-decode resumes on a survivor: the partial tokens the
+        dead replica streamed become part of the retry's prompt, and
+        the stitched payload carries ``recovered``/``resumed_tokens``."""
         deadline = time.monotonic() + timeout_s
         t0_unix = time.time()
         # One trace id per logical request — re-routes reuse it, so the
@@ -318,10 +521,27 @@ class Router:
         tried_busy: Dict[str, float] = {}
         attempts = 0
         first = True
+        want_stream = bool(body.get("stream"))
+        # Token-exact recovery needs (a) the exact prompt ids the
+        # engine will see (a text prompt re-tokenizes identically, but
+        # splicing partials into text cannot be exact) and (b) greedy
+        # decode (resuming a sampled request re-rolls the dice).
+        prompt = body.get("prompt_tokens")
+        recover_ok = (self._recover
+                      and isinstance(prompt, list) and prompt
+                      and all(type(t) is int for t in prompt)
+                      and type(body.get("max_new_tokens", 16)) is int
+                      and body.get("temperature") in (None, 0, 0.0))
+        base_prompt = list(prompt) if recover_ok else []
+        orig_max_new = int(body.get("max_new_tokens", 16)) \
+            if recover_ok else 16
+        resumed: List[int] = []     # tokens carried across dead replicas
+        cur_body = dict(body)
         while attempts < self._max_attempts \
                 and time.monotonic() < deadline:
             candidates = [ep for ep in self.live_replicas()
-                          if ep.name not in tried_busy]
+                          if ep.name not in tried_busy
+                          and not self._is_draining(ep)]
             if not candidates and tried_busy:
                 self._m_busy.inc()
                 raise RouterBusy(
@@ -331,57 +551,265 @@ class Router:
                 attempts += 1
                 time.sleep(self._retry_wait)   # a relaunch may be coming
                 continue
-            ep = min(candidates, key=self._score)
+            candidates.sort(key=self._score)
+            ep = candidates[0]
             attempts += 1
             if not first:
                 self._m_reroutes.inc()
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise RouterDeadlineError(
+                    f"deadline ({timeout_s:.1f}s) exceeded after "
+                    f"{attempts - 1} attempt(s)")
+            use_stream = recover_ok and hasattr(ep, "post_stream")
+            hedge_here = (self._hedge_after is not None and first
+                          and use_stream and len(candidates) >= 2
+                          and hasattr(candidates[1], "post_stream")
+                          and body.get("slo") in (None, "latency"))
             first = False
+            partial: List[int] = []
+
+            def on_event(ev, _partial=partial):
+                if not ev.get("done") and ev.get("new_tokens"):
+                    _partial.extend(int(t) for t in ev["new_tokens"])
+
             with self._lock:
                 self._inflight[ep.name] = \
                     self._inflight.get(ep.name, 0) + 1
             try:
-                try:
-                    status, payload = ep.post(
-                        body,
-                        timeout=max(deadline - time.monotonic(), 1.0),
-                        trace_id=trace_id)
-                except TypeError:
-                    # Duck-typed endpoints predating trace propagation
-                    # (unit-test fakes, user endpoints) keep working;
-                    # their replica spans are simply untagged.
-                    status, payload = ep.post(
-                        body, timeout=max(deadline - time.monotonic(),
-                                          1.0))
+                if hedge_here:
+                    status, payload, ep = self._hedged_post(
+                        cur_body, candidates[0], candidates[1],
+                        timeout=remaining, trace_id=trace_id)
+                elif use_stream:
+                    status, payload = self._post_stream(
+                        ep, cur_body, timeout=remaining,
+                        trace_id=trace_id, on_event=on_event)
+                else:
+                    try:
+                        status, payload = ep.post(
+                            cur_body, timeout=remaining,
+                            trace_id=trace_id)
+                    except TypeError:
+                        # Duck-typed endpoints predating trace
+                        # propagation (unit-test fakes, user endpoints)
+                        # keep working; their replica spans are simply
+                        # untagged.
+                        status, payload = ep.post(cur_body,
+                                                  timeout=remaining)
             except OSError as e:
                 logging.warning("router: replica %s failed mid-request "
                                 "(%s) — re-routing", ep.name, e)
                 self.mark_down(ep)
+                self._note_failure(ep)
+                if partial:
+                    resumed.extend(partial)
+                    done = self._finish_locally(body, base_prompt,
+                                                resumed, orig_max_new)
+                    if done is not None:
+                        return self._stitched(done, [], ep, trace_id,
+                                              t0_unix, attempts,
+                                              resumed, want_stream)
+                    cur_body = dict(body)
+                    cur_body["prompt_tokens"] = base_prompt + resumed
+                    cur_body["max_new_tokens"] = \
+                        orig_max_new - len(resumed)
                 continue
             finally:
                 with self._lock:
                     self._inflight[ep.name] = \
                         max(self._inflight.get(ep.name, 1) - 1, 0)
+            if status == -1:
+                # Hedged request: both legs died transport-side.
+                self.mark_down(ep)
+                self._note_failure(ep)
+                continue
             if status == 200:
-                self._routed_counter(ep).inc()
-                from autodist_tpu.telemetry.profiler import record_span
-                record_span("route", start_unix=t0_unix,
-                            dur_s=time.time() - t0_unix,
-                            trace_id=trace_id, replica=ep.name,
-                            attempts=attempts)
-                return payload
+                self._note_success(ep)
+                return self._stitched(payload, resumed, ep, trace_id,
+                                      t0_unix, attempts, resumed,
+                                      want_stream)
             if status == 429:
                 retry = _retry_after(payload)
+                if payload.get("draining"):
+                    # Healthy replica leaving rotation: skip it for a
+                    # while, but neither mark it down nor let it count
+                    # toward the all-busy verdict.
+                    self._set_draining(ep, retry)
+                    continue
                 tried_busy[ep.name] = retry
                 continue
-            if 500 <= status < 600 or status == 503:
+            if status == 503 and payload.get("shed"):
+                # Deadline shed is load signal, not ill health: another
+                # replica may have the headroom this one lacks.
+                tried_busy[ep.name] = _retry_after(payload)
+                continue
+            if 500 <= status < 600:
                 logging.warning("router: replica %s answered %d — "
                                 "re-routing", ep.name, status)
                 self.mark_down(ep)
+                self._note_failure(ep)
                 continue
             raise RouterRequestError(status, payload)
+        if time.monotonic() >= deadline:
+            raise RouterDeadlineError(
+                f"deadline ({timeout_s:.1f}s) exceeded after "
+                f"{attempts} attempt(s)")
         raise RouterError(
             f"no live replica served the request after {attempts} "
             f"attempt(s)")
+
+    # -- recovery / hedging helpers ---------------------------------------
+    def _post_stream(self, ep, body: dict, *, timeout: float,
+                     trace_id: str, on_event=None) -> Tuple[int, dict]:
+        """Streaming post with the final SSE event mapped back onto the
+        status codes ``complete`` already routes on (timeout/deadline →
+        504, cancelled → 409, engine error → 503)."""
+        sb = dict(body)
+        sb["stream"] = True
+        status, final = ep.post_stream(sb, timeout=timeout,
+                                       trace_id=trace_id,
+                                       on_event=on_event)
+        if status != 200:
+            return status, final
+        if final.get("timeout") or final.get("deadline_exceeded"):
+            return 504, final
+        if final.get("cancelled"):
+            return 409, final
+        if final.get("error"):
+            return 503, final
+        return 200, final
+
+    def _finish_locally(self, body: dict, base_prompt: List[int],
+                        resumed: List[int],
+                        orig_max_new: int) -> Optional[dict]:
+        """The dead replica already streamed everything the request
+        asked for (eos reached, or max_new_tokens exhausted): finish
+        without a resubmit.  Returns None when decoding must continue
+        on a survivor."""
+        eos_id = body.get("eos_id")
+        if eos_id is not None and int(eos_id) in resumed:
+            del resumed[resumed.index(int(eos_id)) + 1:]
+        elif len(resumed) < orig_max_new:
+            return None
+        return {"id": -1,
+                "tokens": list(base_prompt) + list(resumed),
+                "new_tokens": list(resumed)}
+
+    def _stitched(self, payload: dict, prefix: List[int], ep,
+                  trace_id: str, t0_unix: float, attempts: int,
+                  resumed: List[int], want_stream: bool) -> dict:
+        """Final bookkeeping for a served request: splice recovered
+        tokens back in front of the survivor's continuation, stamp the
+        evidence fields, count, and span."""
+        if prefix:
+            payload["new_tokens"] = \
+                list(prefix) + list(payload.get("new_tokens") or [])
+        if resumed:
+            payload["recovered"] = True
+            payload["resumed_tokens"] = len(resumed)
+            self._m_recovered.inc()
+            self._m_recovered_tokens.inc(len(resumed))
+            from autodist_tpu.telemetry import emit_event
+            emit_event("serving/recovered", trace_id=trace_id,
+                       replica=ep.name, resumed_tokens=len(resumed),
+                       attempts=attempts)
+        if not want_stream:
+            payload.pop("done", None)
+        self._routed_counter(ep).inc()
+        from autodist_tpu.telemetry.profiler import record_span
+        record_span("route", start_unix=t0_unix,
+                    dur_s=time.time() - t0_unix,
+                    trace_id=trace_id, replica=ep.name,
+                    attempts=attempts)
+        if resumed:
+            record_span("recover", start_unix=t0_unix,
+                        dur_s=time.time() - t0_unix,
+                        trace_id=trace_id, replica=ep.name,
+                        resumed_tokens=len(resumed))
+        return payload
+
+    def _hedged_post(self, body: dict, primary, secondary, *,
+                     timeout: float,
+                     trace_id: str) -> Tuple[int, dict, Any]:
+        """First-wins hedging: run the primary, and if it has not
+        answered within ``hedge_after_s`` mirror the request to the
+        secondary.  The first leg to return 200 wins; the loser is
+        cancelled through the replica's cancel API using the request
+        id from its stream's announce event.  Returns ``(status,
+        payload, winner_ep)``; a transport failure on both legs comes
+        back as status ``-1``.  Hedge legs do not splice partials —
+        a failed hedge falls back to ``complete``'s standard retry
+        path, where recovery applies."""
+        cond = threading.Condition()
+        outcome: List[Tuple[str, int, dict, Any]] = []
+        rids: Dict[str, int] = {}
+        deadline = time.monotonic() + timeout
+
+        def leg(ep, tag):
+            def on_event(ev):
+                rid = ev.get("id")
+                if isinstance(rid, int) and tag not in rids:
+                    rids[tag] = rid
+            try:
+                status, payload = self._post_stream(
+                    ep, body,
+                    timeout=max(deadline - time.monotonic(), 0.1),
+                    trace_id=trace_id, on_event=on_event)
+            except OSError as e:
+                status, payload = -1, {"error": str(e)}
+            with cond:
+                outcome.append((tag, status, payload, ep))
+                cond.notify_all()
+
+        threading.Thread(target=leg, args=(primary, "p"),
+                         daemon=True,
+                         name="router-hedge-primary").start()
+        with cond:
+            cond.wait_for(lambda: outcome, timeout=self._hedge_after)
+            hedged = not outcome
+        if hedged:
+            self._m_hedged.inc()
+            from autodist_tpu.telemetry import emit_event
+            emit_event("serving/hedge", trace_id=trace_id,
+                       primary=primary.name, secondary=secondary.name,
+                       after_s=self._hedge_after)
+            threading.Thread(target=leg, args=(secondary, "s"),
+                             daemon=True,
+                             name="router-hedge-secondary").start()
+        legs = 2 if hedged else 1
+
+        def settled():
+            return (any(s == 200 for _, s, _, _ in outcome)
+                    or len(outcome) >= legs)
+
+        with cond:
+            cond.wait_for(settled,
+                          timeout=max(deadline - time.monotonic(), 0.1))
+            snapshot = list(outcome)
+        win = next(((t, s, p, e) for t, s, p, e in snapshot
+                    if s == 200), None)
+        if win is not None:
+            tag, status, payload, ep = win
+            if hedged:
+                loser_tag = "s" if tag == "p" else "p"
+                loser_ep = secondary if tag == "p" else primary
+                lrid = rids.get(loser_tag)
+                if lrid is not None and hasattr(loser_ep, "cancel"):
+                    try:
+                        loser_ep.cancel(lrid)
+                    except (OSError, TypeError):
+                        pass
+                if tag == "s":
+                    self._m_hedge_wins.inc()
+            return status, payload, ep
+        for tag, status, payload, ep in snapshot:
+            if tag == "p":
+                return status, payload, ep
+        if snapshot:
+            tag, status, payload, ep = snapshot[0]
+            return status, payload, ep
+        raise OSError("hedged request produced no outcome in time")
 
     def _routed_counter(self, ep):
         c = self._m_routed.get(ep.name)
@@ -494,6 +922,21 @@ class SupervisedReplicaPool:
                     # attempts)
                     attempt.heartbeat_dir = self.beacon_dir(i)
                     os.makedirs(attempt.heartbeat_dir, exist_ok=True)
+                    # Drop beacons left by the previous attempt: the
+                    # monitor judges staleness by file mtime, so a dead
+                    # attempt's beacon would damn the fresh one before
+                    # it finishes starting up (no-beacon-yet gets the
+                    # grace window; a stale beacon gets none).
+                    from autodist_tpu.resilience.heartbeat import \
+                        BEAT_SUFFIX
+                    try:
+                        for fn in os.listdir(attempt.heartbeat_dir):
+                            if fn.endswith(BEAT_SUFFIX):
+                                with contextlib.suppress(OSError):
+                                    os.unlink(os.path.join(
+                                        attempt.heartbeat_dir, fn))
+                    except OSError:
+                        pass
                     proc = self._launch(i, attempt)
                     self._procs[i] = proc
                     return proc
@@ -509,6 +952,77 @@ class SupervisedReplicaPool:
             t.start()
             self._threads.append(t)
         return self
+
+    def rolling_restart(self, *, drain_timeout_s: float = 30.0,
+                        relaunch_timeout_s: float = 60.0) -> Dict[str, Any]:
+        """Cycle every replica with zero failed requests: drain →
+        wait-idle → SIGTERM → supervised relaunch → healthy, one
+        replica at a time (the rest of the pool keeps serving).
+
+        ``POST /admin/drain`` takes the replica out of admission (the
+        router skips it on the draining flag); once ``/v1/stats``
+        reports no outstanding work, SIGTERM fires the replica's drain
+        handler, which exits with ``PREEMPTED_EXIT_CODE`` — the
+        supervisor relaunches WITHOUT consuming restart budget.  The
+        method then waits for the fresh attempt to publish an address
+        and pass a health probe before moving on.  Returns a summary
+        ``{"restarted": [...], "failed": [...]}``."""
+        import signal
+
+        from autodist_tpu.telemetry import emit_event
+
+        summary: Dict[str, Any] = {"restarted": [], "failed": []}
+        grace = float(getattr(self._policy, "kill_grace", None) or 3.0)
+        for i in range(self._n):
+            ep = ReplicaEndpoint(name=f"replica-{i}",
+                                 address_file=self.address_file(i))
+            old = self.current_proc(i)
+            emit_event("serving/drain", phase="rolling", replica=i)
+            cli = ep.client()
+            drained = False
+            if cli is not None:
+                try:
+                    cli._request("POST", "/admin/drain", {},
+                                 timeout=5.0)
+                except OSError:
+                    pass   # already dead — the SIGTERM path handles it
+                t_drain = time.monotonic() + drain_timeout_s
+                while time.monotonic() < t_drain:
+                    try:
+                        st = cli.stats()
+                    except OSError:
+                        break
+                    if int(st.get("outstanding", 0)) == 0:
+                        drained = True
+                        break
+                    time.sleep(0.1)
+            if old is not None and old.poll() is None:
+                try:
+                    os.killpg(os.getpgid(old.pid), signal.SIGTERM)
+                except (ProcessLookupError, PermissionError, OSError):
+                    old.terminate()
+                t_kill = time.monotonic() + grace + drain_timeout_s
+                while old.poll() is None \
+                        and time.monotonic() < t_kill:
+                    time.sleep(0.05)
+                if old.poll() is None:
+                    old.kill()
+            ok = False
+            t_up = time.monotonic() + relaunch_timeout_s
+            while time.monotonic() < t_up:
+                proc = self.current_proc(i)
+                if proc is not None and proc is not old \
+                        and proc.poll() is None and ep.probe():
+                    ok = True
+                    break
+                time.sleep(0.1)
+            (summary["restarted"] if ok
+             else summary["failed"]).append(
+                {"replica": i, "drained": drained})
+            if not ok:
+                logging.error("rolling restart: replica %d did not "
+                              "come back healthy", i)
+        return summary
 
     def stop(self, timeout: float = 20.0) -> None:
         import signal

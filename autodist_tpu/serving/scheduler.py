@@ -69,7 +69,8 @@ import numpy as np
 from autodist_tpu.models.base import ModelSpec
 from autodist_tpu.models.generate import (_vocab_size, check_sampling_args,
                                           require_lm_spec)
-from autodist_tpu.serving.engine import (AdmissionError, TEMPERATURE_FLOOR,
+from autodist_tpu.serving.engine import (AdmissionError, DeadlineError,
+                                         TEMPERATURE_FLOOR,
                                          _sharded_zeros,
                                          _write_prompt_program,
                                          check_speculative_args)
@@ -102,6 +103,11 @@ class PagedRequest:
     # (docs/observability.md).
     trace_id: str = ""
     submit_t: float = 0.0
+    # Absolute monotonic completion deadline (None = unbounded).  The
+    # step boundary cancels a past-deadline request wherever it sits —
+    # queued, prefilling or decoding — and frees its blocks immediately
+    # (docs/serving.md "Fault tolerance").
+    deadline_t: Optional[float] = None
     admit_t: Optional[float] = None
     first_token_t: Optional[float] = None
     done_t: Optional[float] = None
@@ -132,6 +138,8 @@ class PagedEngineStats:
     submitted: int = 0
     completed: int = 0
     rejected_full: int = 0         # AdmissionError raises (queue full)
+    shed_deadline: int = 0         # DeadlineError raises (infeasible)
+    expired_deadline: int = 0      # in-flight/queued deadline cancels
     deferred_blocks: int = 0       # admission waits on pool headroom
     ticks: int = 0
     busy_slot_ticks: int = 0
@@ -175,6 +183,12 @@ class PagedEngineStats:
                 if self.prompt_tokens else 0.0)
 
 
+def _p90(samples) -> float:
+    """90th percentile of a small sample deque (nearest-rank)."""
+    s = sorted(samples)
+    return s[min(int(0.9 * len(s)), len(s) - 1)]
+
+
 def _pow2_bucket(n: int, cap: int) -> int:
     """Pow-2 compile bucket capped at ``cap`` (exact-size fallback) —
     the slot engine's bucketing rule over an explicit cap."""
@@ -215,7 +229,8 @@ class PagedDecodeEngine:
                  model_axis: str = "model",
                  draft_spec: Optional[ModelSpec] = None,
                  draft_params=None, gamma: int = 4,
-                 adapt_gamma: bool = True):
+                 adapt_gamma: bool = True,
+                 deadline_defaults: Optional[Dict[str, float]] = None):
         require_lm_spec(spec, "PagedDecodeEngine")
         cfg = spec.config
         if slots < 1 or chunk < 1:
@@ -274,6 +289,16 @@ class PagedDecodeEngine:
         self._prefill_chunk = prefill_chunk
         self._max_queue = int(max_queue)
         self._reserve = int(reserve_blocks)
+        if deadline_defaults is not None:
+            bad = set(deadline_defaults) - set(SLO_CLASSES)
+            if bad:
+                raise ValueError(
+                    f"deadline_defaults keys must be SLO classes "
+                    f"{SLO_CLASSES}; got {sorted(bad)}")
+            if any(float(v) <= 0 for v in deadline_defaults.values()):
+                raise ValueError("deadline_defaults values must be > 0")
+        self._deadline_defaults = {
+            k: float(v) for k, v in (deadline_defaults or {}).items()}
         self._cache_prefixes = bool(cache_prefixes)
         self._temperature = float(temperature)
         self._top_k = int(top_k)
@@ -307,6 +332,13 @@ class PagedDecodeEngine:
         self._prefilling: Dict[int, PagedRequest] = {}
         self._prefix_tokens: Optional[np.ndarray] = None
         self._avg_request_s = 0.0
+        # Measured service-rate samples feeding the deadline-shed
+        # estimate: queue-wait (submit -> admit) and per-token decode
+        # time, both from completed requests.  Bounded deques — recent
+        # load, not lifetime averages.
+        self._qwait_samples: Deque[float] = deque(maxlen=128)
+        self._per_tok_samples: Deque[float] = deque(maxlen=256)
+        self._expired: Dict[int, Dict[str, object]] = {}
         self._poisoned = False
         self.stats = PagedEngineStats(_slots=slots)
         self.pool = BlockPool(self._num_blocks, block_size)
@@ -383,6 +415,9 @@ class PagedDecodeEngine:
             q.clear()
         self._results.clear()
         self._timings.clear()
+        self._expired.clear()
+        self._qwait_samples.clear()
+        self._per_tok_samples.clear()
         self._slot_req = [None] * self._slots
         self._prefilling.clear()
         self.pool = BlockPool(self._num_blocks, self._block_size)
@@ -431,7 +466,8 @@ class PagedDecodeEngine:
                temperature: Optional[float] = None,
                eos_id: Optional[int] = None, slo: str = SLO_LATENCY,
                use_prefix: bool = False, trace_id: str = "",
-               gamma: Optional[int] = None) -> int:
+               gamma: Optional[int] = None,
+               deadline_s: Optional[float] = None) -> int:
         """Queue a request into its SLO class; returns its id.
 
         ``trace_id`` tags this request's queue-wait/prefill/decode
@@ -440,6 +476,17 @@ class PagedDecodeEngine:
         untagged).  On a speculative engine ``gamma`` caps THIS
         request's proposal depth (default: the engine's ``gamma``);
         the SLO adaptation only ever shrinks below it.
+
+        ``deadline_s`` bounds the request's whole lifetime (default:
+        the engine's ``deadline_defaults`` for its SLO class, if any).
+        Admission SHEDS a deadlined request the measured queue-wait /
+        per-token percentiles say cannot finish in time — a typed
+        :class:`DeadlineError` (503 + Retry-After at the HTTP front)
+        instead of admitting work guaranteed to be thrown away; with
+        no measurements yet the request is admitted optimistically.
+        Past-deadline requests already admitted are cancelled at the
+        next step boundary (blocks freed immediately, surfaced via
+        :meth:`pop_expired`).
 
         Raises :class:`AdmissionError` (with ``retry_after_s``) when the
         class's queue is at ``max_queue``; raises ``ValueError`` for a
@@ -487,17 +534,37 @@ class PagedDecodeEngine:
             gamma = self._gamma_max if gamma is None else int(gamma)
             check_speculative_args(gamma, temperature, span=span,
                                    window=self._window)
+        if deadline_s is None:
+            deadline_s = self._deadline_defaults.get(slo)
+        elif float(deadline_s) <= 0:
+            raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
         q = self._queues[slo]
         if len(q) >= self._max_queue:
             self.stats.rejected_full += 1
             raise AdmissionError(
                 f"{slo} queue full ({self._max_queue}); retry later",
                 retry_after_s=self._retry_hint())
+        if deadline_s is not None:
+            est = self._deadline_estimate(int(max_new_tokens))
+            if est is not None and est > float(deadline_s):
+                self.stats.shed_deadline += 1
+                from autodist_tpu.telemetry import emit_event
+                emit_event("serving/shed", phase="admission", slo=slo,
+                           deadline_s=float(deadline_s),
+                           estimate_s=round(est, 4),
+                           trace_id=str(trace_id or ""))
+                raise DeadlineError(
+                    f"cannot meet deadline_s={deadline_s:g}: estimated "
+                    f"completion {est:.3f}s (measured queue-wait + "
+                    f"{max_new_tokens} tokens at current rates)",
+                    retry_after_s=self._retry_hint())
         req = PagedRequest(prompt, int(max_new_tokens), self._next_id,
                            slo=slo, temperature=temperature,
                            eos_id=eos_id, strip=strip,
                            trace_id=str(trace_id or ""),
                            submit_t=time.monotonic(), gamma=gamma)
+        if deadline_s is not None:
+            req.deadline_t = req.submit_t + float(deadline_s)
         self._next_id += 1
         q.append(req)
         self.stats.submitted += 1
@@ -542,6 +609,71 @@ class PagedDecodeEngine:
         est = (depth + 1) * per_req / max(self._slots, 1)
         return float(min(60.0, max(0.1, est)))
 
+    _MIN_DEADLINE_SAMPLES = 5
+
+    def _deadline_estimate(self, max_new: int) -> Optional[float]:
+        """Estimated completion time for a fresh request: p90 measured
+        queue wait + ``max_new`` tokens at the p90 measured per-token
+        rate.  None (= admit optimistically) until both sample sets
+        have :data:`_MIN_DEADLINE_SAMPLES` — shedding on guesses would
+        reject the very requests that produce the measurements."""
+        if len(self._qwait_samples) < self._MIN_DEADLINE_SAMPLES \
+                or len(self._per_tok_samples) < self._MIN_DEADLINE_SAMPLES:
+            return None
+        return (_p90(self._qwait_samples)
+                + max_new * _p90(self._per_tok_samples))
+
+    def _expire_deadlines(self) -> None:
+        """Step-boundary deadline sweep: cancel every past-deadline
+        request wherever it sits (queued, prefilling, decoding), free
+        its slot and blocks IMMEDIATELY, and record it for
+        :meth:`pop_expired` — decoding tokens past their deadline only
+        steals capacity from requests that can still make theirs."""
+        now = time.monotonic()
+        victims: List[tuple] = []
+        for slo, q in self._queues.items():
+            for req in list(q):
+                if req.deadline_t is not None and now > req.deadline_t:
+                    q.remove(req)
+                    victims.append((req, "queued"))
+        for b, req in list(self._prefilling.items()):
+            if req.deadline_t is not None and now > req.deadline_t:
+                del self._prefilling[b]
+                self._free_slot(b, req)
+                victims.append((req, "prefilling"))
+        for b in range(self._slots):
+            req = self._slot_req[b]
+            if req is not None and req.deadline_t is not None \
+                    and now > req.deadline_t:
+                self._active[b] = False
+                self._done[b] = True
+                self._slot_req[b] = None
+                self._free_slot(b, req)
+                victims.append((req, "decoding"))
+        if not victims:
+            return
+        from autodist_tpu.telemetry import emit_event
+        for req, phase in victims:
+            self.stats.expired_deadline += 1
+            overrun = now - req.deadline_t
+            emit_event("serving/shed", phase=phase, slo=req.slo,
+                       request_id=req.request_id,
+                       trace_id=req.trace_id,
+                       overrun_s=round(overrun, 4))
+            self._expired[req.request_id] = {
+                "phase": phase, "slo": req.slo,
+                "trace_id": req.trace_id,
+                "overrun_s": overrun,
+            }
+
+    def pop_expired(self) -> Dict[int, Dict[str, object]]:
+        """Requests the deadline sweep cancelled since the last call:
+        ``{request_id: {"phase", "slo", "trace_id", "overrun_s"}}``.
+        The HTTP front drains this to resolve their waiters (504 +
+        Retry-After) instead of letting them ride to timeout."""
+        out, self._expired = self._expired, {}
+        return out
+
     def run(self) -> Dict[int, np.ndarray]:
         """Decode until queues, prefill and all slots drain; returns
         and clears ``{request_id: tokens}``."""
@@ -556,6 +688,7 @@ class PagedDecodeEngine:
         wave, one decode chunk.  False when fully drained."""
         self._check_usable()
         self._rebase_tick()
+        self._expire_deadlines()
         self._harvest()
         self._admit()
         if self._prefilling:
@@ -651,6 +784,8 @@ class PagedDecodeEngine:
             "prefix_hit_rate": round(self.stats.prefix_hit_rate, 4),
             "deferred_admissions": self.stats.deferred_blocks,
             "rejected_full": self.stats.rejected_full,
+            "shed_deadline": self.stats.shed_deadline,
+            "expired_deadline": self.stats.expired_deadline,
         }
         # Occupancy split (always present; draft is 0 on a target-only
         # engine) so capacity regressions are attributable to the pool
@@ -1299,6 +1434,11 @@ class PagedDecodeEngine:
                     if req.first_token_t else wall)
             per_tok = ((req.done_t - req.first_token_t) / max(gen - 1, 1)
                        if req.first_token_t and gen > 1 else 0.0)
+            # Service-rate samples for the deadline-shed estimate.
+            self._qwait_samples.append(
+                (req.admit_t or req.done_t) - req.submit_t)
+            if per_tok > 0.0:
+                self._per_tok_samples.append(per_tok)
             self._emit_request_spans(req, gen)
             self._timings[req.request_id] = {
                 "queue_wait_s": (req.admit_t or req.done_t) - req.submit_t,

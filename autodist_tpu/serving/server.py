@@ -24,8 +24,16 @@ Endpoints
   ``"prompt": "text"`` is accepted and ``"text"`` is returned.  Streaming
   responses are Server-Sent Events, one ``data:`` JSON per new-token delta.
 - ``POST /v1/cancel`` — body ``{"id": N}``.
+- ``POST /admin/drain`` — stop admitting (429 + ``"draining": true``),
+  finish in-flight work; ``POST /admin/undrain`` reverses it.  SIGTERM
+  triggers the same drain when :func:`install_drain_on_sigterm` is
+  installed (``serve()`` does, best-effort), then exits
+  ``DRAINED_EXIT_CODE`` once idle — the supervisor's budget-free
+  preemption relaunch path, which is what makes
+  ``SupervisedReplicaPool.rolling_restart()`` drop nothing.
 - ``GET /v1/stats`` — engine counters + server counters (+ request
-  latency p50/p99 estimated from the latency histogram).
+  latency p50/p99 estimated from the latency histogram) + the
+  ``draining`` flag the router's candidate filter reads.
 - ``GET /metrics`` — Prometheus text exposition
   (``autodist_serving_*``: request latency + queue-depth histograms,
   served/failed counters, outstanding gauge — docs/observability.md).
@@ -34,6 +42,8 @@ Endpoints
 from __future__ import annotations
 
 import json
+import os
+import signal
 import threading
 import time
 import uuid
@@ -43,7 +53,9 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
-from autodist_tpu.serving.engine import AdmissionError, DecodeEngine
+from autodist_tpu.resilience.chaos import ServingChaos
+from autodist_tpu.serving.engine import (AdmissionError, DeadlineError,
+                                         DecodeEngine)
 from autodist_tpu.telemetry.registry import (
     DEPTH_BUCKETS,
     MetricsRegistry,
@@ -54,6 +66,7 @@ from autodist_tpu.utils import logging
 
 _MAX_BODY_BYTES = 8 << 20
 _CANCELLED = object()   # sentinel in the done-map for cancelled requests
+_DEADLINE = object()    # ... and for deadline-expired requests (504)
 
 
 class EngineServer:
@@ -95,6 +108,18 @@ class EngineServer:
                 f"tokenizer vocab_size {tok_vocab} < model vocab "
                 f"{engine._vocab}: generated ids would not decode")
         self._timeout = float(request_timeout_s)
+        # DRAINING: stop admitting (429 + "draining": true), finish
+        # in-flight work.  Set by POST /admin/drain or SIGTERM (see
+        # install_drain_on_sigterm); the router's candidate filter
+        # reads the flag off /v1/stats and skips the replica.
+        self._draining = False
+        self._n_submitted = 0
+        self._deadline_info: Dict[int, Dict[str, Any]] = {}
+        # Serving-plane chaos (AUTODIST_CHAOS kill_replica/slow_replica/
+        # drop_response/stale_stats), clocked by the driver loop on
+        # serving progress; empty spec = no-op.
+        self._chaos = ServingChaos.from_env()
+        self._stale_stats: Optional[Dict[str, Any]] = None
 
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)        # new submits
@@ -141,6 +166,24 @@ class EngineServer:
             "completion requests failed/cancelled/timed out")
         self._m_outstanding = self._registry.gauge(
             "autodist_serving_outstanding", "requests currently in flight")
+        # Fault-tolerance surface (docs/serving.md "Fault tolerance").
+        self._m_shed = self._registry.counter(
+            "autodist_serving_shed_total",
+            "requests shed at admission: measured service rates say "
+            "the deadline cannot be met (503)")
+        self._m_expired = self._registry.counter(
+            "autodist_serving_deadline_expired_total",
+            "admitted requests cancelled past their deadline (504)")
+        self._m_drain_refused = self._registry.counter(
+            "autodist_serving_drain_refused_total",
+            "requests refused because the replica is draining (429)")
+        self._m_timeouts = self._registry.counter(
+            "autodist_serving_timeouts_total",
+            "requests that hit request_timeout_s and were cancelled "
+            "(504)")
+        self._m_draining = self._registry.gauge(
+            "autodist_serving_draining",
+            "1 while the replica is draining, else 0")
         # Scheduler-backed engines (PagedDecodeEngine) report richer
         # latency + occupancy telemetry: time-to-first-token and
         # inter-token latency histograms (fixed bounds — multi-replica
@@ -246,6 +289,17 @@ class EngineServer:
         # the server into one batch per drain, defeating continuous
         # batching across concurrent HTTP requests.
         while True:
+            if self._chaos:
+                # Serving-chaos clock: fire on progress (submissions /
+                # generated tokens), journal-before-execute.  Outside
+                # the lock — an injected slow_replica sleep must not
+                # also block handler submits.
+                self._chaos.on_tick(
+                    requests=self._n_submitted,
+                    generated=int(getattr(self._engine.stats,
+                                          "generated_tokens", 0)))
+                if self._chaos.slow_s > 0:
+                    time.sleep(self._chaos.slow_s)
             with self._lock:
                 if self._stop:
                     return
@@ -265,6 +319,19 @@ class EngineServer:
                         if ev is not None:
                             ev.set()
                 if self._paged:
+                    # Deadline sweep results: resolve the waiters of
+                    # requests the scheduler cancelled past-deadline
+                    # (504 + Retry-After) instead of letting them ride
+                    # to the request timeout.
+                    for rid, info in self._engine.pop_expired().items():
+                        if rid in self._outstanding:
+                            self._outstanding.discard(rid)
+                            self._done[rid] = _DEADLINE
+                            self._deadline_info[rid] = info
+                            self._m_expired.inc()
+                            ev = self._events.pop(rid, None)
+                            if ev is not None:
+                                ev.set()
                     self._observe_paged()
                 if self._engine_error is not None:
                     # In-flight work is lost (donated buffers); fail the
@@ -301,6 +368,38 @@ class EngineServer:
             self._m_gamma.set(gamma)
             self._m_gamma_hist.observe(float(gamma))
 
+    # -- graceful drain ----------------------------------------------------
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def drain(self) -> None:
+        """Enter DRAINING: new submits answer 429 with ``"draining":
+        true``; in-flight work runs to completion.  Lock-free (a bool
+        flip) so it is safe from a signal handler."""
+        if not self._draining:
+            self._draining = True
+            self._m_draining.set(1)
+            from autodist_tpu.telemetry import emit_event
+            emit_event("serving/drain", phase="start",
+                       outstanding=len(self._outstanding))
+            logging.info("EngineServer: draining (%d in flight)",
+                         len(self._outstanding))
+
+    def undrain(self) -> None:
+        """Leave DRAINING and admit again (rollback of an aborted
+        rolling restart)."""
+        if self._draining:
+            self._draining = False
+            self._m_draining.set(0)
+            from autodist_tpu.telemetry import emit_event
+            emit_event("serving/drain", phase="undrain")
+
+    def idle(self) -> bool:
+        """True when nothing is in flight (the drained-exit condition)."""
+        return not self._outstanding
+
     # -- request plumbing (called from handler threads) --------------------
 
     def _locked(self):
@@ -311,10 +410,13 @@ class EngineServer:
     def _submit(self, prompt: np.ndarray, max_new: int,
                 temperature=None, eos_id=None,
                 use_prefix: bool = False, slo: Optional[str] = None,
-                trace_id: str = "", gamma: Optional[int] = None) -> int:
+                trace_id: str = "", gamma: Optional[int] = None,
+                deadline_s: Optional[float] = None) -> int:
         with self._locked():
             if self._stop or self._engine_error is not None:
                 raise _Unavailable()
+            if self._draining:
+                raise _Draining(self._drain_retry_hint())
             self._m_queue.observe(float(len(self._outstanding)))
             kwargs = dict(temperature=temperature, eos_id=eos_id,
                           use_prefix=use_prefix)
@@ -335,12 +437,26 @@ class EngineServer:
                         "this server's engine has no SLO classes "
                         "(slot engine); drop the slo field")
                 kwargs["slo"] = slo
+            if deadline_s is not None:
+                if not self._paged:
+                    raise ValueError(
+                        "this server's engine has no deadline support "
+                        "(slot engine); drop the deadline_s field")
+                kwargs["deadline_s"] = deadline_s
             rid = self._engine.submit(prompt, max_new, **kwargs)
+            self._n_submitted += 1
             self._outstanding.add(rid)
             self._m_outstanding.set(len(self._outstanding))
             self._events[rid] = threading.Event()
             self._work.notify()
             return rid
+
+    def _drain_retry_hint(self) -> float:
+        """Retry-After for drain rejections: long enough for the
+        rolling restart's relaunch, short enough that the router's next
+        attempt lands on the fresh process."""
+        hint = getattr(self._engine, "_retry_hint", None)
+        return float(hint()) if callable(hint) else 1.0
 
     def _wait(self, rid: int, timeout_s: float) -> Any:
         """Block until ``rid`` is harvested; returns its tokens.  Waits
@@ -359,6 +475,10 @@ class EngineServer:
                     self._engine.cancel(rid)
                     self._outstanding.discard(rid)
                     self._events.pop(rid, None)
+                    self._m_timeouts.inc()
+                    from autodist_tpu.telemetry import emit_event
+                    emit_event("serving/timeout", request_id=rid,
+                               timeout_s=float(timeout_s))
                     raise _Timeout()
         with self._locked():
             if rid not in self._done:
@@ -407,6 +527,11 @@ class EngineServer:
         self._m_outstanding.set(len(self._outstanding))
 
     def stats(self) -> Dict[str, Any]:
+        if self._chaos and self._chaos.stats_stale \
+                and self._stale_stats is not None:
+            # stale_stats chaos: the router keeps scoring off this
+            # frozen snapshot — the load-balancing-blind drill.
+            return dict(self._stale_stats)
         with self._locked():
             # Counters accumulate numpy scalars (+= np.int32); coerce so
             # json.dumps never trips on a dtype.
@@ -433,6 +558,9 @@ class EngineServer:
                     st["ttft_p50_ms"] = round(p50 * 1e3, 3)
                     st["ttft_p99_ms"] = round(
                         self._m_ttft.percentile(0.99) * 1e3, 3)
+            st["draining"] = self._draining
+            if self._chaos and self._chaos.stats_stale:
+                self._stale_stats = dict(st)
             return st
 
     def render_metrics(self) -> str:
@@ -502,6 +630,14 @@ class _Timeout(Exception):
     pass
 
 
+class _Draining(Exception):
+    """Submit refused: the replica is draining (429 + draining flag)."""
+
+    def __init__(self, retry_after_s: float = 1.0):
+        super().__init__("replica is draining")
+        self.retry_after_s = float(retry_after_s)
+
+
 class _Handler(BaseHTTPRequestHandler):
     # Quiet the default per-request stderr lines; route to our logger.
     def log_message(self, fmt, *args):   # noqa: N802 (stdlib name)
@@ -564,6 +700,13 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 self._json(200, {"id": rid,
                                  "cancelled": srv._cancel(rid)})
+        elif self.path == "/admin/drain":
+            srv.drain()
+            self._json(200, {"draining": True,
+                             "outstanding": len(srv._outstanding)})
+        elif self.path == "/admin/undrain":
+            srv.undrain()
+            self._json(200, {"draining": False})
         else:
             self._json(404, {"error": f"unknown path {self.path}"})
 
@@ -598,11 +741,41 @@ class _Handler(BaseHTTPRequestHandler):
             gamma = body.get("gamma")
             if gamma is not None and type(gamma) is not int:
                 raise ValueError("gamma must be an int")
+            deadline_s = body.get("deadline_s")
+            if deadline_s is not None:
+                if type(deadline_s) not in (int, float) \
+                        or deadline_s <= 0:
+                    raise ValueError("deadline_s must be a number > 0")
+                deadline_s = float(deadline_s)
             rid = srv._submit(prompt, max_new, temperature=temperature,
                               eos_id=eos_id, use_prefix=use_prefix,
-                              slo=slo, trace_id=trace_id, gamma=gamma)
+                              slo=slo, trace_id=trace_id, gamma=gamma,
+                              deadline_s=deadline_s)
         except _Unavailable:
             self._json(503, {"error": "engine unavailable"})
+            return
+        except _Draining as e:
+            # Graceful drain: refuse with the draining flag so the
+            # router routes elsewhere WITHOUT marking the replica
+            # down — it is healthy, just leaving rotation.
+            srv.count_request(served=False)
+            srv._m_drain_refused.inc()
+            retry = max(round(e.retry_after_s, 3), 0.1)
+            self._json(429, {"error": "replica is draining",
+                             "draining": True, "retry_after_s": retry},
+                       headers={"Retry-After": str(int(retry) + 1)})
+            return
+        except DeadlineError as e:
+            # Deadline shed: measured service rates say this request
+            # cannot finish in time.  503 + shed flag: route-elsewhere
+            # territory (another replica may be less loaded), not a
+            # health failure.
+            srv.count_request(served=False)
+            srv._m_shed.inc()
+            retry = max(round(e.retry_after_s, 3), 0.1)
+            self._json(503, {"error": str(e), "shed": True,
+                             "retry_after_s": retry},
+                       headers={"Retry-After": str(int(retry) + 1)})
             return
         except AdmissionError as e:
             # Typed backpressure: the bounded queue rejected the
@@ -626,13 +799,31 @@ class _Handler(BaseHTTPRequestHandler):
         except _Timeout:
             srv.count_request(served=False,
                               latency_s=time.perf_counter() - t0)
+            retry = max(round(srv._drain_retry_hint(), 3), 0.1)
+            # Retry-After on 504 too: a timed-out-and-cancelled request
+            # is load shedding just like the 429 path — tell the
+            # client when the replica expects headroom.
             self._json(504, {"error": f"request {rid} timed out and was "
-                             f"cancelled", "id": rid})
+                             f"cancelled", "id": rid,
+                             "retry_after_s": retry},
+                       headers={"Retry-After": str(int(retry) + 1)})
             return
         except _Unavailable:
             srv.count_request(served=False,
                               latency_s=time.perf_counter() - t0)
             self._json(503, {"error": "engine unavailable", "id": rid})
+            return
+        if tokens is _DEADLINE:
+            info = srv._deadline_info.pop(rid, {})
+            srv.count_request(served=False,
+                              latency_s=time.perf_counter() - t0)
+            retry = max(round(srv._drain_retry_hint(), 3), 0.1)
+            self._json(504, {"error": f"request {rid} missed its "
+                             f"deadline and was cancelled", "id": rid,
+                             "deadline_exceeded": True,
+                             "phase": info.get("phase", ""),
+                             "retry_after_s": retry},
+                       headers={"Retry-After": str(int(retry) + 1)})
             return
         if tokens is _CANCELLED:
             # counted as failed so served+failed covers every handled
@@ -641,6 +832,15 @@ class _Handler(BaseHTTPRequestHandler):
                               latency_s=time.perf_counter() - t0)
             self._json(409, {"error": f"request {rid} was cancelled",
                              "id": rid})
+            return
+        if srv._chaos and srv._chaos.take_drop():
+            # drop_response chaos: the engine finished the work but the
+            # client never hears — sever the connection so the caller
+            # sees a mid-request transport failure (the retry-
+            # idempotence drill).
+            srv.count_request(served=False,
+                              latency_s=time.perf_counter() - t0)
+            self.close_connection = True
             return
         latency = time.perf_counter() - t0
         srv.count_request(served=True, latency_s=latency)
@@ -684,6 +884,11 @@ class _Handler(BaseHTTPRequestHandler):
                                   latency_s=time.perf_counter() - t0)
 
         try:
+            # Announce the request id before any decode progress: a
+            # router-side hedger needs the rid EARLY to cancel the
+            # losing attempt, and a recovery client uses it to
+            # correlate partial tokens (docs/serving.md).
+            emit({"id": rid, "done": False, "new_tokens": []})
             while True:
                 try:
                     snap, done = srv._snapshot(rid)
@@ -695,13 +900,25 @@ class _Handler(BaseHTTPRequestHandler):
                     srv._cancel(rid)
                     srv._finish_stream(rid)
                     count(served=False)
+                    srv._m_timeouts.inc()
+                    from autodist_tpu.telemetry import emit_event
+                    emit_event("serving/timeout", request_id=rid,
+                               timeout_s=srv._timeout, stream=True)
                     emit({"id": rid, "done": True, "timeout": True})
                     return
                 if done:
                     tokens = srv._finish_stream(rid)
-                    if tokens is _CANCELLED or tokens is None:
+                    if tokens is _DEADLINE:
+                        srv._deadline_info.pop(rid, None)
+                        count(served=False)
+                        emit({"id": rid, "done": True,
+                              "deadline_exceeded": True})
+                    elif tokens is _CANCELLED or tokens is None:
                         count(served=False)
                         emit({"id": rid, "done": True, "cancelled": True})
+                    elif srv._chaos and srv._chaos.take_drop():
+                        count(served=False)
+                        self.close_connection = True
                     else:
                         count(served=True)
                         final = srv.render(rid, tokens, prompt_len)
@@ -722,9 +939,42 @@ class _Handler(BaseHTTPRequestHandler):
             count(served=False)
 
 
+def install_drain_on_sigterm(server: EngineServer, *,
+                             exit_code: Optional[int] = None,
+                             settle_s: float = 0.25) -> None:
+    """SIGTERM → graceful drain: stop admitting, let in-flight work
+    finish, then ``os._exit`` once idle (plus ``settle_s`` for the last
+    responses to flush).  The default exit code is the supervisor's
+    ``PREEMPTED_EXIT_CODE`` (75): a drained replica relaunches WITHOUT
+    consuming restart budget, which is what lets
+    ``SupervisedReplicaPool.rolling_restart()`` cycle a whole pool.
+    Must be called from the main thread (the ``signal`` module rule —
+    raises ``ValueError`` otherwise)."""
+    from autodist_tpu.resilience.supervisor import PREEMPTED_EXIT_CODE
+
+    code = PREEMPTED_EXIT_CODE if exit_code is None else int(exit_code)
+
+    def _on_term(signum, frame):
+        server.drain()
+
+        def _exit_when_idle():
+            while not server.idle():
+                time.sleep(0.05)
+            time.sleep(settle_s)
+            from autodist_tpu.telemetry import emit_event
+            emit_event("serving/drain", phase="exit", code=code)
+            os._exit(code)
+
+        threading.Thread(target=_exit_when_idle, daemon=True,
+                         name="drain-exit").start()
+
+    signal.signal(signal.SIGTERM, _on_term)
+
+
 def serve(spec, params, *, host: str = "127.0.0.1", port: int = 8000,
           tokenizer=None, prefix_tokens=None, prefix_text=None,
           paged: bool = False, speculative=None,
+          drain_on_sigterm: Optional[bool] = None,
           **engine_kwargs) -> EngineServer:
     """Build an engine over ``(spec, params)`` and start an
     :class:`EngineServer` on it.  ``paged=True`` selects the
@@ -742,7 +992,13 @@ def serve(spec, params, *, host: str = "127.0.0.1", port: int = 8000,
     a dict with ``spec`` and ``params`` for the draft model, plus
     optional ``gamma`` (proposal depth, default 4) and ``adapt_gamma``
     (SLO adaptation, default True).  Speculation is a mode of the
-    paged scheduler, so it implies ``paged=True``."""
+    paged scheduler, so it implies ``paged=True``.
+
+    ``drain_on_sigterm`` installs :func:`install_drain_on_sigterm`
+    (graceful drain + exit 75 on SIGTERM).  The default (``None``)
+    installs it only when the process looks like a supervised replica
+    (``AUTODIST_REPLICA_NAME`` in the environment) — a test process
+    embedding a server keeps its own signal handling."""
     if "eos_id" not in engine_kwargs:
         eos = getattr(tokenizer, "eos_id", None)
         if eos is not None:
@@ -776,5 +1032,14 @@ def serve(spec, params, *, host: str = "127.0.0.1", port: int = 8000,
         prefix_tokens = tokenizer.encode(prefix_text)
     if prefix_tokens is not None:
         eng.set_prefix(prefix_tokens)
-    return EngineServer(eng, host=host, port=port,
-                        tokenizer=tokenizer).start()
+    srv = EngineServer(eng, host=host, port=port,
+                       tokenizer=tokenizer).start()
+    if drain_on_sigterm is None:
+        drain_on_sigterm = bool(os.environ.get("AUTODIST_REPLICA_NAME"))
+    if drain_on_sigterm:
+        try:
+            install_drain_on_sigterm(srv)
+        except ValueError:   # not the main thread: skip, best-effort
+            logging.warning("serve(): cannot install the SIGTERM drain "
+                            "handler off the main thread")
+    return srv

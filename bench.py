@@ -212,6 +212,9 @@ def run_child(platform: str) -> None:
     # Speculative serving rides the same CPU-child pattern; it reads
     # the committed BENCH_serving baseline, so it runs after it.
     _fill_spec(result)
+    # Serving fault tolerance: recovery/hedging goodput under
+    # deterministic mid-stream faults, its own CPU child.
+    _fill_serving_resilience(result)
     mark("serving")
     # Fast-recovery checkpoint tiers: its own CPU child (host-side
     # mechanics); per-tier time-to-recover + goodput under preemption.
@@ -1670,6 +1673,35 @@ def _fill_spec(result) -> None:
               file=sys.stderr, flush=True)
 
 
+def _fill_serving_resilience(result) -> None:
+    """Serving-plane fault tolerance (docs/serving.md "Fault
+    tolerance", BENCH_serving_resilience.json): a two-replica pool
+    under deterministic mid-stream faults — deadline goodput and
+    re-decoded token waste with token-exact recovery on vs off, and a
+    straggler scenario with hedged requests on vs off.  Token-exactness
+    against the greedy oracle and the block-leak invariant gate every
+    mode inside the child.  Runs in its own CPU child."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    cmd = [sys.executable, "-u", os.path.abspath(__file__),
+           "--serving-chaos-child"]
+    try:
+        proc = subprocess.run(cmd, stdout=subprocess.PIPE, env=env,
+                              timeout=900)
+        payload = _extract_json(proc.stdout.decode())
+        if payload is None or proc.returncode != 0:
+            raise RuntimeError(f"no JSON from serving-chaos child "
+                               f"(rc={proc.returncode})")
+        result["serving_resilience"] = payload
+        with open(os.path.join(REPO, "BENCH_serving_resilience.json"),
+                  "w", encoding="utf-8") as f:
+            json.dump(payload, f, indent=2, sort_keys=True)
+            f.write("\n")
+    except Exception as e:  # pragma: no cover - best-effort enrichment
+        print(f"bench: serving resilience section unavailable ({e!r})",
+              file=sys.stderr, flush=True)
+
+
 def _fill_kernels(result) -> None:
     """Fused Pallas kernel suite (docs/kernels.md, BENCH_kernels.json):
     every fused kernel measured against its unfused reference on the
@@ -2470,6 +2502,276 @@ def run_spec_child() -> None:
     spec_p50 = payload["modes"]["speculative"]["per_token_p50_ms"]
     payload["speculative_beats_committed_baseline"] = (
         ref is not None and spec_p50 < ref)
+    print(json.dumps(payload), flush=True)
+
+
+def run_serving_chaos_child() -> None:
+    """The serving-resilience measurement (child process, CPU): two
+    paged engines behind real EngineServers with a Router in front,
+    under deterministic mid-stream faults (docs/serving.md, "Fault
+    tolerance").
+
+    A fault wrapper severs the SSE stream of designated requests after
+    the first chunk-boundary delta — once per trace, so the retry
+    lands clean — which is exactly what a chaos ``kill_replica`` looks
+    like from the router's side.  Modes:
+
+    * ``baseline_no_faults`` — recovery on, no faults;
+    * ``faults_recovery_on`` — the router carries the streamed partial
+      to the survivor (prefill-and-continue);
+    * ``faults_recovery_off`` — same faults, but the wrapper withholds
+      the deltas so the retry restarts the decode from scratch (the
+      pre-recovery behavior, isolated from transport differences);
+    * ``straggler_hedging_off`` / ``straggler_hedging_on`` — a slow
+      primary with and without first-wins hedged requests.
+
+    Deadline goodput (fraction of requests finishing inside the
+    baseline-derived deadline) and re-decoded token waste compare the
+    modes; token-exactness against the single-engine greedy oracle and
+    ``assert_no_leaks`` on every engine gate every mode — a diverged
+    token or a leaked block fails the child, not just a counter."""
+    _steer("cpu")
+    import queue as queue_mod
+    import threading
+
+    import jax
+    import numpy as np
+
+    from autodist_tpu.models.generate import make_generator
+    from autodist_tpu.models.transformer import dense_attention
+    from autodist_tpu.models.transformer_lm import transformer_lm
+    from autodist_tpu.serving import EngineServer, PagedDecodeEngine, Router
+    from autodist_tpu.serving.router import HTTPReplicaClient
+
+    spec = transformer_lm(vocab_size=128, num_layers=3, num_heads=4,
+                          head_dim=16, d_ff=256, max_len=128, seq_len=16,
+                          attn_fn=dense_attention)
+    params = spec.init(jax.random.PRNGKey(0))
+    geom = dict(window=64, block_size=8, num_blocks=160, chunk=8)
+    rng = np.random.RandomState(11)
+    reqs = [(rng.randint(0, 128, int(rng.randint(4, 25))).astype(np.int32),
+             int(rng.randint(12, 21))) for _ in range(24)]
+    gen = make_generator(spec)
+    oracle = {i: [int(t) for t in np.asarray(gen(params, p[None, :], n))[0]]
+              for i, (p, n) in enumerate(reqs)}
+    # every 3rd request dies mid-stream in the fault modes, keyed by its
+    # (unique-per-workload) prompt so the schedule survives re-routing
+    faulted = {tuple(int(t) for t in reqs[i][0]): i
+               for i in range(0, len(reqs), 3)}
+
+    class _Ep:
+        """Router endpoint over a live EngineServer, with deterministic
+        mid-stream fault injection: designated requests lose their
+        connection right after the first streamed delta (once per
+        trace).  ``forward_partials=False`` additionally withholds the
+        deltas from the router's recovery ledger — same fault, but the
+        retry can only restart from scratch."""
+
+        def __init__(self, name, server, *, fault=False,
+                     forward_partials=True, delay_s=0.0, severed=None):
+            self.name = name
+            self._cli = HTTPReplicaClient(*server.address)
+            self.fault = fault
+            self.forward_partials = forward_partials
+            self.delay_s = delay_s
+            # trace ids already faulted — SHARED across the pool's
+            # endpoints so each request dies at most once wherever the
+            # router places it (the re-route must land clean)
+            self.severed = set() if severed is None else severed
+
+        def probe(self, timeout=2.0):
+            return self._cli.healthz(timeout=timeout)
+
+        def fetch_stats(self):
+            try:
+                return self._cli.stats()
+            except OSError:
+                return None
+
+        def post(self, body, timeout, trace_id=""):
+            return self._cli.post_completion(body, timeout=timeout,
+                                             trace_id=trace_id)
+
+        def cancel(self, request_id):
+            return self._cli.cancel(request_id)
+
+        def post_stream(self, body, timeout, trace_id="", on_event=None):
+            if self.delay_s:
+                time.sleep(self.delay_s)     # the straggler scenario
+            key = tuple(body.get("prompt_tokens") or ())
+            sever = (self.fault and key in faulted
+                     and trace_id not in self.severed)
+            streamed = 0
+
+            def tap(ev):
+                nonlocal streamed
+                new = ev.get("new_tokens") or []
+                if ev.get("done") or not new:   # announce / terminal
+                    if on_event is not None:
+                        on_event(ev)
+                    return
+                streamed += len(new)
+                if on_event is not None and (not sever
+                                             or self.forward_partials):
+                    on_event(ev)
+                if sever and streamed >= 1:
+                    # conn.close() in the client's finally frees the
+                    # replica side (its next write cancels the request)
+                    self.severed.add(trace_id)
+                    raise OSError("bench fault: stream severed "
+                                  "mid-decode")
+
+            return self._cli.post_completion_stream(
+                body, timeout=timeout, trace_id=trace_id, on_event=tap)
+
+    def run_mode(eps, *, recover, hedge_after_s=None, deadline_s=None,
+                 workers=4):
+        engines = [PagedDecodeEngine(spec, params, slots=4, **geom)
+                   for _ in range(2)]
+        for eng in engines:
+            # pace the tick (every mode equally) so chunk-boundary
+            # deltas actually stream before a request finishes — the
+            # mid-decode window the fault injection needs to exist
+            orig = eng.step
+            eng.step = (lambda orig=orig:
+                        (time.sleep(0.02), orig())[1])
+        servers = [EngineServer(eng, port=0,
+                                request_timeout_s=120).start()
+                   for eng in engines]
+        endpoints = [mk(srv) for mk, srv in zip(eps, servers)]
+        # retry_wait × max_attempts must outlive the 2 s mark-down hold
+        # a severed stream puts on a replica, or a burst of faults
+        # exhausts its attempts before anything comes back up
+        router = Router(endpoints, probe_ttl_s=0.5, stats_ttl_s=0.05,
+                        retry_wait_s=0.25, max_attempts=24,
+                        breaker_threshold=8, recover=recover,
+                        hedge_after_s=hedge_after_s)
+        lat = {}
+        failures = []
+        work = queue_mod.Queue()
+        for i, (p, n) in enumerate(reqs):
+            work.put((i, p, n))
+
+        def worker():
+            while True:
+                try:
+                    i, p, n = work.get_nowait()
+                except queue_mod.Empty:
+                    return
+                t0 = time.perf_counter()
+                try:
+                    out = router.complete(
+                        {"prompt_tokens": [int(t) for t in p],
+                         "max_new_tokens": n}, timeout_s=120)
+                    lat[i] = (time.perf_counter() - t0, out)
+                except Exception as e:  # noqa: BLE001 - gates the child
+                    failures.append((i, repr(e)))
+
+        threads = [threading.Thread(target=worker)
+                   for _ in range(workers)]
+        t_wall = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t_wall
+        for srv in servers:
+            srv.close()
+        assert not failures, f"requests failed: {failures}"
+        # the hard gates: greedy token-exactness for every request
+        # (including the recovered ones), and zero leaked blocks
+        for i, (_, out) in lat.items():
+            assert out["tokens"] == oracle[i], \
+                f"request {i} diverged from the greedy oracle"
+        for eng in engines:
+            # a hedged loser's cancel can still be settling at close;
+            # finish any abandoned in-flight decode, then hold the
+            # no-leak gate
+            while eng.step():
+                pass
+            eng.results()
+            eng.assert_no_leaks()
+        lats = sorted(v[0] for v in lat.values())
+
+        def pct(q):
+            return lats[min(int(q * len(lats)), len(lats) - 1)]
+
+        reg = router.registry
+        ideal = sum(n for _, n in reqs)
+        generated = sum(int(eng.stats.generated_tokens)
+                        for eng in engines)
+        mode = {
+            "requests": len(lats),
+            "wall_s": round(wall, 3),
+            "latency_p50_s": round(pct(0.5), 3),
+            "latency_p99_s": round(pct(0.99), 3),
+            "recovered_requests": int(reg.counter(
+                "autodist_router_recovered_total").value),
+            "recovered_tokens": int(reg.counter(
+                "autodist_router_recovered_tokens_total").value),
+            "hedged_requests": int(reg.counter(
+                "autodist_router_hedged_total").value),
+            "hedge_wins": int(reg.counter(
+                "autodist_router_hedge_wins_total").value),
+            "generated_tokens": generated,
+            "redecoded_tokens": generated - ideal,
+            "token_exact_check": "ok",
+            "block_leak_check": "ok",
+        }
+        if deadline_s is not None:
+            mode["deadline_s"] = round(deadline_s, 3)
+            mode["deadline_goodput"] = round(
+                sum(1 for v in lats if v <= deadline_s) / len(lats), 4)
+        return mode
+
+    def pool(**kw):
+        shared = set()
+        return [lambda srv, i=i: _Ep(f"replica-{i}", srv,
+                                     severed=shared, **kw)
+                for i in range(2)]
+
+    def straggler():                        # slow primary, fast peer
+        return [lambda srv: _Ep("replica-0", srv, delay_s=0.4),
+                lambda srv: _Ep("replica-1", srv)]
+
+    payload = {"model": "transformer_lm L3 d64 vocab128",
+               "geometry": dict(geom),
+               "workload": "24 greedy requests, prompts 4-24, "
+                           "max_new 12-20, 4 client threads; every 3rd "
+                           "request severed mid-stream in fault modes",
+               "modes": {}}
+    run_mode(pool(), recover=True)          # warm the jit caches
+    base = run_mode(pool(), recover=True)
+    # the goodput bar: fault-free p50 plus one failover allowance —
+    # the 2 s mark-down hold + the 0.25 s retry wait + ~0.5 s to
+    # prefill-and-finish the resumed continuation.  An SLO that
+    # tolerates single faults promises exactly this; a restarted
+    # decode (recovery off) blows it, a resumed one does not.  (p50,
+    # not p99: the fault-free tail is CPU-noise-dominated and would
+    # make the bar jitter run to run.)
+    deadline = base["latency_p50_s"] + 2.75
+    base["deadline_s"] = round(deadline, 3)
+    base["deadline_goodput"] = 1.0
+    payload["modes"]["baseline_no_faults"] = base
+    payload["modes"]["faults_recovery_on"] = run_mode(
+        pool(fault=True), recover=True, deadline_s=deadline)
+    payload["modes"]["faults_recovery_off"] = run_mode(
+        pool(fault=True, forward_partials=False), recover=True,
+        deadline_s=deadline)
+    payload["modes"]["straggler_hedging_off"] = run_mode(
+        straggler(), recover=True, deadline_s=deadline)
+    payload["modes"]["straggler_hedging_on"] = run_mode(
+        straggler(), recover=True, hedge_after_s=0.1,
+        deadline_s=deadline)
+    on = payload["modes"]["faults_recovery_on"]
+    off = payload["modes"]["faults_recovery_off"]
+    payload["recovery_redecode_savings_tokens"] = (
+        off["redecoded_tokens"] - on["redecoded_tokens"])
+    payload["recovery_goodput_delta"] = round(
+        on["deadline_goodput"] - off["deadline_goodput"], 4)
+    payload["hedging_p99_speedup"] = round(
+        payload["modes"]["straggler_hedging_off"]["latency_p99_s"]
+        / payload["modes"]["straggler_hedging_on"]["latency_p99_s"], 3)
     print(json.dumps(payload), flush=True)
 
 
@@ -4098,6 +4400,8 @@ if __name__ == "__main__":
         run_serving_child()
     elif "--spec-child" in sys.argv:
         run_spec_child()
+    elif "--serving-chaos-child" in sys.argv:
+        run_serving_chaos_child()
     elif "--recovery-child" in sys.argv:
         run_recovery_child()
     elif "--probe" in sys.argv:

@@ -1,0 +1,885 @@
+"""Serving-plane fault tolerance (docs/serving.md, "Fault tolerance").
+
+Fast half, no subprocesses:
+
+* chaos grammar — the serving actions parse, filter by replica/attempt,
+  fire once on progress thresholds, and journal BEFORE executing;
+* router — expired-deadline fast-fail (no post with a floored
+  timeout), mark-down hold expiry and re-entry, the per-replica
+  circuit breaker (open → half-open probe → re-open with doubled
+  hold), drain-aware candidate filtering, shed-aware 503 handling,
+  token-exact in-flight recovery against scripted streaming fakes
+  (mid-stream death, eos-in-partial, exhausted budget), and
+  first-wins hedging with loser cancellation;
+* engine — deadline-aware admission shedding off measured p90s,
+  SLO-class deadline defaults, and the in-flight expiry sweep
+  (queued and decoding phases) with the no-leak invariant;
+* server — graceful drain over HTTP (429 + draining flag, in-flight
+  completion, undrain), 504 timeout/deadline responses with
+  Retry-After + journal events, and the drop_response / stale_stats
+  chaos injections.
+
+Slow half: live drills with real supervised replica subprocesses —
+a chaos-killed replica mid-decode under threaded load (every greedy
+request completes token-exact, with resume-not-restart evidence),
+and a rolling restart that drops nothing.
+"""
+import http.client
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from autodist_tpu.resilience.chaos import (ServingChaos, parse_chaos,
+                                           replica_index_from_env)
+from autodist_tpu.serving.router import Router, RouterDeadlineError
+from autodist_tpu.telemetry import get_journal
+
+pytestmark = pytest.mark.serving_resilience
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+VOCAB = 61
+# Same geometry as tests/test_serving_scheduler.py: the paged programs
+# live in a module-scope jit cache, so shapes compile once per process.
+GEOM = dict(slots=2, window=32, block_size=8, num_blocks=24, chunk=4)
+
+
+def _events_since(mark, kind):
+    return [e for e in get_journal().events[mark:] if e["kind"] == kind]
+
+
+def _mark():
+    return len(get_journal().events)
+
+
+# ---------------------------------------------------------------------------
+# chaos grammar + ServingChaos
+# ---------------------------------------------------------------------------
+
+def test_chaos_grammar_parses_serving_actions():
+    evs = parse_chaos("kill_replica@replica=0,tokens=5;"
+                      "slow_replica@replica=1,seconds=0.25;"
+                      "drop_response@replica=0,count=2;"
+                      "stale_stats@requests=3")
+    assert [e.action for e in evs] == ["kill_replica", "slow_replica",
+                                       "drop_response", "stale_stats"]
+    assert evs[0].replica == 0 and evs[0].args["tokens"] == "5"
+    assert evs[1].replica == 1 and evs[1].args["seconds"] == "0.25"
+    assert evs[2].args["count"] == "2"
+    assert evs[3].replica is None and evs[3].args["requests"] == "3"
+
+
+def test_replica_index_from_env(monkeypatch):
+    monkeypatch.delenv("AUTODIST_REPLICA", raising=False)
+    monkeypatch.delenv("AUTODIST_REPLICA_NAME", raising=False)
+    assert replica_index_from_env() is None
+    monkeypatch.setenv("AUTODIST_REPLICA_NAME", "replica-3")
+    assert replica_index_from_env() == 3
+    monkeypatch.setenv("AUTODIST_REPLICA", "7")      # explicit wins
+    assert replica_index_from_env() == 7
+
+
+def test_serving_chaos_replica_filter_and_thresholds():
+    evs = parse_chaos("kill_replica@replica=0,tokens=5,code=9")
+    other = ServingChaos(evs, replica=1)
+    other.on_tick(requests=99, generated=99)          # wrong replica
+    assert not evs[0].fired
+
+    evs = parse_chaos("kill_replica@replica=0,tokens=5,requests=2")
+    chaos = ServingChaos(evs, replica=0)
+    exits = []
+    chaos._exit = exits.append
+    chaos.on_tick(requests=2, generated=4)            # tokens not met
+    chaos.on_tick(requests=1, generated=9)            # requests not met
+    assert not exits
+    mark = _mark()
+    chaos.on_tick(requests=2, generated=5)            # both met: fires
+    assert exits == [43]
+    # journaled BEFORE executing, with the firing context
+    (ev,) = _events_since(mark, "chaos/kill_replica")
+    assert ev["replica"] == 0 and ev["generated"] == 5
+    chaos.on_tick(requests=9, generated=9)            # fired-once
+    assert exits == [43]
+
+
+def test_serving_chaos_armed_behaviors():
+    chaos = ServingChaos(parse_chaos(
+        "slow_replica@seconds=0.25;drop_response@count=2;stale_stats@"))
+    assert bool(chaos)
+    assert chaos.slow_s == 0.0 and not chaos.stats_stale
+    chaos.on_tick(requests=0, generated=0)
+    assert chaos.slow_s == 0.25
+    assert chaos.stats_stale
+    assert chaos.take_drop() and chaos.take_drop()
+    assert not chaos.take_drop()                      # count=2 consumed
+    assert not ServingChaos([])                       # empty = falsy
+
+
+def test_serving_chaos_ignores_training_actions():
+    chaos = ServingChaos(parse_chaos("kill@step=5,proc=0"))
+    assert not chaos                                  # training-plane only
+
+
+# ---------------------------------------------------------------------------
+# router: deadline fast-fail, mark-down expiry, breaker, drain, shed
+# ---------------------------------------------------------------------------
+
+class FakeReplica:
+    """Duck-typed endpoint without post_stream: the plain-post path."""
+
+    def __init__(self, name, queue_depth=0, mode="ok", retry_after=2.0):
+        self.name = name
+        self.queue_depth = queue_depth
+        self.mode = mode
+        self.retry_after = retry_after
+        self.served = []
+        self.posts = 0
+        self.probe_delay = 0.0
+
+    def probe(self, timeout=2.0):
+        if self.probe_delay:
+            time.sleep(self.probe_delay)
+        return True
+
+    def fetch_stats(self):
+        return {"outstanding": 0,
+                "queue_depth_total": self.queue_depth,
+                "block_occupancy": 0.0,
+                "draining": self.mode == "draining"}
+
+    def post(self, body, timeout):
+        self.posts += 1
+        if self.mode == "die":
+            raise OSError("connection reset by peer")
+        if self.mode == "draining":
+            return 429, {"error": "replica is draining",
+                         "draining": True,
+                         "retry_after_s": self.retry_after}
+        if self.mode == "shed":
+            return 503, {"error": "cannot meet deadline", "shed": True,
+                         "retry_after_s": self.retry_after}
+        self.served.append(body)
+        return 200, {"id": len(self.served), "tokens": [1, 2, 3],
+                     "new_tokens": [2, 3]}
+
+
+def _router(*eps, **kw):
+    kw.setdefault("probe_ttl_s", 0.0)
+    kw.setdefault("stats_ttl_s", 0.0)
+    kw.setdefault("retry_wait_s", 0.01)
+    return Router(eps, **kw)
+
+
+def test_router_expired_deadline_no_floored_post():
+    """Satellite fix: a spent timeout budget raises the typed deadline
+    error immediately — the old path posted once more with a 1 s
+    timeout floor AFTER the deadline passed."""
+    a = FakeReplica("a")
+    r = _router(a)
+    with pytest.raises(RouterDeadlineError):
+        r.complete({"prompt_tokens": [1], "max_new_tokens": 2},
+                   timeout_s=0.0)
+    assert a.posts == 0
+
+    # budget spent DURING candidate selection (a slow probe), not just
+    # before it: still no post
+    a.probe_delay = 0.06
+    with pytest.raises(RouterDeadlineError):
+        r.complete({"prompt_tokens": [1], "max_new_tokens": 2},
+                   timeout_s=0.05)
+    assert a.posts == 0
+
+
+def test_router_mark_down_hold_expires_and_reenters():
+    a = FakeReplica("a")
+    b = FakeReplica("b", queue_depth=5)
+    r = _router(a, b)
+    r.mark_down(a, hold_s=0.08)
+    assert [ep.name for ep in r.live_replicas()] == ["b"]
+    r.complete({"prompt_tokens": [1], "max_new_tokens": 2})
+    assert len(b.served) == 1 and a.posts == 0        # a held down
+    time.sleep(0.1)
+    assert sorted(ep.name for ep in r.live_replicas()) == ["a", "b"]
+    r.complete({"prompt_tokens": [2], "max_new_tokens": 2})
+    assert len(a.served) == 1                         # re-entered, best score
+
+
+def test_circuit_breaker_opens_half_opens_reopens():
+    a = FakeReplica("a", mode="die")
+    b = FakeReplica("b", queue_depth=9)
+    r = _router(a, b, breaker_threshold=2, breaker_hold_s=0.1)
+    for _ in range(2):
+        r._down_until.clear()                 # isolate breaker from hold
+        r.complete({"prompt_tokens": [1], "max_new_tokens": 2})
+    assert a.posts == 2
+    assert r.breaker_open(a)
+    assert r.registry.counter(
+        "autodist_router_breaker_open_total").value == 1
+    r._down_until.clear()
+    assert [ep.name for ep in r.live_replicas()] == ["b"]   # breaker holds
+    time.sleep(0.12)                          # hold expiry = half-open
+    assert sorted(ep.name for ep in r.live_replicas()) == ["a", "b"]
+    r.complete({"prompt_tokens": [1], "max_new_tokens": 2})
+    assert a.posts == 3                       # the half-open probe request
+    assert r.breaker_open(a)                  # ONE failure re-opens
+    assert r._breaker_hold["a"] == pytest.approx(0.4)       # doubled twice
+    # recovery: a success resets the consecutive-failure ledger
+    a.mode = "ok"
+    time.sleep(0.25)
+    r._down_until.clear()
+    r.complete({"prompt_tokens": [1], "max_new_tokens": 2})
+    assert len(a.served) == 1 and not r.breaker_open(a)
+    assert "a" not in r._fails
+
+
+def test_router_skips_draining_replica_without_mark_down():
+    a = FakeReplica("a", mode="draining", retry_after=0.6)
+    b = FakeReplica("b", queue_depth=9)
+    r = _router(a, b)
+    out = r.complete({"prompt_tokens": [1], "max_new_tokens": 2})
+    assert out["tokens"] == [1, 2, 3] and len(b.served) == 1
+    # a was NOT marked down (healthy, just leaving) and the next
+    # request skips it without burning a post on the guaranteed 429
+    assert "a" not in r._down_until
+    posts_before = a.posts
+    r.complete({"prompt_tokens": [2], "max_new_tokens": 2})
+    assert a.posts == posts_before and len(b.served) == 2
+    # drain hold expires: a serves again once it stops refusing
+    a.mode = "ok"
+    time.sleep(0.7)
+    r.complete({"prompt_tokens": [3], "max_new_tokens": 2})
+    assert len(a.served) == 1
+
+
+def test_router_shed_503_routes_elsewhere_without_mark_down():
+    a = FakeReplica("a", mode="shed", retry_after=4.0)
+    b = FakeReplica("b", queue_depth=9)
+    r = _router(a, b)
+    out = r.complete({"prompt_tokens": [1], "max_new_tokens": 2})
+    assert out["tokens"] == [1, 2, 3] and len(b.served) == 1
+    assert "a" not in r._down_until           # shed is load, not health
+
+
+# ---------------------------------------------------------------------------
+# router: token-exact in-flight recovery + hedging (scripted streams)
+# ---------------------------------------------------------------------------
+
+def _continuation(prompt, n):
+    """Deterministic token function of the full prefix — resumable by
+    construction: generating from prompt+partial continues the exact
+    sequence an uninterrupted decode would have produced."""
+    out = [int(t) for t in prompt]
+    for _ in range(n):
+        out.append((sum(out) * 7 + len(out)) % 101)
+    return out
+
+
+class StreamReplica:
+    """Endpoint with the post_stream surface: streams one token per
+    delta event; optionally dies mid-stream (once) or after streaming
+    everything but before the final event (a dropped response)."""
+
+    def __init__(self, name, die_after=None, drop_final=False,
+                 delay_s=0.0, queue_depth=0, rid=1):
+        self.name = name
+        self.die_after = die_after
+        self.drop_final = drop_final
+        self.delay_s = delay_s
+        self.queue_depth = queue_depth
+        self.rid = rid
+        self.posts = []
+        self.cancelled = []
+
+    def probe(self, timeout=2.0):
+        return True
+
+    def fetch_stats(self):
+        return {"outstanding": 0, "queue_depth_total": self.queue_depth,
+                "block_occupancy": 0.0}
+
+    def cancel(self, request_id):
+        self.cancelled.append(request_id)
+        return True
+
+    def post_stream(self, body, timeout, trace_id="", on_event=None):
+        self.posts.append(dict(body))
+        prompt = body["prompt_tokens"]
+        n = body["max_new_tokens"]
+        toks = _continuation(prompt, n)
+        new = toks[len(prompt):]
+        on_event({"id": self.rid, "done": False, "new_tokens": []})
+        for i, t in enumerate(new):
+            if self.die_after is not None and i >= self.die_after:
+                self.die_after = None
+                raise OSError("connection reset by peer")
+            on_event({"id": self.rid, "done": False, "new_tokens": [t]})
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        if self.drop_final:
+            self.drop_final = False
+            raise OSError("stream severed before the final event")
+        return 200, {"id": self.rid, "done": True, "tokens": toks,
+                     "new_tokens": new}
+
+
+def test_recovery_resumes_token_exact_on_survivor():
+    a = StreamReplica("a", die_after=3)
+    b = StreamReplica("b", queue_depth=5)
+    r = _router(a, b)
+    prompt, n = [5, 9], 8
+    oracle = _continuation(prompt, n)
+    mark = _mark()
+    out = r.complete({"prompt_tokens": prompt, "max_new_tokens": n})
+    assert out["new_tokens"] == oracle[len(prompt):]
+    assert out["tokens"] == oracle
+    assert out["recovered"] is True and out["resumed_tokens"] == 3
+    assert "done" not in out
+    # resume, not restart: the survivor was asked to prefill the
+    # carried tokens and decode ONLY the remainder
+    assert b.posts[0]["prompt_tokens"] == oracle[:len(prompt) + 3]
+    assert b.posts[0]["max_new_tokens"] == n - 3
+    (ev,) = _events_since(mark, "serving/recovered")
+    assert ev["resumed_tokens"] == 3 and ev["replica"] == "b"
+    assert r.registry.counter(
+        "autodist_router_recovered_total").value == 1
+    assert r.registry.counter(
+        "autodist_router_recovered_tokens_total").value == 3
+
+
+def test_recovery_finishes_locally_on_eos_in_partial():
+    prompt, n = [4, 2], 6
+    oracle_new = _continuation(prompt, n)[len(prompt):]
+    eos = oracle_new[1]                     # eos lands in the partial
+    a = StreamReplica("a", die_after=3)
+    b = StreamReplica("b", queue_depth=5)
+    r = _router(a, b)
+    out = r.complete({"prompt_tokens": prompt, "max_new_tokens": n,
+                      "eos_id": eos})
+    assert out["new_tokens"] == oracle_new[:2]        # truncated AT eos
+    assert out["tokens"] == prompt + oracle_new[:2]
+    assert out["recovered"] is True and out["resumed_tokens"] == 2
+    assert b.posts == []                    # no resubmit needed
+
+
+def test_recovery_finishes_locally_on_exhausted_budget():
+    """The dying replica streamed every requested token but the final
+    response never arrived (the drop_response shape): nothing is left
+    to decode, so the router completes the request locally."""
+    prompt, n = [7], 5
+    oracle = _continuation(prompt, n)
+    a = StreamReplica("a", drop_final=True)
+    b = StreamReplica("b", queue_depth=5)
+    r = _router(a, b)
+    out = r.complete({"prompt_tokens": prompt, "max_new_tokens": n})
+    assert out["new_tokens"] == oracle[len(prompt):]
+    assert out["tokens"] == oracle
+    assert out["recovered"] is True and out["resumed_tokens"] == n
+    assert b.posts == []
+
+
+def test_recovery_disabled_or_sampled_uses_plain_post():
+    a = FakeReplica("a")
+    r = _router(a, recover=False)
+    out = r.complete({"prompt_tokens": [1], "max_new_tokens": 2})
+    assert "recovered" not in out and a.posts == 1
+    # sampling (temperature > 0) must not stream-recover either: a
+    # resumed sampled request would re-roll the dice
+    b = StreamReplica("b")
+    r2 = _router(b)
+    with pytest.raises(Exception):
+        # StreamReplica has no plain post: proves the router did NOT
+        # take the streaming path for a sampled request
+        r2.complete({"prompt_tokens": [1], "max_new_tokens": 2,
+                     "temperature": 0.8})
+
+
+def test_hedged_request_first_wins_and_cancels_loser():
+    slow = StreamReplica("slow", delay_s=0.5, rid=7)
+    fast = StreamReplica("fast", queue_depth=5, rid=11)
+    r = _router(slow, fast, hedge_after_s=0.05)
+    prompt, n = [3, 1], 4
+    oracle = _continuation(prompt, n)
+    mark = _mark()
+    t0 = time.monotonic()
+    out = r.complete({"prompt_tokens": prompt, "max_new_tokens": n})
+    assert time.monotonic() - t0 < 0.5      # did not wait for the loser
+    assert out["tokens"] == oracle
+    assert r.registry.counter("autodist_router_hedged_total").value == 1
+    assert r.registry.counter(
+        "autodist_router_hedge_wins_total").value == 1
+    assert slow.cancelled == [7]            # loser cancelled by its rid
+    (ev,) = _events_since(mark, "serving/hedge")
+    assert ev["primary"] == "slow" and ev["secondary"] == "fast"
+
+
+# ---------------------------------------------------------------------------
+# engine: deadline shed + expiry sweep
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def lm():
+    import jax
+
+    from autodist_tpu.models.transformer import dense_attention
+    from autodist_tpu.models.transformer_lm import transformer_lm
+
+    spec = transformer_lm(vocab_size=VOCAB, num_layers=2, num_heads=2,
+                          head_dim=8, d_ff=32, max_len=48, seq_len=16,
+                          attn_fn=dense_attention)
+    params = spec.init(jax.random.PRNGKey(0))
+    return spec, params
+
+
+def test_engine_deadline_shed_on_measured_rates(lm):
+    from autodist_tpu.serving import DeadlineError, PagedDecodeEngine
+
+    spec, params = lm
+    eng = PagedDecodeEngine(spec, params, **GEOM)
+    prompt = np.asarray([3, 5, 7], np.int32)
+    # below the sample floor the engine admits optimistically
+    assert eng._deadline_estimate(10) is None
+    eng._qwait_samples.extend([0.2] * 5)
+    eng._per_tok_samples.extend([0.1] * 5)
+    assert eng._deadline_estimate(10) == pytest.approx(1.2)
+    mark = _mark()
+    with pytest.raises(DeadlineError) as exc:
+        eng.submit(prompt, 10, deadline_s=0.5)
+    assert exc.value.retry_after_s > 0
+    assert eng.stats.shed_deadline == 1
+    (ev,) = _events_since(mark, "serving/shed")
+    assert ev["phase"] == "admission"
+    assert eng.scheduler_stats()["shed_deadline"] == 1
+    # a feasible deadline admits and completes normally
+    rid = eng.submit(prompt, 3, deadline_s=30.0)
+    out = eng.run()
+    assert rid in out and len(out[rid]) == prompt.size + 3
+    eng.assert_no_leaks()
+
+
+def test_engine_deadline_class_defaults(lm):
+    from autodist_tpu.serving import DeadlineError, PagedDecodeEngine
+
+    spec, params = lm
+    with pytest.raises(ValueError):
+        PagedDecodeEngine(spec, params, **GEOM,
+                          deadline_defaults={"bogus": 1.0})
+    eng = PagedDecodeEngine(spec, params, **GEOM,
+                            deadline_defaults={"latency": 0.5})
+    eng._qwait_samples.extend([0.2] * 5)
+    eng._per_tok_samples.extend([0.1] * 5)
+    prompt = np.asarray([2, 4], np.int32)
+    with pytest.raises(DeadlineError):
+        eng.submit(prompt, 10, slo="latency")   # class default applies
+    rid = eng.submit(prompt, 10, slo="throughput")  # no default: admits
+    out = eng.run()
+    assert rid in out
+    eng.assert_no_leaks()
+
+
+def test_engine_deadline_expiry_sweep_frees_immediately(lm):
+    from autodist_tpu.serving import PagedDecodeEngine
+
+    spec, params = lm
+    eng = PagedDecodeEngine(spec, params, **GEOM)
+    prompt = np.asarray([1, 2, 3], np.int32)
+    # queued expiry: deadline passes before the first step
+    r1 = eng.submit(prompt, 8, deadline_s=0.01)
+    time.sleep(0.03)
+    mark = _mark()
+    eng.step()
+    exp = eng.pop_expired()
+    assert exp[r1]["phase"] == "queued" and exp[r1]["overrun_s"] > 0
+    assert eng.pop_expired() == {}                    # returns-and-clears
+    assert eng.stats.expired_deadline == 1
+    (ev,) = _events_since(mark, "serving/shed")
+    assert ev["phase"] == "queued" and ev["request_id"] == r1
+    while eng.step():
+        pass
+    assert r1 not in eng.results()
+    eng.assert_no_leaks()
+
+    # decoding expiry: blocks and the slot free at the sweep, not at
+    # the natural end of decode
+    r2 = eng.submit(prompt, 8, deadline_s=60.0)
+    eng.step()                                        # admitted
+    for req in eng._slot_req:
+        if req is not None and req.request_id == r2:
+            req.deadline_t = time.monotonic() - 1.0
+    eng.step()
+    assert eng.pop_expired()[r2]["phase"] == "decoding"
+    while eng.step():
+        pass
+    assert r2 not in eng.results()
+    eng.assert_no_leaks()
+    # the engine stays fully usable after both expiries
+    r3 = eng.submit(prompt, 4, deadline_s=60.0)
+    out = eng.run()
+    assert len(out[r3]) == prompt.size + 4
+    eng.assert_no_leaks()
+
+
+# ---------------------------------------------------------------------------
+# server: drain, deadline/timeout 504s, chaos injections (real HTTP)
+# ---------------------------------------------------------------------------
+
+def _post(addr, path, body, timeout=120):
+    conn = http.client.HTTPConnection(*addr, timeout=timeout)
+    conn.request("POST", path, json.dumps(body),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    out = resp.status, json.loads(resp.read()), dict(resp.getheaders())
+    conn.close()
+    return out
+
+
+def _get(addr, path):
+    conn = http.client.HTTPConnection(*addr, timeout=30)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    raw = resp.read()
+    conn.close()
+    try:
+        return resp.status, json.loads(raw)
+    except ValueError:
+        return resp.status, raw.decode()
+
+
+def _paged_server(lm, **kw):
+    from autodist_tpu.serving import EngineServer, PagedDecodeEngine
+
+    spec, params = lm
+    eng = PagedDecodeEngine(spec, params, **GEOM)
+    return EngineServer(eng, port=0, **kw).start()
+
+
+def test_server_drain_refuses_finishes_inflight_undrains(lm):
+    srv = _paged_server(lm)
+    try:
+        addr = srv.address
+        status, body, _ = _post(addr, "/v1/completions",
+                                {"prompt_tokens": [1, 2],
+                                 "max_new_tokens": 2})
+        assert status == 200, body
+
+        # an in-flight request started BEFORE the drain must finish
+        slow = {}
+
+        def issue():
+            slow["out"] = _post(addr, "/v1/completions",
+                                {"prompt_tokens": [3, 4],
+                                 "max_new_tokens": 20})
+
+        t = threading.Thread(target=issue)
+        t.start()
+        time.sleep(0.05)
+        status, body, _ = _post(addr, "/admin/drain", {})
+        assert status == 200 and body["draining"] is True
+        assert srv.draining
+
+        status, st = _get(addr, "/v1/stats")
+        assert status == 200 and st["draining"] is True
+
+        status, body, hdrs = _post(addr, "/v1/completions",
+                                   {"prompt_tokens": [5],
+                                    "max_new_tokens": 2})
+        assert status == 429 and body["draining"] is True
+        assert body["retry_after_s"] > 0
+        assert any(k.lower() == "retry-after" for k in hdrs)
+
+        t.join(timeout=120)
+        status, body, _ = slow["out"]
+        assert status == 200 and len(body["new_tokens"]) == 20
+
+        status, metrics = _get(addr, "/metrics")
+        assert "autodist_serving_drain_refused_total 1" in metrics
+        assert "autodist_serving_draining 1" in metrics
+
+        status, body, _ = _post(addr, "/admin/undrain", {})
+        assert status == 200 and body["draining"] is False
+        status, body, _ = _post(addr, "/v1/completions",
+                                {"prompt_tokens": [6],
+                                 "max_new_tokens": 2})
+        assert status == 200
+        status, st = _get(addr, "/v1/stats")
+        assert st["draining"] is False
+    finally:
+        srv.close()
+    srv._engine.assert_no_leaks()
+
+
+def test_server_timeout_504_retry_after_and_journal(lm):
+    from autodist_tpu.serving import EngineServer, PagedDecodeEngine
+
+    spec, params = lm
+    eng = PagedDecodeEngine(spec, params, **GEOM)
+    orig_step = eng.step
+    eng.step = lambda: (time.sleep(0.05), orig_step())[1]   # throttle
+    srv = EngineServer(eng, port=0, request_timeout_s=0.15).start()
+    try:
+        mark = _mark()
+        status, body, hdrs = _post(srv.address, "/v1/completions",
+                                   {"prompt_tokens": [1, 2, 3],
+                                    "max_new_tokens": 24})
+        assert status == 504
+        assert body["retry_after_s"] > 0                 # satellite: 504
+        assert any(k.lower() == "retry-after" for k in hdrs)
+        evs = _events_since(mark, "serving/timeout")
+        assert evs and evs[0]["timeout_s"] == pytest.approx(0.15)
+        status, metrics = _get(srv.address, "/metrics")
+        assert "autodist_serving_timeouts_total 1" in metrics
+        eng.step = orig_step          # un-throttle before the drain
+    finally:
+        srv.close()
+    time.sleep(0.1)
+    srv._engine.assert_no_leaks()                        # cancel freed all
+
+
+def test_server_deadline_expiry_504(lm):
+    srv = _paged_server(lm)
+    try:
+        mark = _mark()
+        status, body, hdrs = _post(srv.address, "/v1/completions",
+                                   {"prompt_tokens": [1, 2],
+                                    "max_new_tokens": 24,
+                                    "deadline_s": 0.01})
+        assert status == 504
+        assert body["deadline_exceeded"] is True
+        assert body["phase"] in ("queued", "prefilling", "decoding")
+        assert any(k.lower() == "retry-after" for k in hdrs)
+        assert _events_since(mark, "serving/shed")
+        status, metrics = _get(srv.address, "/metrics")
+        assert "autodist_serving_deadline_expired_total 1" in metrics
+        # bad deadline_s values are a 400, not a shed
+        status, body, _ = _post(srv.address, "/v1/completions",
+                                {"prompt_tokens": [1],
+                                 "max_new_tokens": 2, "deadline_s": -1})
+        assert status == 400
+    finally:
+        srv.close()
+    time.sleep(0.1)
+    srv._engine.assert_no_leaks()
+
+
+def test_server_drop_response_chaos(lm, monkeypatch):
+    monkeypatch.setenv("AUTODIST_CHAOS", "drop_response@count=1")
+    srv = _paged_server(lm)
+    try:
+        with pytest.raises((http.client.HTTPException, OSError)):
+            _post(srv.address, "/v1/completions",
+                  {"prompt_tokens": [1, 2], "max_new_tokens": 2})
+        # one drop armed, one consumed: the next response goes through
+        status, body, _ = _post(srv.address, "/v1/completions",
+                                {"prompt_tokens": [1, 2],
+                                 "max_new_tokens": 2})
+        assert status == 200, body
+    finally:
+        srv.close()
+    srv._engine.assert_no_leaks()
+
+
+def test_server_stale_stats_chaos(lm, monkeypatch):
+    monkeypatch.setenv("AUTODIST_CHAOS", "stale_stats@")
+    srv = _paged_server(lm)
+    try:
+        time.sleep(0.2)                       # let the driver tick fire
+        status, first = _get(srv.address, "/v1/stats")
+        assert status == 200
+        status, body, _ = _post(srv.address, "/v1/completions",
+                                {"prompt_tokens": [1, 2],
+                                 "max_new_tokens": 2})
+        assert status == 200
+        status, again = _get(srv.address, "/v1/stats")
+        # frozen: the served request is invisible to the stats surface
+        assert again["requests_served"] == first["requests_served"]
+        assert again["completed"] == first["completed"]
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# live drills
+# ---------------------------------------------------------------------------
+
+def _wait(pred, timeout, what):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.5)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _pool_and_router(tmp_path, chaos=""):
+    from autodist_tpu.resilience.backoff import Backoff
+    from autodist_tpu.resilience.supervisor import SupervisorPolicy
+    from autodist_tpu.serving.router import SupervisedReplicaPool
+
+    script = os.path.join(REPO, "tests", "integration",
+                          "serving_replica.py")
+    workdir = str(tmp_path / "pool")
+
+    def launch(index, attempt):
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+            # the pool-canonical address path: rolling_restart() probes
+            # pool.address_file(i) to decide a relaunch came back
+            "AUTODIST_REPLICA_ADDR_FILE":
+                os.path.join(workdir, f"replica_{index}.addr.json"),
+            "AUTODIST_REPLICA_HB_DIR": attempt.heartbeat_dir,
+            "AUTODIST_REPLICA_NAME": f"replica-{index}",
+            "AUTODIST_REPLICA_SEED": "0",
+            "AUTODIST_ATTEMPT": str(attempt.index),
+        })
+        if chaos:
+            env["AUTODIST_CHAOS"] = chaos
+        else:
+            env.pop("AUTODIST_CHAOS", None)
+        return subprocess.Popen([sys.executable, "-u", script], env=env,
+                                start_new_session=True,
+                                stdout=subprocess.DEVNULL,
+                                stderr=subprocess.STDOUT)
+
+    policy = SupervisorPolicy(
+        max_restarts=6, heartbeat_timeout=15.0, poll_interval=0.2,
+        backoff=Backoff(max_tries=8, base=0.5, cap=2.0), kill_grace=3.0)
+    pool = SupervisedReplicaPool(2, launch, workdir, policy=policy)
+    eps = pool.endpoints()
+    router = Router(eps, probe_ttl_s=0.5, stats_ttl_s=0.2,
+                    retry_wait_s=0.5, max_attempts=20)
+    return pool, eps, router
+
+
+def _oracle_fn():
+    import jax
+
+    from autodist_tpu.models.generate import make_generator
+    from autodist_tpu.models.transformer import dense_attention
+    from autodist_tpu.models.transformer_lm import transformer_lm
+
+    spec = transformer_lm(vocab_size=VOCAB, num_layers=2, num_heads=2,
+                          head_dim=8, d_ff=32, max_len=48, seq_len=16,
+                          attn_fn=dense_attention)
+    params = spec.init(jax.random.PRNGKey(0))
+    gen = make_generator(spec)
+    return lambda p, n: np.asarray(gen(params, p[None, :], n))[0]
+
+
+@pytest.mark.slow
+def test_chaos_kill_mid_decode_recovers_token_exact(tmp_path):
+    """The tentpole drill: chaos kills replica 0 mid-decode (after 10
+    generated tokens, attempt 0 only) under 12-thread greedy load.
+    Every request completes token-exact against the uninterrupted
+    oracle, and at least one carries resume-not-restart evidence
+    (recovered + resumed_tokens, plus the serving/recovered journal
+    event) — the survivor continued the decode instead of redoing it."""
+    # slow_replica paces replica 0 (50ms per driver tick) so the
+    # streamed chunk-boundary deltas are on the wire before the kill
+    # lands mid-decode; both events are attempt-0-only so the
+    # relaunched attempt serves clean.
+    chaos = ("slow_replica@replica=0,seconds=0.05,attempt=0;"
+             "kill_replica@replica=0,tokens=10,attempt=0")
+    pool, eps, router = _pool_and_router(tmp_path, chaos=chaos)
+    oracle = _oracle_fn()
+    rng = np.random.RandomState(42)
+    reqs = [(rng.randint(0, VOCAB, rng.randint(2, 6)).astype(np.int32),
+             int(rng.randint(10, 17))) for _ in range(12)]
+    want = {i: oracle(p, n) for i, (p, n) in enumerate(reqs)}
+    mark = _mark()
+
+    with pool:
+        _wait(lambda: all(ep.probe() for ep in eps), 180,
+              "both replicas serving")
+        results, errors = {}, []
+
+        def issue(i, prompt, n):
+            try:
+                out = router.complete(
+                    {"prompt_tokens": [int(t) for t in prompt],
+                     "max_new_tokens": n}, timeout_s=240)
+                results[i] = out
+            except Exception as e:  # noqa: BLE001 - collected for assert
+                errors.append((i, repr(e)))
+
+        threads = [threading.Thread(target=issue, args=(i, p, n))
+                   for i, (p, n) in enumerate(reqs)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert not errors, f"requests failed: {errors}"
+        assert sorted(results) == list(range(len(reqs)))
+        for i in sorted(want):
+            np.testing.assert_array_equal(
+                np.asarray(results[i]["tokens"]), want[i],
+                err_msg=f"request {i} diverged after chaos kill")
+        # the chaos fault actually fired and was journaled first
+        assert _events_since(mark, "serving/recovered") or \
+            router.registry.counter(
+                "autodist_router_recovered_total").value >= 1
+        recovered = [results[i] for i in results
+                     if results[i].get("recovered")]
+        assert recovered, "no request carried resume evidence"
+        assert all(r["resumed_tokens"] >= 1 for r in recovered)
+        # replica 0 relaunches back into rotation (attempt 1 has no
+        # matching chaos event)
+        _wait(lambda: eps[0].probe(), 120, "replica 0 relaunch")
+
+
+@pytest.mark.slow
+def test_rolling_restart_drops_nothing(tmp_path):
+    """Drain → SIGTERM(exit 75) → supervised relaunch, one replica at
+    a time, under continuous load: zero failed requests, all outputs
+    token-exact, both replicas come back with fresh processes."""
+    pool, eps, router = _pool_and_router(tmp_path)
+    oracle = _oracle_fn()
+    prompt = np.asarray([3, 5, 7], np.int32)
+    want = oracle(prompt, 4)
+
+    with pool:
+        _wait(lambda: all(ep.probe() for ep in eps), 180,
+              "both replicas serving")
+        old_pids = {i: pool.current_proc(i).pid for i in range(2)}
+        stop = threading.Event()
+        errors, served = [], []
+
+        def load():
+            while not stop.is_set():
+                try:
+                    out = router.complete(
+                        {"prompt_tokens": [int(t) for t in prompt],
+                         "max_new_tokens": 4}, timeout_s=120)
+                    served.append(out)
+                    np.testing.assert_array_equal(
+                        np.asarray(out["tokens"]), want)
+                except Exception as e:  # noqa: BLE001
+                    errors.append(repr(e))
+                    return
+
+        threads = [threading.Thread(target=load) for _ in range(4)]
+        for t in threads:
+            t.start()
+        try:
+            summary = pool.rolling_restart(drain_timeout_s=60.0,
+                                           relaunch_timeout_s=180.0)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=120)
+        assert summary["failed"] == [], summary
+        assert [r["replica"] for r in summary["restarted"]] == [0, 1]
+        assert not errors, f"requests failed during restart: {errors}"
+        assert len(served) > 0
+        for i in range(2):
+            assert pool.current_proc(i).pid != old_pids[i]
+        # post-restart sanity: both fresh replicas serve token-exact
+        out = router.complete({"prompt_tokens": [int(t) for t in prompt],
+                               "max_new_tokens": 4}, timeout_s=120)
+        np.testing.assert_array_equal(np.asarray(out["tokens"]), want)
